@@ -1,0 +1,31 @@
+"""ray_lightning_tpu — TPU-native distributed training framework.
+
+From-scratch rebuild of the capability surface of ``ray_lightning``
+(/root/reference): Ray-style actor launch fabric + Lightning-style trainer +
+distributed strategies, re-designed for TPU (JAX/XLA/pjit/Pallas). Public
+surface mirrors the reference's three strategies
+(/root/reference/ray_lightning/__init__.py:1-5) plus the Tune module, with a
+standalone Trainer/TPUModule since the framework does not depend on PyTorch
+Lightning.
+"""
+__version__ = "0.1.0"
+
+_LAZY = {
+    "fabric": "ray_lightning_tpu",
+}
+
+
+def __getattr__(name):
+    # Lazy exports keep `import ray_lightning_tpu` light (no jax import) so
+    # the fabric can spawn workers whose env is configured before jax loads.
+    if name in _LAZY:
+        import importlib
+
+        if name == "fabric":
+            return importlib.import_module("ray_lightning_tpu.fabric")
+        mod = importlib.import_module(_LAZY[name])
+        return getattr(mod, name)
+    raise AttributeError(f"module 'ray_lightning_tpu' has no attribute {name!r}")
+
+
+__all__ = list(_LAZY)

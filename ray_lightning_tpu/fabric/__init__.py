@@ -1,0 +1,63 @@
+"""ray_lightning_tpu.fabric — a from-scratch actor/process launch fabric.
+
+The reference delegates process launch, object transport, and driver<->worker
+messaging to Ray core (C++ raylet + plasma object store; see SURVEY.md §2b and
+/root/reference/ray_lightning/launchers/ray_launcher.py:105-114,235). This
+module is the TPU build's native equivalent: a minimal actor system built on
+OS processes, shared-memory object transport, and logical multi-node resource
+scheduling. It deliberately exposes a Ray-like surface (``remote``, ``get``,
+``put``, ``wait``, ``kill``) so the launcher layer reads like the reference
+architecture while being a fully independent implementation.
+
+Key properties:
+- Actors are spawned processes; env vars (XLA flags, TPU topology) are applied
+  in the child *before* any heavy import, so each actor can own its own XLA
+  runtime configuration.
+- ``put`` serializes through POSIX shared memory for zero-copy transport of
+  model pytrees to workers on the same host (the C++ arena store in ``csrc/``
+  accelerates large buffers; pure-Python fallback always available).
+- Logical nodes with resource capacities ({"CPU": n, "TPU": n, custom}) enable
+  fake multi-node clusters for tests, mirroring ``ray.cluster_utils.Cluster``
+  usage in the reference test suite (test_ddp.py:54-61).
+"""
+from ray_lightning_tpu.fabric.core import (
+    ActorHandle,
+    FabricError,
+    InsufficientResourcesError,
+    ObjectRef,
+    TaskRef,
+    available_resources,
+    cluster_resources,
+    get,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_lightning_tpu.fabric.queue import Queue
+from ray_lightning_tpu.fabric import cluster_utils
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "nodes",
+    "available_resources",
+    "cluster_resources",
+    "ObjectRef",
+    "TaskRef",
+    "ActorHandle",
+    "Queue",
+    "FabricError",
+    "InsufficientResourcesError",
+    "cluster_utils",
+]

@@ -1,0 +1,596 @@
+"""Fabric core: session, logical nodes, object store, actors, futures.
+
+Native replacement for the Ray-core features the reference consumes
+(SURVEY.md §2b): actor creation with per-worker resources
+(ray_launcher.py:105-114), ``ray.put`` model shipping (:235), ``ray.get`` /
+``ray.wait`` driver loops (util.py:57-70), and ``ray.kill(no_restart=True)``
+teardown (:125-127). Implementation is process-based and from scratch.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing as mp
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_lightning_tpu.utils.ports import get_node_ip
+
+
+class FabricError(RuntimeError):
+    pass
+
+
+class InsufficientResourcesError(FabricError):
+    pass
+
+
+class ActorDiedError(FabricError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Logical nodes & resources
+# --------------------------------------------------------------------------
+@dataclass
+class Node:
+    node_id: str
+    node_ip: str
+    capacity: Dict[str, float]
+    used: Dict[str, float] = field(default_factory=dict)
+
+    def available(self) -> Dict[str, float]:
+        return {
+            k: self.capacity.get(k, 0.0) - self.used.get(k, 0.0)
+            for k in self.capacity
+        }
+
+    def fits(self, req: Dict[str, float]) -> bool:
+        avail = self.available()
+        return all(avail.get(k, 0.0) >= v - 1e-9 for k, v in req.items() if v)
+
+    def acquire(self, req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            if v:
+                self.used[k] = self.used.get(k, 0.0) + v
+
+    def release(self, req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            if v:
+                self.used[k] = max(0.0, self.used.get(k, 0.0) - v)
+
+
+def _detect_local_capacity() -> Dict[str, float]:
+    cap: Dict[str, float] = {"CPU": float(os.cpu_count() or 1)}
+    # TPU chips: respect an explicit override (set by tests / TPU VM metadata);
+    # otherwise probe lazily via jax only if it is already imported, to keep
+    # fabric.init() cheap on the driver (which may have no accelerator).
+    env_chips = os.environ.get("RLT_NUM_TPU_CHIPS")
+    if env_chips is not None:
+        cap["TPU"] = float(env_chips)
+    else:
+        import sys
+
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                cap["TPU"] = float(
+                    len([d for d in jax_mod.devices() if d.platform == "tpu"])
+                )
+            except Exception:  # noqa: BLE001 - no backend on driver is fine
+                pass
+    return cap
+
+
+# --------------------------------------------------------------------------
+# Session
+# --------------------------------------------------------------------------
+class _Session:
+    def __init__(self) -> None:
+        self.nodes: List[Node] = []
+        self.actors: Dict[str, "ActorHandle"] = {}
+        self.store: Dict[str, Tuple[shared_memory.SharedMemory, int]] = {}
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)
+        self.results: Dict[Tuple[str, int], Tuple[bool, Any]] = {}
+        self.dead_actors: Dict[str, str] = {}  # actor_id -> reason
+        self.mp_ctx = mp.get_context("spawn")
+        self._manager: Optional[Any] = None
+        self._counter = itertools.count()
+
+    @property
+    def manager(self):
+        if self._manager is None:
+            self._manager = self.mp_ctx.Manager()
+        return self._manager
+
+    def next_id(self) -> int:
+        return next(self._counter)
+
+
+_session: Optional[_Session] = None
+
+
+def is_initialized() -> bool:
+    return _session is not None
+
+
+def init(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    ignore_reinit_error: bool = True,
+) -> None:
+    """Start the fabric session with a single local head node.
+
+    ``resources`` adds custom logical resources (the reference tests this
+    passthrough with ``ray.init(resources={"extra": 4})``, test_ddp.py:34-39).
+    """
+    global _session
+    if _session is not None:
+        if ignore_reinit_error:
+            return
+        raise FabricError("fabric already initialized")
+    _session = _Session()
+    cap = _detect_local_capacity()
+    if num_cpus is not None:
+        cap["CPU"] = float(num_cpus)
+    if num_tpus is not None:
+        cap["TPU"] = float(num_tpus)
+    if resources:
+        cap.update({k: float(v) for k, v in resources.items()})
+    _session.nodes.append(Node("node-0", get_node_ip(), cap))
+
+
+def _require_session() -> _Session:
+    if _session is None:
+        init()
+    assert _session is not None
+    return _session
+
+
+def shutdown() -> None:
+    global _session
+    if _session is None:
+        return
+    sess = _session
+    with sess.lock:
+        handles = list(sess.actors.values())
+    for handle in handles:
+        try:
+            kill(handle)
+        except Exception:  # noqa: BLE001
+            pass
+    for shm, _ in sess.store.values():
+        try:
+            shm.close()
+            shm.unlink()
+        except Exception:  # noqa: BLE001
+            pass
+    sess.store.clear()
+    if sess._manager is not None:
+        try:
+            sess._manager.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+    _session = None
+
+
+atexit.register(shutdown)
+
+
+def _add_node(capacity: Dict[str, float], node_ip: Optional[str] = None) -> Node:
+    """Register an extra logical node (used by cluster_utils for fake clusters)."""
+    sess = _require_session()
+    with sess.lock:
+        node_id = f"node-{len(sess.nodes)}"
+        ip = node_ip or f"10.77.{len(sess.nodes)}.1"
+        node = Node(node_id, ip, dict(capacity))
+        sess.nodes.append(node)
+        return node
+
+
+def nodes() -> List[Dict[str, Any]]:
+    sess = _require_session()
+    with sess.lock:
+        return [
+            {
+                "NodeID": n.node_id,
+                "NodeManagerAddress": n.node_ip,
+                "Resources": dict(n.capacity),
+                "Available": n.available(),
+                "alive": True,
+            }
+            for n in sess.nodes
+        ]
+
+
+def cluster_resources() -> Dict[str, float]:
+    sess = _require_session()
+    with sess.lock:
+        total: Dict[str, float] = {}
+        for n in sess.nodes:
+            for k, v in n.capacity.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+
+def available_resources() -> Dict[str, float]:
+    sess = _require_session()
+    with sess.lock:
+        total: Dict[str, float] = {}
+        for n in sess.nodes:
+            for k, v in n.available().items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+
+# --------------------------------------------------------------------------
+# Object store (shared memory)
+# --------------------------------------------------------------------------
+@dataclass(frozen=True)
+class ObjectRef:
+    """Reference to an object in the driver's shared-memory store.
+
+    Picklable: workers receiving a ref attach to the shm segment by name and
+    deserialize in place — the fabric equivalent of plasma-store transport
+    behind ``ray.put`` (ray_launcher.py:235).
+    """
+
+    id: str
+    shm_name: str
+    size: int
+
+    def __reduce__(self):
+        return (_objectref_from_wire, (self.id, self.shm_name, self.size))
+
+
+def _objectref_from_wire(id: str, shm_name: str, size: int) -> "ObjectRef":
+    return ObjectRef(id=id, shm_name=shm_name, size=size)
+
+
+def put(obj: Any) -> ObjectRef:
+    sess = _require_session()
+    payload = cloudpickle.dumps(obj, protocol=5)
+    ref_id = uuid.uuid4().hex[:16]
+    shm = shared_memory.SharedMemory(create=True, size=max(1, len(payload)))
+    shm.buf[: len(payload)] = payload
+    with sess.lock:
+        sess.store[ref_id] = (shm, len(payload))
+    return ObjectRef(id=ref_id, shm_name=shm.name, size=len(payload))
+
+
+def _get_object(ref: ObjectRef) -> Any:
+    sess = _session
+    if sess is not None:
+        with sess.lock:
+            entry = sess.store.get(ref.id)
+        if entry is not None:
+            shm, size = entry
+            return cloudpickle.loads(bytes(shm.buf[:size]))
+    # Not the owner (we are inside a worker): attach read-only by name.
+    shm = shared_memory.SharedMemory(name=ref.shm_name)
+    try:
+        return cloudpickle.loads(bytes(shm.buf[: ref.size]))
+    finally:
+        shm.close()
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    sess = _require_session()
+    with sess.lock:
+        for ref in refs:
+            entry = sess.store.pop(ref.id, None)
+            if entry is not None:
+                shm, _ = entry
+                try:
+                    shm.close()
+                    shm.unlink()
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+# --------------------------------------------------------------------------
+# Futures
+# --------------------------------------------------------------------------
+@dataclass(frozen=True)
+class TaskRef:
+    """Future for an in-flight actor method call."""
+
+    actor_id: str
+    call_id: int
+
+
+def _task_done(sess: _Session, ref: TaskRef) -> bool:
+    return (ref.actor_id, ref.call_id) in sess.results or ref.actor_id in sess.dead_actors
+
+
+def get(refs: Any, timeout: Optional[float] = None) -> Any:
+    """Resolve ObjectRef/TaskRef (or a list of them) to values."""
+    if isinstance(refs, (list, tuple)):
+        return type(refs)(get(r, timeout=timeout) for r in refs)
+    if isinstance(refs, ObjectRef):
+        return _get_object(refs)
+    if not isinstance(refs, TaskRef):
+        return refs  # plain value passthrough
+    sess = _require_session()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with sess.cv:
+        while not _task_done(sess, refs):
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError("fabric.get timed out")
+            sess.cv.wait(timeout=remaining if remaining is not None else 1.0)
+        key = (refs.actor_id, refs.call_id)
+        if key not in sess.results:
+            raise ActorDiedError(
+                f"actor {refs.actor_id} died: {sess.dead_actors.get(refs.actor_id)}"
+            )
+        # Results stay cached so repeated get()/wait() on the same ref keep
+        # working (Ray-like contract; the driver poll loop re-waits refs).
+        ok, value = sess.results[key]
+    if ok:
+        return value
+    exc, tb = value
+    if hasattr(exc, "add_note"):
+        exc.add_note(f"[worker traceback]\n{tb}")
+    raise exc
+
+
+def wait(
+    refs: Sequence[TaskRef],
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+) -> Tuple[List[TaskRef], List[TaskRef]]:
+    """Split ``refs`` into (done, pending); blocks until ``num_returns`` done
+    or ``timeout`` elapses. ``timeout=0`` polls — the driver's result loop uses
+    this exactly like the reference's ``ray.wait(timeout=0)`` poll
+    (util.py:57-70)."""
+    sess = _require_session()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with sess.cv:
+        while True:
+            done = [r for r in refs if _task_done(sess, r)]
+            if len(done) >= min(num_returns, len(refs)):
+                break
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break
+            sess.cv.wait(timeout=min(0.25, remaining) if remaining is not None else 0.25)
+        done_set = {(r.actor_id, r.call_id) for r in done}
+        pending = [r for r in refs if (r.actor_id, r.call_id) not in done_set]
+    return done, pending
+
+
+# --------------------------------------------------------------------------
+# Actors
+# --------------------------------------------------------------------------
+class _RemoteMethod:
+    def __init__(self, handle: "ActorHandle", name: str) -> None:
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args: Any, **kwargs: Any) -> TaskRef:
+        return self._handle._call(self._name, args, kwargs)
+
+    def __repr__(self) -> str:
+        return f"<RemoteMethod {self._handle.actor_id}.{self._name}>"
+
+
+class ActorHandle:
+    """Driver-side handle to a spawned actor process."""
+
+    def __init__(
+        self,
+        actor_id: str,
+        process: Any,
+        conn: Any,
+        node: Node,
+        request: Dict[str, float],
+        options: Dict[str, Any],
+    ) -> None:
+        self.actor_id = actor_id
+        self._process = process
+        self._conn = conn
+        self._node = node
+        self._request = request
+        self._options = options
+        self._send_lock = threading.Lock()
+        self._alive = True
+        self._reader = threading.Thread(
+            target=self._reader_loop, name=f"fabric-reader-{actor_id}", daemon=True
+        )
+        self._reader.start()
+
+    # -- introspection used by tests / launcher ---------------------------
+    @property
+    def node_id(self) -> str:
+        return self._node.node_id
+
+    @property
+    def node_ip(self) -> str:
+        return self._node.node_ip
+
+    @property
+    def allocated_resources(self) -> Dict[str, float]:
+        return dict(self._request)
+
+    @property
+    def actor_options(self) -> Dict[str, Any]:
+        return dict(self._options)
+
+    def is_alive(self) -> bool:
+        return self._alive and self._process.is_alive()
+
+    # -- plumbing ---------------------------------------------------------
+    def _reader_loop(self) -> None:
+        sess = _session
+        while True:
+            try:
+                msg = cloudpickle.loads(self._conn.recv_bytes())
+            except (EOFError, OSError):
+                break
+            except Exception:  # noqa: BLE001 - deserialization failure
+                break
+            if msg[0] == "result":
+                _, call_id, ok, value = msg
+                if sess is not None:
+                    with sess.cv:
+                        sess.results[(self.actor_id, call_id)] = (ok, value)
+                        sess.cv.notify_all()
+            elif msg[0] in ("ready", "ready_error"):
+                if sess is not None:
+                    with sess.cv:
+                        sess.results[(self.actor_id, -1)] = (
+                            msg[0] == "ready",
+                            msg[1],
+                        )
+                        sess.cv.notify_all()
+        # Pipe closed: mark actor dead so blocked getters wake up, and release
+        # its node resources so a relaunch after a crash can be placed.
+        self._alive = False
+        if sess is not None:
+            with sess.cv:
+                exitcode = self._process.exitcode
+                sess.dead_actors.setdefault(
+                    self.actor_id, f"process exited (exitcode={exitcode})"
+                )
+                if sess.actors.pop(self.actor_id, None) is not None:
+                    self._node.release(self._request)
+                sess.cv.notify_all()
+
+    def _send(self, msg: Any) -> None:
+        if not self._alive:
+            raise ActorDiedError(f"actor {self.actor_id} is dead")
+        payload = cloudpickle.dumps(msg, protocol=5)
+        with self._send_lock:
+            self._conn.send_bytes(payload)
+
+    def _call(self, name: str, args: Tuple, kwargs: Dict) -> TaskRef:
+        sess = _require_session()
+        call_id = sess.next_id()
+        blob = cloudpickle.dumps((name, args, kwargs), protocol=5)
+        self._send(("call", call_id, blob))
+        return TaskRef(actor_id=self.actor_id, call_id=call_id)
+
+    def __getattr__(self, name: str) -> _RemoteMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _RemoteMethod(self, name)
+
+    def _shutdown(self, force: bool = False) -> None:
+        if self._alive:
+            try:
+                self._send(("shutdown",))
+            except Exception:  # noqa: BLE001
+                pass
+        self._process.join(timeout=0.1 if force else 5.0)
+        if self._process.is_alive():
+            self._process.terminate()
+            self._process.join(timeout=2.0)
+            if self._process.is_alive():
+                self._process.kill()
+                self._process.join(timeout=2.0)
+        self._alive = False
+
+
+class ActorClass:
+    """Result of ``fabric.remote(cls)``; spawn with ``.options(...).remote()``."""
+
+    def __init__(self, cls: type, default_options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._default_options = default_options or {}
+
+    def options(self, **opts: Any) -> "ActorClass":
+        merged = dict(self._default_options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args: Any, **kwargs: Any) -> ActorHandle:
+        return _spawn_actor(self._cls, args, kwargs, self._default_options)
+
+
+def remote(cls: type) -> ActorClass:
+    """Decorator/wrapper turning a class into a spawnable actor class."""
+    return ActorClass(cls)
+
+
+def _spawn_actor(
+    cls: type,
+    args: Tuple,
+    kwargs: Dict,
+    opts: Dict[str, Any],
+) -> ActorHandle:
+    sess = _require_session()
+    request: Dict[str, float] = {}
+    request["CPU"] = float(opts.get("num_cpus", 1) or 0)
+    if opts.get("num_tpus"):
+        request["TPU"] = float(opts["num_tpus"])
+    for k, v in (opts.get("resources") or {}).items():
+        request[k] = float(v)
+
+    with sess.lock:
+        node = None
+        for cand in sess.nodes:
+            if cand.fits(request):
+                node = cand
+                break
+        if node is None:
+            raise InsufficientResourcesError(
+                f"cannot place actor requiring {request}; "
+                f"available per node: {[n.available() for n in sess.nodes]}"
+            )
+        node.acquire(request)
+
+    env = dict(opts.get("env") or {})
+    actor_id = f"actor-{uuid.uuid4().hex[:8]}"
+    parent_conn, child_conn = sess.mp_ctx.Pipe(duplex=True)
+    from ray_lightning_tpu.fabric.worker import _worker_main
+
+    proc = sess.mp_ctx.Process(
+        target=_worker_main,
+        args=(
+            child_conn,
+            env,
+            {"node_id": node.node_id, "node_ip": node.node_ip},
+        ),
+        name=actor_id,
+        daemon=False,
+    )
+    proc.start()
+    child_conn.close()
+    handle = ActorHandle(actor_id, proc, parent_conn, node, request, opts)
+    with sess.lock:
+        sess.actors[actor_id] = handle
+
+    # Ship the class + ctor args (after env application in the child).
+    blob = cloudpickle.dumps((cls, args, kwargs), protocol=5)
+    handle._send(("init", blob))
+    # Wait for construction so init errors surface eagerly on the driver.
+    try:
+        get(TaskRef(actor_id=actor_id, call_id=-1), timeout=opts.get("init_timeout", 300.0))
+    except BaseException:
+        kill(handle)
+        raise
+    return handle
+
+
+def kill(handle: ActorHandle, no_restart: bool = True) -> None:  # noqa: ARG001
+    """Terminate an actor and release its resources (no restart semantics,
+    matching ``ray.kill(no_restart=True)`` in ray_launcher.py:126)."""
+    sess = _require_session()
+    handle._shutdown(force=True)
+    with sess.lock:
+        if handle.actor_id in sess.actors:
+            handle._node.release(handle._request)
+            del sess.actors[handle.actor_id]
+        sess.dead_actors.setdefault(handle.actor_id, "killed")
+    with sess.cv:
+        sess.cv.notify_all()

@@ -1,0 +1,100 @@
+"""Child-process entrypoint for fabric actors.
+
+Kept intentionally light: only stdlib imports at module scope, so the spawned
+process can apply environment overrides (XLA_FLAGS, JAX_PLATFORMS, TPU
+topology vars) *before* anything imports jax. The actor class itself arrives
+as a cloudpickle blob after env setup.
+
+Wire protocol (length-prefixed cloudpickle over a duplex Pipe):
+  driver -> worker: ("init", blob)            instantiate actor class
+                    ("call", call_id, blob)   run method, blob=(name, args, kw)
+                    ("shutdown",)
+  worker -> driver: ("ready", actor_repr)
+                    ("result", call_id, ok, blob)  blob=value or (exc, tb_str)
+"""
+import os
+import sys
+import traceback
+
+
+def _worker_main(conn, env_overrides, node_info):
+    """Run the actor loop. ``conn`` is the child end of a duplex Pipe."""
+    for key, value in env_overrides.items():
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = str(value)
+
+    # Make the logical node identity visible to actor code (rank math, IPs).
+    os.environ["RLT_NODE_ID"] = str(node_info.get("node_id", "node-0"))
+    os.environ["RLT_NODE_IP"] = str(node_info.get("node_ip", "127.0.0.1"))
+
+    # Honor an explicit JAX platform choice even when a PJRT plugin loaded at
+    # interpreter boot (via sitecustomize) has already forced its own
+    # ``jax_platforms`` config, which silently overrides the env var.
+    if "JAX_PLATFORMS" in env_overrides and env_overrides["JAX_PLATFORMS"]:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", str(env_overrides["JAX_PLATFORMS"]))
+        except Exception:  # noqa: BLE001 - jax may be absent in pure actors
+            pass
+
+    import cloudpickle  # after env setup; cheap, no jax dependency
+
+    actor = None
+    try:
+        while True:
+            try:
+                msg = cloudpickle.loads(conn.recv_bytes())
+            except (EOFError, OSError):
+                break
+            kind = msg[0]
+            if kind == "shutdown":
+                break
+            if kind == "init":
+                try:
+                    cls, args, kwargs = cloudpickle.loads(msg[1])
+                    actor = cls(*args, **kwargs)
+                    conn.send_bytes(cloudpickle.dumps(("ready", repr(type(actor)))))
+                except BaseException as exc:  # noqa: BLE001 - report to driver
+                    conn.send_bytes(
+                        cloudpickle.dumps(
+                            ("ready_error", _exc_payload(exc))
+                        )
+                    )
+                continue
+            if kind == "call":
+                call_id, blob = msg[1], msg[2]
+                try:
+                    name, args, kwargs = cloudpickle.loads(blob)
+                    if actor is None:
+                        raise RuntimeError("actor not initialized")
+                    result = getattr(actor, name)(*args, **kwargs)
+                    payload = cloudpickle.dumps(("result", call_id, True, result))
+                except BaseException as exc:  # noqa: BLE001 - ship to driver
+                    payload = cloudpickle.dumps(
+                        ("result", call_id, False, _exc_payload(exc))
+                    )
+                conn.send_bytes(payload)
+                continue
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+        # Normal interpreter shutdown (atexit handlers run, letting runtimes
+        # like PJRT release device locks cleanly).
+        sys.stdout.flush()
+        sys.stderr.flush()
+
+
+def _exc_payload(exc):
+    tb = traceback.format_exc()
+    try:
+        import cloudpickle
+
+        cloudpickle.dumps(exc)  # probe picklability
+        return (exc, tb)
+    except Exception:  # noqa: BLE001
+        return (RuntimeError(f"{type(exc).__name__}: {exc}"), tb)

@@ -1,0 +1,27 @@
+"""Shared utilities for ray_lightning_tpu.
+
+TPU-native re-imagination of the reference's ``util.py`` + ``launchers/utils.py``
+(see /root/reference/ray_lightning/util.py:1-102): state streams are JAX pytrees
+serialized to host numpy instead of torch tensors, and device binding is owned by
+PJRT instead of ``torch.cuda.set_device``.
+"""
+from ray_lightning_tpu.utils.ports import find_free_port
+from ray_lightning_tpu.utils.seed import reset_seed, seed_everything
+from ray_lightning_tpu.utils.state_stream import (
+    load_state_stream,
+    to_state_stream,
+)
+from ray_lightning_tpu.utils.rank_zero import rank_zero_info, rank_zero_only, rank_zero_warn
+from ray_lightning_tpu.utils.unavailable import Unavailable
+
+__all__ = [
+    "find_free_port",
+    "reset_seed",
+    "seed_everything",
+    "to_state_stream",
+    "load_state_stream",
+    "rank_zero_only",
+    "rank_zero_info",
+    "rank_zero_warn",
+    "Unavailable",
+]

@@ -1,0 +1,28 @@
+"""Free-port discovery for the distributed coordinator.
+
+Plays the role of the reference's ``find_free_port``
+(/root/reference/ray_lightning/launchers/utils.py:12-17) but the port feeds
+``jax.distributed.initialize(coordinator_address=...)`` instead of
+``MASTER_PORT`` for torch.distributed.
+"""
+import contextlib
+import socket
+
+
+def find_free_port(host: str = "") -> int:
+    """Bind port 0 on ``host`` and return the OS-assigned free port."""
+    with contextlib.closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def get_node_ip() -> str:
+    """Best-effort IP of this host, as the coordinator address."""
+    try:
+        with contextlib.closing(socket.socket(socket.AF_INET, socket.SOCK_DGRAM)) as s:
+            # No packets are sent; connect() on UDP just resolves the route.
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

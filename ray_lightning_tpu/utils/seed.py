@@ -1,0 +1,35 @@
+"""Seed plumbing.
+
+The reference broadcasts ``PL_GLOBAL_SEED`` to every worker and calls
+``reset_seed()`` per worker (/root/reference/ray_lightning/ray_ddp.py:167,
+launchers/ray_launcher.py:169-172). Here the seed additionally derives the
+root ``jax.random.PRNGKey`` for model init, so a fixed seed gives bitwise
+reproducible initial parameters across workers.
+"""
+import os
+import random
+from typing import Optional
+
+import numpy as np
+
+GLOBAL_SEED_ENV = "RLT_GLOBAL_SEED"
+
+
+def seed_everything(seed: Optional[int] = None) -> int:
+    """Seed python, numpy, and record the seed for worker broadcast."""
+    if seed is None:
+        env = os.environ.get(GLOBAL_SEED_ENV)
+        seed = int(env) if env is not None else random.randint(0, 2**31 - 1)
+    seed = int(seed)
+    os.environ[GLOBAL_SEED_ENV] = str(seed)
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return seed
+
+
+def reset_seed() -> Optional[int]:
+    """Re-apply the broadcast seed inside a worker, if one was set."""
+    env = os.environ.get(GLOBAL_SEED_ENV)
+    if env is None:
+        return None
+    return seed_everything(int(env))

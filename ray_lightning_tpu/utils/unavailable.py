@@ -1,0 +1,30 @@
+"""Placeholder class for optional dependencies.
+
+Same contract as the reference's ``Unavailable``
+(/root/reference/ray_lightning/util.py:42-46): importable at module scope,
+raises only when actually instantiated/used, so optional integrations degrade
+gracefully when their dependency is absent.
+"""
+from typing import Any
+
+
+class Unavailable:
+    """Stands in for a class whose optional dependency is not installed."""
+
+    _reason = "a required optional dependency is not installed"
+
+    def __init_subclass__(cls, reason: str = "", **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        if reason:
+            cls._reason = reason
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        raise RuntimeError(f"{type(self).__name__} is unavailable: {self._reason}.")
+
+    def __getattr__(self, name: str) -> Any:
+        raise RuntimeError(f"{type(self).__name__} is unavailable: {self._reason}.")
+
+
+def make_unavailable(name: str, reason: str) -> type:
+    """Create a named Unavailable subclass with a custom error reason."""
+    return type(name, (Unavailable,), {"_reason": reason})

@@ -1,0 +1,51 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices (the JAX analog of the
+reference's fake clusters, per SURVEY.md §4): JAX_PLATFORMS=cpu +
+--xla_force_host_platform_device_count=8 must be set before jax is imported
+anywhere in the test process. Real-TPU tests are gated behind RLT_TPU=1,
+mirroring the reference's CLUSTER=1 gate (test_ddp_gpu.py:126-129).
+"""
+import os
+
+# Must happen before any jax import (including transitive ones).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Workers inherit the same virtual-device config unless a test overrides it.
+os.environ.setdefault("RLT_NUM_TPU_CHIPS", "0")
+
+# A PJRT plugin loaded via sitecustomize can force its own jax_platforms
+# config, which overrides JAX_PLATFORMS; pin CPU explicitly.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def start_fabric():
+    """Init the fabric with given resources; always shut down after the test."""
+    from ray_lightning_tpu import fabric
+
+    created = []
+
+    def _start(**kwargs):
+        fabric.init(**kwargs)
+        created.append(True)
+        return fabric
+
+    yield _start
+    fabric.shutdown()
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("RLT_TPU") != "1":
+        skip_tpu = pytest.mark.skip(reason="needs real TPU (set RLT_TPU=1)")
+        for item in items:
+            if "tpu_hw" in item.keywords:
+                item.add_marker(skip_tpu)
