@@ -1,0 +1,125 @@
+"""Benchmark harness: steps/sec/chip for the framework vs single-process baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- value: steps/sec/chip of ``Trainer.fit`` under RayTPUStrategy (full path:
+  actor launch, object-store shipping, compiled DP step), from post-warmup
+  epoch times measured inside the worker (TPUStatsCallback).
+- vs_baseline: ratio vs an in-process single-device loop on the same
+  hardware — the "DDP-vs-RayTPU throughput ratio" of BASELINE.md (north star
+  >= 0.90). The reference publishes no numbers (BASELINE.md), so the
+  baseline is measured, not inherited.
+
+Both measurements run inside worker actors so the driver never binds the
+accelerator.
+"""
+import argparse
+import json
+import time
+
+
+def _fit_and_time(strategy, epochs: int, batch_size: int, n_train: int):
+    """Fit MNIST with the given strategy; return (steps/epoch, epoch_times, chips)."""
+    from ray_lightning_tpu.models import MNISTClassifier
+    from ray_lightning_tpu.trainer import Trainer, TPUStatsCallback
+
+    stats = TPUStatsCallback(verbose=False)
+    module = MNISTClassifier(batch_size=batch_size, n_train=n_train, lr=1e-3)
+    trainer = Trainer(
+        max_epochs=epochs,
+        enable_checkpointing=False,
+        callbacks=[stats],
+        seed=0,
+        log_every_n_steps=10**9,  # no mid-epoch host syncs
+        strategy=strategy,
+    )
+    trainer.fit(module)
+    steps_per_epoch = trainer.global_step // epochs
+    return steps_per_epoch, stats.epoch_times, trainer
+
+
+def _baseline_in_worker(epochs: int, batch_size: int, n_train: int, use_tpu: bool):
+    """Single-device loop in a fresh worker process (no strategy overhead)."""
+    from ray_lightning_tpu import fabric
+    from ray_lightning_tpu.launchers.utils import TrainWorker
+
+    def run():
+        import os
+
+        import jax
+
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        steps_per_epoch, times, trainer = _fit_and_time(
+            None, epochs, batch_size, n_train
+        )
+        return steps_per_epoch, times, len(jax.local_devices())
+
+    env = (
+        {}
+        if use_tpu
+        else {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        }
+    )
+    resources = {"TPU": 1.0} if use_tpu else {}
+    actor = (
+        fabric.remote(TrainWorker)
+        .options(num_cpus=1, resources=resources, env=env)
+        .remote()
+    )
+    try:
+        return fabric.get(actor.execute.remote(run), timeout=1800)
+    finally:
+        fabric.kill(actor)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=4)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--n-train", type=int, default=8192)
+    args = parser.parse_args()
+
+    from ray_lightning_tpu import fabric
+    from ray_lightning_tpu.strategies import RayTPUStrategy
+
+    fabric.init()
+    use_tpu = fabric.cluster_resources().get("TPU", 0) >= 1
+    num_workers = max(1, int(fabric.cluster_resources().get("TPU", 0))) if use_tpu else 1
+
+    # Baseline: plain single-device loop, no launcher/strategy.
+    b_steps, b_times, b_chips = _baseline_in_worker(
+        args.epochs, args.batch_size, args.n_train, use_tpu
+    )
+    b_timed = b_times[1:] or b_times  # drop compile epoch
+    baseline_sps_chip = b_steps * len(b_timed) / sum(b_timed) / max(1, b_chips)
+
+    # Framework path: full launcher + strategy; worker-side epoch times come
+    # back through the callback-state sync.
+    steps_per_epoch, times, trainer = _fit_and_time(
+        RayTPUStrategy(num_workers=num_workers, use_tpu=use_tpu),
+        args.epochs,
+        args.batch_size,
+        args.n_train,
+    )
+    timed = times[1:] or times
+    sps_chip = steps_per_epoch * len(timed) / sum(timed) / max(1, num_workers)
+
+    vs_baseline = sps_chip / baseline_sps_chip if baseline_sps_chip > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_steps_per_sec_per_chip",
+                "value": round(sps_chip, 3),
+                "unit": "steps/s/chip",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+    fabric.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,10 @@ __version__ = "0.1.0"
 
 _LAZY = {
     "fabric": "ray_lightning_tpu",
+    "RayStrategy": "ray_lightning_tpu.strategies",
+    "RayTPUStrategy": "ray_lightning_tpu.strategies",
+    "Trainer": "ray_lightning_tpu.trainer",
+    "TPUModule": "ray_lightning_tpu.trainer",
 }
 
 

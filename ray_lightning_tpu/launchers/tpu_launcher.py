@@ -1,0 +1,231 @@
+"""TPULauncher: create worker actors, rendezvous, run, recover results.
+
+The heart of the system — the parity target is ``RayLauncher``
+(/root/reference/ray_lightning/launchers/ray_launcher.py:27-379), re-shaped
+for TPU: per-*host* actors instead of per-GPU processes, a JAX coordination
+service address instead of MASTER_ADDR/PORT env rendezvous, and no
+CUDA_VISIBLE_DEVICES pooling (PJRT owns each host's chips; SURVEY.md §7
+mapping table).
+
+Launch sequence (cf. SURVEY.md §3.1):
+  1. setup_workers: spawn actors with per-worker resources + env, run
+     init_hook on each (ray_launcher.py:79-83 analog).
+  2. coordinator = worker-0 node IP + a free port on that node
+     (ray_launcher.py:85-87 analog) — process 0 hosts the JAX coordination
+     service.
+  3. env broadcast (seed, coordinator) to all actors (:159-175 analog).
+  4. global->(local, node) rank map from actor node IPs (:130-157 analog).
+  5. ship (module, spec, strategy) once via the object store, run the loop
+     entry in every actor, drive process_results.
+  6. collect rank-0 WorkerOutput, restore into the driver's trainer
+     (:312-379 analog), teardown actors.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_lightning_tpu import fabric
+from ray_lightning_tpu.launchers.utils import (
+    WorkerOutput,
+    get_executable_cls,
+    process_results,
+)
+from ray_lightning_tpu.parallel.env import DistEnv
+from ray_lightning_tpu.utils.seed import GLOBAL_SEED_ENV
+import os
+
+
+def _worker_entry(
+    spec_ref: Any,
+    host_rank: int,
+    dist_env: DistEnv,
+    stage: str,
+    ckpt_stream: Optional[bytes],
+    queue: Any,
+) -> Optional[WorkerOutput]:
+    """Runs inside each actor: rebuild the loop and execute the stage.
+
+    The analog of ``_wrapping_function`` (ray_launcher.py:252-310), minus the
+    pickled-live-trainer tricks: everything arrives via one object-store ref.
+    """
+    from ray_lightning_tpu.trainer.loop import TrainingLoop
+
+    module, spec, strategy, datamodule = fabric.get(spec_ref)
+    strategy.set_remote(True)
+    strategy.setup_worker(dist_env)
+
+    tune_session = None
+    if queue is not None:
+        from ray_lightning_tpu.tune import session as tune_session_mod
+
+        tune_session_mod.init_session(rank=host_rank, queue=queue)
+        tune_session = tune_session_mod.get_session()
+
+    loop = TrainingLoop(
+        spec, module, strategy, dist_env, tune_session=tune_session, datamodule=datamodule
+    )
+    if stage == "fit":
+        return loop.run_fit(ckpt_stream)
+    if stage in ("validate", "test"):
+        return loop.run_evaluate(stage, ckpt_stream)
+    if stage == "predict":
+        return loop.run_predict(ckpt_stream)
+    raise ValueError(f"unknown stage {stage}")
+
+
+class TPULauncher:
+    def __init__(self, strategy: Any, trainer: Any) -> None:
+        self._strategy = strategy
+        self._trainer = trainer
+        self._workers: List[Any] = []
+        self.tune_queue: Any = None
+
+    # ------------------------------------------------------------------
+    def launch(
+        self,
+        stage: str,
+        module: Any,
+        datamodule: Any = None,
+        ckpt_stream: Optional[bytes] = None,
+    ) -> Optional[WorkerOutput]:
+        if not fabric.is_initialized():
+            fabric.init()
+        plans, use_tpu = self._strategy.plan_workers()
+        try:
+            self.setup_workers(plans)
+            dist_envs = self._build_dist_envs(plans, use_tpu)
+            output = self.run_function_on_workers(
+                stage, module, datamodule, ckpt_stream, dist_envs
+            )
+        finally:
+            self.teardown_workers()
+        return output
+
+    # ------------------------------------------------------------------
+    def setup_workers(self, plans: List[Any]) -> None:
+        from ray_lightning_tpu.tune.session import is_tune_session
+
+        worker_cls = get_executable_cls()
+        for plan in plans:
+            actor = (
+                fabric.remote(worker_cls)
+                .options(
+                    num_cpus=plan.num_cpus,
+                    resources=plan.resources,
+                    env=plan.env,
+                )
+                .remote()
+            )
+            self._workers.append(actor)
+        if self._strategy.init_hook:
+            fabric.get(
+                [w.execute.remote(self._strategy.init_hook) for w in self._workers]
+            )
+        # Seed broadcast (PL_GLOBAL_SEED analog, ray_launcher.py:169-172).
+        seed = os.environ.get(GLOBAL_SEED_ENV)
+        if seed is not None:
+            fabric.get(
+                [
+                    w.set_env_var.remote(GLOBAL_SEED_ENV, seed)
+                    for w in self._workers
+                ]
+            )
+        if is_tune_session():
+            self.tune_queue = fabric.Queue()
+
+    def _build_dist_envs(self, plans: List[Any], use_tpu: bool) -> List[DistEnv]:
+        num_hosts = len(plans)
+        chips_per_host = self._strategy.num_workers // num_hosts
+        coordinator = None
+        if num_hosts > 1:
+            # Coordination service runs inside host_rank 0; its address must
+            # be that actor's node, not the driver (multi-node correctness).
+            ip = fabric.get(self._workers[0].get_node_ip.remote())
+            port = fabric.get(self._workers[0].find_free_port.remote())
+            coordinator = f"{ip}:{port}"
+        global_to_local = self.get_local_ranks()
+        envs = []
+        for rank, plan in enumerate(plans):
+            envs.append(
+                DistEnv(
+                    world_size=self._strategy.num_workers,
+                    num_hosts=num_hosts,
+                    host_rank=rank,
+                    node_rank=global_to_local[rank][1],
+                    local_chips=chips_per_host,
+                    coordinator_address=coordinator,
+                    first_chip_rank=rank * chips_per_host,
+                    global_to_local=global_to_local,
+                )
+            )
+        return envs
+
+    def get_local_ranks(self) -> Dict[int, Tuple[int, int]]:
+        """host_rank -> (local_rank, node_rank) from actor node IPs — same
+        algorithm as the reference (ray_launcher.py:130-157)."""
+        node_ips = fabric.get([w.get_node_ip.remote() for w in self._workers])
+        rank_map: Dict[int, Tuple[int, int]] = {}
+        node_order: List[str] = []
+        per_node_counter: Dict[str, int] = defaultdict(int)
+        for global_rank, ip in enumerate(node_ips):
+            if ip not in node_order:
+                node_order.append(ip)
+            node_rank = node_order.index(ip)
+            rank_map[global_rank] = (per_node_counter[ip], node_rank)
+            per_node_counter[ip] += 1
+        return rank_map
+
+    # ------------------------------------------------------------------
+    def run_function_on_workers(
+        self,
+        stage: str,
+        module: Any,
+        datamodule: Any,
+        ckpt_stream: Optional[bytes],
+        dist_envs: List[DistEnv],
+    ) -> Optional[WorkerOutput]:
+        # Single object-store upload shared by all workers (the reference's
+        # ray.put(model) + trainer.model=None double-pickle avoidance,
+        # ray_launcher.py:232-247, falls out of the explicit-spec design).
+        spec = self._trainer._make_spec()
+        # Strip the driver-trainer backref so the object-store payload holds
+        # only the module (the reference nulls trainer.model for the same
+        # double-pickle reason, ray_launcher.py:232-247).
+        module.trainer = None
+        spec_ref = fabric.put((module, spec, self._strategy, datamodule))
+        try:
+            futures = [
+                w.execute.remote(
+                    _worker_entry,
+                    spec_ref,
+                    rank,
+                    dist_envs[rank],
+                    stage,
+                    ckpt_stream,
+                    self.tune_queue,
+                )
+                for rank, w in enumerate(self._workers)
+            ]
+            results = process_results(futures, self.tune_queue)
+        finally:
+            module.trainer = self._trainer
+            from ray_lightning_tpu.fabric.core import free
+
+            free([spec_ref])
+        return results[0]
+
+    # ------------------------------------------------------------------
+    def teardown_workers(self) -> None:
+        if self.tune_queue is not None:
+            try:
+                self.tune_queue.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            self.tune_queue = None
+        for worker in self._workers:
+            try:
+                fabric.kill(worker)
+            except Exception:  # noqa: BLE001
+                pass
+        self._workers = []

@@ -1,0 +1,108 @@
+"""Worker actor class, result wire-format, and the driver result loop.
+
+Parity targets from the reference:
+- ``RayExecutor`` actor (launchers/utils.py:27-52): generic "run this
+  closure" worker with env-var and node-introspection helpers.
+- ``_RayOutput`` (launchers/utils.py:55-69): the record rank 0 returns.
+- ``process_results`` / ``_handle_queue`` (util.py:49-70): the driver's
+  wait-loop that polls training futures while draining the Tune callback
+  queue.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+from ray_lightning_tpu import fabric
+
+
+class TrainWorker:
+    """Generic worker actor: env plumbing, node introspection, closure exec."""
+
+    def set_env_var(self, key: str, value: str) -> None:
+        os.environ[key] = str(value)
+
+    def set_env_vars(self, keys: List[str], values: List[str]) -> None:
+        for key, value in zip(keys, values):
+            self.set_env_var(key, value)
+
+    def get_node_ip(self) -> str:
+        return os.environ.get("RLT_NODE_IP", "127.0.0.1")
+
+    def get_node_id(self) -> str:
+        return os.environ.get("RLT_NODE_ID", "node-0")
+
+    def find_free_port(self) -> int:
+        from ray_lightning_tpu.utils.ports import find_free_port
+
+        return find_free_port()
+
+    def get_local_device_count(self) -> int:
+        import jax
+
+        return len(jax.local_devices())
+
+    def execute(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Run an arbitrary closure — the actor's universal entrypoint."""
+        return fn(*args, **kwargs)
+
+
+_train_worker_cls = TrainWorker
+
+
+def get_executable_cls() -> type:
+    """Test hook: the actor class the launcher spawns (reference
+    launchers/utils.py:20-24 uses the same seam for mock actors)."""
+    return _train_worker_cls
+
+
+def set_executable_cls(cls: Optional[type]) -> None:
+    global _train_worker_cls
+    _train_worker_cls = cls or TrainWorker
+
+
+class WorkerOutput(NamedTuple):
+    """What worker rank 0 ships back to the driver (the ``_RayOutput``
+    analog). Weights travel as a state stream — bytes, not file paths — so
+    recovery works across nodes without a shared filesystem
+    (ray_launcher.py:332-336 rationale)."""
+
+    best_model_path: Optional[str]
+    state_stream: Optional[bytes]
+    trainer_state: Dict[str, Any]
+    results: Any
+    callback_metrics: Dict[str, Any]
+    logged_metrics: Dict[str, Any]
+    callback_states: Dict[str, Any]
+
+
+def _handle_queue(queue: Any) -> None:
+    """Execute all pending (rank, closure) items from the worker queue."""
+    if queue is None:
+        return
+    while not queue.empty():
+        try:
+            (_actor_rank, item) = queue.get_nowait()
+        except Exception:  # noqa: BLE001 - drained concurrently
+            return
+        if isinstance(item, Callable):
+            item()
+
+
+def process_results(training_result_futures: List[Any], queue: Any = None) -> List[Any]:
+    """Wait for all workers while servicing the worker->driver queue.
+
+    This is the driver's main loop during a fit: poll the futures with a
+    zero-timeout wait and run queued closures (e.g. ``tune.report``) between
+    polls, exactly the reference's event loop shape (util.py:57-70).
+    """
+    not_ready = list(training_result_futures)
+    while not_ready:
+        if queue is not None:
+            _handle_queue(queue)
+        _ready, not_ready = fabric.wait(not_ready, num_returns=len(not_ready), timeout=0)
+        time.sleep(0.02)
+    if queue is not None:
+        _handle_queue(queue)
+    return fabric.get(training_result_futures)

@@ -1,0 +1,19 @@
+"""Model zoo: test fixtures + benchmark/flagship models.
+
+Fixture parity with the reference's test models
+(/root/reference/ray_lightning/tests/utils.py:16-210): BoringModel (minimal
+linear, exercises every hook), XORModule (exact-metric assertions),
+MNISTClassifier (accuracy-bound assertions). Benchmark models (ResNet-18,
+GPT-2) land with the models milestone.
+"""
+from ray_lightning_tpu.models.boring import BoringModule, RandomDataset
+from ray_lightning_tpu.models.mnist import MNISTClassifier, make_fake_mnist
+from ray_lightning_tpu.models.xor import XORModule
+
+__all__ = [
+    "BoringModule",
+    "RandomDataset",
+    "XORModule",
+    "MNISTClassifier",
+    "make_fake_mnist",
+]

@@ -1,0 +1,79 @@
+"""BoringModule: the minimal end-to-end fixture.
+
+JAX counterpart of the reference's ``BoringModel``
+(/root/reference/ray_lightning/tests/utils.py:28-96): a single linear layer
+over random data, exercising train/val/test/predict plus checkpoint
+round-trips, small enough that a full fit runs in seconds on CPU devices.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.trainer.module import TPUModule
+
+
+class RandomDataset(ArrayDataset):
+    def __init__(self, size: int, length: int, seed: int = 0) -> None:
+        g = np.random.default_rng(seed)
+        super().__init__(g.standard_normal((length, size), dtype=np.float32))
+
+
+class BoringModule(TPUModule):
+    def __init__(self, lr: float = 0.1, dataset_length: int = 64) -> None:
+        super().__init__()
+        self.lr = lr
+        self.dataset_length = dataset_length
+        self.val_epoch = 0  # host-side hook bookkeeping, like the reference
+
+    def init_params(self, rng: jax.Array, batch: Any) -> Any:
+        x = batch if not isinstance(batch, tuple) else batch[0]
+        k = jax.random.split(rng, 2)
+        return {
+            "w": jax.random.normal(k[0], (x.shape[-1], 2)) * 0.1,
+            "b": jnp.zeros((2,)),
+        }
+
+    def _forward(self, params: Any, x: jax.Array) -> jax.Array:
+        return x @ params["w"] + params["b"]
+
+    def training_step(
+        self, params: Any, batch: Any, rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        out = self._forward(params, batch)
+        loss = jnp.mean(out**2)
+        return loss, {"loss": loss}
+
+    def validation_step(self, params: Any, batch: Any) -> Dict[str, jax.Array]:
+        out = self._forward(params, batch)
+        return {"val_loss": jnp.mean(out**2)}
+
+    def test_step(self, params: Any, batch: Any) -> Dict[str, jax.Array]:
+        out = self._forward(params, batch)
+        return {"test_loss": jnp.mean(out**2)}
+
+    def predict_step(self, params: Any, batch: Any) -> jax.Array:
+        return self._forward(params, batch)
+
+    def configure_optimizers(self) -> optax.GradientTransformation:
+        return optax.sgd(self.lr)
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(RandomDataset(32, self.dataset_length), batch_size=2)
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(RandomDataset(32, self.dataset_length, seed=1), batch_size=2)
+
+    def test_dataloader(self) -> DataLoader:
+        return DataLoader(RandomDataset(32, self.dataset_length, seed=2), batch_size=2)
+
+    def predict_dataloader(self) -> DataLoader:
+        return DataLoader(RandomDataset(32, self.dataset_length, seed=3), batch_size=2)
+
+    def on_validation_epoch_end(self, metrics: Dict[str, float]) -> None:
+        self.val_epoch += 1

@@ -1,0 +1,115 @@
+"""MNISTClassifier: the accuracy-bound fixture and baseline benchmark model.
+
+Counterpart of the reference's ``LightningMNISTClassifier``
+(/root/reference/ray_lightning/tests/utils.py:99-148) and the model in
+BASELINE.md configs 1-2. Uses a synthetic separable "fake MNIST" by default
+(zero-egress environments); real MNIST arrays can be passed in.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.trainer.module import TPUModule
+
+
+def make_fake_mnist(
+    n: int = 512, seed: int = 0, image_shape: Tuple[int, int] = (28, 28)
+) -> ArrayDataset:
+    """Synthetic 10-class dataset with class-dependent mean patterns —
+    linearly separable enough that a small MLP exceeds 0.5 accuracy within
+    an epoch (the reference's predict_test bound, tests/utils.py:256-272)."""
+    g = np.random.default_rng(seed)
+    h, w = image_shape
+    labels = g.integers(0, 10, size=n).astype(np.int32)
+    # Class prototypes come from a FIXED rng so train/val/test splits (built
+    # with different seeds) share the same class structure; only the sample
+    # noise varies per split.
+    proto = np.random.default_rng(1234).standard_normal((10, h, w)).astype(np.float32)
+    images = proto[labels] + 0.5 * g.standard_normal((n, h, w), dtype=np.float32)
+    return ArrayDataset(images, labels)
+
+
+class MNISTClassifier(TPUModule):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        hidden: int = 128,
+        batch_size: int = 32,
+        dataset: Optional[ArrayDataset] = None,
+        n_train: int = 512,
+    ) -> None:
+        super().__init__()
+        self.lr = lr
+        self.hidden = hidden
+        self.batch_size = batch_size
+        self._dataset = dataset
+        self.n_train = n_train
+
+    # -- model ----------------------------------------------------------
+    def init_params(self, rng: jax.Array, batch: Any) -> Any:
+        x = batch[0]
+        d = int(np.prod(x.shape[1:]))
+        k1, k2, k3 = jax.random.split(rng, 3)
+        s1 = jnp.sqrt(2.0 / d)
+        s2 = jnp.sqrt(2.0 / self.hidden)
+        return {
+            "w1": jax.random.normal(k1, (d, self.hidden)) * s1,
+            "b1": jnp.zeros((self.hidden,)),
+            "w2": jax.random.normal(k2, (self.hidden, self.hidden)) * s2,
+            "b2": jnp.zeros((self.hidden,)),
+            "w3": jax.random.normal(k3, (self.hidden, 10)) * s2,
+            "b3": jnp.zeros((10,)),
+        }
+
+    def _forward(self, params: Any, x: jax.Array) -> jax.Array:
+        x = x.reshape(x.shape[0], -1)
+        h = jax.nn.relu(x @ params["w1"] + params["b1"])
+        h = jax.nn.relu(h @ params["w2"] + params["b2"])
+        return h @ params["w3"] + params["b3"]
+
+    def _loss_acc(self, params: Any, batch: Tuple) -> Tuple[jax.Array, jax.Array]:
+        x, y = batch
+        logits = self._forward(params, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, acc
+
+    # -- steps ----------------------------------------------------------
+    def training_step(self, params, batch, rng):
+        loss, acc = self._loss_acc(params, batch)
+        return loss, {"loss": loss, "acc": acc}
+
+    def validation_step(self, params, batch):
+        loss, acc = self._loss_acc(params, batch)
+        return {"ptl/val_loss": loss, "ptl/val_accuracy": acc}
+
+    def predict_step(self, params, batch):
+        x = batch[0] if isinstance(batch, tuple) else batch
+        return jnp.argmax(self._forward(params, x), -1)
+
+    def configure_optimizers(self):
+        return optax.adam(self.lr)
+
+    # -- data -----------------------------------------------------------
+    def _data(self) -> ArrayDataset:
+        if self._dataset is None:
+            self._dataset = make_fake_mnist(self.n_train)
+        return self._dataset
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(self._data(), batch_size=self.batch_size, shuffle=True)
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(make_fake_mnist(128, seed=7), batch_size=self.batch_size)
+
+    def test_dataloader(self) -> DataLoader:
+        return DataLoader(make_fake_mnist(128, seed=8), batch_size=self.batch_size)
+
+    def predict_dataloader(self) -> DataLoader:
+        return DataLoader(make_fake_mnist(128, seed=8), batch_size=self.batch_size)

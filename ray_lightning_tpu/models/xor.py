@@ -1,0 +1,82 @@
+"""XORModule: deterministic fixture for exact-metric-value assertions.
+
+Counterpart of the reference's XORModel/XORDataModule
+(/root/reference/ray_lightning/tests/utils.py:151-210), used to assert that
+metrics computed in workers arrive on the driver bit-exact
+(test_ddp.py:326-352).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.trainer.module import DataModule, TPUModule
+
+_X = np.array([[0.0, 0.0], [0.0, 1.0], [1.0, 0.0], [1.0, 1.0]], dtype=np.float32)
+_Y = np.array([0, 1, 1, 0], dtype=np.int32)
+
+
+def xor_dataset(repeat: int = 2) -> ArrayDataset:
+    return ArrayDataset(np.tile(_X, (repeat, 1)), np.tile(_Y, repeat))
+
+
+class XORDataModule(DataModule):
+    def __init__(self, batch_size: int = 1, repeat: int = 2) -> None:
+        self.batch_size = batch_size
+        self.repeat = repeat
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(xor_dataset(self.repeat), batch_size=self.batch_size)
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(xor_dataset(self.repeat), batch_size=self.batch_size)
+
+
+class XORModule(TPUModule):
+    def __init__(self, lr: float = 0.1, hidden: int = 8, batch_size: int = 1) -> None:
+        super().__init__()
+        self.lr = lr
+        self.hidden = hidden
+        self.batch_size = batch_size
+
+    def init_params(self, rng: jax.Array, batch: Any) -> Any:
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (2, self.hidden)) * 0.5,
+            "b1": jnp.zeros((self.hidden,)),
+            "w2": jax.random.normal(k2, (self.hidden, 2)) * 0.5,
+            "b2": jnp.zeros((2,)),
+        }
+
+    def _forward(self, params: Any, x: jax.Array) -> jax.Array:
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+    def _loss_acc(self, params: Any, batch: Tuple) -> Tuple[jax.Array, jax.Array]:
+        x, y = batch
+        logits = self._forward(params, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, acc
+
+    def training_step(self, params, batch, rng):
+        loss, acc = self._loss_acc(params, batch)
+        return loss, {"loss": loss, "acc": acc}
+
+    def validation_step(self, params, batch):
+        loss, acc = self._loss_acc(params, batch)
+        return {"val_loss": loss, "val_acc": acc}
+
+    def configure_optimizers(self):
+        return optax.adam(self.lr)
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(xor_dataset(), batch_size=self.batch_size)
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(xor_dataset(), batch_size=self.batch_size)

@@ -1,0 +1,12 @@
+"""Parallelism substrate: device meshes, shardings, collectives, ZeRO rules.
+
+The TPU-native replacement for the reference's delegation to
+torch.distributed/NCCL/Horovod/FairScale (SURVEY.md §2b): rendezvous is
+``jax.distributed.initialize``, gradient sync is a GSPMD-inserted (or
+explicitly scheduled) XLA collective over the ICI mesh, and optimizer-state
+sharding is a ``NamedSharding`` rule on the optimizer pytree.
+"""
+from ray_lightning_tpu.parallel.env import DistEnv
+from ray_lightning_tpu.parallel.mesh import build_mesh, local_chip_count
+
+__all__ = ["DistEnv", "build_mesh", "local_chip_count"]

@@ -1,0 +1,43 @@
+"""Distributed environment descriptor shared by launcher and workers.
+
+Carries what the reference spreads across env vars + strategy properties
+(MASTER_ADDR/PORT broadcast at ray_launcher.py:85-87,159-175; rank
+properties at ray_ddp.py:205-257): who I am (host_rank/node_rank), how many
+of us there are, and where the coordination service lives. The TPU twist:
+one worker *process* owns several chips, so chip-level ("worker") and
+host-level (process) ranks are both represented (SURVEY.md §7 "hard parts").
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class DistEnv:
+    world_size: int = 1  # total chips == data-parallel ranks ("num_workers")
+    num_hosts: int = 1  # worker processes (one per TPU host)
+    host_rank: int = 0  # this process's rank (coordinator process_id)
+    node_rank: int = 0  # logical node index (== host_rank on 1-proc-per-node)
+    local_chips: int = 1  # chips owned by this process
+    coordinator_address: Optional[str] = None  # "ip:port" for rendezvous
+    # global chip-rank of this host's first chip; chip-ranks are contiguous
+    # per host: [first_chip_rank, first_chip_rank + local_chips)
+    first_chip_rank: int = 0
+    # host_rank -> (local_rank, node_rank) as computed by the launcher from
+    # node IPs (the reference's get_local_ranks, ray_launcher.py:130-157)
+    global_to_local: Dict[int, tuple] = field(default_factory=dict)
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def global_rank(self) -> int:
+        return self.host_rank
+
+    @property
+    def local_rank(self) -> int:
+        if self.global_to_local and self.host_rank in self.global_to_local:
+            return self.global_to_local[self.host_rank][0]
+        return 0

@@ -1,0 +1,54 @@
+"""Device mesh construction.
+
+Replaces the reference's process-group bootstrap
+(torch.distributed.init_process_group at ray_ddp.py:192-196): after
+``jax.distributed.initialize``, every process sees the global device list and
+builds the same Mesh; XLA routes collectives over ICI within a slice and DCN
+across slices based on the mesh axes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+
+def local_chip_count() -> int:
+    return len(jax.local_devices())
+
+
+def build_mesh(
+    axis_shape: Optional[Sequence[int]] = None,
+    axis_names: Tuple[str, ...] = ("data",),
+) -> Mesh:
+    """Build a Mesh over all global devices.
+
+    Default: 1-D "data" mesh over every chip (pure DP). Multi-axis shapes
+    (e.g. ``(dp, model)``) carve the same device list for DP x TP/FSDP; on
+    multi-host topologies the leading axis should span hosts so per-step DP
+    all-reduces ride ICI within a host first.
+    """
+    devices = jax.devices()
+    if axis_shape is None:
+        axis_shape = (len(devices),)
+    total = 1
+    for s in axis_shape:
+        total *= s
+    if total != len(devices):
+        raise ValueError(
+            f"mesh shape {tuple(axis_shape)} needs {total} devices, "
+            f"have {len(devices)}"
+        )
+    return jax.make_mesh(tuple(axis_shape), axis_names)
+
+
+def setup_distributed(env) -> None:
+    """Rendezvous this process with its peers (no-op single-host)."""
+    if not env.is_distributed:
+        return
+    jax.distributed.initialize(
+        coordinator_address=env.coordinator_address,
+        num_processes=env.num_hosts,
+        process_id=env.host_rank,
+    )

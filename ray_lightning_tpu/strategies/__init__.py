@@ -1,0 +1,15 @@
+"""Distributed strategies.
+
+Public surface parity with /root/reference/ray_lightning/__init__.py:1-5
+(RayStrategy, HorovodRayStrategy, RayShardedStrategy) plus the TPU-native
+names. Sharded/ring variants land with their milestones.
+"""
+from ray_lightning_tpu.strategies.base import SingleDeviceStrategy, Strategy
+from ray_lightning_tpu.strategies.ddp import RayStrategy, RayTPUStrategy
+
+__all__ = [
+    "Strategy",
+    "SingleDeviceStrategy",
+    "RayStrategy",
+    "RayTPUStrategy",
+]

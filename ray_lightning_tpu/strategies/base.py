@@ -1,0 +1,340 @@
+"""Strategy base: resource planning (driver side) + compiled execution (worker side).
+
+The reference's strategies subclass PTL Strategy classes and configure
+launchers + process groups (ray_ddp.py:23-126). Here a Strategy owns both
+sides explicitly:
+
+- driver: plan worker actors (count, resources, env) and pick the launcher —
+  the analog of ``_configure_launcher`` + resource bookkeeping
+  (ray_ddp.py:84-126);
+- worker: rendezvous (``jax.distributed.initialize`` — replacing
+  ``init_process_group``, ray_ddp.py:192-196), build the device Mesh, place
+  params/optimizer/batch with NamedShardings, and compile the train/eval
+  steps. Gradient averaging is *not* a per-parameter hook like DDP: the loss
+  is the mean over the globally-sharded batch, so XLA's SPMD partitioner
+  inserts the all-reduce into the compiled step itself.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_lightning_tpu.parallel.env import DistEnv
+
+
+@dataclass
+class WorkerPlan:
+    """Placement request for one worker actor."""
+
+    host_rank: int
+    resources: Dict[str, float]
+    env: Dict[str, str]
+    num_cpus: float = 1.0
+
+
+class Strategy:
+    """Base distributed strategy."""
+
+    strategy_name = "base"
+
+    def __init__(
+        self,
+        num_workers: int = 1,
+        num_cpus_per_worker: float = 1,
+        use_tpu: Any = "auto",
+        num_hosts: Optional[int] = None,
+        init_hook: Optional[Callable[[], None]] = None,
+        resources_per_worker: Optional[Dict[str, float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.num_workers = int(num_workers)  # chip-level DP ranks
+        self.num_cpus_per_worker = num_cpus_per_worker
+        self.use_tpu = use_tpu
+        self._num_hosts = num_hosts
+        self.init_hook = init_hook
+        self.resources_per_worker = dict(resources_per_worker or {})
+        self.extra_kwargs = kwargs
+        # Worker-side state (populated in setup_worker)
+        self.mesh = None
+        self.dist_env: Optional[DistEnv] = None
+        self._is_remote = False
+
+    # ------------------------------------------------------------------
+    # Driver side
+    # ------------------------------------------------------------------
+    def _resolve_use_tpu(self) -> bool:
+        if self.use_tpu == "auto":
+            from ray_lightning_tpu import fabric
+
+            try:
+                return fabric.cluster_resources().get("TPU", 0) >= 1
+            except Exception:  # noqa: BLE001
+                return False
+        return bool(self.use_tpu)
+
+    def _resolve_num_hosts(self, use_tpu: bool) -> int:
+        if self._num_hosts is not None:
+            if self.num_workers % self._num_hosts:
+                raise ValueError(
+                    f"num_workers={self.num_workers} not divisible by "
+                    f"num_hosts={self._num_hosts}"
+                )
+            return self._num_hosts
+        if use_tpu:
+            from ray_lightning_tpu import fabric
+
+            # One actor per TPU host; chips_per_host from the node with TPUs.
+            per_node = [
+                n["Resources"].get("TPU", 0) for n in fabric.nodes() if n["Resources"].get("TPU", 0) > 0
+            ]
+            chips_per_host = int(per_node[0]) if per_node else 1
+            if self.num_workers % chips_per_host == 0:
+                return max(1, self.num_workers // chips_per_host)
+            return self.num_workers  # fall back to 1 chip per actor
+        return 1  # CPU: one process with N virtual devices
+
+    def plan_workers(self) -> Tuple[List[WorkerPlan], bool]:
+        """Compute actor placements. Returns (plans, use_tpu)."""
+        use_tpu = self._resolve_use_tpu()
+        num_hosts = self._resolve_num_hosts(use_tpu)
+        chips_per_host = self.num_workers // num_hosts
+        plans: List[WorkerPlan] = []
+        for host_rank in range(num_hosts):
+            resources = dict(self.resources_per_worker)
+            env: Dict[str, str] = {}
+            if use_tpu:
+                resources["TPU"] = float(chips_per_host)
+            else:
+                # CPU mode: the actor simulates its chips with virtual XLA
+                # host devices (the test strategy from SURVEY.md §4).
+                env["JAX_PLATFORMS"] = "cpu"
+                flags = os.environ.get("XLA_FLAGS", "")
+                import re
+
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", "", flags
+                ).strip()
+                env["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count={chips_per_host}"
+                ).strip()
+            plans.append(
+                WorkerPlan(
+                    host_rank=host_rank,
+                    resources=resources,
+                    env=env,
+                    num_cpus=self.num_cpus_per_worker,
+                )
+            )
+        return plans, use_tpu
+
+    def _configure_launcher(self, trainer: Any):
+        from ray_lightning_tpu.launchers.tpu_launcher import TPULauncher
+
+        return TPULauncher(self, trainer)
+
+    # Rank properties, valid on the driver before launch (the reference's
+    # driver-side fallbacks, ray_horovod.py:110-141) and inside workers after
+    # setup_worker.
+    @property
+    def world_size(self) -> int:
+        return self.num_workers
+
+    @property
+    def global_rank(self) -> int:
+        return self.dist_env.host_rank if self.dist_env else 0
+
+    @property
+    def local_rank(self) -> int:
+        return self.dist_env.local_rank if self.dist_env else 0
+
+    @property
+    def node_rank(self) -> int:
+        return self.dist_env.node_rank if self.dist_env else 0
+
+    def set_remote(self, remote: bool) -> None:
+        self._is_remote = remote
+
+    # ------------------------------------------------------------------
+    # Worker side
+    # ------------------------------------------------------------------
+    def setup_worker(self, dist_env: DistEnv) -> None:
+        """Rendezvous + build the mesh. Called once inside each worker."""
+        import jax
+
+        from ray_lightning_tpu.parallel import mesh as mesh_lib
+
+        self.dist_env = dist_env
+        self._is_remote = True
+        mesh_lib.setup_distributed(dist_env)
+        n_devices = len(jax.devices())
+        if n_devices != dist_env.world_size:
+            raise RuntimeError(
+                f"strategy expected {dist_env.world_size} global devices "
+                f"(num_workers), found {n_devices}"
+            )
+        self.mesh = self.build_mesh()
+
+    def build_mesh(self):
+        from ray_lightning_tpu.parallel.mesh import build_mesh
+
+        return build_mesh(axis_names=("data",))
+
+    # -- shardings ------------------------------------------------------
+    def param_sharding(self, params: Any) -> Any:
+        """Sharding (pytree or single) for model params: replicated for DP."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    def opt_sharding(self, opt_state: Any, params: Any) -> Any:
+        """Sharding for optimizer state: replicated for plain DP."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self) -> Any:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P("data"))
+
+    def place_params(self, params: Any) -> Any:
+        import jax
+
+        sharding = self.param_sharding(params)
+        if isinstance(sharding, jax.sharding.Sharding):
+            return jax.device_put(params, sharding)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), params, sharding
+        )
+
+    def place_opt_state(self, opt_state: Any, params: Any) -> Any:
+        import jax
+
+        sharding = self.opt_sharding(opt_state, params)
+        if isinstance(sharding, jax.sharding.Sharding):
+            return jax.device_put(opt_state, sharding)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), opt_state, sharding
+        )
+
+    def make_global_batch(self, host_batch: Any) -> Any:
+        """Host-local numpy batch -> globally sharded jax.Array pytree."""
+        import jax
+
+        sharding = self.batch_sharding()
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            host_batch,
+        )
+
+    # -- compiled steps -------------------------------------------------
+    def compile_train_step(self, module: Any, tx: Any) -> Callable:
+        """Build the jitted train step.
+
+        The whole optimization step — fwd, bwd, (XLA-inserted) grad
+        all-reduce, optimizer update — is one compiled program, the TPU
+        equivalent of the reference's ★ HOT LOOP (SURVEY.md §3.1) where
+        DDP hooks overlap allreduce with backward.
+        """
+        import jax
+        import optax
+
+        def step(params, opt_state, batch, rng):
+            def loss_fn(p):
+                loss, logs = module.training_step(p, batch, rng)
+                return loss, dict(logs)
+
+            (loss, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+            params2 = optax.apply_updates(params, updates)
+            logs.setdefault("loss", loss)
+            return params2, opt_state2, logs
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def compile_eval_step(self, module: Any, stage: str) -> Callable:
+        import jax
+
+        if stage == "predict":
+
+            def pstep(params, batch):
+                return module.predict_step(params, batch)
+
+            # Replicate predictions so every host can fetch the full result.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.jit(
+                pstep, out_shardings=NamedSharding(self.mesh, P())
+            )
+
+        fn = module.validation_step if stage in ("val", "validate") else module.test_step
+
+        def estep(params, batch):
+            return dict(fn(params, batch))
+
+        return jax.jit(estep)
+
+    # -- state movement -------------------------------------------------
+    def gather_state(self, tree: Any) -> Any:
+        """Device pytree -> host numpy pytree (full, unsharded).
+
+        DP state is replicated so this is a plain device_get; sharded
+        strategies override with an all-gather (SURVEY.md §7 "checkpoint of
+        sharded state").
+        """
+        import jax
+        import numpy as np
+
+        return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+    def sampler_kwargs(self) -> Dict[str, int]:
+        """Dataset sharding is per *host process*; in-host distribution across
+        chips happens via the batch sharding (contrast with the reference's
+        per-worker-process sampler, ray_ddp.py:315-324)."""
+        env = self.dist_env
+        if env is None:
+            return {"num_replicas": 1, "rank": 0}
+        return {"num_replicas": env.num_hosts, "rank": env.host_rank}
+
+    @property
+    def batch_multiplier(self) -> int:
+        """Local chips per host: host batch = batch_size * this."""
+        env = self.dist_env
+        return env.local_chips if env else 1
+
+    def teardown_worker(self) -> None:
+        import jax
+
+        if self.dist_env is not None and self.dist_env.is_distributed:
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class SingleDeviceStrategy(Strategy):
+    """In-process strategy used when Trainer has no distributed strategy.
+
+    Runs on the default local device set (1-chip TPU or N virtual CPU
+    devices) without any launcher — the non-distributed baseline that
+    ``bench.py`` compares distributed throughput against.
+    """
+
+    strategy_name = "single_device"
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(num_workers=1, **kwargs)
+
+    def setup_worker(self, dist_env: DistEnv) -> None:
+        import jax
+
+        self.dist_env = dist_env
+        n = len(jax.local_devices())
+        dist_env.world_size = n
+        dist_env.local_chips = n
+        self.mesh = self.build_mesh()

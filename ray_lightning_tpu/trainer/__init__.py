@@ -1,0 +1,31 @@
+from ray_lightning_tpu.trainer.callbacks import (
+    Callback,
+    EarlyStopping,
+    ModelCheckpoint,
+    TPUStatsCallback,
+)
+from ray_lightning_tpu.trainer.data import (
+    ArrayDataset,
+    DataLoader,
+    Dataset,
+    DistributedSampler,
+)
+from ray_lightning_tpu.trainer.loop import TrainerSpec, TrainingLoop
+from ray_lightning_tpu.trainer.module import DataModule, TPUModule
+from ray_lightning_tpu.trainer.trainer import Trainer
+
+__all__ = [
+    "Trainer",
+    "TPUModule",
+    "DataModule",
+    "TrainerSpec",
+    "TrainingLoop",
+    "Callback",
+    "ModelCheckpoint",
+    "EarlyStopping",
+    "TPUStatsCallback",
+    "DataLoader",
+    "Dataset",
+    "ArrayDataset",
+    "DistributedSampler",
+]

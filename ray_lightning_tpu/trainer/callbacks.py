@@ -1,0 +1,250 @@
+"""Callbacks: host-side hooks at step/epoch boundaries.
+
+The reference reuses PTL callbacks (ModelCheckpoint/EarlyStopping are
+exercised by test_ddp.py:241-247,289-308); this framework defines its own,
+with the TPU-specific constraint that callbacks run *between* compiled steps
+— they can read aggregated metrics (already on host) but never reach inside
+the jitted step. Checkpoint IO is rank-0 only, mirroring the reference's
+rank-zero discipline (ray_ddp.py:169).
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class Callback:
+    def on_fit_start(self, trainer: Any, module: Any) -> None: ...
+
+    def on_train_epoch_start(self, trainer: Any, module: Any) -> None: ...
+
+    def on_train_batch_end(
+        self, trainer: Any, module: Any, logs: Dict[str, float], batch_idx: int
+    ) -> None: ...
+
+    def on_train_epoch_end(self, trainer: Any, module: Any) -> None: ...
+
+    def on_validation_end(self, trainer: Any, module: Any) -> None: ...
+
+    def on_fit_end(self, trainer: Any, module: Any) -> None: ...
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None: ...
+
+
+def _metric_value(trainer: Any, monitor: str) -> Optional[float]:
+    val = trainer.callback_metrics.get(monitor)
+    if val is None:
+        return None
+    return float(np.asarray(val))
+
+
+class ModelCheckpoint(Callback):
+    """Save the training state each validation/epoch end; track the best.
+
+    Files are state-stream checkpoints (utils/state_stream.py) containing
+    params + optimizer state + loop counters, so resume restores exactly.
+    ``best_model_path`` propagates to the driver in the worker output, like
+    the reference's (ray_launcher.py:319-321, :357-360).
+    """
+
+    def __init__(
+        self,
+        dirpath: Optional[str] = None,
+        filename: str = "epoch={epoch}-step={step}",
+        monitor: Optional[str] = None,
+        mode: str = "min",
+        save_top_k: int = 1,
+        save_last: bool = False,
+    ) -> None:
+        assert mode in ("min", "max")
+        self.dirpath = dirpath
+        self.filename = filename
+        self.monitor = monitor
+        self.mode = mode
+        self.save_top_k = save_top_k
+        self.save_last = save_last
+        self.best_model_path: str = ""
+        self.best_model_score: Optional[float] = None
+        self.last_model_path: str = ""
+        self._saved: list[tuple[float, str]] = []
+
+    def _is_better(self, score: float) -> bool:
+        if self.best_model_score is None:
+            return True
+        if self.mode == "min":
+            return score < self.best_model_score
+        return score > self.best_model_score
+
+    def on_validation_end(self, trainer: Any, module: Any) -> None:
+        self._save(trainer, module)
+
+    def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
+        # Only save here when there is no val loop (val end already saved).
+        if not trainer.has_validation:
+            self._save(trainer, module)
+
+    def _save(self, trainer: Any, module: Any) -> None:
+        if trainer.global_rank != 0 or self.save_top_k == 0:
+            return
+        dirpath = self.dirpath or os.path.join(trainer.default_root_dir, "checkpoints")
+        os.makedirs(dirpath, exist_ok=True)
+        name = self.filename.format(epoch=trainer.current_epoch, step=trainer.global_step)
+        path = os.path.join(dirpath, name + ".ckpt")
+        trainer.save_checkpoint(path)
+        score = _metric_value(trainer, self.monitor) if self.monitor else None
+        if self.monitor is None:
+            # No monitor: latest checkpoint is "best" (Lightning behavior)
+            # and the previous one is pruned so only save_top_k remain.
+            prev = self.best_model_path
+            self.best_model_path = path
+            if (
+                self.save_top_k == 1
+                and prev
+                and prev != path
+                and os.path.exists(prev)
+            ):
+                try:
+                    os.remove(prev)
+                except OSError:
+                    pass
+        elif score is not None and not math.isnan(score):
+            if self._is_better(score):
+                self.best_model_score = score
+                self.best_model_path = path
+            self._saved.append((score, path))
+            self._prune()
+        if self.save_last:
+            last = os.path.join(dirpath, "last.ckpt")
+            trainer.save_checkpoint(last)
+            self.last_model_path = last
+
+    def _prune(self) -> None:
+        if self.save_top_k < 0:
+            return
+        reverse = self.mode == "max"
+        self._saved.sort(key=lambda t: t[0], reverse=reverse)
+        while len(self._saved) > self.save_top_k:
+            _, path = self._saved.pop()
+            if path != self.best_model_path and os.path.exists(path):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "best_model_path": self.best_model_path,
+            "best_model_score": self.best_model_score,
+            "last_model_path": self.last_model_path,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.best_model_path = state.get("best_model_path", "")
+        self.best_model_score = state.get("best_model_score")
+        self.last_model_path = state.get("last_model_path", "")
+
+
+class EarlyStopping(Callback):
+    """Stop training when a monitored metric stops improving."""
+
+    def __init__(
+        self,
+        monitor: str = "val_loss",
+        patience: int = 3,
+        mode: str = "min",
+        min_delta: float = 0.0,
+    ) -> None:
+        assert mode in ("min", "max")
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.wait = 0
+        self.best: Optional[float] = None
+
+    def _improved(self, score: float) -> bool:
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return score < self.best - self.min_delta
+        return score > self.best + self.min_delta
+
+    def on_validation_end(self, trainer: Any, module: Any) -> None:
+        score = _metric_value(trainer, self.monitor)
+        if score is None or math.isnan(score):
+            return
+        if self._improved(score):
+            self.best = score
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                trainer.should_stop = True
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"wait": self.wait, "best": self.best}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.wait = state.get("wait", 0)
+        self.best = state.get("best")
+
+
+class TPUStatsCallback(Callback):
+    """Per-epoch wall time and device memory stats, averaged across hosts.
+
+    TPU-native answer to the reference's example-level ``CUDACallback``
+    (examples/ray_ddp_sharded_example.py:16-46), which measured epoch time and
+    peak CUDA memory. Uses ``device.memory_stats()`` where the PJRT backend
+    exposes it.
+    """
+
+    def __init__(self, verbose: bool = True) -> None:
+        self.verbose = verbose
+        self.epoch_times: list[float] = []
+        self.peak_memory: list[float] = []
+        self._t0 = 0.0
+
+    def on_train_epoch_start(self, trainer: Any, module: Any) -> None:
+        import time
+
+        import jax
+
+        # Drain pending async dispatches so the timer is honest.
+        jax.effects_barrier()
+        self._t0 = time.perf_counter()
+
+    def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
+        import time
+
+        import jax
+
+        jax.effects_barrier()
+        dt = time.perf_counter() - self._t0
+        self.epoch_times.append(dt)
+        peak = 0.0
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats() or {}
+                peak = max(peak, float(stats.get("peak_bytes_in_use", 0)))
+            except Exception:  # noqa: BLE001 - CPU backend has no stats
+                pass
+        self.peak_memory.append(peak)
+        if self.verbose and trainer.global_rank == 0:
+            print(
+                f"[epoch {trainer.current_epoch}] time {dt:.3f}s"
+                + (f", peak device mem {peak / 2**20:.1f} MiB" if peak else "")
+            )
+
+    def state_dict(self) -> Dict[str, Any]:
+        # Measurements ride the callback-state sync back to the driver.
+        return {"epoch_times": self.epoch_times, "peak_memory": self.peak_memory}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.epoch_times = list(state.get("epoch_times", []))
+        self.peak_memory = list(state.get("peak_memory", []))

@@ -1,0 +1,198 @@
+"""Data loading with distributed sharding semantics.
+
+The reference injects ``DistributedSampler(num_replicas=world_size,
+rank=global_rank)`` kwargs into PTL's dataloaders
+(/root/reference/ray_lightning/ray_ddp.py:315-324; behavior pinned by
+test_ddp.py:179-211: train shuffled, val/test not, correct replica/rank).
+
+TPU twist: one worker process owns several chips, so sharding happens at two
+levels — the sampler shards the *dataset* across host processes, and the
+global-batch array is sharded across *chips* by GSPMD when the loop builds a
+globally-sharded ``jax.Array`` from each host's local slice
+(``jax.make_array_from_process_local_data``). ``DataLoader.batch_size`` is
+the per-chip microbatch, matching the reference's per-worker semantics.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Dataset:
+    """Minimal map-style dataset protocol: __len__ + __getitem__."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, idx: int) -> Any:
+        raise NotImplementedError
+
+
+class ArrayDataset(Dataset):
+    """Dataset over parallel numpy arrays (features, labels, ...)."""
+
+    def __init__(self, *arrays: np.ndarray) -> None:
+        assert arrays and all(len(a) == len(arrays[0]) for a in arrays)
+        self.arrays = tuple(np.asarray(a) for a in arrays)
+
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+    def __getitem__(self, idx):
+        item = tuple(a[idx] for a in self.arrays)
+        return item if len(item) > 1 else item[0]
+
+
+class DistributedSampler:
+    """Deterministic shard of dataset indices for one replica.
+
+    Pads by wrap-around so every replica sees the same number of samples
+    (same contract as torch's DistributedSampler, which the reference relies
+    on for equal step counts across ranks).
+    """
+
+    def __init__(
+        self,
+        dataset_len: int,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ) -> None:
+        if rank >= num_replicas:
+            raise ValueError(f"rank {rank} >= num_replicas {num_replicas}")
+        self.dataset_len = dataset_len
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last and dataset_len % num_replicas:
+            self.num_samples = dataset_len // num_replicas
+        else:
+            self.num_samples = math.ceil(dataset_len / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def indices(self) -> np.ndarray:
+        if self.shuffle:
+            g = np.random.default_rng(self.seed + self.epoch)
+            idx = g.permutation(self.dataset_len)
+        else:
+            idx = np.arange(self.dataset_len)
+        if not self.drop_last and len(idx) < self.total_size:
+            extra = self.total_size - len(idx)
+            idx = np.concatenate([idx, idx[:extra]])
+        else:
+            idx = idx[: self.total_size]
+        return idx[self.rank : self.total_size : self.num_replicas]
+
+
+class DataLoader:
+    """Batching spec over a dataset.
+
+    Constructed by the user with per-chip ``batch_size``; the worker loop
+    injects distributed sampling (``use_distributed_sampler`` semantics of
+    the reference) and the per-host batch multiplier before iteration.
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset | Sequence,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+        collate_fn: Optional[Any] = None,
+    ) -> None:
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.collate_fn = collate_fn
+        # Injected by the worker loop (distributed_sampler_kwargs analog).
+        self.sampler: Optional[DistributedSampler] = None
+
+    def with_sampler(self, num_replicas: int, rank: int, seed: int) -> "DataLoader":
+        loader = DataLoader(
+            self.dataset,
+            batch_size=self.batch_size,
+            shuffle=self.shuffle,
+            drop_last=self.drop_last,
+            seed=self.seed,
+            collate_fn=self.collate_fn,
+        )
+        loader.sampler = DistributedSampler(
+            len(self.dataset),
+            num_replicas=num_replicas,
+            rank=rank,
+            shuffle=self.shuffle,
+            seed=seed,
+            drop_last=self.drop_last,
+        )
+        return loader
+
+    def set_epoch(self, epoch: int) -> None:
+        if self.sampler is not None:
+            self.sampler.set_epoch(epoch)
+
+    def _collate(self, items: list) -> Any:
+        if self.collate_fn is not None:
+            return self.collate_fn(items)
+        first = items[0]
+        if isinstance(first, tuple):
+            return tuple(
+                np.stack([np.asarray(it[j]) for it in items]) for j in range(len(first))
+            )
+        return np.stack([np.asarray(it) for it in items])
+
+    def iter_batches(self, batch_multiplier: int = 1) -> Iterator[Any]:
+        """Yield host-level batches of ``batch_size * batch_multiplier``.
+
+        ``batch_multiplier`` is the number of local chips this host feeds;
+        GSPMD then splits the array across them.
+        """
+        if self.sampler is not None:
+            idx = self.sampler.indices()
+        else:
+            if self.shuffle:
+                g = np.random.default_rng(self.seed)
+                idx = g.permutation(len(self.dataset))
+            else:
+                idx = np.arange(len(self.dataset))
+        bs = self.batch_size * batch_multiplier
+        n_full = len(idx) // bs
+        remainder = len(idx) - n_full * bs
+        for b in range(n_full):
+            sel = idx[b * bs : (b + 1) * bs]
+            yield self._collate([self.dataset[int(i)] for i in sel])
+        if remainder and not self.drop_last:
+            # Pad the tail batch by wrap-around so its leading dim stays
+            # divisible across chips (static shapes for XLA). np.resize
+            # cycles the index list, covering shards smaller than one batch.
+            sel = idx[n_full * bs :]
+            pad = np.resize(idx, bs - len(sel))
+            sel = np.concatenate([sel, pad])
+            yield self._collate([self.dataset[int(i)] for i in sel])
+
+    def num_batches(self, batch_multiplier: int = 1) -> int:
+        n = (
+            self.sampler.num_samples
+            if self.sampler is not None
+            else len(self.dataset)
+        )
+        bs = self.batch_size * batch_multiplier
+        return n // bs if self.drop_last else math.ceil(n / bs)
+
+    def __iter__(self) -> Iterator[Any]:
+        return self.iter_batches(1)
+
+    def __len__(self) -> int:
+        return self.num_batches(1)

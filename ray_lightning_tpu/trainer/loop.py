@@ -1,0 +1,407 @@
+"""The worker-side training engine.
+
+Runs inside each worker actor (or in-process for the single-device path):
+builds params/optimizer on the mesh, compiles the train/eval steps through
+the strategy, iterates epochs with host-side callbacks only at boundaries,
+and packages rank-0 results as a WorkerOutput.
+
+This replaces the role PTL's Trainer loop plays for the reference (the
+``results = function(...)`` hot loop at ray_launcher.py:297 runs PTL's whole
+fit); here the loop is framework-owned and XLA-first: one compiled step per
+batch, async dispatch, metrics fetched at epoch/log boundaries to avoid
+device->host syncs (SURVEY.md §7 "No mid-step Python").
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_lightning_tpu.launchers.utils import WorkerOutput
+from ray_lightning_tpu.utils.seed import reset_seed
+from ray_lightning_tpu.utils.state_stream import (
+    load_state_stream,
+    to_state_stream,
+)
+
+
+@dataclass
+class TrainerSpec:
+    """Picklable trainer configuration shipped driver -> workers.
+
+    The reference pickles a live PTL Trainer through ``function.__self__``
+    (ray_launcher.py:269-288) and reconciles side effects afterward; we
+    design the shipped state explicitly instead (SURVEY.md §7 hard parts).
+    """
+
+    max_epochs: int = 1
+    max_steps: Optional[int] = None
+    limit_train_batches: Optional[Any] = None  # int or float fraction
+    limit_val_batches: Optional[Any] = None
+    check_val_every_n_epoch: int = 1
+    log_every_n_steps: int = 50
+    enable_checkpointing: bool = True
+    default_root_dir: str = "."
+    seed: Optional[int] = None
+    precision: str = "fp32"
+    callbacks: List[Any] = field(default_factory=list)
+
+
+def _limit(n_batches: int, limit: Any) -> int:
+    if limit is None:
+        return n_batches
+    if isinstance(limit, float):
+        return max(1, int(n_batches * limit))
+    return min(n_batches, int(limit))
+
+
+class TrainingLoop:
+    """Executes fit/validate/test/predict for one worker process."""
+
+    def __init__(
+        self,
+        spec: TrainerSpec,
+        module: Any,
+        strategy: Any,
+        dist_env: Any,
+        tune_session: Any = None,
+        datamodule: Any = None,
+    ) -> None:
+        self.spec = spec
+        self.module = module
+        self.strategy = strategy
+        self.dist_env = dist_env
+        self.tune_session = tune_session
+        self.datamodule = datamodule
+        # Trainer-facade state visible to callbacks
+        self.current_epoch = 0
+        self.global_step = 0
+        self.should_stop = False
+        self.callback_metrics: Dict[str, Any] = {}
+        self.logged_metrics: Dict[str, Any] = {}
+        self.state: Dict[str, Any] = {"status": "initializing", "stage": None}
+        self.callbacks = list(spec.callbacks)
+        # Device state
+        self.params = None
+        self.opt_state = None
+        self._tx = None
+        self._rng = None
+        self.sanity_checking = False
+
+    # -- facade properties used by callbacks ---------------------------
+    @property
+    def global_rank(self) -> int:
+        return self.dist_env.host_rank
+
+    @property
+    def world_size(self) -> int:
+        return self.dist_env.world_size
+
+    @property
+    def default_root_dir(self) -> str:
+        return self.spec.default_root_dir
+
+    @property
+    def has_validation(self) -> bool:
+        return self._val_loader is not None
+
+    @property
+    def lightning_module(self) -> Any:  # parity-friendly alias
+        return self.module
+
+    # ------------------------------------------------------------------
+    def _call_callbacks(self, hook: str, *args: Any) -> None:
+        for cb in self.callbacks:
+            getattr(cb, hook)(self, self.module, *args)
+
+    def _setup_common(self) -> None:
+        import jax
+
+        reset_seed()
+        self.module.trainer = self
+        self.module.precision = self.spec.precision
+        seed = self.spec.seed if self.spec.seed is not None else 0
+        self._rng = jax.random.PRNGKey(seed)
+
+        source = self.module
+        if self.datamodule is not None:
+            # Per-node data prep hook, like the reference's worker-side
+            # ``prepare_data`` call (ray_launcher.py:290).
+            self.datamodule.prepare_data()
+            self.datamodule.setup()
+            source = self.datamodule
+        skw = self.strategy.sampler_kwargs()
+        try:
+            loader = source.train_dataloader()
+        except NotImplementedError:
+            loader = None
+        if loader is not None and hasattr(loader, "with_sampler"):
+            loader = loader.with_sampler(
+                num_replicas=skw["num_replicas"], rank=skw["rank"], seed=seed
+            )
+        self._train_loader = loader
+        val = source.val_dataloader()
+        if val is not None and hasattr(val, "with_sampler"):
+            # Val/test are evaluated un-shuffled (test_ddp.py:179-211
+            # semantics) and sharded the same per-host way.
+            val = val.with_sampler(
+                num_replicas=skw["num_replicas"], rank=skw["rank"], seed=seed
+            )
+        self._val_loader = val
+
+    def _init_state(self, ckpt_stream: Optional[bytes]) -> None:
+        import jax
+
+        sample_batch = next(iter(self._train_loader.iter_batches(1)))
+        init_rng, self._rng = jax.random.split(self._rng)
+        params = self.module.init_params(init_rng, sample_batch)
+        self._tx = self.module.configure_optimizers()
+        opt_state = self._tx.init(params)
+        if ckpt_stream is not None:
+            state = load_state_stream(ckpt_stream)
+            params = state["params"]
+            opt_state = state.get("opt_state", opt_state)
+            self.current_epoch = int(state.get("epoch", -1)) + 1
+            self.global_step = int(state.get("global_step", 0))
+            for cb in self.callbacks:
+                cb_state = state.get("callbacks", {}).get(type(cb).__name__)
+                if cb_state:
+                    cb.load_state_dict(cb_state)
+        self.params = self.strategy.place_params(params)
+        self.opt_state = self.strategy.place_opt_state(opt_state, params)
+
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, path: str) -> None:
+        """Gather full state and write a state-stream checkpoint (rank 0)."""
+        if self.global_rank != 0:
+            return
+        stream = to_state_stream(self.checkpoint_state())
+        from ray_lightning_tpu.utils.state_stream import state_stream_to_file
+
+        state_stream_to_file(stream, path)
+
+    def checkpoint_state(self) -> Dict[str, Any]:
+        return {
+            "params": self.strategy.gather_state(self.params),
+            "opt_state": self.strategy.gather_state(self.opt_state),
+            "epoch": self.current_epoch,
+            "global_step": self.global_step,
+            "callbacks": {
+                type(cb).__name__: cb.state_dict() for cb in self.callbacks
+            },
+        }
+
+    # ------------------------------------------------------------------
+    def run_fit(self, ckpt_stream: Optional[bytes] = None) -> Optional[WorkerOutput]:
+        import jax
+
+        self.state = {"status": "running", "stage": "fit"}
+        self._setup_common()
+        if self._train_loader is None:
+            raise RuntimeError("fit requires train_dataloader()")
+        self._init_state(ckpt_stream)
+        train_step = self.strategy.compile_train_step(self.module, self._tx)
+        val_step = (
+            self.strategy.compile_eval_step(self.module, "val")
+            if self._val_loader is not None
+            else None
+        )
+
+        self.module.on_fit_start()
+        self._call_callbacks("on_fit_start")
+        mult = self.strategy.batch_multiplier
+
+        stop = False
+        start_epoch = self.current_epoch
+        for epoch in range(start_epoch, self.spec.max_epochs):
+            if stop or self.should_stop:
+                break
+            self.current_epoch = epoch
+            self._train_loader.set_epoch(epoch)
+            self.module.on_train_epoch_start(epoch)
+            self._call_callbacks("on_train_epoch_start")
+
+            n_batches = _limit(
+                self._train_loader.num_batches(mult), self.spec.limit_train_batches
+            )
+            epoch_logs: List[Dict[str, Any]] = []
+            for batch_idx, host_batch in enumerate(
+                self._train_loader.iter_batches(mult)
+            ):
+                if batch_idx >= n_batches:
+                    break
+                batch = self.strategy.make_global_batch(host_batch)
+                step_rng = jax.random.fold_in(self._rng, self.global_step)
+                self.params, self.opt_state, logs = train_step(
+                    self.params, self.opt_state, batch, step_rng
+                )
+                epoch_logs.append(logs)  # device scalars; no sync here
+                self.global_step += 1
+                if (
+                    self.global_step % self.spec.log_every_n_steps == 0
+                    or batch_idx == n_batches - 1
+                ):
+                    host_logs = {
+                        k: float(np.asarray(v)) for k, v in logs.items()
+                    }
+                    self.logged_metrics.update(host_logs)
+                    self._call_callbacks("on_train_batch_end", host_logs, batch_idx)
+                if (
+                    self.spec.max_steps is not None
+                    and self.global_step >= self.spec.max_steps
+                ):
+                    stop = True
+                    break
+
+            # One device->host fetch for the whole epoch's train metrics.
+            if epoch_logs:
+                fetched = jax.device_get(epoch_logs)
+                keys = fetched[0].keys()
+                epoch_means = {
+                    k: float(np.mean([float(d[k]) for d in fetched])) for k in keys
+                }
+                self.callback_metrics.update(epoch_means)
+                # _step-forked keys, like PTL's `loss_step`/`loss_epoch`
+                # metric fidelity the reference asserts (test_ddp.py:326-352)
+                self.callback_metrics.update(
+                    {f"{k}_epoch": v for k, v in epoch_means.items()}
+                )
+
+            if (
+                val_step is not None
+                and (epoch + 1) % self.spec.check_val_every_n_epoch == 0
+            ):
+                self._run_eval_epoch(val_step, self._val_loader, "val")
+                self._call_callbacks("on_validation_end")
+
+            self.module.on_train_epoch_end(epoch, dict(self.callback_metrics))
+            self._call_callbacks("on_train_epoch_end")
+
+        self.state = {"status": "finished", "stage": "fit"}
+        self.module.params = self.params
+        self.module.on_fit_end()
+        self._call_callbacks("on_fit_end")
+        self.strategy.teardown_worker()
+        return self._collect_rank_zero_results(results=None)
+
+    def _run_eval_epoch(self, eval_step, loader, prefix: str) -> Dict[str, float]:
+        import jax
+
+        mult = self.strategy.batch_multiplier
+        n_batches = _limit(loader.num_batches(mult), self.spec.limit_val_batches)
+        all_logs: List[Dict[str, Any]] = []
+        for batch_idx, host_batch in enumerate(loader.iter_batches(mult)):
+            if batch_idx >= n_batches:
+                break
+            batch = self.strategy.make_global_batch(host_batch)
+            all_logs.append(eval_step(self.params, batch))
+        if not all_logs:
+            return {}
+        fetched = jax.device_get(all_logs)
+        keys = fetched[0].keys()
+        means = {k: float(np.mean([float(d[k]) for d in fetched])) for k in keys}
+        self.callback_metrics.update(means)
+        self.logged_metrics.update(means)
+        if prefix in ("val", "validate"):
+            self.module.on_validation_epoch_end(means)
+        return means
+
+    def run_evaluate(
+        self, stage: str, ckpt_stream: Optional[bytes] = None
+    ) -> Optional[WorkerOutput]:
+        self.state = {"status": "running", "stage": stage}
+        self._setup_common()
+        source = self.datamodule if self.datamodule is not None else self.module
+        loader = (
+            self._val_loader
+            if stage in ("val", "validate")
+            else source.test_dataloader()
+        )
+        if loader is not None and hasattr(loader, "with_sampler") and stage not in ("val", "validate"):
+            skw = self.strategy.sampler_kwargs()
+            loader = loader.with_sampler(
+                num_replicas=skw["num_replicas"], rank=skw["rank"], seed=0
+            )
+        if loader is None:
+            raise RuntimeError(f"{stage} requires a dataloader")
+        self._restore_or_adopt(ckpt_stream)
+        eval_step = self.strategy.compile_eval_step(self.module, stage)
+        metrics = self._run_eval_epoch(eval_step, loader, stage)
+        self.state = {"status": "finished", "stage": stage}
+        self.strategy.teardown_worker()
+        return self._collect_rank_zero_results(results=[metrics])
+
+    def run_predict(
+        self, ckpt_stream: Optional[bytes] = None
+    ) -> Optional[WorkerOutput]:
+        self.state = {"status": "running", "stage": "predict"}
+        self._setup_common()
+        source = self.datamodule if self.datamodule is not None else self.module
+        loader = source.predict_dataloader()
+        if loader is not None and hasattr(loader, "with_sampler"):
+            skw = self.strategy.sampler_kwargs()
+            loader = loader.with_sampler(
+                num_replicas=skw["num_replicas"], rank=skw["rank"], seed=0
+            )
+        if loader is None:
+            raise RuntimeError("predict requires predict_dataloader()")
+        self._restore_or_adopt(ckpt_stream)
+        predict_step = self.strategy.compile_eval_step(self.module, "predict")
+        import jax
+
+        mult = self.strategy.batch_multiplier
+        preds = []
+        for host_batch in loader.iter_batches(mult):
+            batch = self.strategy.make_global_batch(host_batch)
+            preds.append(jax.device_get(predict_step(self.params, batch)))
+        self.state = {"status": "finished", "stage": "predict"}
+        self.strategy.teardown_worker()
+        return self._collect_rank_zero_results(results=preds)
+
+    def _restore_or_adopt(self, ckpt_stream: Optional[bytes]) -> None:
+        """Load params from a checkpoint stream or adopt the module's own."""
+        if ckpt_stream is not None:
+            state = load_state_stream(ckpt_stream)
+            params = state["params"] if "params" in state else state
+        elif self.module.params is not None:
+            params = self.module.params
+        else:
+            raise RuntimeError(
+                "no parameters available: fit first, or pass ckpt_path"
+            )
+        self.params = self.strategy.place_params(params)
+
+    # ------------------------------------------------------------------
+    def _collect_rank_zero_results(self, results: Any) -> Optional[WorkerOutput]:
+        """Package rank-0 state for the driver (the reference's
+        ``_collect_rank_zero_results``, ray_launcher.py:312-349: rank!=0
+        returns None; weights go host-side as bytes; metrics cross as
+        numpy)."""
+        if self.global_rank != 0:
+            return None
+        state_stream = None
+        if self.params is not None:
+            module_state = dict(self.module.state_dict())
+            module_state["params"] = self.strategy.gather_state(self.params)
+            state_stream = to_state_stream(module_state)
+        best_model_path = None
+        callback_states: Dict[str, Any] = {}
+        for cb in self.callbacks:
+            callback_states[type(cb).__name__] = cb.state_dict()
+            if hasattr(cb, "best_model_path") and cb.best_model_path:
+                best_model_path = cb.best_model_path
+        return WorkerOutput(
+            best_model_path=best_model_path,
+            state_stream=state_stream,
+            trainer_state=dict(self.state, epoch=self.current_epoch, global_step=self.global_step),
+            results=results,
+            callback_metrics={
+                k: np.asarray(v) for k, v in self.callback_metrics.items()
+            },
+            logged_metrics={
+                k: np.asarray(v) for k, v in self.logged_metrics.items()
+            },
+            callback_states=callback_states,
+        )

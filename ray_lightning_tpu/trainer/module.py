@@ -1,0 +1,135 @@
+"""TPUModule: the user-facing model abstraction (Lightning-module analog).
+
+The reference delegates the module contract to PyTorch Lightning's
+``LightningModule``; this framework is standalone, so it defines its own —
+designed functionally for XLA: the hot-path methods (``training_step`` etc.)
+are *pure functions of (params, batch, rng)* that get traced once under jit
+and compiled for the device mesh. Host-side hooks run only at step/epoch
+boundaries, never inside the compiled step (SURVEY.md §7 "No mid-step
+Python").
+
+Test-model equivalents of the reference's fixtures (BoringModel,
+LightningMNISTClassifier, XORModel — /root/reference/ray_lightning/tests/
+utils.py:28-210) live in ``ray_lightning_tpu.models``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import jax
+
+
+class TPUModule:
+    """Base class for user models.
+
+    Required overrides:
+      - ``init_params(rng, batch) -> params``: build the initial parameter
+        pytree (e.g. ``self.model.init(rng, batch[0])`` for a flax module).
+      - ``training_step(params, batch, rng) -> (loss, logs)``: pure, traced
+        under jit. ``logs`` is a flat dict of scalar jnp arrays. The loss must
+        be the mean over the *local* batch shard; global averaging across the
+        data axis is inserted by the strategy/XLA.
+      - ``configure_optimizers() -> optax.GradientTransformation``
+      - ``train_dataloader() -> DataLoader``
+
+    Optional: ``validation_step``, ``test_step``, ``predict_step``
+    (pure), ``val_dataloader``, ``test_dataloader``, ``predict_dataloader``,
+    and host-side hooks ``on_fit_start/on_train_epoch_start/
+    on_train_epoch_end/on_validation_epoch_end/on_fit_end``.
+
+    Instances must be cloudpickle-able: they are shipped driver -> worker
+    through the fabric object store, like the reference ships the
+    LightningModule via ``ray.put`` (ray_launcher.py:232-237).
+    """
+
+    def __init__(self) -> None:
+        self.params: Any = None  # populated after fit()/restore
+        self.trainer: Any = None  # back-reference set by Trainer
+
+    # ------------------------------------------------------------------
+    # Required
+    # ------------------------------------------------------------------
+    def init_params(self, rng: jax.Array, batch: Any) -> Any:
+        raise NotImplementedError
+
+    def training_step(
+        self, params: Any, batch: Any, rng: jax.Array
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    def configure_optimizers(self) -> Any:
+        raise NotImplementedError
+
+    def train_dataloader(self) -> Any:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Optional steps (pure, jit-traced)
+    # ------------------------------------------------------------------
+    def validation_step(self, params: Any, batch: Any) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def test_step(self, params: Any, batch: Any) -> Dict[str, jax.Array]:
+        # Default: reuse the validation logic under test/ keys.
+        return self.validation_step(params, batch)
+
+    def predict_step(self, params: Any, batch: Any) -> Any:
+        raise NotImplementedError
+
+    def val_dataloader(self) -> Optional[Any]:
+        return None
+
+    def test_dataloader(self) -> Optional[Any]:
+        return None
+
+    def predict_dataloader(self) -> Optional[Any]:
+        return None
+
+    # ------------------------------------------------------------------
+    # Host-side hooks (step/epoch boundaries only)
+    # ------------------------------------------------------------------
+    def on_fit_start(self) -> None: ...
+
+    def on_fit_end(self) -> None: ...
+
+    def on_train_epoch_start(self, epoch: int) -> None: ...
+
+    def on_train_epoch_end(self, epoch: int, metrics: Dict[str, float]) -> None: ...
+
+    def on_validation_epoch_end(self, metrics: Dict[str, float]) -> None: ...
+
+    # ------------------------------------------------------------------
+    # State (mirrors state_dict/load_state_dict usage in the reference's
+    # result recovery, ray_launcher.py:362-370)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"params": self.params}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+
+
+class DataModule:
+    """Optional container bundling dataloaders (LightningDataModule analog)."""
+
+    def prepare_data(self) -> None:
+        """Called once per node before dataloaders (download datasets here).
+
+        Equivalent of the hook the reference invokes via
+        ``trainer._data_connector.prepare_data()`` in each worker
+        (ray_launcher.py:290).
+        """
+
+    def setup(self, stage: Optional[str] = None) -> None: ...
+
+    def train_dataloader(self) -> Any:
+        raise NotImplementedError
+
+    def val_dataloader(self) -> Optional[Any]:
+        return None
+
+    def test_dataloader(self) -> Optional[Any]:
+        return None
+
+    def predict_dataloader(self) -> Optional[Any]:
+        return None

@@ -1,0 +1,215 @@
+"""Driver-side Trainer: the user entrypoint.
+
+``Trainer(strategy=RayTPUStrategy(num_workers=N)).fit(module)`` reproduces
+the reference's user surface (README.md:57-62) with a standalone trainer:
+with a distributed strategy, work is launched onto fabric actors and rank-0
+results are recovered into this process (ray_launcher.py:351-379 analog);
+with no strategy, the same TrainingLoop runs in-process on the local
+devices — the baseline path.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_lightning_tpu.parallel.env import DistEnv
+from ray_lightning_tpu.strategies.base import SingleDeviceStrategy, Strategy
+from ray_lightning_tpu.trainer.loop import TrainerSpec, TrainingLoop
+from ray_lightning_tpu.utils.seed import seed_everything
+
+
+class Trainer:
+    def __init__(
+        self,
+        max_epochs: int = 1,
+        max_steps: Optional[int] = None,
+        strategy: Optional[Strategy] = None,
+        callbacks: Optional[List[Any]] = None,
+        limit_train_batches: Optional[Any] = None,
+        limit_val_batches: Optional[Any] = None,
+        check_val_every_n_epoch: int = 1,
+        log_every_n_steps: int = 50,
+        enable_checkpointing: bool = True,
+        default_root_dir: Optional[str] = None,
+        seed: Optional[int] = None,
+        precision: str = "fp32",
+    ) -> None:
+        self.max_epochs = max_epochs
+        self.max_steps = max_steps
+        self.strategy = strategy
+        self.callbacks = list(callbacks or [])
+        self.limit_train_batches = limit_train_batches
+        self.limit_val_batches = limit_val_batches
+        self.check_val_every_n_epoch = check_val_every_n_epoch
+        self.log_every_n_steps = log_every_n_steps
+        self.enable_checkpointing = enable_checkpointing
+        self.default_root_dir = default_root_dir or os.path.join(
+            tempfile.gettempdir(), "rlt_runs"
+        )
+        # Lightning semantics: enable_checkpointing adds a default
+        # ModelCheckpoint when the user supplied none; False means no
+        # implicit checkpointing (explicit callbacks still run).
+        if enable_checkpointing and not any(
+            hasattr(cb, "best_model_path") for cb in self.callbacks
+        ):
+            from ray_lightning_tpu.trainer.callbacks import ModelCheckpoint
+
+            self.callbacks.append(ModelCheckpoint())
+        self.seed = seed_everything(seed)
+        self.precision = precision
+        # Post-run state (restored from rank-0 worker output)
+        self.callback_metrics: Dict[str, Any] = {}
+        self.logged_metrics: Dict[str, Any] = {}
+        self.state: Dict[str, Any] = {"status": "initialized", "stage": None}
+        self.current_epoch = 0
+        self.global_step = 0
+        self._module: Any = None
+
+    # ------------------------------------------------------------------
+    def _make_spec(self) -> TrainerSpec:
+        return TrainerSpec(
+            max_epochs=self.max_epochs,
+            max_steps=self.max_steps,
+            limit_train_batches=self.limit_train_batches,
+            limit_val_batches=self.limit_val_batches,
+            check_val_every_n_epoch=self.check_val_every_n_epoch,
+            log_every_n_steps=self.log_every_n_steps,
+            enable_checkpointing=self.enable_checkpointing,
+            default_root_dir=self.default_root_dir,
+            seed=self.seed,
+            precision=self.precision,
+            callbacks=self.callbacks,
+        )
+
+    @property
+    def lightning_module(self) -> Any:
+        return self._module
+
+    @property
+    def checkpoint_callback(self) -> Optional[Any]:
+        for cb in self.callbacks:
+            if hasattr(cb, "best_model_path"):
+                return cb
+        return None
+
+    # ------------------------------------------------------------------
+    def _run(
+        self,
+        stage: str,
+        module: Any,
+        datamodule: Any = None,
+        ckpt_path: Optional[str] = None,
+    ) -> Any:
+        self._module = module
+        module.trainer = self
+        ckpt_stream = self._read_ckpt(ckpt_path)
+        if self.strategy is None or isinstance(self.strategy, SingleDeviceStrategy):
+            output = self._run_in_process(stage, module, datamodule, ckpt_stream)
+        else:
+            launcher = self.strategy._configure_launcher(self)
+            output = launcher.launch(
+                stage, module, datamodule=datamodule, ckpt_stream=ckpt_stream
+            )
+        return self._recover_results_in_main_process(output, module)
+
+    def _run_in_process(
+        self, stage: str, module: Any, datamodule: Any, ckpt_stream: Optional[bytes]
+    ) -> Any:
+        strategy = SingleDeviceStrategy()
+        dist_env = DistEnv()
+        strategy.setup_worker(dist_env)
+        loop = TrainingLoop(
+            self._make_spec(), module, strategy, dist_env, datamodule=datamodule
+        )
+        if stage == "fit":
+            return loop.run_fit(ckpt_stream)
+        if stage in ("validate", "test"):
+            return loop.run_evaluate(stage, ckpt_stream)
+        return loop.run_predict(ckpt_stream)
+
+    @staticmethod
+    def _read_ckpt(ckpt_path: Optional[str]) -> Optional[bytes]:
+        if ckpt_path is None:
+            return None
+        import fsspec
+
+        with fsspec.open(ckpt_path, "rb") as f:
+            return f.read()
+
+    # ------------------------------------------------------------------
+    def fit(
+        self,
+        module: Any,
+        datamodule: Any = None,
+        ckpt_path: Optional[str] = None,
+    ) -> "Trainer":
+        self._run("fit", module, datamodule, ckpt_path)
+        return self
+
+    def validate(
+        self, module: Any, datamodule: Any = None, ckpt_path: Optional[str] = None
+    ) -> List[Dict[str, float]]:
+        return self._run("validate", module, datamodule, ckpt_path)
+
+    def test(
+        self, module: Any, datamodule: Any = None, ckpt_path: Optional[str] = None
+    ) -> List[Dict[str, float]]:
+        return self._run("test", module, datamodule, ckpt_path)
+
+    def predict(
+        self, module: Any, datamodule: Any = None, ckpt_path: Optional[str] = None
+    ) -> List[Any]:
+        return self._run("predict", module, datamodule, ckpt_path)
+
+    # ------------------------------------------------------------------
+    def _recover_results_in_main_process(self, output: Any, module: Any) -> Any:
+        """Restore rank-0 worker results into this process (the reference's
+        ``_recover_results_in_main_process``, ray_launcher.py:351-379)."""
+        if output is None:
+            return None
+        if output.state_stream is not None:
+            from ray_lightning_tpu.utils.state_stream import load_state_stream
+
+            state = load_state_stream(output.state_stream)
+            module.load_state_dict(state)
+        self.state = dict(output.trainer_state)
+        self.current_epoch = int(self.state.pop("epoch", 0))
+        self.global_step = int(self.state.pop("global_step", 0))
+        # Metrics cross the boundary as numpy and are re-exposed as floats
+        # (reference re-tensorizes at ray_launcher.py:374-379).
+        self.callback_metrics = {
+            k: float(np.asarray(v)) for k, v in output.callback_metrics.items()
+        }
+        self.logged_metrics = {
+            k: float(np.asarray(v)) for k, v in output.logged_metrics.items()
+        }
+        # Sync driver-side callback objects (best_model_path etc.,
+        # ray_launcher.py:357-360).
+        for cb in self.callbacks:
+            cb_state = output.callback_states.get(type(cb).__name__)
+            if cb_state:
+                cb.load_state_dict(cb_state)
+        return output.results
+
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, path: str) -> None:
+        """Save the current module params from the driver."""
+        if self._module is None or self._module.params is None:
+            raise RuntimeError("nothing to checkpoint: fit first")
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        state = {
+            "params": self._module.params,
+            "epoch": self.current_epoch,
+            "global_step": self.global_step,
+            "callbacks": {
+                type(cb).__name__: cb.state_dict() for cb in self.callbacks
+            },
+        }
+        state_stream_to_file(to_state_stream(state), path)

@@ -1,0 +1,20 @@
+"""Hyperparameter-tuning integration (reference: ray_lightning/tune.py).
+
+Populated incrementally: session channel first (needed by the launcher);
+the Tuner/search/report callbacks land with the tune milestone.
+"""
+from ray_lightning_tpu.tune.session import (
+    get_actor_rank,
+    get_session,
+    init_session,
+    is_tune_session,
+    put_queue,
+)
+
+__all__ = [
+    "init_session",
+    "get_session",
+    "get_actor_rank",
+    "put_queue",
+    "is_tune_session",
+]

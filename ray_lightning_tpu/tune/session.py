@@ -1,0 +1,60 @@
+"""Per-worker session: the worker -> driver closure channel.
+
+Mirrors the reference's ``session.py`` (/root/reference/ray_lightning/
+session.py:6-63): a module-global singleton per worker process holding
+(rank, queue); ``put_queue(closure)`` enqueues ``(rank, closure)`` items the
+driver executes in ``_handle_queue`` (util.py:49-54). This is how mid-train
+callbacks (tune reporting/checkpointing) reach the trial driver without
+breaking the compiled step cadence.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+
+class TrainingSession:
+    def __init__(self, rank: int, queue: Any) -> None:
+        self.rank = rank
+        self.queue = queue
+
+    def put_queue(self, item: Callable[[], Any]) -> None:
+        if self.queue is None:
+            raise RuntimeError("session has no queue attached")
+        self.queue.put((self.rank, item))
+
+
+_session: Optional[TrainingSession] = None
+
+
+def init_session(rank: int, queue: Any) -> None:
+    global _session
+    _session = TrainingSession(rank=rank, queue=queue)
+
+
+def get_session() -> Optional[TrainingSession]:
+    return _session
+
+
+def clear_session() -> None:
+    global _session
+    _session = None
+
+
+def get_actor_rank() -> int:
+    sess = get_session()
+    return sess.rank if sess is not None else 0
+
+
+def put_queue(item: Callable[[], Any]) -> None:
+    sess = get_session()
+    if sess is None:
+        raise RuntimeError("put_queue called outside a worker session")
+    sess.put_queue(item)
+
+
+def is_tune_session() -> bool:
+    """True when the driver itself runs inside a Tune trial (then workers
+    need the queue channel; reference gates on this at
+    ray_launcher.py:101-103)."""
+    return os.environ.get("RLT_TUNE_SESSION") == "1"

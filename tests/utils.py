@@ -1,0 +1,57 @@
+"""Shared test helpers, mirroring the reference's tests/utils.py harness
+(get_trainer/train_test/load_test/predict_test — /root/reference/
+ray_lightning/tests/utils.py:213-272)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_lightning_tpu.trainer import Trainer
+
+
+def get_trainer(
+    strategy: Any = None,
+    max_epochs: int = 1,
+    callbacks: Optional[list] = None,
+    seed: int = 42,
+    **kwargs: Any,
+) -> Trainer:
+    return Trainer(
+        max_epochs=max_epochs,
+        strategy=strategy,
+        callbacks=callbacks,
+        enable_checkpointing=kwargs.pop("enable_checkpointing", False),
+        seed=seed,
+        **kwargs,
+    )
+
+
+def flat_norm(params: Any) -> float:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(params)
+    return float(sum(np.linalg.norm(np.asarray(l)) for l in leaves))
+
+
+def train_test(trainer: Trainer, module: Any) -> None:
+    """Fit and assert training moved the weights (reference
+    train_test asserts weight-norm delta > 0.1, tests/utils.py:236-245)."""
+    import jax
+
+    before = None
+    if module.params is not None:
+        before = flat_norm(module.params)
+    trainer.fit(module)
+    assert trainer.state["status"] == "finished"
+    after = flat_norm(module.params)
+    if before is not None:
+        assert abs(after - before) > 1e-3
+    assert np.isfinite(after)
+
+
+def predict_test(trainer: Trainer, module: Any, min_acc: float = 0.5) -> None:
+    """Fit then check accuracy >= bound (reference tests/utils.py:256-272)."""
+    trainer.fit(module)
+    acc = trainer.callback_metrics.get("ptl/val_accuracy")
+    assert acc is not None and acc >= min_acc, f"accuracy {acc} < {min_acc}"
