@@ -85,6 +85,8 @@ def main() -> None:
     from ray_lightning_tpu import fabric
     from ray_lightning_tpu.strategies import RayTPUStrategy
 
+    # fabric.init probes TPU capacity in a short-lived subprocess; the driver
+    # itself never initializes the TPU runtime (workers own the chips).
     fabric.init()
     use_tpu = fabric.cluster_resources().get("TPU", 0) >= 1
     num_workers = max(1, int(fabric.cluster_resources().get("TPU", 0))) if use_tpu else 1

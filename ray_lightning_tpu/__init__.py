@@ -14,6 +14,9 @@ _LAZY = {
     "fabric": "ray_lightning_tpu",
     "RayStrategy": "ray_lightning_tpu.strategies",
     "RayTPUStrategy": "ray_lightning_tpu.strategies",
+    "RayShardedStrategy": "ray_lightning_tpu.strategies",
+    "RingTPUStrategy": "ray_lightning_tpu.strategies",
+    "HorovodRayStrategy": "ray_lightning_tpu.strategies",
     "Trainer": "ray_lightning_tpu.trainer",
     "TPUModule": "ray_lightning_tpu.trainer",
 }

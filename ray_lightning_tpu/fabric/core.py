@@ -75,17 +75,52 @@ def _detect_local_capacity() -> Dict[str, float]:
     env_chips = os.environ.get("RLT_NUM_TPU_CHIPS")
     if env_chips is not None:
         cap["TPU"] = float(env_chips)
-    else:
-        import sys
+        return cap
+    # Probe an already-initialized backend for free...
+    import sys
 
-        jax_mod = sys.modules.get("jax")
-        if jax_mod is not None:
-            try:
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if _xb.backends_are_initialized():
                 cap["TPU"] = float(
                     len([d for d in jax_mod.devices() if d.platform == "tpu"])
                 )
-            except Exception:  # noqa: BLE001 - no backend on driver is fine
-                pass
+                return cap
+        except Exception:  # noqa: BLE001
+            pass
+    # ...otherwise count chips in a short-lived subprocess: initializing the
+    # TPU runtime in the *driver* would hold the host's chips for the whole
+    # process lifetime (libtpu is exclusive), starving the worker actors —
+    # and can hang outright if the device service is wedged, hence the
+    # timeout. Set RLT_NUM_TPU_CHIPS=0 to skip the probe entirely.
+    try:
+        import subprocess
+        import sys as _sys
+
+        out = subprocess.run(
+            [
+                _sys.executable,
+                "-c",
+                "import jax; print(len([d for d in jax.devices() if d.platform=='tpu']))",
+            ],
+            capture_output=True,
+            timeout=90,
+            text=True,
+        )
+        chips = int(out.stdout.strip().splitlines()[-1]) if out.returncode == 0 else 0
+        if chips:
+            cap["TPU"] = float(chips)
+    except Exception:  # noqa: BLE001 - probe failure means no TPUs visible
+        import warnings
+
+        warnings.warn(
+            "TPU probe subprocess failed or timed out; assuming no TPU chips. "
+            "Set RLT_NUM_TPU_CHIPS to override.",
+            stacklevel=2,
+        )
     return cap
 
 
@@ -494,7 +529,10 @@ class ActorHandle:
         self._process.join(timeout=0.1 if force else 5.0)
         if self._process.is_alive():
             self._process.terminate()
-            self._process.join(timeout=2.0)
+            # Generous grace: SIGTERM triggers the worker's atexit teardown,
+            # which may itself be shutting down nested actors; SIGKILL too
+            # early would orphan them.
+            self._process.join(timeout=15.0)
             if self._process.is_alive():
                 self._process.kill()
                 self._process.join(timeout=2.0)

@@ -21,13 +21,22 @@ class Queue:
         self._closed = False
 
     def put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> None:
-        self._q.put(item, block, timeout)
+        # cloudpickle framing: Manager queues use plain pickle internally,
+        # which rejects the closures/lambdas this channel exists to carry
+        # (tune report closures, session.py contract).
+        import cloudpickle
+
+        self._q.put(cloudpickle.dumps(item), block, timeout)
 
     def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
-        return self._q.get(block, timeout)
+        import cloudpickle
+
+        return cloudpickle.loads(self._q.get(block, timeout))
 
     def get_nowait(self) -> Any:
-        return self._q.get_nowait()
+        import cloudpickle
+
+        return cloudpickle.loads(self._q.get_nowait())
 
     def empty(self) -> bool:
         try:

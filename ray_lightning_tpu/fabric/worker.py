@@ -19,6 +19,13 @@ import traceback
 
 def _worker_main(conn, env_overrides, node_info):
     """Run the actor loop. ``conn`` is the child end of a duplex Pipe."""
+    import signal
+
+    # SIGTERM (e.g. a tuner killing a trial actor) must run atexit so this
+    # process's own fabric session shuts down any nested actors it spawned
+    # (a trial's training workers) instead of orphaning them.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+
     for key, value in env_overrides.items():
         if value is None:
             os.environ.pop(key, None)
@@ -72,6 +79,11 @@ def _worker_main(conn, env_overrides, node_info):
                         raise RuntimeError("actor not initialized")
                     result = getattr(actor, name)(*args, **kwargs)
                     payload = cloudpickle.dumps(("result", call_id, True, result))
+                except (SystemExit, KeyboardInterrupt):
+                    # SIGTERM's sys.exit must propagate so the process exits
+                    # promptly (running atexit -> nested-actor cleanup)
+                    # instead of being reported as a call failure.
+                    raise
                 except BaseException as exc:  # noqa: BLE001 - ship to driver
                     payload = cloudpickle.dumps(
                         ("result", call_id, False, _exc_payload(exc))

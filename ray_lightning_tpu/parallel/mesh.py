@@ -28,6 +28,11 @@ def build_mesh(
     (e.g. ``(dp, model)``) carve the same device list for DP x TP/FSDP; on
     multi-host topologies the leading axis should span hosts so per-step DP
     all-reduces ride ICI within a host first.
+
+    Axes are ``Auto`` (GSPMD propagation): the strategies annotate inputs
+    with NamedShardings and let the partitioner infer the rest — newer JAX
+    defaults to ``Explicit`` sharding-in-types, which rejects the
+    ZeRO-style mixed shardings these strategies rely on.
     """
     devices = jax.devices()
     if axis_shape is None:
@@ -40,7 +45,8 @@ def build_mesh(
             f"mesh shape {tuple(axis_shape)} needs {total} devices, "
             f"have {len(devices)}"
         )
-    return jax.make_mesh(tuple(axis_shape), axis_names)
+    axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
+    return jax.make_mesh(tuple(axis_shape), axis_names, axis_types=axis_types)
 
 
 def setup_distributed(env) -> None:

@@ -1,0 +1,69 @@
+"""ZeRO-style sharding rules for parameter/optimizer pytrees.
+
+The TPU-native replacement for FairScale's OSS/ShardedDDP, which the
+reference inherits through PTL's ``DDPSpawnShardedStrategy``
+(/root/reference/ray_lightning/ray_ddp_sharded.py:1-13): instead of a
+C++/CUDA sharded optimizer, state is partitioned by GSPMD — each leaf is
+annotated with a ``NamedSharding`` that splits its largest divisible axis
+across the mesh's "data" axis, and XLA materializes the ZeRO gather/scatter
+communication inside the compiled step.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_spec_for(shape, axis_size: int, axis_name: str = "data") -> P:
+    """PartitionSpec splitting the largest axis divisible by ``axis_size``.
+
+    Leaves too small (or with no divisible axis) stay replicated — the same
+    pragmatic rule ZeRO implementations use to avoid padding overheads.
+    """
+    if not shape:
+        return P()
+    best_dim: Optional[int] = None
+    best_size = 0
+    for dim, size in enumerate(shape):
+        if size % axis_size == 0 and size > best_size and size >= axis_size:
+            best_dim = dim
+            best_size = size
+    if best_dim is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best_dim] = axis_name
+    return P(*spec)
+
+
+def tree_shardings(
+    tree: Any, mesh: Mesh, axis_name: str = "data"
+) -> Any:
+    """Pytree of NamedShardings mirroring ``tree``'s structure."""
+    axis_size = mesh.shape[axis_name]
+
+    def leaf_sharding(leaf: Any) -> NamedSharding:
+        shape = np.shape(leaf)
+        return NamedSharding(mesh, shard_spec_for(shape, axis_size, axis_name))
+
+    return jax.tree_util.tree_map(leaf_sharding, tree)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded_bytes_fraction(tree: Any, shardings: Any) -> float:
+    """Fraction of the tree's bytes that got sharded (diagnostics/tests)."""
+    total = 0
+    sharded = 0
+    for leaf, sh in zip(
+        jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(shardings)
+    ):
+        n = int(np.prod(np.shape(leaf) or (1,)))
+        total += n
+        if isinstance(sh, NamedSharding) and sh.spec != P():
+            sharded += n
+    return sharded / total if total else 0.0

@@ -6,10 +6,15 @@ names. Sharded/ring variants land with their milestones.
 """
 from ray_lightning_tpu.strategies.base import SingleDeviceStrategy, Strategy
 from ray_lightning_tpu.strategies.ddp import RayStrategy, RayTPUStrategy
+from ray_lightning_tpu.strategies.ring import HorovodRayStrategy, RingTPUStrategy
+from ray_lightning_tpu.strategies.sharded import RayShardedStrategy
 
 __all__ = [
     "Strategy",
     "SingleDeviceStrategy",
     "RayStrategy",
     "RayTPUStrategy",
+    "RayShardedStrategy",
+    "RingTPUStrategy",
+    "HorovodRayStrategy",
 ]

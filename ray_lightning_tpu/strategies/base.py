@@ -202,25 +202,32 @@ class Strategy:
 
         return NamedSharding(self.mesh, P("data"))
 
-    def place_params(self, params: Any) -> Any:
-        import jax
+    @staticmethod
+    def _place_tree(tree: Any, sharding: Any) -> Any:
+        """device_put a pytree without aliasing caller-held buffers.
 
-        sharding = self.param_sharding(params)
+        Placed arrays are donated by the compiled step; device_put can reuse
+        the source buffer even with may_alias=False (observed on the CPU
+        backend), which would delete the caller's arrays on donation. A host
+        round-trip guarantees fresh device buffers; placement happens once
+        per run so the copy cost is setup-only.
+        """
+        import jax
+        import numpy as np
+
+        def place(x, s):
+            host = x if isinstance(x, np.ndarray) else np.asarray(jax.device_get(x))
+            return jax.device_put(host, s)
+
         if isinstance(sharding, jax.sharding.Sharding):
-            return jax.device_put(params, sharding)
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, s), params, sharding
-        )
+            return jax.tree_util.tree_map(lambda x: place(x, sharding), tree)
+        return jax.tree_util.tree_map(place, tree, sharding)
+
+    def place_params(self, params: Any) -> Any:
+        return self._place_tree(params, self.param_sharding(params))
 
     def place_opt_state(self, opt_state: Any, params: Any) -> Any:
-        import jax
-
-        sharding = self.opt_sharding(opt_state, params)
-        if isinstance(sharding, jax.sharding.Sharding):
-            return jax.device_put(opt_state, sharding)
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, s), opt_state, sharding
-        )
+        return self._place_tree(opt_state, self.opt_sharding(opt_state, params))
 
     def make_global_batch(self, host_batch: Any) -> Any:
         """Host-local numpy batch -> globally sharded jax.Array pytree."""

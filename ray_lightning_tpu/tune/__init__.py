@@ -1,20 +1,58 @@
-"""Hyperparameter-tuning integration (reference: ray_lightning/tune.py).
+"""Hyperparameter-tuning integration.
 
-Populated incrementally: session channel first (needed by the launcher);
-the Tuner/search/report callbacks land with the tune milestone.
+Feature parity with the reference's tune module
+(/root/reference/ray_lightning/tune.py): ``get_tune_resources``,
+``TuneReportCallback``, ``TuneReportCheckpointCallback``, plus — because
+this framework owns its process fabric instead of depending on ray.tune — a
+from-scratch trial runner (``Tuner``/``run``) with grid/random search and an
+ASHA early-stopping scheduler.
 """
+from ray_lightning_tpu.tune.callbacks import (
+    TuneReportCallback,
+    TuneReportCheckpointCallback,
+    _TuneCheckpointCallback,
+)
+from ray_lightning_tpu.tune.search import choice, grid_search, loguniform, uniform
 from ray_lightning_tpu.tune.session import (
     get_actor_rank,
     get_session,
+    get_trial_dir,
+    get_trial_session,
     init_session,
+    init_trial_session,
     is_tune_session,
     put_queue,
+    report,
+)
+from ray_lightning_tpu.tune.tuner import (
+    ASHAScheduler,
+    Result,
+    ResultGrid,
+    Tuner,
+    get_tune_resources,
+    run,
 )
 
 __all__ = [
+    "Tuner",
+    "run",
+    "ResultGrid",
+    "Result",
+    "ASHAScheduler",
+    "get_tune_resources",
+    "TuneReportCallback",
+    "TuneReportCheckpointCallback",
+    "grid_search",
+    "choice",
+    "uniform",
+    "loguniform",
+    "report",
     "init_session",
     "get_session",
     "get_actor_rank",
     "put_queue",
     "is_tune_session",
+    "init_trial_session",
+    "get_trial_session",
+    "get_trial_dir",
 ]

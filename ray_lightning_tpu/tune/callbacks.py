@@ -1,0 +1,159 @@
+"""Tune report/checkpoint callbacks.
+
+Parity targets (/root/reference/ray_lightning/tune.py):
+- ``TuneReportCallback`` (:58-134): rank-0 only, ships a ``tune.report``
+  closure through the worker->driver queue at a chosen hook.
+- ``_TuneCheckpointCallback`` (:136-178): dumps the full checkpoint to
+  bytes in the worker, writes it driver-side under the trial dir via fsspec.
+- ``TuneReportCheckpointCallback`` (:180-236): composition of both.
+
+TPU-shaped details: metrics are already host floats at hook time (the loop
+fetches them at epoch boundaries), so shipping them costs no extra device
+sync; checkpoint bytes are the state-stream format.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Union
+
+from ray_lightning_tpu.trainer.callbacks import Callback
+from ray_lightning_tpu.tune import session as tune_session
+
+
+def _resolve_metrics(
+    metrics: Union[None, str, List[str], Dict[str, str]],
+    available: Dict[str, float],
+) -> Dict[str, float]:
+    if metrics is None:
+        return dict(available)
+    if isinstance(metrics, str):
+        metrics = [metrics]
+    if isinstance(metrics, list):
+        return {m: available[m] for m in metrics if m in available}
+    return {new: available[old] for new, old in metrics.items() if old in available}
+
+
+class TuneCallback(Callback):
+    """Base: fires on a configured hook, rank 0 only."""
+
+    def __init__(self, on: str = "validation_end") -> None:
+        valid = ("validation_end", "train_epoch_end", "fit_end")
+        if on not in valid:
+            raise ValueError(f"on must be one of {valid}")
+        self._on = on
+
+    def on_validation_end(self, trainer: Any, module: Any) -> None:
+        if self._on == "validation_end":
+            self._maybe_handle(trainer, module)
+
+    def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
+        if self._on == "train_epoch_end":
+            self._maybe_handle(trainer, module)
+
+    def on_fit_end(self, trainer: Any, module: Any) -> None:
+        if self._on == "fit_end":
+            self._maybe_handle(trainer, module)
+
+    def _maybe_handle(self, trainer: Any, module: Any) -> None:
+        if trainer.global_rank != 0:
+            return
+        if getattr(trainer, "sanity_checking", False):
+            # Skip the pre-train sanity check (reference tune.py:113-114).
+            return
+        self._handle(trainer, module)
+
+    def _handle(self, trainer: Any, module: Any) -> None:
+        raise NotImplementedError
+
+
+class TuneReportCallback(TuneCallback):
+    """Ship current metrics to the tuner at the configured hook."""
+
+    def __init__(
+        self,
+        metrics: Union[None, str, List[str], Dict[str, str]] = None,
+        on: str = "validation_end",
+    ) -> None:
+        super().__init__(on=on)
+        self._metrics = metrics
+
+    def _handle(self, trainer: Any, module: Any) -> None:
+        report = _resolve_metrics(self._metrics, dict(trainer.callback_metrics))
+        if not report:
+            return
+        # Closure crosses the worker->driver queue and runs in the trial
+        # driver (reference tune.py:130-134 pattern), or runs directly for
+        # in-process fits.
+        _dispatch(lambda: tune_session.report(metrics=report))
+
+
+def _dispatch(closure: Any) -> None:
+    """Run ``closure`` in the trial driver: via the worker queue when inside
+    a launched worker, directly when the fit is in-process in the trial."""
+    worker_session = tune_session.get_session()
+    if worker_session is not None and worker_session.queue is not None:
+        worker_session.put_queue(closure)
+    elif tune_session.get_trial_session() is not None:
+        closure()
+
+
+def _checkpoint_closure(stream: bytes, step: int, filename: str):
+    """Build the trial-driver-side closure that writes checkpoint bytes under
+    the trial dir (single source of truth for the checkpoint layout)."""
+
+    def write_checkpoint() -> str:
+        from ray_lightning_tpu.utils.state_stream import state_stream_to_file
+
+        trial_dir = tune_session.get_trial_dir() or "."
+        ckpt_dir = os.path.join(trial_dir, f"checkpoint_{step:06d}")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        path = os.path.join(ckpt_dir, filename)
+        state_stream_to_file(stream, path)
+        return path
+
+    return write_checkpoint
+
+
+class _TuneCheckpointCallback(TuneCallback):
+    """Dump a full checkpoint and deliver it into the trial dir."""
+
+    def __init__(self, filename: str = "checkpoint.ckpt", on: str = "validation_end") -> None:
+        super().__init__(on=on)
+        self._filename = filename
+
+    def _handle(self, trainer: Any, module: Any) -> None:
+        from ray_lightning_tpu.utils.state_stream import to_state_stream
+
+        stream = to_state_stream(trainer.checkpoint_state())
+        _dispatch(_checkpoint_closure(stream, trainer.global_step, self._filename))
+
+
+class TuneReportCheckpointCallback(TuneCallback):
+    """Checkpoint then report, as one atomic hook (reference tune.py:180-236
+    notes checkpointing must precede the report)."""
+
+    def __init__(
+        self,
+        metrics: Union[None, str, List[str], Dict[str, str]] = None,
+        filename: str = "checkpoint.ckpt",
+        on: str = "validation_end",
+    ) -> None:
+        super().__init__(on=on)
+        self._metrics = metrics
+        self._filename = filename
+
+    def _handle(self, trainer: Any, module: Any) -> None:
+        report = _resolve_metrics(self._metrics, dict(trainer.callback_metrics))
+        from ray_lightning_tpu.utils.state_stream import to_state_stream
+
+        stream = to_state_stream(trainer.checkpoint_state())
+        write_checkpoint = _checkpoint_closure(
+            stream, trainer.global_step, self._filename
+        )
+
+        def checkpoint_and_report() -> None:
+            path = write_checkpoint()
+            if report:
+                tune_session.report(metrics=report, checkpoint_path=path)
+
+        _dispatch(checkpoint_and_report)

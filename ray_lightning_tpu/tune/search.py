@@ -1,0 +1,87 @@
+"""Search-space primitives and samplers for the tuner.
+
+The reference delegates search to ray.tune (grid_search/choice/uniform in
+examples, e.g. examples/ray_ddp_tune.py); these are from-scratch
+equivalents sufficient for the same example/test surface.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Sequence
+
+
+@dataclass(frozen=True)
+class GridSearch:
+    values: Sequence[Any]
+
+
+@dataclass(frozen=True)
+class Choice:
+    values: Sequence[Any]
+
+
+@dataclass(frozen=True)
+class Uniform:
+    low: float
+    high: float
+
+
+@dataclass(frozen=True)
+class LogUniform:
+    low: float
+    high: float
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(tuple(values))
+
+
+def choice(values: Sequence[Any]) -> Choice:
+    return Choice(tuple(values))
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def generate_configs(
+    param_space: Dict[str, Any], num_samples: int = 1, seed: int = 0
+) -> List[Dict[str, Any]]:
+    """Expand the space: full cross-product of grid axes x num_samples draws
+    of stochastic axes (ray.tune semantics)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
+    grid_values = [list(param_space[k].values) for k in grid_keys]
+    combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+
+    def sample_stochastic() -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for key, v in param_space.items():
+            if isinstance(v, GridSearch):
+                continue
+            if isinstance(v, Choice):
+                out[key] = rng.choice(list(v.values))
+            elif isinstance(v, Uniform):
+                out[key] = rng.uniform(v.low, v.high)
+            elif isinstance(v, LogUniform):
+                out[key] = math.exp(
+                    rng.uniform(math.log(v.low), math.log(v.high))
+                )
+            else:
+                out[key] = v  # constant
+        return out
+
+    configs: List[Dict[str, Any]] = []
+    for _ in range(max(1, num_samples)):
+        for combo in combos:
+            cfg = sample_stochastic()
+            cfg.update(dict(zip(grid_keys, combo)))
+            configs.append(cfg)
+    return configs

@@ -58,3 +58,63 @@ def is_tune_session() -> bool:
     need the queue channel; reference gates on this at
     ray_launcher.py:101-103)."""
     return os.environ.get("RLT_TUNE_SESSION") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Trial session: lives in the *trial driver* process (the actor the tuner
+# spawned). ``report()`` forwards metrics to the tuner's results queue —
+# the function worker-shipped closures ultimately call, equivalent to
+# ``tune.report`` reaching Ray Tune in the reference (tune.py:130-134).
+# ---------------------------------------------------------------------------
+class TrialSession:
+    def __init__(self, trial_id: str, trial_dir: str, results_queue: Any) -> None:
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self.results_queue = results_queue
+        self.iteration = 0
+
+    def report(self, metrics: dict, checkpoint_path: Optional[str] = None) -> None:
+        self.iteration += 1
+        self.results_queue.put(
+            {
+                "trial_id": self.trial_id,
+                "iteration": self.iteration,
+                "metrics": dict(metrics),
+                "checkpoint_path": checkpoint_path,
+            }
+        )
+
+
+_trial_session: Optional[TrialSession] = None
+
+
+def init_trial_session(trial_id: str, trial_dir: str, results_queue: Any) -> None:
+    global _trial_session
+    _trial_session = TrialSession(trial_id, trial_dir, results_queue)
+    os.environ["RLT_TUNE_SESSION"] = "1"
+
+
+def get_trial_session() -> Optional[TrialSession]:
+    return _trial_session
+
+
+def clear_trial_session() -> None:
+    global _trial_session
+    _trial_session = None
+    os.environ.pop("RLT_TUNE_SESSION", None)
+
+
+def report(metrics: Optional[dict] = None, checkpoint_path: Optional[str] = None, **kw: Any) -> None:
+    """Report trial metrics (``tune.report`` analog). Callable from the
+    trial driver; worker-side callbacks ship closures that call this."""
+    sess = get_trial_session()
+    if sess is None:
+        raise RuntimeError("tune.report() called outside a trial session")
+    merged = dict(metrics or {})
+    merged.update(kw)
+    sess.report(merged, checkpoint_path=checkpoint_path)
+
+
+def get_trial_dir() -> Optional[str]:
+    sess = get_trial_session()
+    return sess.trial_dir if sess is not None else None

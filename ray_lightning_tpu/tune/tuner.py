@@ -1,0 +1,352 @@
+"""The trial runner: a from-scratch mini-Tune on the fabric.
+
+The reference nests N-worker distributed fits inside ray.tune trials
+(SURVEY.md §3.3); since this framework owns its process fabric, it also owns
+the trial layer: each trial is a fabric actor running the user's
+``train_fn(config)`` (which typically builds a Trainer + strategy, spawning
+its *own* nested worker actors), reporting metrics back to the tuner through
+a results queue. An ASHA-style scheduler can terminate underperforming
+trials early by killing the trial actor — the same mechanism ray.tune uses
+(trial process termination), made safe by the fabric's SIGTERM cleanup of
+nested actors.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_lightning_tpu import fabric
+from ray_lightning_tpu.tune.search import generate_configs
+
+
+def get_tune_resources(
+    num_workers: int = 1,
+    num_cpus_per_worker: float = 1,
+    use_tpu: bool = False,
+    chips_per_worker: float = 1,
+) -> Dict[str, float]:
+    """Resource request for ONE trial: 1 CPU for the trial driver + the
+    training workers' bundle (reference ``get_tune_resources`` builds the
+    same [{CPU:1}] + N x {CPU, GPU} PlacementGroupFactory, tune.py:32-56)."""
+    total = {"CPU": 1.0 + num_workers * num_cpus_per_worker}
+    if use_tpu:
+        total["TPU"] = num_workers * chips_per_worker
+    return total
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    trial_dir: str
+    status: str = "pending"  # pending/running/terminated/stopped/errored
+    iterations: int = 0
+    last_metrics: Dict[str, float] = field(default_factory=dict)
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    actor: Any = None
+    future: Any = None
+
+
+@dataclass
+class Result:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, float]
+    checkpoint_path: Optional[str]
+    history: List[Dict[str, Any]]
+    error: Optional[str]
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result]) -> None:
+        self._results = results
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def get_best_result(self, metric: str, mode: str = "min") -> Result:
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return min(scored, key=key) if mode == "min" else max(scored, key=key)
+
+    @property
+    def errors(self) -> List[Result]:
+        return [r for r in self._results if r.error]
+
+
+class ASHAScheduler:
+    """Async successive halving: at each rung, keep the top 1/eta of trials
+    by the monitored metric and kill the rest."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "min",
+        grace_period: int = 1,
+        reduction_factor: int = 2,
+        max_t: Optional[int] = None,
+    ) -> None:
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = max(1, grace_period)
+        self.eta = max(2, reduction_factor)
+        self.max_t = max_t
+        self._rungs: Dict[int, List[float]] = {}
+
+    def _rung_for(self, iteration: int) -> Optional[int]:
+        rung = self.grace_period
+        while rung <= iteration:
+            if rung == iteration:
+                return rung
+            rung *= self.eta
+        return None
+
+    def on_report(self, trial: Trial, iteration: int, metrics: Dict[str, float]) -> str:
+        """Returns "continue" or "stop"."""
+        if self.max_t is not None and iteration >= self.max_t:
+            return "stop"
+        value = metrics.get(self.metric)
+        if value is None:
+            return "continue"
+        rung = self._rung_for(iteration)
+        if rung is None:
+            return "continue"
+        peers = self._rungs.setdefault(rung, [])
+        peers.append(float(value))
+        if len(peers) < self.eta:
+            return "continue"
+        ordered = sorted(peers, reverse=(self.mode == "max"))
+        cutoff = ordered[max(0, len(peers) // self.eta - 1)]
+        good = value <= cutoff if self.mode == "min" else value >= cutoff
+        return "continue" if good else "stop"
+
+
+def _trial_entry(train_fn: Callable, config: Dict[str, Any], trial_id: str,
+                 trial_dir: str, results_queue: Any) -> Dict[str, Any]:
+    """Runs inside the trial actor process."""
+    from ray_lightning_tpu.tune import session as tune_session
+
+    os.makedirs(trial_dir, exist_ok=True)
+    tune_session.init_trial_session(trial_id, trial_dir, results_queue)
+    try:
+        train_fn(config)
+        return {"status": "terminated"}
+    finally:
+        # The trial's own nested fabric session (training workers) dies with
+        # this process's atexit; nothing else to clean here.
+        tune_session.clear_trial_session()
+
+
+class Tuner:
+    """Run trials over a search space with bounded concurrency.
+
+    usage:
+        tuner = Tuner(train_fn, param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+                      num_samples=4, resources_per_trial=get_tune_resources(2),
+                      scheduler=ASHAScheduler("val_loss"))
+        results = tuner.fit()
+    """
+
+    def __init__(
+        self,
+        train_fn: Callable[[Dict[str, Any]], None],
+        param_space: Dict[str, Any],
+        num_samples: int = 1,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        scheduler: Optional[ASHAScheduler] = None,
+        max_concurrent: Optional[int] = None,
+        experiment_dir: Optional[str] = None,
+        seed: int = 0,
+    ) -> None:
+        self.train_fn = train_fn
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.resources_per_trial = resources_per_trial or {"CPU": 1.0}
+        self.scheduler = scheduler
+        self.max_concurrent = max_concurrent
+        self.experiment_dir = experiment_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"rlt_tune_{uuid.uuid4().hex[:6]}"
+        )
+        self.seed = seed
+
+    # -- scheduling ----------------------------------------------------
+    def _can_launch(self, running: List[Trial]) -> bool:
+        if self.max_concurrent is not None and len(running) >= self.max_concurrent:
+            return False
+        avail = fabric.available_resources()
+        need = self.resources_per_trial
+        return all(avail.get(k, 0.0) >= v for k, v in need.items())
+
+    def _launch(self, trial: Trial, results_queue: Any) -> None:
+        from ray_lightning_tpu.launchers.utils import TrainWorker
+
+        # The trial actor reserves the FULL trial bundle (driver CPU + its
+        # nested training workers' resources) in the tuner's pool — the
+        # placement-group-per-trial model of the reference (tune.py:50-55).
+        # Nested workers are spawned from the trial process's own fabric
+        # session and do not draw from this pool, so reserving the bundle
+        # here is what bounds trial concurrency.
+        bundle = dict(self.resources_per_trial)
+        num_cpus = bundle.pop("CPU", 1.0)
+        trial.actor = (
+            fabric.remote(TrainWorker)
+            .options(
+                num_cpus=num_cpus,
+                resources=bundle,
+                env={"RLT_TUNE_SESSION": "1"},
+            )
+            .remote()
+        )
+        trial.future = trial.actor.execute.remote(
+            _trial_entry,
+            self.train_fn,
+            trial.config,
+            trial.trial_id,
+            trial.trial_dir,
+            results_queue,
+        )
+        trial.status = "running"
+
+    def _drain_reports(self, trials: Dict[str, Trial], results_queue: Any) -> None:
+        while not results_queue.empty():
+            try:
+                item = results_queue.get_nowait()
+            except Exception:  # noqa: BLE001
+                return
+            trial = trials.get(item["trial_id"])
+            if trial is None:
+                continue
+            trial.iterations = item["iteration"]
+            trial.last_metrics = dict(item["metrics"])
+            trial.history.append(
+                {"iteration": item["iteration"], **item["metrics"]}
+            )
+            if item.get("checkpoint_path"):
+                trial.checkpoint_path = item["checkpoint_path"]
+            if self.scheduler and trial.status == "running":
+                decision = self.scheduler.on_report(
+                    trial, item["iteration"], item["metrics"]
+                )
+                if decision == "stop":
+                    self._stop_trial(trial)
+
+    def _stop_trial(self, trial: Trial) -> None:
+        trial.status = "stopped"
+        if trial.actor is not None:
+            try:
+                fabric.kill(trial.actor)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- main loop -----------------------------------------------------
+    def fit(self) -> ResultGrid:
+        if not fabric.is_initialized():
+            fabric.init()
+        # Fail fast if the per-trial bundle can never fit the cluster, so the
+        # scheduler loop can't spin forever with nothing launchable.
+        total = fabric.cluster_resources()
+        impossible = {
+            k: v for k, v in self.resources_per_trial.items() if total.get(k, 0.0) < v
+        }
+        if impossible:
+            raise fabric.InsufficientResourcesError(
+                f"resources_per_trial {self.resources_per_trial} can never be "
+                f"satisfied: cluster total is {total} (short on {impossible})"
+            )
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        configs = generate_configs(self.param_space, self.num_samples, self.seed)
+        results_queue = fabric.Queue()
+        trials: Dict[str, Trial] = {}
+        for i, config in enumerate(configs):
+            trial_id = f"trial_{i:04d}"
+            trials[trial_id] = Trial(
+                trial_id=trial_id,
+                config=config,
+                trial_dir=os.path.join(self.experiment_dir, trial_id),
+            )
+        pending = list(trials.values())
+        running: List[Trial] = []
+
+        while pending or running:
+            while pending and self._can_launch(running):
+                trial = pending.pop(0)
+                try:
+                    self._launch(trial, results_queue)
+                    running.append(trial)
+                except fabric.InsufficientResourcesError:
+                    pending.insert(0, trial)
+                    break
+            self._drain_reports(trials, results_queue)
+            still_running: List[Trial] = []
+            for trial in running:
+                if trial.status == "stopped":
+                    continue
+                done, _ = fabric.wait([trial.future], timeout=0)
+                if done:
+                    try:
+                        fabric.get(trial.future)
+                        trial.status = "terminated"
+                    except Exception as exc:  # noqa: BLE001
+                        trial.status = "errored"
+                        trial.error = str(exc)
+                    if trial.actor is not None:
+                        try:
+                            fabric.kill(trial.actor)
+                        except Exception:  # noqa: BLE001
+                            pass
+                else:
+                    still_running.append(trial)
+            running = still_running
+            time.sleep(0.05)
+        self._drain_reports(trials, results_queue)
+
+        results = [
+            Result(
+                trial_id=t.trial_id,
+                config=t.config,
+                metrics=t.last_metrics,
+                checkpoint_path=t.checkpoint_path,
+                history=t.history,
+                error=t.error,
+            )
+            for t in trials.values()
+        ]
+        with open(os.path.join(self.experiment_dir, "results.json"), "w") as f:
+            json.dump(
+                [
+                    {
+                        "trial_id": r.trial_id,
+                        "config": r.config,
+                        "metrics": r.metrics,
+                        "checkpoint_path": r.checkpoint_path,
+                        "error": r.error,
+                    }
+                    for r in results
+                ],
+                f,
+                indent=2,
+                default=str,
+            )
+        return ResultGrid(results)
+
+
+def run(
+    train_fn: Callable[[Dict[str, Any]], None],
+    config: Dict[str, Any],
+    num_samples: int = 1,
+    **tuner_kwargs: Any,
+) -> ResultGrid:
+    """ray.tune.run-shaped convenience wrapper."""
+    return Tuner(train_fn, config, num_samples=num_samples, **tuner_kwargs).fit()

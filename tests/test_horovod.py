@@ -1,0 +1,81 @@
+"""Ring-strategy (Horovod-flavor) tests, mirroring the reference's
+test_horovod.py parity suite (train/load/predict — SURVEY.md §4) plus a
+numerical-equivalence check against the GSPMD DP path.
+"""
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import BoringModule, XORModule
+from ray_lightning_tpu.strategies import (
+    HorovodRayStrategy,
+    RayStrategy,
+    RingTPUStrategy,
+)
+from tests.utils import get_trainer
+
+
+def test_ctor_parity_surface():
+    s = HorovodRayStrategy(num_workers=2, num_cpus_per_worker=1, use_gpu=False)
+    assert s.num_workers == 2
+    assert s.strategy_name == "horovod_ray"
+    assert s.world_size == 2
+    # Driver-side rank fallbacks before launch (ray_horovod.py:110-141)
+    assert s.global_rank == 0
+    assert s.local_rank == 0
+
+
+def test_ring_step_in_process_matches_gspmd():
+    """shard_map+pmean and GSPMD sharding must produce the same update."""
+    import jax
+
+    from ray_lightning_tpu.parallel.env import DistEnv
+    from ray_lightning_tpu.strategies import RayTPUStrategy
+
+    def build(strategy_cls):
+        strategy = strategy_cls(num_workers=8, use_tpu=False)
+        strategy.dist_env = DistEnv(
+            world_size=8, num_hosts=1, host_rank=0, local_chips=8
+        )
+        strategy.mesh = strategy.build_mesh()
+        return strategy
+
+    module = XORModule(batch_size=2)
+    rng = jax.random.PRNGKey(0)
+    x = np.tile(
+        np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32), (4, 1)
+    )
+    y = np.tile(np.array([0, 1, 1, 0], np.int32), 4)
+    params = module.init_params(rng, (x, y))
+    tx = module.configure_optimizers()
+    opt_state = tx.init(params)
+
+    outs = {}
+    for name, cls in [("gspmd", RayTPUStrategy), ("ring", RingTPUStrategy)]:
+        strategy = build(cls)
+        p = strategy.place_params(params)
+        o = strategy.place_opt_state(opt_state, params)
+        b = strategy.make_global_batch((x, y))
+        step = strategy.compile_train_step(module, tx)
+        new_p, _, logs = step(p, o, b, rng)
+        outs[name] = (
+            np.asarray(new_p["w1"]),
+            float(np.asarray(logs["loss"])),
+        )
+    np.testing.assert_allclose(outs["gspmd"][0], outs["ring"][0], rtol=1e-5, atol=1e-6)
+    assert abs(outs["gspmd"][1] - outs["ring"][1]) < 1e-5
+
+
+@pytest.mark.slow
+def test_ring_train_end_to_end(start_fabric):
+    start_fabric(num_cpus=2)
+    module = BoringModule()
+    trainer = get_trainer(
+        strategy=HorovodRayStrategy(num_workers=2, use_gpu=False), max_epochs=1
+    )
+    trainer.fit(module)
+    assert trainer.state["status"] == "finished"
+    assert np.isfinite(np.asarray(module.params["w"])).all()
+    assert "val_loss" in trainer.callback_metrics
+    # predict parity (reference test_horovod.py predict suite)
+    preds = trainer.predict(module)
+    assert preds and preds[0].shape[-1] == 2
