@@ -1,0 +1,115 @@
+"""MNIST data-parallel training example (RayTPUStrategy).
+
+Counterpart of the reference's ``examples/ray_ddp_example.py``
+(/root/reference/ray_lightning/examples/ray_ddp_example.py:1-173): trains an
+MNIST classifier under the data-parallel strategy, with an optional ``--tune``
+mode that wraps the same training function in a hyperparameter sweep.
+
+Doubles as an integration smoke test (run with ``--smoke-test``), the role
+the reference's examples play in CI (.github/workflows/test.yaml:95-107).
+"""
+import argparse
+
+from ray_lightning_tpu import fabric
+from ray_lightning_tpu.models import MNISTClassifier
+from ray_lightning_tpu.strategies import RayTPUStrategy
+from ray_lightning_tpu.trainer import Trainer
+
+
+def train_mnist(
+    config: dict,
+    num_workers: int = 2,
+    num_epochs: int = 2,
+    use_tpu: bool = False,
+    callbacks: list = None,
+) -> Trainer:
+    module = MNISTClassifier(
+        lr=config.get("lr", 1e-3),
+        hidden=config.get("hidden", 128),
+        batch_size=config.get("batch_size", 32),
+    )
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        callbacks=list(callbacks or []),
+        strategy=RayTPUStrategy(num_workers=num_workers, use_tpu=use_tpu),
+        enable_checkpointing=False,
+    )
+    trainer.fit(module)
+    return trainer
+
+
+def tune_mnist(
+    num_workers: int = 2,
+    num_epochs: int = 2,
+    num_samples: int = 2,
+    use_tpu: bool = False,
+) -> None:
+    from ray_lightning_tpu import tune
+
+    def train_fn(config: dict) -> None:
+        train_mnist(
+            config,
+            num_workers=num_workers,
+            num_epochs=num_epochs,
+            use_tpu=use_tpu,
+            callbacks=[
+                tune.TuneReportCallback(
+                    {"loss": "ptl/val_loss", "mean_accuracy": "ptl/val_accuracy"},
+                    on="validation_end",
+                )
+            ],
+        )
+
+    results = tune.Tuner(
+        train_fn,
+        param_space={
+            "lr": tune.loguniform(1e-4, 1e-1),
+            "hidden": tune.choice([64, 128]),
+            "batch_size": tune.choice([32, 64]),
+        },
+        num_samples=num_samples,
+        resources_per_trial=tune.get_tune_resources(
+            num_workers=num_workers, use_tpu=use_tpu
+        ),
+    ).fit()
+    best = results.get_best_result("mean_accuracy", mode="max")
+    print("Best hyperparameters found were:", best.config)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--num-samples", type=int, default=2)
+    parser.add_argument("--use-tpu", action="store_true", default=False)
+    parser.add_argument("--tune", action="store_true", help="run a tune sweep")
+    parser.add_argument(
+        "--smoke-test", action="store_true", help="tiny fast run for CI"
+    )
+    parser.add_argument(
+        "--address", type=str, default=None, help="fabric head address (client mode)"
+    )
+    parser.add_argument(
+        "--num-cpus", type=int, default=None,
+        help="logical CPU capacity for the fabric head (defaults to the host count; smoke tests over-provision so worker bundles always fit)",
+    )
+    args = parser.parse_args()
+
+    num_cpus = args.num_cpus
+    if num_cpus is None and args.smoke_test:
+        num_cpus = 8  # logical: lets tune trial bundles fit tiny CI hosts
+    fabric.init(address=args.address, num_cpus=num_cpus)
+    num_epochs = 1 if args.smoke_test else args.num_epochs
+    num_samples = 1 if args.smoke_test else args.num_samples
+    if args.tune:
+        tune_mnist(args.num_workers, num_epochs, num_samples, args.use_tpu)
+    else:
+        trainer = train_mnist(
+            {}, num_workers=args.num_workers, num_epochs=num_epochs, use_tpu=args.use_tpu
+        )
+        print("Final metrics:", trainer.callback_metrics)
+    fabric.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Sharded (ZeRO) training example with the perf-probe callback.
+
+Counterpart of the reference's ``examples/ray_ddp_sharded_example.py``
+(/root/reference/ray_lightning/examples/ray_ddp_sharded_example.py:1-133),
+which trains ImageGPT under the FairScale-sharded strategy with fp16 and a
+``CUDACallback`` measuring epoch time + peak memory. Here:
+``RayShardedStrategy`` (GSPMD optimizer-state sharding, strategies/
+sharded.py), bf16 precision, and ``TPUStatsCallback`` as the perf probe.
+"""
+import argparse
+
+from ray_lightning_tpu import fabric
+from ray_lightning_tpu.strategies import RayShardedStrategy
+from ray_lightning_tpu.trainer import TPUStatsCallback, Trainer
+
+
+def _build_module(smoke_test: bool, batch_size: int):
+    """GPT-2-style LM when available; MNIST MLP for smoke tests."""
+    if not smoke_test:
+        try:
+            from ray_lightning_tpu.models import GPT2LM
+
+            return GPT2LM.mini(batch_size=batch_size)
+        except ImportError:
+            print("GPT2LM unavailable (flax missing?); using MNIST MLP instead")
+    from ray_lightning_tpu.models import MNISTClassifier
+
+    return MNISTClassifier(batch_size=batch_size, n_train=256)
+
+
+def train(
+    num_workers: int = 2,
+    num_epochs: int = 2,
+    batch_size: int = 16,
+    zero_stage: int = 1,
+    use_tpu: bool = False,
+    smoke_test: bool = False,
+) -> Trainer:
+    stats = TPUStatsCallback()
+    module = _build_module(smoke_test, batch_size)
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        precision="bf16",
+        callbacks=[stats],
+        enable_checkpointing=False,
+        strategy=RayShardedStrategy(
+            num_workers=num_workers, use_tpu=use_tpu, zero_stage=zero_stage
+        ),
+    )
+    trainer.fit(module)
+    if stats.epoch_times:
+        avg = sum(stats.epoch_times) / len(stats.epoch_times)
+        print(f"Average epoch time: {avg:.3f} s")
+    if stats.peak_memory and max(stats.peak_memory):
+        print(f"Peak device memory: {max(stats.peak_memory) / 2**20:.1f} MiB")
+    return trainer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--zero-stage", type=int, default=1, choices=(1, 2, 3))
+    parser.add_argument("--use-tpu", action="store_true", default=False)
+    parser.add_argument("--smoke-test", action="store_true")
+    parser.add_argument("--address", type=str, default=None)
+    parser.add_argument(
+        "--num-cpus", type=int, default=None,
+        help="logical CPU capacity for the fabric head (defaults to the host count; smoke tests over-provision so worker bundles always fit)",
+    )
+    args = parser.parse_args()
+
+    num_cpus = args.num_cpus
+    if num_cpus is None and args.smoke_test:
+        num_cpus = 8  # logical: lets tune trial bundles fit tiny CI hosts
+    fabric.init(address=args.address, num_cpus=num_cpus)
+    trainer = train(
+        num_workers=args.num_workers,
+        num_epochs=1 if args.smoke_test else args.num_epochs,
+        batch_size=args.batch_size,
+        zero_stage=args.zero_stage,
+        use_tpu=args.use_tpu,
+        smoke_test=args.smoke_test,
+    )
+    print("Final metrics:", trainer.callback_metrics)
+    fabric.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Hyperparameter sweep over distributed MNIST fits (Tuner + checkpointing).
+
+Counterpart of the reference's ``examples/ray_ddp_tune.py``
+(/root/reference/ray_lightning/examples/ray_ddp_tune.py:1-118): each trial
+runs an N-worker distributed fit and reports metrics + checkpoints back to
+the tuner (nested parallelism, SURVEY.md §3.3). Demonstrates
+``TuneReportCheckpointCallback`` and an ``init_hook`` that runs once per
+worker before training (the reference's FileLock download pattern,
+ray_ddp_tune.py:21-36 — here it pre-builds the synthetic dataset).
+"""
+import argparse
+
+from ray_lightning_tpu import fabric, tune
+from ray_lightning_tpu.models import MNISTClassifier
+from ray_lightning_tpu.strategies import RayTPUStrategy
+from ray_lightning_tpu.trainer import Trainer
+
+
+def download_data() -> None:
+    """Per-worker init hook (reference's download_data, ray_ddp_tune.py:21-36)."""
+    from ray_lightning_tpu.models.mnist import make_fake_mnist
+
+    make_fake_mnist(128)
+
+
+def train_mnist(config: dict, num_workers: int = 2, num_epochs: int = 2,
+                use_tpu: bool = False) -> None:
+    module = MNISTClassifier(
+        lr=config["lr"], batch_size=config["batch_size"], n_train=256
+    )
+    trainer = Trainer(
+        max_epochs=num_epochs,
+        enable_checkpointing=False,
+        callbacks=[
+            tune.TuneReportCheckpointCallback(
+                metrics={"loss": "ptl/val_loss", "mean_accuracy": "ptl/val_accuracy"},
+                filename="checkpoint",
+                on="validation_end",
+            )
+        ],
+        strategy=RayTPUStrategy(
+            num_workers=num_workers, use_tpu=use_tpu, init_hook=download_data
+        ),
+    )
+    trainer.fit(module)
+
+
+def tune_mnist(num_workers: int = 2, num_epochs: int = 2, num_samples: int = 2,
+               use_tpu: bool = False) -> None:
+    def train_fn(config: dict) -> None:
+        train_mnist(config, num_workers, num_epochs, use_tpu)
+
+    results = tune.Tuner(
+        train_fn,
+        param_space={
+            "lr": tune.loguniform(1e-4, 1e-1),
+            "batch_size": tune.choice([32, 64]),
+        },
+        num_samples=num_samples,
+        resources_per_trial=tune.get_tune_resources(
+            num_workers=num_workers, use_tpu=use_tpu
+        ),
+        scheduler=tune.ASHAScheduler("loss", mode="min", max_t=num_epochs),
+    ).fit()
+    best = results.get_best_result("mean_accuracy", mode="max")
+    print("Best hyperparameters found were:", best.config)
+    print("Best checkpoint:", best.checkpoint_path)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--num-samples", type=int, default=2)
+    parser.add_argument("--use-tpu", action="store_true", default=False)
+    parser.add_argument("--smoke-test", action="store_true")
+    parser.add_argument("--address", type=str, default=None)
+    parser.add_argument(
+        "--num-cpus", type=int, default=None,
+        help="logical CPU capacity for the fabric head (defaults to the host count; smoke tests over-provision so worker bundles always fit)",
+    )
+    args = parser.parse_args()
+
+    num_cpus = args.num_cpus
+    if num_cpus is None and args.smoke_test:
+        num_cpus = 8  # logical: lets tune trial bundles fit tiny CI hosts
+    fabric.init(address=args.address, num_cpus=num_cpus)
+    if args.smoke_test:
+        tune_mnist(num_workers=2, num_epochs=1, num_samples=1, use_tpu=False)
+    else:
+        tune_mnist(args.num_workers, args.num_epochs, args.num_samples, args.use_tpu)
+    fabric.shutdown()
+
+
+if __name__ == "__main__":
+    main()

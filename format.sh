@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Lint/format gate (reference: format.sh:1-147, yapf+flake8). This build uses
+# ruff for both roles. `./format.sh` fixes in place; `./format.sh --check` is
+# the CI mode.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+TARGETS=(ray_lightning_tpu tests examples bench.py __graft_entry__.py)
+
+if ! command -v ruff >/dev/null 2>&1; then
+    echo "ruff not installed; skipping lint (CI installs it)" >&2
+    exit 0
+fi
+
+if [[ "${1:-}" == "--check" ]]; then
+    ruff check "${TARGETS[@]}"
+    ruff format --check "${TARGETS[@]}"
+else
+    ruff check --fix "${TARGETS[@]}"
+    ruff format "${TARGETS[@]}"
+fi

@@ -1,0 +1,16 @@
+"""Fabric client mode: drive a remote fabric head from a lightweight process.
+
+Parity target: Ray Client ("infinite laptop") usage in the reference —
+``ray_start_client_server`` fixtures and ``ray.init("ray://...")`` examples
+(/root/reference/ray_lightning/tests/test_client.py:17-30). A driver with no
+accelerator connects to a head that owns the resources; all actor
+creation/object transport proxies over a socket.
+"""
+from __future__ import annotations
+
+
+def connect(address: str) -> None:
+    raise NotImplementedError(
+        "fabric client mode is not wired up yet; run the driver on the head "
+        "node (fabric.init() with no address)"
+    )

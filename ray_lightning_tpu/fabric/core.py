@@ -162,13 +162,22 @@ def init(
     num_tpus: Optional[float] = None,
     resources: Optional[Dict[str, float]] = None,
     ignore_reinit_error: bool = True,
+    address: Optional[str] = None,
 ) -> None:
     """Start the fabric session with a single local head node.
 
     ``resources`` adds custom logical resources (the reference tests this
     passthrough with ``ray.init(resources={"extra": 4})``, test_ddp.py:34-39).
+    ``address="host:port"`` requests client mode — connecting to a remote
+    fabric head (the Ray Client "infinite laptop" analog, SURVEY.md §4);
+    until ``fabric.client`` lands this raises NotImplementedError.
     """
     global _session
+    if address is not None:
+        from ray_lightning_tpu.fabric import client
+
+        client.connect(address)
+        return
     if _session is not None:
         if ignore_reinit_error:
             return

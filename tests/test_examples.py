@@ -1,0 +1,48 @@
+"""Examples as smoke tests.
+
+The reference runs its examples with ``--smoke-test`` in CI as the
+integration layer of the test pyramid (.github/workflows/test.yaml:95-107);
+these tests do the same in-process-spawned subprocesses.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run_example(name: str, *args: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    # Examples must work without the conftest's virtual-device setup; give
+    # workers a clean slate (they configure their own XLA flags).
+    env.pop("XLA_FLAGS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo_root = os.path.abspath(os.path.join(EXAMPLES, ".."))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name), "--smoke-test", *args],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+    )
+
+
+@pytest.mark.parametrize(
+    "name,args",
+    [
+        ("ray_ddp_example.py", ()),
+        ("ray_ddp_example.py", ("--tune",)),
+        ("ray_ddp_tune.py", ()),
+        ("ray_horovod_example.py", ()),
+        ("ray_ddp_sharded_example.py", ()),
+    ],
+    ids=["ddp", "ddp-tune", "tune", "ring", "sharded"],
+)
+def test_example_smoke(name, args):
+    proc = _run_example(name, *args)
+    assert proc.returncode == 0, (
+        f"{name} {args} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
