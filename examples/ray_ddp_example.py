@@ -87,7 +87,8 @@ def main() -> None:
         "--smoke-test", action="store_true", help="tiny fast run for CI"
     )
     parser.add_argument(
-        "--address", type=str, default=None, help="fabric head address (client mode)"
+        "--address", type=str, default=None,
+        help="fabric head address for client mode (raises until fabric.client lands)"
     )
     parser.add_argument(
         "--num-cpus", type=int, default=None,

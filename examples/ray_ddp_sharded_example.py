@@ -22,7 +22,10 @@ def _build_module(smoke_test: bool, batch_size: int):
 
             return GPT2LM.mini(batch_size=batch_size)
         except ImportError:
-            print("GPT2LM unavailable (flax missing?); using MNIST MLP instead")
+            print(
+                "GPT2LM is not available in this build of "
+                "ray_lightning_tpu.models; using the MNIST MLP instead"
+            )
     from ray_lightning_tpu.models import MNISTClassifier
 
     return MNISTClassifier(batch_size=batch_size, n_train=256)
@@ -64,7 +67,10 @@ def main() -> None:
     parser.add_argument("--zero-stage", type=int, default=1, choices=(1, 2, 3))
     parser.add_argument("--use-tpu", action="store_true", default=False)
     parser.add_argument("--smoke-test", action="store_true")
-    parser.add_argument("--address", type=str, default=None)
+    parser.add_argument(
+        "--address", type=str, default=None,
+        help="fabric head address for client mode (raises until fabric.client lands)",
+    )
     parser.add_argument(
         "--num-cpus", type=int, default=None,
         help="logical CPU capacity for the fabric head (defaults to the host count; smoke tests over-provision so worker bundles always fit)",

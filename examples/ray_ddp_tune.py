@@ -74,7 +74,10 @@ def main() -> None:
     parser.add_argument("--num-samples", type=int, default=2)
     parser.add_argument("--use-tpu", action="store_true", default=False)
     parser.add_argument("--smoke-test", action="store_true")
-    parser.add_argument("--address", type=str, default=None)
+    parser.add_argument(
+        "--address", type=str, default=None,
+        help="fabric head address for client mode (raises until fabric.client lands)",
+    )
     parser.add_argument(
         "--num-cpus", type=int, default=None,
         help="logical CPU capacity for the fabric head (defaults to the host count; smoke tests over-provision so worker bundles always fit)",

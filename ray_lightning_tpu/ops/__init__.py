@@ -1,0 +1,21 @@
+"""TPU compute ops: Pallas kernels and mesh collectives for the hot path.
+
+The reference has no kernels of its own — its hot loop is torch/NCCL
+(SURVEY.md §2b). This package is the TPU build's native compute layer:
+
+- ``attention``: plain-XLA reference attention (ground truth + fallback).
+- ``flash_attention``: Pallas online-softmax attention kernel (TPU MXU
+  tiling; interpret mode on CPU for tests).
+- ``ring_attention``: sequence-parallel blockwise attention over a mesh
+  axis (ICI ``ppermute`` ring) for long-context training.
+"""
+from ray_lightning_tpu.ops.attention import attention_reference
+from ray_lightning_tpu.ops.flash_attention import flash_attention
+from ray_lightning_tpu.ops.ring_attention import ring_attention, ring_self_attention
+
+__all__ = [
+    "attention_reference",
+    "flash_attention",
+    "ring_attention",
+    "ring_self_attention",
+]

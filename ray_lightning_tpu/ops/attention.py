@@ -1,0 +1,61 @@
+"""Reference scaled-dot-product attention (plain XLA).
+
+Ground truth for the Pallas/ring kernels' tests and the fallback path on
+backends where the kernels are unavailable. Layout convention throughout the
+framework: ``(batch, seq, heads, head_dim)`` — the natural layout for
+sequence sharding (seq is a leading, shardable axis).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask_allowed(
+    sq: int, sk: int, row_offset: int = 0, col_offset: int = 0
+) -> jax.Array:
+    """Bool (sq, sk) matrix, True where attention is allowed.
+
+    With no offsets the diagonal is aligned to the *end* of the key sequence
+    (decode-style Sq < Sk: queries are the last Sq positions). Ring/blockwise
+    callers pass global row/col offsets instead. Single source of truth for
+    masking semantics across the reference, flash backward, and ring paths.
+    """
+    if (
+        isinstance(row_offset, int)
+        and isinstance(col_offset, int)
+        and row_offset == 0
+        and col_offset == 0
+    ):
+        row_offset = sk - sq
+    row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + row_offset
+    col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1) + col_offset
+    return col <= row
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """softmax(q k^T / sqrt(d)) v with optional causal mask.
+
+    Shapes: q (B, Sq, H, D); k, v (B, Sk, H, D) -> (B, Sq, H, D).
+    Softmax statistics are computed in float32 regardless of input dtype
+    (bf16-safe), matching the kernels.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        s = jnp.where(causal_mask_allowed(q.shape[1], k.shape[1]), s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v
+    ).astype(q.dtype)

@@ -1,0 +1,200 @@
+"""Flash attention: Pallas online-softmax kernel for the TPU MXU.
+
+The forward pass is a Pallas kernel (one grid cell per (batch*head,
+q-block); K/V stream through an online-softmax ``fori_loop`` so the (Sq, Sk)
+score matrix never materializes in HBM). The backward pass uses the
+flash-attention gradient identities on recomputed scores — plain XLA, which
+fuses it into a few MXU matmuls.
+
+On non-TPU backends the same kernel runs in Pallas interpret mode (tests),
+or falls back to ``attention_reference``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ray_lightning_tpu.ops.attention import attention_reference, causal_mask_allowed
+
+_NEG_INF = float("-inf")
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float
+):
+    # Block shapes: q (1, block_q, d); k, v (1, Sk, d); o like q;
+    # lse (1, block_q).
+    block_q = q_ref.shape[1]
+    seq_k = k_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
+
+    q_offset = iq * block_q
+    if causal:
+        # Only key blocks at or below this q block's diagonal contribute.
+        num_kb = jax.lax.div(q_offset + block_q + block_k - 1, block_k)
+    else:
+        num_kb = seq_k // block_k
+
+    def body(i, carry):
+        m_prev, l_prev, acc_prev = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        if causal:
+            row = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col <= row, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+        jnp.zeros((block_q, head_dim), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, init)
+    # Rows with no unmasked keys (can't happen for causal self-attention with
+    # aligned blocks, but keep the kernel total) produce l=0 -> output 0.
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m[:, 0] + jnp.log(l_safe[:, 0])).astype(jnp.float32)
+
+
+def _flash_fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    """Run the kernel on (B, S, H, D) inputs; returns (out, lse)."""
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            f"sequence lengths ({seq_q}, {seq_k}) must be divisible by the "
+            f"block sizes ({block_q}, {block_k})"
+        )
+    if causal and seq_q != seq_k:
+        raise ValueError("causal flash kernel requires Sq == Sk (self-attention)")
+    # Fold heads into the grid's batch dimension: (B*H, S, D).
+    qf = q.transpose(0, 2, 1, 3).reshape(batch * heads, seq_q, head_dim)
+    kf = k.transpose(0, 2, 1, 3).reshape(batch * heads, seq_k, head_dim)
+    vf = v.transpose(0, 2, 1, 3).reshape(batch * heads, seq_k, head_dim)
+
+    grid = (batch * heads, seq_q // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, seq_q, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch * heads, seq_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(batch, heads, seq_q, head_dim).transpose(0, 2, 1, 3)
+    lse = lse.reshape(batch, heads, seq_q)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    """Flash-attention backward: recompute P from saved lse, then the
+    standard dq/dk/dv identities — a handful of MXU matmuls under XLA."""
+    q, k, v, out, lse = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", qf, kf, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        s = jnp.where(
+            causal_mask_allowed(q.shape[1], k.shape[1]), s, _NEG_INF
+        )
+    p = jnp.exp(s - lse[..., None])  # (B, H, Sq, Sk), rows sum to 1
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    # delta = rowsum(do * o) = rowsum(dp * p)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B, Sq, H)
+    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * sm_scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas flash attention on (B, S, H, D) tensors.
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU, interpret mode
+    elsewhere (so the same code path is testable on CPU). Falls back to
+    ``attention_reference`` for shapes the kernel does not support.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, seq_q), min(block_k, seq_k)
+    if seq_q % bq or seq_k % bk or (causal and seq_q != seq_k):
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)

@@ -1,0 +1,133 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+Long-context support (SURVEY.md notes the reference has none — this is a
+capability the TPU build adds as first-class): the sequence dimension is
+sharded across devices on a mesh axis; each device keeps its local Q shard
+resident and K/V shards rotate around the ring via ``lax.ppermute`` (ICI
+neighbor exchange), with online-softmax accumulation so the full (S, S)
+score matrix never exists on any chip and per-chip memory stays
+O(S_local * S_local) per step. This is the blockwise/ring formulation of
+attention (Liu et al., Ring Attention) expressed as an SPMD per-rank
+program under ``shard_map``.
+
+Differentiable: built from ``lax.scan`` + ``ppermute``, both of which have
+transposes, so ``jax.grad`` works through it (the backward pass rotates
+gradients the opposite way around the ring).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-rank ring attention; call inside ``shard_map``/``pmap``.
+
+    Args:
+      q, k, v: local sequence shards, (B, S_local, H, D). The global
+        sequence is the concatenation over the ``axis_name`` ring order.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: apply a causal mask in *global* positions.
+
+    Returns the local output shard (B, S_local, H, D).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    batch, s_local, heads, head_dim = q.shape
+    qf = q.astype(jnp.float32) * sm_scale
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, step_idx):
+        k_cur, v_cur, m_prev, l_prev, acc_prev = carry
+        # The K/V shard currently held originated on rank (my_idx - step).
+        src_idx = (my_idx - step_idx) % axis_size
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            qf,
+            k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )  # (B, H, Sq_local, Sk_local)
+        if causal:
+            from ray_lightning_tpu.ops.attention import causal_mask_allowed
+
+            allowed = causal_mask_allowed(
+                s_local, s_local,
+                row_offset=my_idx * s_local,
+                col_offset=src_idx * s_local,
+            )
+            s = jnp.where(allowed[None, None], s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)  # (B, H, Sq)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Fully-masked-so-far rows have m_new == -inf; substitute 0 in the
+        # exponent shifts (exp(-inf - 0) = 0) to avoid (-inf) - (-inf) NaNs.
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.exp(m_prev - m_safe)  # (B, H, Sq)
+        p = jnp.exp(s - m_safe[..., None])  # (B, H, Sq, Sk)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc_prev * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        # Rotate K/V to the next rank (ICI neighbor exchange). The final
+        # rotation returns the shards home, keeping the scan carry uniform.
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, acc_new), None
+
+    def _varying(x):
+        # shard_map's vma type system requires the scan carry to be marked
+        # device-varying over the ring axis (the accumulators genuinely
+        # differ per rank).
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pvary(x, axis_name)
+
+    init = (
+        k,
+        v,
+        _varying(jnp.full((batch, heads, s_local), _NEG_INF, jnp.float32)),
+        _varying(jnp.zeros((batch, heads, s_local), jnp.float32)),
+        _varying(jnp.zeros((batch, heads, s_local, head_dim), jnp.float32)),
+    )
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        step, init, jnp.arange(axis_size), length=axis_size
+    )
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe[..., None]  # (B, H, Sq, D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: jax.sharding.Mesh,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Global-view wrapper: shards (B, S, H, D) over ``axis_name`` and runs
+    the per-rank ring program under ``shard_map``."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

@@ -1,0 +1,110 @@
+"""Kernel correctness: flash attention and ring attention vs the XLA
+reference, values and gradients, on the 8-virtual-device CPU mesh."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.ops import (
+    attention_reference,
+    flash_attention,
+    ring_self_attention,
+)
+
+
+def _make_qkv(batch=2, seq=64, heads=2, head_dim=8, seed=0, dtype=jnp.float32):
+    g = jax.random.PRNGKey(seed)
+    kq, kk, kv = jax.random.split(g, 3)
+    shape = (batch, seq, heads, head_dim)
+    return (
+        jax.random.normal(kq, shape, dtype),
+        jax.random.normal(kk, shape, dtype),
+        jax.random.normal(kv, shape, dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = _make_qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match(causal):
+    q, k, v = _make_qkv(seq=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=16, block_k=16) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(gf, gr, atol=5e-5, rtol=5e-5)
+
+
+def test_flash_unaligned_falls_back():
+    # Sequence not divisible by block: must still produce correct values
+    # (reference fallback path).
+    q, k, v = _make_qkv(seq=24)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def _seq_mesh(n=8):
+    return jax.sharding.Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    q, k, v = _make_qkv(seq=64)
+    mesh = _seq_mesh()
+    out = ring_self_attention(q, k, v, mesh, axis_name="seq", causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_gradients_match():
+    q, k, v = _make_qkv(seq=32, batch=1)
+    mesh = _seq_mesh()
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            ring_self_attention(q, k, v, mesh, axis_name="seq", causal=True) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr_, gref in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr_), gref, atol=5e-5, rtol=5e-5)
+
+
+def test_ring_attention_long_context_sharded_memory():
+    # The point of the ring: each device only ever holds S/n of K/V. Check
+    # output correctness at a longer sequence under jit with sharded inputs.
+    mesh = _seq_mesh()
+    q, k, v = _make_qkv(batch=1, seq=256, heads=1, head_dim=8)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(x, shard) for x in (q, k, v))
+    fn = jax.jit(
+        functools.partial(ring_self_attention, mesh=mesh, causal=True)
+    )
+    out = fn(qs, ks, vs)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+    # Output keeps the sequence sharding (no implicit all-gather).
+    assert out.sharding.spec == P(None, "seq", None, None)
