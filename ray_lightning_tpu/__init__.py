@@ -17,6 +17,7 @@ _LAZY = {
     "RayShardedStrategy": "ray_lightning_tpu.strategies",
     "RingTPUStrategy": "ray_lightning_tpu.strategies",
     "HorovodRayStrategy": "ray_lightning_tpu.strategies",
+    "GSPMDStrategy": "ray_lightning_tpu.strategies",
     "Trainer": "ray_lightning_tpu.trainer",
     "TPUModule": "ray_lightning_tpu.trainer",
 }

@@ -598,21 +598,7 @@ def _spawn_actor(
 
     env = dict(opts.get("env") or {})
     actor_id = f"actor-{uuid.uuid4().hex[:8]}"
-    parent_conn, child_conn = sess.mp_ctx.Pipe(duplex=True)
-    from ray_lightning_tpu.fabric.worker import _worker_main
-
-    proc = sess.mp_ctx.Process(
-        target=_worker_main,
-        args=(
-            child_conn,
-            env,
-            {"node_id": node.node_id, "node_ip": node.node_ip},
-        ),
-        name=actor_id,
-        daemon=False,
-    )
-    proc.start()
-    child_conn.close()
+    proc, parent_conn = _boot_worker_process(actor_id, env, node)
     handle = ActorHandle(actor_id, proc, parent_conn, node, request, opts)
     with sess.lock:
         sess.actors[actor_id] = handle
@@ -627,6 +613,123 @@ def _spawn_actor(
         kill(handle)
         raise
     return handle
+
+
+class _ProcHandle:
+    """subprocess.Popen wrapped in the multiprocessing.Process API surface
+    ActorHandle expects (is_alive/exitcode/join/terminate/kill)."""
+
+    def __init__(self, popen: Any) -> None:
+        self._p = popen
+
+    def is_alive(self) -> bool:
+        return self._p.poll() is None
+
+    @property
+    def exitcode(self) -> Optional[int]:
+        return self._p.poll()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        import subprocess
+
+        try:
+            self._p.wait(timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def terminate(self) -> None:
+        self._p.terminate()
+
+    def kill(self) -> None:
+        self._p.kill()
+
+
+def _boot_worker_process(actor_id: str, env: Dict[str, Any], node: Node):
+    """Exec a fresh worker interpreter and hand back (process, connection).
+
+    Uses ``python -m ray_lightning_tpu.fabric.worker`` + an AF_UNIX
+    Listener — NOT multiprocessing.Process — so the child never replays the
+    driver's ``__main__`` module (mp spawn would, re-running unguarded user
+    scripts recursively). Env overrides are applied to the exec environment,
+    i.e. strictly before the child interpreter (and thus jax) starts.
+    """
+    import secrets
+    import subprocess
+    import sys
+    from multiprocessing.connection import Listener
+
+    child_env = dict(os.environ)
+    # Propagate the driver's import roots (mp spawn used to ship sys.path in
+    # its preparation data; exec'd workers need it via PYTHONPATH so classes
+    # cloudpickled *by reference* — e.g. from a test module or a script's
+    # package — resolve in the child).
+    driver_paths = [p for p in sys.path if p]
+    inherited = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = os.pathsep.join(
+        driver_paths + ([inherited] if inherited else [])
+    )
+    for key, value in env.items():
+        if value is None:
+            child_env.pop(key, None)
+        elif key == "PYTHONPATH":
+            # Merge rather than clobber: the driver sys.path entries above
+            # are what let by-reference cloudpickles resolve in the child.
+            child_env[key] = os.pathsep.join(
+                [str(value), child_env.get("PYTHONPATH", "")]
+            ).rstrip(os.pathsep)
+        else:
+            child_env[key] = str(value)
+    # Logical node identity for actor code (rank math, IPs).
+    child_env["RLT_NODE_ID"] = str(node.node_id)
+    child_env["RLT_NODE_IP"] = str(node.node_ip)
+
+    authkey = secrets.token_bytes(32)
+    listener = Listener(family="AF_UNIX", authkey=authkey)
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_lightning_tpu.fabric.worker",
+             str(listener.address)],
+            env=child_env,
+            stdin=subprocess.PIPE,
+        )
+        proc.stdin.write(authkey.hex().encode() + b"\n")
+        # Second line: the driver's multiprocessing authkey. Manager/Queue
+        # proxies authenticate with current_process().authkey, which
+        # mp.Process children inherit automatically but exec'd workers do
+        # not; the worker restores it so driver-owned proxies (tune queues)
+        # keep working across any nesting depth.
+        proc.stdin.write(mp.current_process().authkey.hex().encode() + b"\n")
+        proc.stdin.flush()
+        proc.stdin.close()
+        # accept() has no timeout; run it in a thread and watch for the
+        # child dying pre-connect so a boot crash can't hang the driver.
+        box: Dict[str, Any] = {}
+
+        def _accept() -> None:
+            try:
+                box["conn"] = listener.accept()
+            except BaseException as exc:  # noqa: BLE001
+                box["err"] = exc
+
+        t = threading.Thread(target=_accept, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 120.0
+        while "conn" not in box and "err" not in box:
+            if proc.poll() is not None:
+                raise ActorDiedError(
+                    f"actor {actor_id} worker process exited during boot "
+                    f"(exitcode={proc.returncode})"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise ActorDiedError(f"actor {actor_id} boot timed out")
+            t.join(timeout=0.05)
+        if "err" in box:
+            proc.kill()
+            raise box["err"]
+        return _ProcHandle(proc), box["conn"]
+    finally:
+        listener.close()
 
 
 def kill(handle: ActorHandle, no_restart: bool = True) -> None:  # noqa: ARG001

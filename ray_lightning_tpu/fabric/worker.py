@@ -1,11 +1,18 @@
 """Child-process entrypoint for fabric actors.
 
-Kept intentionally light: only stdlib imports at module scope, so the spawned
-process can apply environment overrides (XLA_FLAGS, JAX_PLATFORMS, TPU
-topology vars) *before* anything imports jax. The actor class itself arrives
-as a cloudpickle blob after env setup.
+Spawned as ``python -m ray_lightning_tpu.fabric.worker <socket-address>`` by
+the driver (NOT via multiprocessing.Process): a fresh interpreter that never
+re-imports the user's ``__main__`` module, so unguarded user scripts cannot
+recursively re-launch training the way multiprocessing's spawn
+``_fixup_main_from_path`` would. This mirrors Ray's worker-process model
+(the reference's actors are plain Ray workers, launchers/utils.py:27-52).
 
-Wire protocol (length-prefixed cloudpickle over a duplex Pipe):
+Environment overrides (XLA_FLAGS, JAX_PLATFORMS, TPU topology vars) arrive
+via the process environment — set by the driver *before* exec, hence before
+anything can import jax. The actor class arrives as a cloudpickle blob over
+the connection.
+
+Wire protocol (length-prefixed cloudpickle over a Connection):
   driver -> worker: ("init", blob)            instantiate actor class
                     ("call", call_id, blob)   run method, blob=(name, args, kw)
                     ("shutdown",)
@@ -17,8 +24,8 @@ import sys
 import traceback
 
 
-def _worker_main(conn, env_overrides, node_info):
-    """Run the actor loop. ``conn`` is the child end of a duplex Pipe."""
+def _worker_main(conn):
+    """Run the actor loop. ``conn`` is an authenticated duplex Connection."""
     import signal
 
     # SIGTERM (e.g. a tuner killing a trial actor) must run atexit so this
@@ -26,24 +33,14 @@ def _worker_main(conn, env_overrides, node_info):
     # (a trial's training workers) instead of orphaning them.
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
 
-    for key, value in env_overrides.items():
-        if value is None:
-            os.environ.pop(key, None)
-        else:
-            os.environ[key] = str(value)
-
-    # Make the logical node identity visible to actor code (rank math, IPs).
-    os.environ["RLT_NODE_ID"] = str(node_info.get("node_id", "node-0"))
-    os.environ["RLT_NODE_IP"] = str(node_info.get("node_ip", "127.0.0.1"))
-
     # Honor an explicit JAX platform choice even when a PJRT plugin loaded at
     # interpreter boot (via sitecustomize) has already forced its own
     # ``jax_platforms`` config, which silently overrides the env var.
-    if "JAX_PLATFORMS" in env_overrides and env_overrides["JAX_PLATFORMS"]:
+    if os.environ.get("JAX_PLATFORMS"):
         try:
             import jax
 
-            jax.config.update("jax_platforms", str(env_overrides["JAX_PLATFORMS"]))
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         except Exception:  # noqa: BLE001 - jax may be absent in pure actors
             pass
 
@@ -110,3 +107,27 @@ def _exc_payload(exc):
         return (exc, tb)
     except Exception:  # noqa: BLE001
         return (RuntimeError(f"{type(exc).__name__}: {exc}"), tb)
+
+
+def main(argv) -> None:
+    """``python -m ray_lightning_tpu.fabric.worker <address>`` entrypoint.
+
+    The connection authkey arrives on stdin (hex line) so it never shows in
+    ``/proc/*/cmdline`` or the environment.
+    """
+    import multiprocessing as mp
+    from multiprocessing.connection import Client
+
+    address = argv[1]
+    authkey = bytes.fromhex(sys.stdin.readline().strip())
+    mp_authkey = bytes.fromhex(sys.stdin.readline().strip())
+    # Restore the driver's multiprocessing authkey (normally inherited by
+    # mp children) so Manager/Queue proxies shipped from the driver
+    # authenticate in this process and in any actors it nests.
+    mp.current_process().authkey = mp_authkey
+    conn = Client(address, authkey=authkey)
+    _worker_main(conn)
+
+
+if __name__ == "__main__":
+    main(sys.argv)

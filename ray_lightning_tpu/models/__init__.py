@@ -7,6 +7,13 @@ MNISTClassifier (accuracy-bound assertions). Benchmark models (ResNet-18,
 GPT-2) land with the models milestone.
 """
 from ray_lightning_tpu.models.boring import BoringModule, RandomDataset
+from ray_lightning_tpu.models.gpt import (
+    GPTConfig,
+    GPTLM,
+    gpt_forward,
+    init_gpt_params,
+    make_fake_text,
+)
 from ray_lightning_tpu.models.mnist import MNISTClassifier, make_fake_mnist
 from ray_lightning_tpu.models.xor import XORModule
 
@@ -16,4 +23,9 @@ __all__ = [
     "XORModule",
     "MNISTClassifier",
     "make_fake_mnist",
+    "GPTConfig",
+    "GPTLM",
+    "gpt_forward",
+    "init_gpt_params",
+    "make_fake_text",
 ]

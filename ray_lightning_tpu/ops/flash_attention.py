@@ -27,7 +27,9 @@ def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float
 ):
     # Block shapes: q (1, block_q, d); k, v (1, Sk, d); o like q;
-    # lse (1, block_q).
+    # lse (1, block_q, 8) — the stats row is padded to 8 lanes because TPU
+    # block shapes must have their last two dims (8, 128)-conformant; the
+    # wrapper slices lane 0 back out.
     block_q = q_ref.shape[1]
     seq_k = k_ref.shape[1]
     head_dim = q_ref.shape[2]
@@ -76,7 +78,8 @@ def _fwd_kernel(
     # aligned blocks, but keep the kernel total) produce l=0 -> output 0.
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m[:, 0] + jnp.log(l_safe[:, 0])).astype(jnp.float32)
+    lse = (m + jnp.log(l_safe)).astype(jnp.float32)  # (bq, 1)
+    lse_ref[0] = jnp.broadcast_to(lse, (block_q, 8))
 
 
 def _flash_fwd(
@@ -120,16 +123,16 @@ def _flash_fwd(
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 8), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((batch * heads, seq_q, head_dim), q.dtype),
-            jax.ShapeDtypeStruct((batch * heads, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((batch * heads, seq_q, 8), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
     out = out.reshape(batch, heads, seq_q, head_dim).transpose(0, 2, 1, 3)
-    lse = lse.reshape(batch, heads, seq_q)
+    lse = lse[:, :, 0].reshape(batch, heads, seq_q)
     return out, lse
 
 

@@ -32,6 +32,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    vary_axes: Optional[tuple] = None,
 ) -> jax.Array:
     """Per-rank ring attention; call inside ``shard_map``/``pmap``.
 
@@ -40,6 +41,9 @@ def ring_attention(
         sequence is the concatenation over the ``axis_name`` ring order.
       axis_name: mesh axis the sequence is sharded over.
       causal: apply a causal mask in *global* positions.
+      vary_axes: every mesh axis the inputs are sharded (device-varying)
+        over — needed to type the scan carry when batch/heads ride dp/tp
+        axes in addition to the ring axis. Defaults to (axis_name,).
 
     Returns the local output shard (B, S_local, H, D).
     """
@@ -88,13 +92,15 @@ def ring_attention(
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         return (k_next, v_next, m_new, l_new, acc_new), None
 
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+
     def _varying(x):
         # shard_map's vma type system requires the scan carry to be marked
-        # device-varying over the ring axis (the accumulators genuinely
-        # differ per rank).
+        # device-varying over every axis the inputs are sharded on (the
+        # accumulators genuinely differ per rank on each of them).
         if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, axis_name)
+            return jax.lax.pcast(x, axes, to="varying")
+        return jax.lax.pvary(x, axes)
 
     init = (
         k,
@@ -121,12 +127,32 @@ def ring_self_attention(
     sm_scale: Optional[float] = None,
 ) -> jax.Array:
     """Global-view wrapper: shards (B, S, H, D) over ``axis_name`` and runs
-    the per-rank ring program under ``shard_map``."""
+    the per-rank ring program under ``shard_map``.
+
+    The batch dim stays sharded over any nontrivial data-parallel mesh axes
+    (otherwise shard_map would declare it replicated and XLA would
+    all-gather activations over the dp axes at every layer)."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, axis_name, None, None)
+    dp_axes = tuple(
+        ax
+        for ax in ("data", "fsdp")
+        if ax != axis_name and mesh.shape.get(ax, 1) > 1
+    )
+    # Heads ride the tensor-parallel axis when they divide it (matches the
+    # GSPMD qkv sharding; each model rank runs the ring on its own heads).
+    head_axis = None
+    model_size = mesh.shape.get("model", 1)
+    if "model" != axis_name and model_size > 1 and q.shape[2] % model_size == 0:
+        head_axis = "model"
+    spec = P(dp_axes or None, axis_name, head_axis, None)
+    vary = (axis_name,) + dp_axes + ((head_axis,) if head_axis else ())
     fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale
+        ring_attention,
+        axis_name=axis_name,
+        causal=causal,
+        sm_scale=sm_scale,
+        vary_axes=vary,
     )
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec

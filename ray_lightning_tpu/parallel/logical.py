@@ -1,0 +1,94 @@
+"""Logical-axis sharding rules (t5x/flax-style) for multi-axis meshes.
+
+The reference's parallelism is pure DP (SURVEY.md §2c) so it never needs a
+notion of *which tensor axis maps to which mesh axis*. A TPU-native GSPMD
+strategy does: models annotate each parameter axis with a logical name
+("embed", "heads", "mlp", ...) and the strategy maps logical names to mesh
+axes ("data", "fsdp", "model", "seq") through a rule list. This decouples
+model code from the physical mesh: the same model runs pure-DP, FSDP, TP, or
+any combination by changing rules only.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Default rules, checked in order. First rule whose mesh axis exists in the
+# mesh *and* divides the tensor dim wins. "embed"->fsdp gives ZeRO-3-style
+# parameter sharding; "heads"/"mlp"/"vocab"->model is megatron-style TP.
+DEFAULT_RULES: Tuple[Tuple[str, str], ...] = (
+    ("batch", "data"),
+    ("batch", "fsdp"),
+    ("vocab", "model"),
+    ("heads", "model"),
+    ("mlp", "model"),
+    ("embed", "fsdp"),
+    ("kv", None),
+    ("layers", None),
+    ("seq", "seq"),
+)
+
+
+def spec_from_logical(
+    shape: Sequence[int],
+    logical_axes: Sequence[Optional[str]],
+    rules: Sequence[Tuple[str, Optional[str]]],
+    mesh: Mesh,
+) -> P:
+    """Resolve one tensor's logical axis names to a PartitionSpec.
+
+    Rules are checked in order per logical name. A ``(name, None)`` rule is
+    *terminal*: it pins that logical axis replicated (the t5x-style
+    first-match-wins override — prepend ``('heads', None)`` to keep heads
+    unsharded). A rule whose mesh axis is absent, has size 1, does not
+    divide the tensor dim, or was already used by an earlier tensor axis (a
+    mesh axis may appear at most once per spec) falls through to the next
+    matching rule.
+    """
+    if len(shape) != len(logical_axes):
+        raise ValueError(
+            f"shape {tuple(shape)} has {len(shape)} dims but "
+            f"{len(logical_axes)} logical axes {tuple(logical_axes)}"
+        )
+    used: set = set()
+    spec: list = []
+    for dim_size, logical in zip(shape, logical_axes):
+        assigned = None
+        if logical is not None:
+            for name, mesh_axis in rules:
+                if name != logical:
+                    continue
+                if mesh_axis is None:
+                    break  # explicit replicate — terminal
+                size = mesh.shape.get(mesh_axis, 1)
+                if size <= 1 or mesh_axis in used:
+                    continue
+                if dim_size % size:
+                    continue
+                assigned = mesh_axis
+                used.add(mesh_axis)
+                break
+        spec.append(assigned)
+    return P(*spec)
+
+
+def tree_logical_shardings(
+    tree: Any,
+    logical_tree: Any,
+    mesh: Mesh,
+    rules: Optional[Sequence[Tuple[str, Optional[str]]]] = None,
+) -> Any:
+    """Pytree of NamedShardings from a matching pytree of logical-axis tuples."""
+    rules = tuple(rules) if rules is not None else DEFAULT_RULES
+
+    def leaf(x: Any, axes: Any) -> NamedSharding:
+        # ``axes`` is a tuple of per-dim logical names (None entries =
+        # replicate that dim). tree_map stops descending at ``tree``'s leaf
+        # positions (flatten_up_to), so the tuples survive intact.
+        shape = np.shape(x)
+        return NamedSharding(mesh, spec_from_logical(shape, axes, rules, mesh))
+
+    return jax.tree_util.tree_map(leaf, tree, logical_tree)

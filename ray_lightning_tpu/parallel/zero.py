@@ -55,6 +55,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def gather_to_host(tree: Any, mesh: Mesh) -> Any:
+    """All-gather sharded leaves and return a full host-numpy pytree.
+
+    The shared checkpoint-gather path for every sharded strategy
+    (SURVEY.md §7 "checkpoint of sharded state"): a jitted identity with
+    replicated out_shardings makes XLA emit the all-gathers, then the
+    replicated copies are fetched to host.
+    """
+    import jax
+
+    rep = NamedSharding(mesh, P())
+    gathered = jax.jit(lambda t: t, out_shardings=rep)(tree)
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)), gathered
+    )
+
+
 def sharded_bytes_fraction(tree: Any, shardings: Any) -> float:
     """Fraction of the tree's bytes that got sharded (diagnostics/tests)."""
     total = 0

@@ -6,6 +6,7 @@ names. Sharded/ring variants land with their milestones.
 """
 from ray_lightning_tpu.strategies.base import SingleDeviceStrategy, Strategy
 from ray_lightning_tpu.strategies.ddp import RayStrategy, RayTPUStrategy
+from ray_lightning_tpu.strategies.gspmd import GSPMDStrategy
 from ray_lightning_tpu.strategies.ring import HorovodRayStrategy, RingTPUStrategy
 from ray_lightning_tpu.strategies.sharded import RayShardedStrategy
 
@@ -17,4 +18,5 @@ __all__ = [
     "RayShardedStrategy",
     "RingTPUStrategy",
     "HorovodRayStrategy",
+    "GSPMDStrategy",
 ]

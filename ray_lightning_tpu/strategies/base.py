@@ -61,6 +61,7 @@ class Strategy:
         self.mesh = None
         self.dist_env: Optional[DistEnv] = None
         self._is_remote = False
+        self._module: Optional[Any] = None
 
     # ------------------------------------------------------------------
     # Driver side
@@ -177,6 +178,12 @@ class Strategy:
             )
         self.mesh = self.build_mesh()
 
+    def bind_module(self, module: Any) -> None:
+        """Give the strategy the user module before state placement, so
+        sharding rules can consult module hooks (``param_logical_axes``,
+        ``bind_mesh``). Called by the loop once the mesh exists."""
+        self._module = module
+
     def build_mesh(self):
         from ray_lightning_tpu.parallel.mesh import build_mesh
 
@@ -259,6 +266,17 @@ class Strategy:
             (loss, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             updates, opt_state2 = tx.update(grads, opt_state, params)
             params2 = optax.apply_updates(params, updates)
+            # Pin outputs to the strategy's shardings: without the
+            # constraint GSPMD may pick a different layout for the updated
+            # state, causing a reshard every step (observed on multi-axis
+            # meshes). Sharding rules only need shapes, so they work on
+            # tracers.
+            params2 = jax.lax.with_sharding_constraint(
+                params2, self.param_sharding(params2)
+            )
+            opt_state2 = jax.lax.with_sharding_constraint(
+                opt_state2, self.opt_sharding(opt_state2, params2)
+            )
             logs.setdefault("loss", loss)
             return params2, opt_state2, logs
 

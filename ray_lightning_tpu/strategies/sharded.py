@@ -52,12 +52,6 @@ class RayShardedStrategy(RayTPUStrategy):
     def gather_state(self, tree: Any) -> Any:
         """All-gather sharded leaves to full host arrays for checkpointing
         (SURVEY.md §7 'checkpoint of sharded state' hard part)."""
-        import jax
-        import numpy as np
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ray_lightning_tpu.parallel.zero import gather_to_host
 
-        rep = NamedSharding(self.mesh, P())
-        gathered = jax.jit(lambda t: t, out_shardings=rep)(tree)
-        return jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(x)), gathered
-        )
+        return gather_to_host(tree, self.mesh)

@@ -122,6 +122,7 @@ class TrainingLoop:
         reset_seed()
         self.module.trainer = self
         self.module.precision = self.spec.precision
+        self.strategy.bind_module(self.module)
         seed = self.spec.seed if self.spec.seed is not None else 0
         self._rng = jax.random.PRNGKey(seed)
 

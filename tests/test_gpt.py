@@ -1,0 +1,297 @@
+"""GPT model family + GSPMDStrategy (dp/fsdp/tp/sp) tests.
+
+Runs on the 8-virtual-CPU-device mesh from conftest. Mirrors the reference's
+behavioral test style (weights move, metrics finite — tests/utils.py:236-272)
+and adds TPU-specific assertions: parameter shardings land on the intended
+mesh axes, tensor/sequence-parallel forwards agree with the dense one.
+"""
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import GPTConfig, GPTLM, make_fake_text
+from ray_lightning_tpu.models.gpt import gpt_forward, init_gpt_params
+from ray_lightning_tpu.strategies import GSPMDStrategy
+
+TINY = GPTConfig(
+    vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq=32,
+    attn_impl="reference",
+)
+
+
+def make_inprocess(mesh_shape, num_workers=8, **kw):
+    """GSPMD strategy wired for in-process use (the __graft_entry__ pattern)."""
+    from ray_lightning_tpu.parallel.env import DistEnv
+
+    s = GSPMDStrategy(
+        num_workers=num_workers, use_tpu=False, mesh_shape=mesh_shape, **kw
+    )
+    s.dist_env = DistEnv(
+        world_size=num_workers, num_hosts=1, host_rank=0, local_chips=num_workers
+    )
+    s.mesh = s.build_mesh()
+    return s
+
+
+def test_forward_shape_and_flash_parity():
+    import jax
+
+    rng = jax.random.PRNGKey(0)
+    params = init_gpt_params(rng, TINY)
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, TINY.vocab_size)
+    )
+    ref = gpt_forward(params, toks, TINY)
+    assert ref.shape == (2, 16, TINY.vocab_size)
+    assert np.isfinite(np.asarray(ref)).all()
+    import dataclasses
+
+    flash_cfg = dataclasses.replace(TINY, attn_impl="flash")
+    out = gpt_forward(params, toks, flash_cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+def test_mesh_shape_validation():
+    with pytest.raises(ValueError, match="covers"):
+        GSPMDStrategy(num_workers=8, use_tpu=False, mesh_shape={"data": 4})
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        GSPMDStrategy(num_workers=8, use_tpu=False, mesh_shape={"pp": 8})
+    with pytest.raises(ValueError, match="sequence_parallel"):
+        GSPMDStrategy(
+            num_workers=8,
+            use_tpu=False,
+            mesh_shape={"data": 8},
+            sequence_parallel=True,
+        )
+
+
+def test_param_shardings_land_on_mesh_axes():
+    """wqkv heads dim -> model axis, embed dims -> fsdp axis; optimizer
+    moments follow their parameters."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    strategy = make_inprocess({"data": 2, "fsdp": 2, "model": 2})
+    module = GPTLM(config=TINY, batch_size=4)
+    strategy.bind_module(module)
+
+    params = init_gpt_params(jax.random.PRNGKey(0), TINY)
+    shardings = strategy.param_sharding(params)
+    assert shardings["blocks"]["wqkv"].spec == P(None, "fsdp", None, "model", None)
+    assert shardings["blocks"]["wi"].spec == P(None, "fsdp", "model")
+    assert shardings["blocks"]["wo2"].spec == P(None, "model", "fsdp")
+    assert shardings["wte"].spec == P("model", "fsdp")
+    assert shardings["lnf_g"].spec == P(None)
+
+    tx = module.configure_optimizers()
+    opt_state = tx.init(params)
+    opt_sh = strategy.opt_sharding(opt_state, params)
+    flat = jax.tree_util.tree_leaves(opt_sh)
+    specs = {s.spec for s in flat}
+    assert P(None, "fsdp", None, "model", None) in specs  # mu/nu for wqkv
+    assert P() in specs  # count scalar replicated
+
+
+def test_tp_forward_matches_dense():
+    """The same params under a dp2 x model4 mesh produce the same logits as
+    the unsharded forward — GSPMD sharding must not change the math."""
+    import jax
+
+    strategy = make_inprocess({"data": 2, "model": 4})
+    module = GPTLM(config=TINY, batch_size=4)
+    strategy.bind_module(module)
+
+    params = init_gpt_params(jax.random.PRNGKey(0), TINY)
+    dense = gpt_forward(
+        params,
+        np.asarray(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, TINY.vocab_size)
+        ),
+        TINY,
+    )
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, TINY.vocab_size)
+    )
+    placed = strategy.place_params(params)
+    sharded = jax.jit(lambda p, t: gpt_forward(p, t, TINY))(placed, toks)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), atol=1e-4)
+
+
+def test_sequence_parallel_ring_matches_dense():
+    """Ring attention over the seq axis reproduces the dense causal logits."""
+    import jax
+
+    strategy = make_inprocess(
+        {"data": 2, "seq": 4}, sequence_parallel=True
+    )
+    module = GPTLM(config=TINY, batch_size=4)
+    strategy.bind_module(module)
+    assert module._seq_axis == "seq"
+
+    params = init_gpt_params(jax.random.PRNGKey(0), TINY)
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, TINY.vocab_size)
+    )
+    dense = gpt_forward(params, toks, TINY)
+    placed = strategy.place_params(params)
+    ringed = jax.jit(lambda p, t: module._forward(p, t))(placed, toks)
+    np.testing.assert_allclose(np.asarray(ringed), np.asarray(dense), atol=1e-3)
+
+
+def test_gspmd_compiled_step_trains():
+    """Full sharded train step on dp2 x fsdp2 x model2: loss decreases and
+    shardings survive the step (donation + out shardings stable)."""
+    import jax
+
+    strategy = make_inprocess({"data": 2, "fsdp": 2, "model": 2})
+    module = GPTLM(config=TINY, batch_size=4, lr=1e-2, warmup_steps=2)
+    strategy.bind_module(module)
+
+    data = make_fake_text(64, seq_len=16, vocab=TINY.vocab_size)
+    toks = data.arrays[0][:16]
+    rng = jax.random.PRNGKey(0)
+    params = module.init_params(rng, (toks,))
+    tx = module.configure_optimizers()
+    opt_state = tx.init(params)
+
+    params = strategy.place_params(params)
+    opt_state = strategy.place_opt_state(opt_state, params)
+    batch = strategy.make_global_batch((toks,))
+    step = strategy.compile_train_step(module, tx)
+
+    losses = []
+    for _ in range(20):
+        params, opt_state, logs = step(params, opt_state, batch, rng)
+        losses.append(float(np.asarray(logs["loss"])))
+    assert losses[-1] < losses[0] * 0.8, losses
+    wqkv = params["blocks"]["wqkv"]
+    expected = strategy.param_sharding(params)["blocks"]["wqkv"]
+    assert wqkv.sharding.is_equivalent_to(expected, wqkv.ndim)
+
+
+def test_gspmd_fallback_without_logical_axes():
+    """Modules without param_logical_axes get ZeRO-3-style fsdp sharding."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ray_lightning_tpu.models import MNISTClassifier
+
+    strategy = make_inprocess({"fsdp": 8})
+    module = MNISTClassifier(batch_size=4)
+    strategy.bind_module(module)
+    params = module.init_params(
+        jax.random.PRNGKey(0), (np.zeros((8, 28, 28), np.float32), np.zeros(8, np.int32))
+    )
+    sh = strategy.param_sharding(params)
+    assert sh["w1"].spec == P("fsdp", None)
+
+
+def test_logical_spec_resolution():
+    from jax.sharding import PartitionSpec as P
+
+    from ray_lightning_tpu.parallel.logical import (
+        DEFAULT_RULES,
+        spec_from_logical,
+    )
+
+    strategy = make_inprocess({"data": 2, "fsdp": 2, "model": 2})
+    mesh = strategy.mesh
+    # indivisible dim stays replicated
+    assert spec_from_logical((3, 32), ("heads", "embed"), DEFAULT_RULES, mesh) == P(
+        None, "fsdp"
+    )
+    # a mesh axis is used at most once per spec
+    assert spec_from_logical(
+        (32, 32), ("embed", "embed"), DEFAULT_RULES, mesh
+    ) == P("fsdp", None)
+    with pytest.raises(ValueError, match="logical axes"):
+        spec_from_logical((32,), ("embed", "mlp"), DEFAULT_RULES, mesh)
+
+
+def test_logical_none_rule_override():
+    """A prepended (name, None) rule pins the axis replicated (t5x-style
+    first-match-wins), overriding later rules for the same name."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ray_lightning_tpu.parallel.logical import DEFAULT_RULES
+
+    strategy = make_inprocess(
+        {"data": 2, "fsdp": 2, "model": 2},
+        logical_axis_rules=[("heads", None)] + list(DEFAULT_RULES),
+    )
+    module = GPTLM(config=TINY)
+    strategy.bind_module(module)
+    params = init_gpt_params(jax.random.PRNGKey(0), TINY)
+    sh = strategy.param_sharding(params)
+    assert sh["blocks"]["wqkv"].spec == P(None, "fsdp", None, None, None)
+
+
+def test_opt_sharding_no_shape_collision():
+    """Same-shape params with different layouts (d_ff == d_model) keep
+    per-param moment shardings (structure-matched, not shape-matched)."""
+    import jax
+
+    cfg = GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=32, d_ff=32, max_seq=32,
+        attn_impl="reference",
+    )
+    strategy = make_inprocess({"fsdp": 4, "model": 2})
+    module = GPTLM(config=cfg)
+    strategy.bind_module(module)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tx = module.configure_optimizers()
+    opt_state = tx.init(params)
+    psh = strategy.param_sharding(params)
+    osh = strategy.opt_sharding(opt_state, params)
+    # Find the mu subtree (same treedef as params) inside the optax state.
+    mu_sh = jax.tree_util.tree_leaves(
+        osh, is_leaf=lambda n: isinstance(n, dict) and "blocks" in n
+    )
+    mu_trees = [n for n in mu_sh if isinstance(n, dict)]
+    assert mu_trees, "no param-structured subtree found in opt shardings"
+    for tree in mu_trees:
+        assert tree["blocks"]["wi"].spec == psh["blocks"]["wi"].spec
+        assert tree["blocks"]["wo2"].spec == psh["blocks"]["wo2"].spec
+    assert psh["blocks"]["wi"].spec != psh["blocks"]["wo2"].spec
+
+
+def test_gspmd_sampler_follows_dp_extent():
+    """dp < num_hosts (tp spans hosts): host groups sharing a dp shard get
+    identical sampler ranks; dp % hosts == 0 keeps per-host sharding."""
+    from ray_lightning_tpu.parallel.env import DistEnv
+
+    s = GSPMDStrategy(
+        num_workers=8, use_tpu=False, mesh_shape={"data": 2, "model": 4}
+    )
+    s.dist_env = DistEnv(world_size=8, num_hosts=4, host_rank=3, local_chips=2)
+    assert s.sampler_kwargs() == {"num_replicas": 2, "rank": 1}
+    assert s.batch_multiplier == 1
+
+    s.dist_env = DistEnv(world_size=8, num_hosts=2, host_rank=1, local_chips=4)
+    assert s.sampler_kwargs() == {"num_replicas": 2, "rank": 1}
+
+    s2 = GSPMDStrategy(
+        num_workers=6, use_tpu=False, mesh_shape={"data": 3, "model": 2}
+    )
+    s2.dist_env = DistEnv(world_size=6, num_hosts=2, host_rank=0, local_chips=3)
+    with pytest.raises(ValueError, match="divide"):
+        s2.sampler_kwargs()
+
+
+def test_gptlm_fit_end_to_end(start_fabric, tmp_path):
+    """Trainer.fit(GPTLM, GSPMDStrategy) through the actor fabric: the full
+    driver->worker->driver path with a tp-sharded transformer."""
+    fabric = start_fabric(num_cpus=2)
+    from tests.utils import get_trainer, train_test
+
+    strategy = GSPMDStrategy(
+        num_workers=4,
+        use_tpu=False,
+        mesh_shape={"data": 2, "model": 2},
+    )
+    module = GPTLM(config=TINY, batch_size=4, n_train=64)
+    trainer = get_trainer(
+        strategy=strategy, max_epochs=1, default_root_dir=str(tmp_path)
+    )
+    train_test(trainer, module)
+    assert trainer.callback_metrics.get("val_loss") is not None
