@@ -77,9 +77,9 @@ def _baseline_in_worker(epochs: int, batch_size: int, n_train: int, use_tpu: boo
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--epochs", type=int, default=4)
+    parser.add_argument("--epochs", type=int, default=6)
     parser.add_argument("--batch-size", type=int, default=64)
-    parser.add_argument("--n-train", type=int, default=8192)
+    parser.add_argument("--n-train", type=int, default=49152)
     args = parser.parse_args()
 
     from ray_lightning_tpu import fabric
@@ -95,8 +95,11 @@ def main() -> None:
     b_steps, b_times, b_chips = _baseline_in_worker(
         args.epochs, args.batch_size, args.n_train, use_tpu
     )
+    import statistics
+
     b_timed = b_times[1:] or b_times  # drop compile epoch
-    baseline_sps_chip = b_steps * len(b_timed) / sum(b_timed) / max(1, b_chips)
+    # Median epoch time: robust to one-off host hiccups in short epochs.
+    baseline_sps_chip = b_steps / statistics.median(b_timed) / max(1, b_chips)
 
     # Framework path: full launcher + strategy; worker-side epoch times come
     # back through the callback-state sync.
@@ -107,7 +110,7 @@ def main() -> None:
         args.n_train,
     )
     timed = times[1:] or times
-    sps_chip = steps_per_epoch * len(timed) / sum(timed) / max(1, num_workers)
+    sps_chip = steps_per_epoch / statistics.median(timed) / max(1, num_workers)
 
     vs_baseline = sps_chip / baseline_sps_chip if baseline_sps_chip > 0 else 0.0
     print(

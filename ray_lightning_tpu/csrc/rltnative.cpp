@@ -1,0 +1,96 @@
+// rltnative: native data-path kernels for the host side of training.
+//
+// The reference delegates its native needs to torch/NCCL/Horovod C++ cores
+// (SURVEY.md §2b); the TPU build's device math lives in XLA/Pallas, but the
+// *host* data path (batch assembly feeding the async dispatch queue) is pure
+// CPU work where Python costs real step time. These kernels do batch
+// gather/convert with the GIL released (ctypes drops it for the call
+// duration), so a prefetch thread overlaps batch assembly with device
+// compute.
+//
+// Built on first use via g++ (see utils/native.py); no pybind11 — plain C
+// ABI + ctypes, per the environment's binding constraints.
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows of a contiguous 2D-view array: out[i, :] = src[idx[i], :].
+// row_bytes covers all trailing dims. Multi-threaded for large batches.
+void rlt_gather_rows(const uint8_t* src, uint8_t* out, const int64_t* idx,
+                     int64_t n_idx, int64_t row_bytes, int32_t n_threads) {
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(out + i * row_bytes, src + idx[i] * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+  };
+  if (n_threads <= 1 || n_idx < 4 * n_threads) {
+    work(0, n_idx);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n_idx ? lo + chunk : n_idx;
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Fused gather + uint8 -> float32 normalize: out[i, j] =
+// (src[idx[i], j] * scale) + shift. The image-dataset hot path (CIFAR/MNIST
+// bytes to normalized floats) without a second pass over the batch.
+void rlt_gather_u8_to_f32(const uint8_t* src, float* out, const int64_t* idx,
+                          int64_t n_idx, int64_t row_elems, float scale,
+                          float shift, int32_t n_threads) {
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* s = src + idx[i] * row_elems;
+      float* o = out + i * row_elems;
+      for (int64_t j = 0; j < row_elems; ++j) {
+        o[j] = static_cast<float>(s[j]) * scale + shift;
+      }
+    }
+  };
+  if (n_threads <= 1 || n_idx < 4 * n_threads) {
+    work(0, n_idx);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n_idx ? lo + chunk : n_idx;
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Fisher-Yates shuffle of an index range with SplitMix64 — the sampler's
+// per-epoch permutation without numpy allocation churn.
+void rlt_shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
+  uint64_t x = seed + 0x9E3779B97F4A7C15ULL;
+  auto next = [&x]() {
+    x += 0x9E3779B97F4A7C15ULL;
+    uint64_t z = x;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  };
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = static_cast<int64_t>(next() % static_cast<uint64_t>(i + 1));
+    int64_t tmp = idx[i];
+    idx[i] = idx[j];
+    idx[j] = tmp;
+  }
+}
+
+int32_t rlt_abi_version() { return 1; }
+
+}  // extern "C"

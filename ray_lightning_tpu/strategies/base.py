@@ -258,7 +258,12 @@ class Strategy:
         import jax
         import optax
 
-        def step(params, opt_state, batch, rng):
+        def step(params, opt_state, batch, rng, step_idx):
+            # Per-step rng derivation happens *inside* the compiled program
+            # (the loop passes the base key + step counter), avoiding a
+            # separate fold_in dispatch on the host every step.
+            rng = jax.random.fold_in(rng, step_idx)
+
             def loss_fn(p):
                 loss, logs = module.training_step(p, batch, rng)
                 return loss, dict(logs)

@@ -54,7 +54,12 @@ class RingTPUStrategy(RayTPUStrategy):
             in_specs=(P(), P(), P("data"), P()),
             out_specs=(P(), P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=(0, 1))
+
+        def step(params, opt_state, batch, rng, step_idx):
+            rng = jax.random.fold_in(rng, step_idx)
+            return sharded(params, opt_state, batch, rng)
+
+        return jax.jit(step, donate_argnums=(0, 1))
 
     def compile_eval_step(self, module: Any, stage: str) -> Callable:
         import jax

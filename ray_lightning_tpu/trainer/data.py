@@ -153,12 +153,21 @@ class DataLoader:
             )
         return np.stack([np.asarray(it) for it in items])
 
-    def iter_batches(self, batch_multiplier: int = 1) -> Iterator[Any]:
-        """Yield host-level batches of ``batch_size * batch_multiplier``.
+    def _gather(self, sel: np.ndarray) -> Any:
+        """Assemble one batch for the row indices ``sel``.
 
-        ``batch_multiplier`` is the number of local chips this host feeds;
-        GSPMD then splits the array across them.
+        ArrayDataset fast path: whole-batch native row gather (GIL released,
+        csrc/rltnative.cpp) instead of a per-item Python loop — this is what
+        makes the prefetch thread actually overlap with device compute.
         """
+        if self.collate_fn is None and isinstance(self.dataset, ArrayDataset):
+            from ray_lightning_tpu.utils.native import gather_rows
+
+            outs = tuple(gather_rows(a, sel) for a in self.dataset.arrays)
+            return outs if len(outs) > 1 else outs[0]
+        return self._collate([self.dataset[int(i)] for i in sel])
+
+    def _iter_selections(self, batch_multiplier: int) -> Iterator[np.ndarray]:
         if self.sampler is not None:
             idx = self.sampler.indices()
         else:
@@ -171,16 +180,75 @@ class DataLoader:
         n_full = len(idx) // bs
         remainder = len(idx) - n_full * bs
         for b in range(n_full):
-            sel = idx[b * bs : (b + 1) * bs]
-            yield self._collate([self.dataset[int(i)] for i in sel])
+            yield idx[b * bs : (b + 1) * bs]
         if remainder and not self.drop_last:
             # Pad the tail batch by wrap-around so its leading dim stays
             # divisible across chips (static shapes for XLA). np.resize
             # cycles the index list, covering shards smaller than one batch.
             sel = idx[n_full * bs :]
             pad = np.resize(idx, bs - len(sel))
-            sel = np.concatenate([sel, pad])
-            yield self._collate([self.dataset[int(i)] for i in sel])
+            yield np.concatenate([sel, pad])
+
+    def iter_batches(
+        self, batch_multiplier: int = 1, prefetch: Optional[int] = None
+    ) -> Iterator[Any]:
+        """Yield host-level batches of ``batch_size * batch_multiplier``.
+
+        ``batch_multiplier`` is the number of local chips this host feeds;
+        GSPMD then splits the array across them. ``prefetch`` > 0 assembles
+        up to that many batches ahead in a background thread (default: 2
+        when the native gather is available, else synchronous).
+        """
+        if prefetch is None:
+            from ray_lightning_tpu.utils.native import native_available
+
+            prefetch = 2 if native_available() else 0
+        if prefetch <= 0:
+            for sel in self._iter_selections(batch_multiplier):
+                yield self._gather(sel)
+            return
+
+        import queue as queue_mod
+        import threading
+
+        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=prefetch)
+        stop = threading.Event()
+        SENTINEL = object()
+
+        def producer() -> None:
+            try:
+                for sel in self._iter_selections(batch_multiplier):
+                    batch = self._gather(sel)
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue_mod.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                payload: Any = SENTINEL
+            except BaseException as exc:  # noqa: BLE001 - reraise in consumer
+                payload = exc
+            while not stop.is_set():
+                try:
+                    q.put(payload, timeout=0.1)
+                    return
+                except queue_mod.Full:
+                    continue
+
+        t = threading.Thread(target=producer, name="rlt-prefetch", daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
 
     def num_batches(self, batch_multiplier: int = 1) -> int:
         n = (

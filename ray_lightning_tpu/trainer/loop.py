@@ -234,9 +234,8 @@ class TrainingLoop:
                 if batch_idx >= n_batches:
                     break
                 batch = self.strategy.make_global_batch(host_batch)
-                step_rng = jax.random.fold_in(self._rng, self.global_step)
                 self.params, self.opt_state, logs = train_step(
-                    self.params, self.opt_state, batch, step_rng
+                    self.params, self.opt_state, batch, self._rng, self.global_step
                 )
                 epoch_logs.append(logs)  # device scalars; no sync here
                 self.global_step += 1

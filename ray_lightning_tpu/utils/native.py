@@ -1,0 +1,166 @@
+"""Loader for the native data-path library (csrc/rltnative.cpp).
+
+Compiles the C++ source with g++ on first use into a per-user cache keyed by
+source hash (so edits rebuild automatically), binds it with ctypes (no
+pybind11 in this environment), and degrades to numpy fallbacks when no
+compiler is available or RLT_NO_NATIVE=1. ctypes releases the GIL for the
+call duration, which is what lets the prefetch thread in
+``trainer.data`` overlap batch assembly with device compute.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_SRC = Path(__file__).resolve().parent.parent / "csrc" / "rltnative.cpp"
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return Path(base) / "ray_lightning_tpu"
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    src = _SRC.read_bytes()
+    digest = hashlib.sha256(src).hexdigest()[:16]
+    out = _cache_dir() / f"rltnative-{digest}.so"
+    if not out.exists():
+        out.parent.mkdir(parents=True, exist_ok=True)
+        tmp = out.with_suffix(f".build-{os.getpid()}.so")
+        cmd = [
+            os.environ.get("CXX", "g++"),
+            "-O3",
+            "-shared",
+            "-fPIC",
+            "-std=c++17",
+            "-pthread",
+            str(_SRC),
+            "-o",
+            str(tmp),
+        ]
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed: {proc.stderr.decode(errors='replace')}"
+            )
+        os.replace(tmp, out)  # atomic vs concurrent workers building too
+    lib = ctypes.CDLL(str(out))
+    lib.rlt_abi_version.restype = ctypes.c_int32
+    if lib.rlt_abi_version() != 1:
+        raise RuntimeError("rltnative ABI mismatch")
+    lib.rlt_gather_rows.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    lib.rlt_gather_u8_to_f32.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_float,
+        ctypes.c_float,
+        ctypes.c_int32,
+    ]
+    lib.rlt_shuffle_indices.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+    ]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (fallback mode)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("RLT_NO_NATIVE") == "1":
+            return None
+        try:
+            _lib = _build()
+        except Exception:  # noqa: BLE001 - any failure means fallback
+            _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def _n_threads(n_rows: int) -> int:
+    cpus = os.cpu_count() or 1
+    return max(1, min(4, cpus, n_rows // 512))
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """out[i] = src[idx[i]] for contiguous src; GIL-free when native."""
+    lib = get_lib()
+    if lib is None or not src.flags.c_contiguous:
+        return src[idx]
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], initial=1))
+    lib.rlt_gather_rows(
+        src.ctypes.data,
+        out.ctypes.data,
+        idx.ctypes.data,
+        len(idx),
+        row_bytes,
+        _n_threads(len(idx)),
+    )
+    return out
+
+
+def gather_rows_u8_to_f32(
+    src: np.ndarray, idx: np.ndarray, scale: float = 1.0 / 255.0, shift: float = 0.0
+) -> np.ndarray:
+    """Fused gather + uint8->float32 normalize (image batch hot path)."""
+    lib = get_lib()
+    if lib is None or not src.flags.c_contiguous or src.dtype != np.uint8:
+        return src[idx].astype(np.float32) * scale + shift
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], dtype=np.float32)
+    row_elems = int(np.prod(src.shape[1:], initial=1))
+    lib.rlt_gather_u8_to_f32(
+        src.ctypes.data,
+        out.ctypes.data,
+        idx.ctypes.data,
+        len(idx),
+        row_elems,
+        scale,
+        shift,
+        _n_threads(len(idx)),
+    )
+    return out
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    """Permutation of range(n); native Fisher-Yates when available."""
+    lib = get_lib()
+    if lib is None:
+        return np.random.default_rng(seed).permutation(n)
+    idx = np.arange(n, dtype=np.int64)
+    lib.rlt_shuffle_indices(idx.ctypes.data, n, ctypes.c_uint64(seed & (2**64 - 1)).value)
+    return idx

@@ -159,8 +159,8 @@ def test_gspmd_compiled_step_trains():
     step = strategy.compile_train_step(module, tx)
 
     losses = []
-    for _ in range(20):
-        params, opt_state, logs = step(params, opt_state, batch, rng)
+    for i in range(20):
+        params, opt_state, logs = step(params, opt_state, batch, rng, i)
         losses.append(float(np.asarray(logs["loss"])))
     assert losses[-1] < losses[0] * 0.8, losses
     wqkv = params["blocks"]["wqkv"]

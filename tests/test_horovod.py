@@ -56,7 +56,7 @@ def test_ring_step_in_process_matches_gspmd():
         o = strategy.place_opt_state(opt_state, params)
         b = strategy.make_global_batch((x, y))
         step = strategy.compile_train_step(module, tx)
-        new_p, _, logs = step(p, o, b, rng)
+        new_p, _, logs = step(p, o, b, rng, 0)
         outs[name] = (
             np.asarray(new_p["w1"]),
             float(np.asarray(logs["loss"])),

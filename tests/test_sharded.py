@@ -80,7 +80,7 @@ def test_opt_state_is_sharded_on_mesh():
     # One compiled step runs and keeps shardings stable
     batch = strategy.make_global_batch((np.random.randn(32, 28, 28).astype(np.float32), np.zeros((32,), np.int32)))
     step = strategy.compile_train_step(module, tx)
-    new_params, new_opt, logs = step(placed_params, placed_opt, batch, rng)
+    new_params, new_opt, logs = step(placed_params, placed_opt, batch, rng, 0)
     new_mu = [
         l
         for l in jax.tree_util.tree_leaves(new_opt)
