@@ -1,0 +1,219 @@
+"""Command-line interface: build Trainer + strategy + model from flags/YAML.
+
+Parity target: the reference keeps its strategies LightningCLI/jsonargparse-
+constructible — plain typed ctor kwargs instantiated from CLI flags
+(/root/reference/ray_lightning/tests/test_lightning_cli.py:11-27,
+SURVEY.md §5 config/flag system). jsonargparse is not in this environment,
+so the CLI is self-contained: argparse + constructor introspection, with
+Lightning's ``{class_path, init_args}`` YAML convention and dotted CLI
+overrides.
+
+Usage:
+    python -m ray_lightning_tpu.cli fit \
+        --model ray_lightning_tpu.models.MNISTClassifier --model.lr 3e-4 \
+        --strategy RayTPUStrategy --strategy.num_workers 4 \
+        --trainer.max_epochs 2 [--config run.yaml]
+
+YAML config (merged under CLI overrides):
+    model:
+      class_path: ray_lightning_tpu.models.GPTLM
+      init_args: {batch_size: 8}
+    strategy:
+      class_path: ray_lightning_tpu.strategies.GSPMDStrategy
+      init_args: {num_workers: 8, mesh_shape: {data: 4, model: 2}}
+    trainer: {max_epochs: 3}
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+_SUBCOMMANDS = ("fit", "validate", "test", "predict")
+
+
+def import_class(path: str) -> type:
+    """Resolve ``pkg.mod.Class`` (or a bare name from the strategies /
+    models namespaces) to a class object."""
+    if "." in path:
+        module_name, _, cls_name = path.rpartition(".")
+        return getattr(importlib.import_module(module_name), cls_name)
+    for ns in ("ray_lightning_tpu.strategies", "ray_lightning_tpu.models"):
+        mod = importlib.import_module(ns)
+        if hasattr(mod, path):
+            return getattr(mod, path)
+    raise ValueError(f"cannot resolve class {path!r}")
+
+
+def _target_type(annotation: Any, default: Any) -> Optional[type]:
+    """Best-effort scalar type from a ctor annotation (which is usually a
+    *string* — the package uses ``from __future__ import annotations``) or
+    the default value."""
+    if isinstance(annotation, type):
+        return annotation
+    if isinstance(annotation, str):
+        for name, typ in (("bool", bool), ("int", int), ("float", float),
+                          ("str", str)):
+            if name in annotation:
+                return typ
+    if annotation is inspect.Parameter.empty and default is not None:
+        if isinstance(default, (bool, int, float, str)):
+            return type(default)
+    return None
+
+
+def _coerce(value: str, annotation: Any, default: Any) -> Any:
+    """Parse a CLI string with YAML, then bend it toward the ctor's type
+    (YAML alone keeps e.g. '3e-4' a string — its float resolver wants a
+    dot)."""
+    parsed = yaml.safe_load(value)
+    target = _target_type(annotation, default)
+    if target is bool:
+        return parsed if isinstance(parsed, bool) else str(parsed).lower() in (
+            "1", "true", "yes",
+        )
+    if target in (int, float) and isinstance(parsed, (int, float, str)):
+        try:
+            return target(parsed)
+        except (TypeError, ValueError):
+            return parsed
+    return parsed
+
+
+def instantiate_class(spec: Any, default_class: Optional[str] = None) -> Any:
+    """Instantiate Lightning-style ``{class_path, init_args}`` (or a bare
+    class-path string)."""
+    if isinstance(spec, str):
+        spec = {"class_path": spec, "init_args": {}}
+    class_path = spec.get("class_path") or default_class
+    if class_path is None:
+        raise ValueError(f"missing class_path in {spec!r}")
+    cls = import_class(class_path)
+    kwargs = dict(spec.get("init_args") or {})
+    _validate_ctor_kwargs(cls, kwargs)
+    return cls(**kwargs)
+
+
+def _validate_ctor_kwargs(cls: type, kwargs: Dict[str, Any]) -> None:
+    sig = inspect.signature(cls.__init__)
+    accepts_var_kw = any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values()
+    )
+    if accepts_var_kw:
+        return
+    valid = set(sig.parameters) - {"self"}
+    unknown = set(kwargs) - valid
+    if unknown:
+        raise ValueError(
+            f"{cls.__name__} does not accept {sorted(unknown)}; "
+            f"valid args: {sorted(valid)}"
+        )
+
+
+def _apply_dotted(
+    config: Dict[str, Any], dotted: List[Tuple[str, str]]
+) -> Dict[str, Any]:
+    """Merge ``--section.key value`` overrides into the config tree, coercing
+    through the target constructor's signature where known."""
+    for key, raw in dotted:
+        section, _, field = key.partition(".")
+        if section not in ("model", "strategy", "trainer", "data"):
+            raise ValueError(f"unknown config section {section!r} in --{key}")
+        node = config.setdefault(section, {})
+        if not field:  # bare --model X == class path
+            node["class_path"] = raw
+            continue
+        if section == "trainer":
+            node[field] = yaml.safe_load(raw)
+            continue
+        init_args = node.setdefault("init_args", {})
+        cls_path = node.get("class_path")
+        annotation: Any = inspect.Parameter.empty
+        default: Any = None
+        if cls_path:
+            try:
+                sig = inspect.signature(import_class(cls_path).__init__)
+                if field in sig.parameters:
+                    annotation = sig.parameters[field].annotation
+                    default = sig.parameters[field].default
+            except Exception:  # noqa: BLE001 - fall back to yaml typing
+                pass
+        init_args[field] = _coerce(raw, annotation, default)
+    return config
+
+
+def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(
+        prog="ray_lightning_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("subcommand", choices=_SUBCOMMANDS)
+    parser.add_argument("--config", action="append", default=[])
+    known, rest = parser.parse_known_args(argv)
+
+    config: Dict[str, Any] = {}
+    for path in known.config:
+        with open(path) as f:
+            loaded = yaml.safe_load(f) or {}
+        for section, value in loaded.items():
+            if isinstance(value, dict) and isinstance(config.get(section), dict):
+                merged = dict(config[section])
+                merged.update(value)
+                config[section] = merged
+            else:
+                config[section] = value
+
+    dotted: List[Tuple[str, str]] = []
+    i = 0
+    while i < len(rest):
+        arg = rest[i]
+        if not arg.startswith("--"):
+            raise ValueError(f"unexpected argument {arg!r}")
+        key = arg[2:]
+        if "=" in key:
+            key, _, value = key.partition("=")
+        else:
+            i += 1
+            if i >= len(rest):
+                raise ValueError(f"missing value for --{key}")
+            value = rest[i]
+        dotted.append((key, value))
+        i += 1
+    return known.subcommand, _apply_dotted(config, dotted)
+
+
+def build(config: Dict[str, Any]) -> Tuple[Any, Any, Optional[Any]]:
+    """(trainer, model, datamodule) from a parsed config tree."""
+    from ray_lightning_tpu.trainer import Trainer
+
+    if "model" not in config:
+        raise ValueError("a --model (or model: section) is required")
+    model = instantiate_class(config["model"])
+    datamodule = (
+        instantiate_class(config["data"]) if config.get("data") else None
+    )
+    strategy = None
+    if config.get("strategy"):
+        strategy = instantiate_class(config["strategy"])
+    trainer_kwargs = dict(config.get("trainer") or {})
+    _validate_ctor_kwargs(Trainer, trainer_kwargs)
+    trainer = Trainer(strategy=strategy, **trainer_kwargs)
+    return trainer, model, datamodule
+
+
+def main(argv: Optional[List[str]] = None) -> Any:
+    subcommand, config = parse_args(argv)
+    trainer, model, datamodule = build(config)
+    fn = getattr(trainer, subcommand)
+    if datamodule is not None:
+        return fn(model, datamodule=datamodule)
+    return fn(model)
+
+
+if __name__ == "__main__":
+    main()

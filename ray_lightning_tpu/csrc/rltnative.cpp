@@ -72,25 +72,6 @@ void rlt_gather_u8_to_f32(const uint8_t* src, float* out, const int64_t* idx,
   for (auto& t : ts) t.join();
 }
 
-// Fisher-Yates shuffle of an index range with SplitMix64 — the sampler's
-// per-epoch permutation without numpy allocation churn.
-void rlt_shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
-  uint64_t x = seed + 0x9E3779B97F4A7C15ULL;
-  auto next = [&x]() {
-    x += 0x9E3779B97F4A7C15ULL;
-    uint64_t z = x;
-    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
-    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
-    return z ^ (z >> 31);
-  };
-  for (int64_t i = n - 1; i > 0; --i) {
-    int64_t j = static_cast<int64_t>(next() % static_cast<uint64_t>(i + 1));
-    int64_t tmp = idx[i];
-    idx[i] = idx[j];
-    idx[j] = tmp;
-  }
-}
-
 int32_t rlt_abi_version() { return 1; }
 
 }  // extern "C"

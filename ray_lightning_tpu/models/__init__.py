@@ -15,6 +15,7 @@ from ray_lightning_tpu.models.gpt import (
     make_fake_text,
 )
 from ray_lightning_tpu.models.mnist import MNISTClassifier, make_fake_mnist
+from ray_lightning_tpu.models.resnet import CIFARResNet, make_fake_cifar
 from ray_lightning_tpu.models.xor import XORModule
 
 __all__ = [
@@ -25,6 +26,8 @@ __all__ = [
     "make_fake_mnist",
     "GPTConfig",
     "GPTLM",
+    "CIFARResNet",
+    "make_fake_cifar",
     "gpt_forward",
     "init_gpt_params",
     "make_fake_text",

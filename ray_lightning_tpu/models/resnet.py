@@ -1,0 +1,185 @@
+"""ResNet-18 for CIFAR-10 — the conv/MXU benchmark model family.
+
+Parity target: BASELINE.md config 3 ("ResNet-18 / CIFAR-10, Horovod-
+equivalent ICI allreduce"); the reference itself only touches MNIST MLPs and
+an example-level ImageGPT (SURVEY.md §2 row 12).
+
+TPU-first choices:
+- NHWC layout end to end (XLA's native conv layout on TPU; channels ride
+  the 128-lane minor dim).
+- GroupNorm instead of BatchNorm: stateless, so the training step stays a
+  pure function of (params, batch, rng) — no mutable batch_stats to thread
+  through the compiled step or to sync across data-parallel ranks — and it
+  is batch-size independent (per-chip batches shrink as dp grows).
+- Batches arrive as uint8 and are normalized on-device: 4x less
+  host->device transfer than shipping f32, and the cast fuses into the
+  first conv.
+- Defined with flax.linen (the framework's TPUModule contract is
+  param-pytree + pure apply, so flax modules drop straight in).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.trainer.module import TPUModule
+
+
+def make_fake_cifar(
+    n: int = 512, seed: int = 0, num_classes: int = 10
+) -> ArrayDataset:
+    """Synthetic separable CIFAR-shaped dataset (uint8 NHWC), mirroring the
+    fake-MNIST fixture: class-dependent prototype images + noise."""
+    g = np.random.default_rng(seed)
+    labels = g.integers(0, num_classes, size=n).astype(np.int32)
+    proto = np.random.default_rng(4321).integers(
+        0, 256, size=(num_classes, 32, 32, 3)
+    )
+    noise = g.normal(0.0, 32.0, size=(n, 32, 32, 3))
+    images = np.clip(proto[labels] + noise, 0, 255).astype(np.uint8)
+    return ArrayDataset(images, labels)
+
+
+try:
+    import flax.linen as nn
+
+    class _Block(nn.Module):
+        """Basic residual block (two 3x3 convs, GroupNorm)."""
+
+        filters: int
+        stride: int = 1
+        groups: int = 32
+
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            r = x
+            x = nn.Conv(self.filters, (3, 3), (self.stride, self.stride),
+                        use_bias=False)(x)
+            x = nn.GroupNorm(num_groups=min(self.groups, self.filters))(x)
+            x = nn.relu(x)
+            x = nn.Conv(self.filters, (3, 3), use_bias=False)(x)
+            x = nn.GroupNorm(num_groups=min(self.groups, self.filters))(x)
+            if r.shape != x.shape:
+                r = nn.Conv(self.filters, (1, 1), (self.stride, self.stride),
+                            use_bias=False)(r)
+                r = nn.GroupNorm(num_groups=min(self.groups, self.filters))(r)
+            return nn.relu(x + r)
+
+    class ResNet18(nn.Module):
+        """CIFAR-variant ResNet-18: 3x3 stem (no maxpool), stages
+        [2,2,2,2] x [64,128,256,512], global average pool, linear head."""
+
+        num_classes: int = 10
+        width: int = 64
+        stage_sizes: Sequence[int] = (2, 2, 2, 2)
+
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
+            x = nn.GroupNorm(num_groups=min(32, self.width))(x)
+            x = nn.relu(x)
+            for stage, n_blocks in enumerate(self.stage_sizes):
+                filters = self.width * (2**stage)
+                for block in range(n_blocks):
+                    stride = 2 if stage > 0 and block == 0 else 1
+                    x = _Block(filters, stride)(x)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(self.num_classes)(x)
+
+    FLAX_AVAILABLE = True
+except ImportError:  # pragma: no cover - flax is baked into this image
+    FLAX_AVAILABLE = False
+
+
+class CIFARResNet(TPUModule):
+    """ResNet-18/CIFAR-10 TPUModule (BASELINE.md config 3)."""
+
+    def __init__(
+        self,
+        lr: float = 0.1,
+        batch_size: int = 32,
+        n_train: int = 512,
+        num_classes: int = 10,
+        width: int = 64,
+        momentum: float = 0.9,
+        weight_decay: float = 5e-4,
+        dataset: Optional[ArrayDataset] = None,
+    ) -> None:
+        super().__init__()
+        if not FLAX_AVAILABLE:
+            raise ImportError("CIFARResNet requires flax")
+        self.lr = lr
+        self.batch_size = batch_size
+        self.n_train = n_train
+        self.num_classes = num_classes
+        self.width = width
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self._dataset = dataset
+        self.model = ResNet18(num_classes=num_classes, width=width)
+
+    # -- model -----------------------------------------------------------
+    def init_params(self, rng: jax.Array, batch: Any) -> Any:
+        x = self._prep(batch[0][:1])
+        return self.model.init(rng, x)
+
+    @staticmethod
+    def _prep(x: jax.Array) -> jax.Array:
+        """uint8 NHWC -> normalized f32, on device."""
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 255.0
+        return (x - 0.5) / 0.25
+
+    def _loss_acc(self, params: Any, batch: Tuple) -> Tuple[jax.Array, jax.Array]:
+        x, y = batch
+        logits = self.model.apply(params, self._prep(x))
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, acc
+
+    # -- steps -----------------------------------------------------------
+    def training_step(self, params, batch, rng):
+        loss, acc = self._loss_acc(params, batch)
+        return loss, {"loss": loss, "acc": acc}
+
+    def validation_step(self, params, batch):
+        loss, acc = self._loss_acc(params, batch)
+        return {"val_loss": loss, "val_accuracy": acc}
+
+    def predict_step(self, params, batch):
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return jnp.argmax(self.model.apply(params, self._prep(x)), -1)
+
+    def configure_optimizers(self):
+        return optax.chain(
+            optax.add_decayed_weights(self.weight_decay),
+            optax.sgd(self.lr, momentum=self.momentum),
+        )
+
+    # -- data ------------------------------------------------------------
+    def _data(self) -> ArrayDataset:
+        if self._dataset is None:
+            self._dataset = make_fake_cifar(
+                self.n_train, num_classes=self.num_classes
+            )
+        return self._dataset
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(self._data(), batch_size=self.batch_size, shuffle=True)
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(
+            make_fake_cifar(128, seed=7, num_classes=self.num_classes),
+            batch_size=self.batch_size,
+        )
+
+    def test_dataloader(self) -> DataLoader:
+        return DataLoader(
+            make_fake_cifar(128, seed=8, num_classes=self.num_classes),
+            batch_size=self.batch_size,
+        )

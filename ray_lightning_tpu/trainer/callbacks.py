@@ -37,6 +37,19 @@ class Callback:
     def load_state_dict(self, state: Dict[str, Any]) -> None: ...
 
 
+def _remove_checkpoint(path: str) -> None:
+    """Delete a checkpoint file or sharded checkpoint directory."""
+    import shutil
+
+    try:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        else:
+            os.remove(path)
+    except OSError:
+        pass
+
+
 def _metric_value(trainer: Any, monitor: str) -> Optional[float]:
     val = trainer.callback_metrics.get(monitor)
     if val is None:
@@ -61,8 +74,10 @@ class ModelCheckpoint(Callback):
         mode: str = "min",
         save_top_k: int = 1,
         save_last: bool = False,
+        save_sharded: bool = False,
     ) -> None:
         assert mode in ("min", "max")
+        self.save_sharded = save_sharded
         self.dirpath = dirpath
         self.filename = filename
         self.monitor = monitor
@@ -90,13 +105,27 @@ class ModelCheckpoint(Callback):
             self._save(trainer, module)
 
     def _save(self, trainer: Any, module: Any) -> None:
-        if trainer.global_rank != 0 or self.save_top_k == 0:
+        if self.save_top_k == 0:
+            return
+        if trainer.global_rank != 0 and not self.save_sharded:
             return
         dirpath = self.dirpath or os.path.join(trainer.default_root_dir, "checkpoints")
         os.makedirs(dirpath, exist_ok=True)
         name = self.filename.format(epoch=trainer.current_epoch, step=trainer.global_step)
-        path = os.path.join(dirpath, name + ".ckpt")
-        trainer.save_checkpoint(path)
+        if self.save_sharded:
+            # Directory checkpoint; every rank writes its shards (the
+            # orbax save is collective), rank 0 keeps the bookkeeping.
+            path = os.path.join(dirpath, name)
+            trainer.save_checkpoint(path, sharded=True)
+            if self.save_last:
+                last = os.path.join(dirpath, "last")
+                trainer.save_checkpoint(last, sharded=True)
+                self.last_model_path = last
+            if trainer.global_rank != 0:
+                return
+        else:
+            path = os.path.join(dirpath, name + ".ckpt")
+            trainer.save_checkpoint(path)
         score = _metric_value(trainer, self.monitor) if self.monitor else None
         if self.monitor is None:
             # No monitor: latest checkpoint is "best" (Lightning behavior)
@@ -109,17 +138,14 @@ class ModelCheckpoint(Callback):
                 and prev != path
                 and os.path.exists(prev)
             ):
-                try:
-                    os.remove(prev)
-                except OSError:
-                    pass
+                _remove_checkpoint(prev)
         elif score is not None and not math.isnan(score):
             if self._is_better(score):
                 self.best_model_score = score
                 self.best_model_path = path
             self._saved.append((score, path))
             self._prune()
-        if self.save_last:
+        if self.save_last and not self.save_sharded:
             last = os.path.join(dirpath, "last.ckpt")
             trainer.save_checkpoint(last)
             self.last_model_path = last
@@ -132,10 +158,7 @@ class ModelCheckpoint(Callback):
         while len(self._saved) > self.save_top_k:
             _, path = self._saved.pop()
             if path != self.best_model_path and os.path.exists(path):
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+                _remove_checkpoint(path)
 
     def state_dict(self) -> Dict[str, Any]:
         return {

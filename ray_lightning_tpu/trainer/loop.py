@@ -152,30 +152,79 @@ class TrainingLoop:
             )
         self._val_loader = val
 
-    def _init_state(self, ckpt_stream: Optional[bytes]) -> None:
+    def _init_state(self, ckpt_stream: Optional[Any]) -> None:
         import jax
 
-        sample_batch = next(iter(self._train_loader.iter_batches(1)))
+        # Shape probe only — prefetch=0 so no background thread spins up
+        # assembling batches that get discarded.
+        sample_batch = next(iter(self._train_loader.iter_batches(1, prefetch=0)))
         init_rng, self._rng = jax.random.split(self._rng)
         params = self.module.init_params(init_rng, sample_batch)
         self._tx = self.module.configure_optimizers()
         opt_state = self._tx.init(params)
-        if ckpt_stream is not None:
+        sharded_path = (
+            ckpt_stream.get("orbax_path")
+            if isinstance(ckpt_stream, dict)
+            else None
+        )
+        if ckpt_stream is not None and sharded_path is None:
             state = load_state_stream(ckpt_stream)
             params = state["params"]
             opt_state = state.get("opt_state", opt_state)
-            self.current_epoch = int(state.get("epoch", -1)) + 1
-            self.global_step = int(state.get("global_step", 0))
-            for cb in self.callbacks:
-                cb_state = state.get("callbacks", {}).get(type(cb).__name__)
-                if cb_state:
-                    cb.load_state_dict(cb_state)
+            self._restore_progress(state)
         self.params = self.strategy.place_params(params)
         self.opt_state = self.strategy.place_opt_state(opt_state, params)
+        if sharded_path is not None:
+            # Sharded restore: read straight into this topology's
+            # shardings (works across different worker counts/mesh shapes).
+            from ray_lightning_tpu.trainer.checkpoint_io import (
+                OrbaxCheckpointIO,
+            )
+
+            restored, meta = OrbaxCheckpointIO().restore(
+                sharded_path,
+                {"params": self.params, "opt_state": self.opt_state},
+            )
+            self.params = restored["params"]
+            self.opt_state = restored["opt_state"]
+            self._restore_progress(meta)
+
+    def _restore_progress(self, state: Dict[str, Any]) -> None:
+        self.current_epoch = int(state.get("epoch", -1)) + 1
+        self.global_step = int(state.get("global_step", 0))
+        for cb in self.callbacks:
+            cb_state = state.get("callbacks", {}).get(type(cb).__name__)
+            if cb_state:
+                cb.load_state_dict(cb_state)
 
     # ------------------------------------------------------------------
-    def save_checkpoint(self, path: str) -> None:
-        """Gather full state and write a state-stream checkpoint (rank 0)."""
+    def save_checkpoint(self, path: str, sharded: bool = False) -> None:
+        """Write a checkpoint.
+
+        Default: rank 0 gathers full state into a state-stream file (the
+        reference's wire format, SURVEY.md §3.4). ``sharded=True``: every
+        process writes its own shards via orbax — no gather, scales with
+        GSPMD/ZeRO state (call from ALL ranks).
+        """
+        if sharded:
+            from ray_lightning_tpu.trainer.checkpoint_io import (
+                OrbaxCheckpointIO,
+            )
+
+            meta = {
+                "epoch": self.current_epoch,
+                "global_step": self.global_step,
+                "callbacks": {
+                    type(cb).__name__: cb.state_dict() for cb in self.callbacks
+                },
+            }
+            OrbaxCheckpointIO().save(
+                path,
+                {"params": self.params, "opt_state": self.opt_state},
+                meta,
+                is_rank_zero=self.global_rank == 0,
+            )
+            return
         if self.global_rank != 0:
             return
         stream = to_state_stream(self.checkpoint_state())

@@ -131,9 +131,19 @@ class Trainer:
         return loop.run_predict(ckpt_stream)
 
     @staticmethod
-    def _read_ckpt(ckpt_path: Optional[str]) -> Optional[bytes]:
+    def _read_ckpt(ckpt_path: Optional[str]) -> Optional[Any]:
         if ckpt_path is None:
             return None
+        from ray_lightning_tpu.trainer.checkpoint_io import (
+            is_sharded_checkpoint,
+        )
+
+        if is_sharded_checkpoint(ckpt_path):
+            # Sharded (orbax) checkpoints are restored inside the workers
+            # against the live mesh; ship the path, not bytes. Requires the
+            # directory to be reachable from every host (shared FS), like
+            # the reference's best_model_path contract (SURVEY.md §5).
+            return {"orbax_path": os.path.abspath(ckpt_path)}
         import fsspec
 
         with fsspec.open(ckpt_path, "rb") as f:

@@ -79,11 +79,6 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_float,
         ctypes.c_int32,
     ]
-    lib.rlt_shuffle_indices.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_int64,
-        ctypes.c_uint64,
-    ]
     return lib
 
 
@@ -155,12 +150,3 @@ def gather_rows_u8_to_f32(
     )
     return out
 
-
-def shuffle_indices(n: int, seed: int) -> np.ndarray:
-    """Permutation of range(n); native Fisher-Yates when available."""
-    lib = get_lib()
-    if lib is None:
-        return np.random.default_rng(seed).permutation(n)
-    idx = np.arange(n, dtype=np.int64)
-    lib.rlt_shuffle_indices(idx.ctypes.data, n, ctypes.c_uint64(seed & (2**64 - 1)).value)
-    return idx

@@ -37,15 +37,6 @@ def test_gather_u8_to_f32_fused():
     assert out.dtype == np.float32
 
 
-def test_shuffle_indices_is_permutation_and_deterministic():
-    a = native.shuffle_indices(1000, seed=42)
-    b = native.shuffle_indices(1000, seed=42)
-    c = native.shuffle_indices(1000, seed=43)
-    np.testing.assert_array_equal(np.sort(a), np.arange(1000))
-    np.testing.assert_array_equal(a, b)
-    assert not np.array_equal(a, c)
-
-
 def test_noncontiguous_falls_back():
     src = np.asfortranarray(np.random.default_rng(2).standard_normal((16, 4)))
     idx = np.array([3, 1, 2])

@@ -1,0 +1,152 @@
+"""Orbax sharded checkpoint tests.
+
+Parity anchor: the reference verifies checkpoint param-equality and resume
+with a *different* worker count (test_ddp_sharded.py:27-137); the sharded IO
+must reproduce both without ever gathering full state on one host.
+"""
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import GPTConfig, GPTLM, MNISTClassifier
+from ray_lightning_tpu.strategies import GSPMDStrategy, RayShardedStrategy
+from ray_lightning_tpu.trainer.checkpoint_io import (
+    OrbaxCheckpointIO,
+    is_sharded_checkpoint,
+)
+
+TINY = GPTConfig(
+    vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq=32,
+    attn_impl="reference",
+)
+
+
+def make_strategy(cls, num_workers=8, **kw):
+    from ray_lightning_tpu.parallel.env import DistEnv
+
+    s = cls(num_workers=num_workers, use_tpu=False, **kw)
+    s.dist_env = DistEnv(
+        world_size=num_workers, num_hosts=1, host_rank=0, local_chips=num_workers
+    )
+    s.mesh = s.build_mesh()
+    return s
+
+
+def _init_gpt_state(strategy, module):
+    import jax
+
+    strategy.bind_module(module)
+    toks = np.zeros((8, 17), np.int32)
+    params = module.init_params(jax.random.PRNGKey(0), (toks,))
+    tx = module.configure_optimizers()
+    opt_state = tx.init(params)
+    placed_p = strategy.place_params(params)
+    placed_o = strategy.place_opt_state(opt_state, params)
+    return placed_p, placed_o
+
+
+def test_sharded_roundtrip_same_mesh(tmp_path):
+    import jax
+
+    strategy = make_strategy(
+        GSPMDStrategy, mesh_shape={"fsdp": 4, "model": 2}
+    )
+    module = GPTLM(config=TINY)
+    params, opt_state = _init_gpt_state(strategy, module)
+
+    ckpt = str(tmp_path / "ckpt")
+    io = OrbaxCheckpointIO()
+    io.save(
+        ckpt,
+        {"params": params, "opt_state": opt_state},
+        {"epoch": 3, "global_step": 40, "callbacks": {}},
+    )
+    assert is_sharded_checkpoint(ckpt)
+
+    restored, meta = io.restore(
+        ckpt, {"params": params, "opt_state": opt_state}
+    )
+    assert meta["epoch"] == 3 and meta["global_step"] == 40
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(restored["params"]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored arrays carry the live shardings
+    leaf = restored["params"]["blocks"]["wqkv"]
+    assert leaf.sharding.is_equivalent_to(
+        params["blocks"]["wqkv"].sharding, leaf.ndim
+    )
+
+
+def test_sharded_restore_into_different_mesh(tmp_path):
+    """Save under fsdp8, restore under fsdp2 x model2 on 4 'devices' worth
+    of shards — the resume-with-fewer-workers contract."""
+    import jax
+
+    save_strat = make_strategy(GSPMDStrategy, mesh_shape={"fsdp": 8})
+    module = GPTLM(config=TINY)
+    p1, o1 = _init_gpt_state(save_strat, module)
+    ckpt = str(tmp_path / "ckpt")
+    io = OrbaxCheckpointIO()
+    io.save(ckpt, {"params": p1, "opt_state": o1}, {"epoch": 0})
+
+    from jax.sharding import Mesh
+
+    from ray_lightning_tpu.parallel.env import DistEnv
+
+    load_strat = GSPMDStrategy(
+        num_workers=4, use_tpu=False, mesh_shape={"fsdp": 2, "model": 2}
+    )
+    load_strat.dist_env = DistEnv(
+        world_size=4, num_hosts=1, host_rank=0, local_chips=4
+    )
+    # A 4-device topology simulated on the first half of the 8 virtual
+    # devices (build_mesh would claim all of them).
+    load_strat.mesh = Mesh(
+        np.array(jax.devices()[:4]).reshape(1, 2, 2, 1),
+        ("data", "fsdp", "model", "seq"),
+    )
+    module2 = GPTLM(config=TINY)
+    p2, o2 = _init_gpt_state(load_strat, module2)
+    restored, _ = io.restore(ckpt, {"params": p2, "opt_state": o2})
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p1),
+        jax.tree_util.tree_leaves(restored["params"]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    leaf = restored["params"]["blocks"]["wqkv"]
+    assert leaf.sharding.mesh.shape["model"] == 2
+
+
+def test_zero3_fit_saves_sharded_and_resumes(start_fabric, tmp_path):
+    """End to end: fit with ZeRO-3 + ModelCheckpoint(save_sharded=True),
+    then resume from the sharded directory with a different worker count."""
+    start_fabric(num_cpus=2)
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    cb = ModelCheckpoint(dirpath=ckpt_dir, save_sharded=True, filename="e{epoch}")
+    module = MNISTClassifier(batch_size=8, n_train=64)
+    trainer = Trainer(
+        max_epochs=1,
+        strategy=RayShardedStrategy(num_workers=4, use_tpu=False, zero_stage=3),
+        callbacks=[cb],
+        enable_checkpointing=False,
+        seed=0,
+    )
+    trainer.fit(module)
+    assert cb.best_model_path and is_sharded_checkpoint(cb.best_model_path)
+    w1_after_fit = np.asarray(module.params["w1"])
+
+    module2 = MNISTClassifier(batch_size=8, n_train=64)
+    trainer2 = Trainer(
+        max_epochs=2,
+        strategy=RayShardedStrategy(num_workers=2, use_tpu=False, zero_stage=3),
+        enable_checkpointing=False,
+        seed=0,
+    )
+    trainer2.fit(module2, ckpt_path=cb.best_model_path)
+    # Resumed from epoch 1 -> ran exactly one more epoch.
+    assert trainer2.current_epoch >= 1
+    assert np.isfinite(np.asarray(module2.params["w1"])).all()
+    assert not np.array_equal(np.asarray(module2.params["w1"]), w1_after_fit)
