@@ -118,15 +118,30 @@ def _apply_dotted(
     config: Dict[str, Any], dotted: List[Tuple[str, str]]
 ) -> Dict[str, Any]:
     """Merge ``--section.key value`` overrides into the config tree, coercing
-    through the target constructor's signature where known."""
+    through the target constructor's signature where known.
+
+    Two passes so coercion is order-independent: class paths (from YAML or
+    any ``--model X`` flag, in either position) are all known before any
+    field value is typed.
+    """
+    # Pass 1: class paths + normalize bare-string YAML nodes to dict form.
+    field_overrides: List[Tuple[str, str, str]] = []
     for key, raw in dotted:
         section, _, field = key.partition(".")
         if section not in ("model", "strategy", "trainer", "data"):
             raise ValueError(f"unknown config section {section!r} in --{key}")
-        node = config.setdefault(section, {})
+        node = config.get(section)
+        if isinstance(node, str):  # YAML bare class-path form
+            config[section] = {"class_path": node, "init_args": {}}
+        elif node is None:
+            config[section] = {}
         if not field:  # bare --model X == class path
-            node["class_path"] = raw
-            continue
+            config[section]["class_path"] = raw
+        else:
+            field_overrides.append((section, field, raw))
+    # Pass 2: typed field values.
+    for section, field, raw in field_overrides:
+        node = config[section]
         if section == "trainer":
             node[field] = yaml.safe_load(raw)
             continue

@@ -49,6 +49,16 @@ class GPTConfig:
     remat: bool = False
     attn_impl: str = "flash"  # "flash" | "reference"
     init_std: float = 0.02
+    # Mixture-of-Experts: n_experts > 0 replaces every block's dense MLP
+    # with a switch (top-1) MoE layer (parallel/moe.py); expert weights
+    # shard over the "ep" mesh axis under GSPMDStrategy.
+    n_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
+    # Pipeline parallelism: used when the bound mesh has a "pp" axis > 1
+    # (layers shard over pp; microbatched GPipe schedule,
+    # parallel/pipeline.py). 0 -> one microbatch per pipeline stage.
+    num_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -90,6 +100,24 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
     def norm(key, shape, s):
         return (jax.random.normal(key, shape) * s).astype(jnp.float32)
 
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        k_moe = jax.random.split(keys[4], 3)
+        mlp = {
+            "router": norm(k_moe[0], (L, D, E), std),
+            "wi": norm(k_moe[1], (L, E, D, F), std),
+            "bi": jnp.zeros((L, E, F)),
+            "wo2": norm(k_moe[2], (L, E, F, D), res_std),
+            "bo2": jnp.zeros((L, E, D)),
+        }
+    else:
+        mlp = {
+            "wi": norm(keys[4], (L, D, F), std),
+            "bi": jnp.zeros((L, F)),
+            "wo2": norm(keys[5], (L, F, D), res_std),
+            "bo2": jnp.zeros((L, D)),
+        }
+
     return {
         "wte": norm(keys[0], (cfg.vocab_size, D), std),
         "wpe": norm(keys[1], (cfg.max_seq, D), std),
@@ -102,10 +130,7 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
             "bo": jnp.zeros((L, D)),
             "ln2_g": jnp.ones((L, D)),
             "ln2_b": jnp.zeros((L, D)),
-            "wi": norm(keys[4], (L, D, F), std),
-            "bi": jnp.zeros((L, F)),
-            "wo2": norm(keys[5], (L, F, D), res_std),
-            "bo2": jnp.zeros((L, D)),
+            **mlp,
         },
         "lnf_g": jnp.ones((D,)),
         "lnf_b": jnp.zeros((D,)),
@@ -114,7 +139,23 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
 
 def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
     """Logical axis names per parameter, consumed by GSPMDStrategy via
-    ``parallel.logical`` rules (embed->fsdp, heads/mlp/vocab->model)."""
+    ``parallel.logical`` rules (embed->fsdp, heads/mlp/vocab->model,
+    expert->ep)."""
+    if cfg.n_experts > 0:
+        mlp = {
+            "router": ("layers", "embed", None),
+            "wi": ("layers", "expert", "embed", "mlp"),
+            "bi": ("layers", "expert", "mlp"),
+            "wo2": ("layers", "expert", "mlp", "embed"),
+            "bo2": ("layers", "expert", None),
+        }
+    else:
+        mlp = {
+            "wi": ("layers", "embed", "mlp"),
+            "bi": ("layers", "mlp"),
+            "wo2": ("layers", "mlp", "embed"),
+            "bo2": ("layers", None),
+        }
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
@@ -127,10 +168,7 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
             "bo": ("layers", None),
             "ln2_g": ("layers", None),
             "ln2_b": ("layers", None),
-            "wi": ("layers", "embed", "mlp"),
-            "bi": ("layers", "mlp"),
-            "wo2": ("layers", "mlp", "embed"),
-            "bo2": ("layers", None),
+            **mlp,
         },
         "lnf_g": (None,),
         "lnf_b": (None,),
@@ -150,11 +188,14 @@ def gpt_forward(
     cfg: GPTConfig,
     mesh: Optional[jax.sharding.Mesh] = None,
     seq_axis: Optional[str] = None,
-) -> jax.Array:
+    return_aux: bool = False,
+) -> Any:
     """tokens (B, S) int32 -> logits (B, S, V).
 
     ``mesh``+``seq_axis`` switch attention to the sequence-parallel ring
-    (set by GSPMDStrategy when the mesh's seq axis is >1).
+    (set by GSPMDStrategy when the mesh's seq axis is >1). With
+    ``return_aux`` also returns the mean MoE load-balancing loss (zero for
+    dense configs).
     """
     from ray_lightning_tpu.ops import (
         attention_reference,
@@ -180,7 +221,37 @@ def gpt_forward(
             return flash_attention(q, k, v, causal=True)
         return attention_reference(q, k, v, causal=True)
 
-    def block(h: jax.Array, lp: Dict[str, jax.Array]) -> Tuple[jax.Array, None]:
+    def mlp(h: jax.Array, lp: Dict[str, jax.Array]) -> Tuple[jax.Array, jax.Array]:
+        m = _layernorm(h, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts > 0:
+            from ray_lightning_tpu.parallel.moe import moe_ffn
+
+            out, aux = moe_ffn(
+                {
+                    "router": lp["router"],
+                    "wi": lp["wi"],
+                    "bi": lp["bi"],
+                    "wo": lp["wo2"],
+                    "bo": lp["bo2"],
+                },
+                m,
+                capacity_factor=cfg.moe_capacity_factor,
+                compute_dtype=cdt,
+            )
+            return out, aux["aux_loss"]
+        m = jax.nn.gelu(
+            jnp.einsum("bsd,df->bsf", m, lp["wi"].astype(cdt))
+            + lp["bi"].astype(cdt)
+        )
+        out = jnp.einsum("bsf,fd->bsd", m, lp["wo2"].astype(cdt)) + lp[
+            "bo2"
+        ].astype(cdt)
+        return out, jnp.zeros((), jnp.float32)
+
+    def block(
+        carry: Tuple[jax.Array, jax.Array], lp: Dict[str, jax.Array]
+    ) -> Tuple[Tuple[jax.Array, jax.Array], None]:
+        h, aux_acc = carry
         a = _layernorm(h, lp["ln1_g"], lp["ln1_b"])
         qkv = (
             jnp.einsum("bsd,dthk->bsthk", a, lp["wqkv"].astype(cdt))
@@ -191,23 +262,44 @@ def gpt_forward(
         h = h + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cdt)) + lp[
             "bo"
         ].astype(cdt)
-        m = _layernorm(h, lp["ln2_g"], lp["ln2_b"])
-        m = jax.nn.gelu(
-            jnp.einsum("bsd,df->bsf", m, lp["wi"].astype(cdt))
-            + lp["bi"].astype(cdt)
-        )
-        h = h + jnp.einsum("bsf,fd->bsd", m, lp["wo2"].astype(cdt)) + lp[
-            "bo2"
-        ].astype(cdt)
-        return h, None
+        m_out, aux = mlp(h, lp)
+        return (h + m_out, aux_acc + aux), None
 
-    body = jax.checkpoint(block) if cfg.remat else block
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp_size > 1:
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "MoE + pipeline parallelism is not supported yet "
+                "(expert all-to-all inside the pp shard_map)"
+            )
+        from ray_lightning_tpu.parallel.pipeline import pipeline_apply
+
+        def stage(lp: Dict[str, jax.Array], h: jax.Array) -> jax.Array:
+            (h2, _), _ = block((h, jnp.zeros((), jnp.float32)), lp)
+            return h2
+
+        stage_body = jax.checkpoint(stage) if cfg.remat else stage
+        x = pipeline_apply(
+            stage_body,
+            params["blocks"],
+            x,
+            mesh,
+            num_microbatches=cfg.num_microbatches or None,
+        )
+        aux_total = jnp.zeros((), jnp.float32)
+    else:
+        body = jax.checkpoint(block) if cfg.remat else block
+        (x, aux_total), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+        )
     x = _layernorm(x, params["lnf_g"], params["lnf_b"])
     # Tied output head (GPT-2 weight tying); logits reduce in fp32.
-    return jnp.einsum(
+    logits = jnp.einsum(
         "bsd,vd->bsv", x.astype(jnp.float32), params["wte"].astype(jnp.float32)
     )
+    if return_aux:
+        return logits, aux_total / max(1, cfg.n_layer)
+    return logits
 
 
 def lm_loss(
@@ -288,15 +380,33 @@ class GPTLM(TPUModule):
             params, tokens, self.config, mesh=self._mesh, seq_axis=self._seq_axis
         )
 
-    def _loss(self, params: Any, batch: Any) -> Tuple[jax.Array, jax.Array]:
+    def _loss(
+        self, params: Any, batch: Any, return_aux: bool = False
+    ) -> Any:
         toks = batch[0] if isinstance(batch, (tuple, list)) else batch
-        logits = self._forward(params, toks[:, :-1])
-        return lm_loss(logits, toks[:, 1:])
+        out = gpt_forward(
+            params,
+            toks[:, :-1],
+            self.config,
+            mesh=self._mesh,
+            seq_axis=self._seq_axis,
+            return_aux=return_aux,
+        )
+        if return_aux:
+            logits, aux = out
+            loss, acc = lm_loss(logits, toks[:, 1:])
+            return loss, acc, aux
+        loss, acc = lm_loss(out, toks[:, 1:])
+        return loss, acc
 
     # -- steps -----------------------------------------------------------
     def training_step(self, params, batch, rng):
-        loss, acc = self._loss(params, batch)
-        return loss, {"loss": loss, "acc": acc}
+        loss, acc, aux = self._loss(params, batch, return_aux=True)
+        total = loss + self.config.moe_aux_weight * aux
+        logs = {"loss": loss, "acc": acc}
+        if self.config.n_experts > 0:
+            logs["moe_aux"] = aux
+        return total, logs
 
     def validation_step(self, params, batch):
         loss, acc = self._loss(params, batch)

@@ -27,8 +27,10 @@ DEFAULT_RULES: Tuple[Tuple[str, str], ...] = (
     ("mlp", "model"),
     ("embed", "fsdp"),
     ("kv", None),
-    ("layers", None),
+    ("layers", "pp"),  # pipeline stages when the mesh has a pp axis...
+    ("layers", None),  # ...replicated otherwise (terminal)
     ("seq", "seq"),
+    ("expert", "ep"),
 )
 
 

@@ -1,0 +1,108 @@
+"""SPMD pipeline parallelism (GPipe-style) over a "pp" mesh axis.
+
+Beyond-parity capability (the reference's parallelism surface is DP-only,
+SURVEY.md §2c). The stacked-layer parameter tree (every block leaf carries a
+leading ``layers`` dim) is sharded over the "pp" axis, so each pipeline rank
+holds L/P consecutive layers. Under ``shard_map`` (manual over "pp" only —
+data/model/ep axes stay under GSPMD), every rank runs the same per-tick
+program:
+
+    tick t: rank 0 feeds microbatch t; every rank applies its local layers
+    to its current activation; activations hop one rank down the pipeline
+    via ``ppermute`` (ICI neighbor exchange).
+
+After M + P - 1 ticks all M microbatches have drained; the last rank's
+collected outputs are broadcast with a masked ``psum``. Built entirely from
+``lax.scan`` + ``ppermute`` so the backward pass is the reverse pipeline
+schedule by transposition — no hand-written backward needed.
+
+The bubble fraction is the textbook (P-1)/(M+P-1); raise
+``num_microbatches`` to amortize it.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    num_microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Run ``x`` through L stacked layers pipelined over ``axis_name``.
+
+    Args:
+      stage_fn: applies ONE layer: ``stage_fn(layer_params, h) -> h`` with
+        ``h`` (mb, S, D)-like. Scanned over each rank's local layer shard.
+      stacked_params: pytree whose leaves have leading dim L, sharded
+        ``P(axis_name)`` on that dim (the "layers" -> "pp" logical rule).
+      x: global activations (B, ...), replicated w.r.t. the pp axis.
+      num_microbatches: default P; B must divide by it.
+
+    Returns activations (B, ...), replicated w.r.t. the pp axis.
+    """
+    pp = mesh.shape[axis_name]
+    M = int(num_microbatches or pp)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+
+    def per_rank(blocks_local: Any, x_full: jax.Array) -> jax.Array:
+        stage = jax.lax.axis_index(axis_name)
+        mb = x_full.reshape(M, B // M, *x_full.shape[1:])
+
+        def apply_local(h: jax.Array) -> jax.Array:
+            h, _ = jax.lax.scan(
+                lambda c, lp: (stage_fn(lp, c), None), h, blocks_local
+            )
+            return h
+
+        T = M + pp - 1
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def varying(v):
+            # The scan carry genuinely differs per pp rank; mark it so for
+            # shard_map's varying-mesh-axes type system.
+            if hasattr(jax.lax, "pcast"):
+                return jax.lax.pcast(v, (axis_name,), to="varying")
+            return jax.lax.pvary(v, (axis_name,))
+
+        zero = varying(jnp.zeros_like(mb[0]))
+        outs0 = varying(jnp.zeros_like(mb))
+
+        def tick(carry, t):
+            recv, outs = carry
+            feed = mb[jnp.clip(t, 0, M - 1)]
+            inp = jnp.where(stage == 0, feed, recv)
+            out = apply_local(inp)
+            slot = t - (pp - 1)
+            idx = jnp.clip(slot, 0, M - 1)
+            collect = jnp.logical_and(stage == pp - 1, slot >= 0)
+            outs = outs.at[idx].set(jnp.where(collect, out, outs[idx]))
+            nxt = jax.lax.ppermute(out, axis_name, perm)
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (zero, outs0), jnp.arange(T))
+        # Only the last stage holds real outputs; masked psum replicates
+        # them across the pp axis (everyone else contributes zeros).
+        outs = jax.lax.psum(
+            jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs)), axis_name
+        )
+        return outs.reshape(B, *x_full.shape[1:])
+
+    return jax.shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names={axis_name},
+    )(stacked_params, x)

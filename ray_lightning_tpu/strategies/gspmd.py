@@ -18,18 +18,20 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 from ray_lightning_tpu.strategies.ddp import RayTPUStrategy
 
-_AXES = ("data", "fsdp", "model", "seq")
+_AXES = ("data", "fsdp", "model", "seq", "ep", "pp")
 
 
 class GSPMDStrategy(RayTPUStrategy):
     """Args (beyond RayTPUStrategy's):
 
-    mesh_shape: dict axis-name -> size over {"data","fsdp","model","seq"}.
-        Sizes must multiply to ``num_workers``. Missing axes get size 1;
-        if *no* axis is given, everything lands on "data" (pure DP).
+    mesh_shape: dict axis-name -> size over {"data","fsdp","model","seq",
+        "ep","pp"} (data parallel, ZeRO/FSDP, tensor, sequence, expert,
+        pipeline). Sizes must multiply to ``num_workers``. Missing axes get
+        size 1; if *no* axis is given, everything lands on "data" (pure DP).
     logical_axis_rules: override for ``parallel.logical.DEFAULT_RULES``.
     sequence_parallel: shard the sequence dim of inputs over the "seq"
-        axis and switch mesh-aware models to ring attention.
+        axis and switch mesh-aware models to ring attention (mutually
+        exclusive with a pp axis > 1).
     """
 
     strategy_name = "gspmd_ray"
@@ -60,6 +62,11 @@ class GSPMDStrategy(RayTPUStrategy):
         if sequence_parallel and shape.get("seq", 1) < 2:
             raise ValueError(
                 "sequence_parallel=True needs mesh_shape['seq'] >= 2"
+            )
+        if sequence_parallel and shape.get("pp", 1) > 1:
+            raise ValueError(
+                "sequence_parallel cannot be combined with pipeline "
+                "parallelism (ring attention inside the pp shard_map)"
             )
         self.mesh_shape = shape
         self.logical_axis_rules = logical_axis_rules

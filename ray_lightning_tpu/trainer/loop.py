@@ -409,8 +409,34 @@ class TrainingLoop:
         self.strategy.teardown_worker()
         return self._collect_rank_zero_results(results=preds)
 
-    def _restore_or_adopt(self, ckpt_stream: Optional[bytes]) -> None:
-        """Load params from a checkpoint stream or adopt the module's own."""
+    def _restore_or_adopt(self, ckpt_stream: Optional[Any]) -> None:
+        """Load params from a checkpoint (stream bytes or sharded orbax
+        directory marker) or adopt the module's own."""
+        sharded_path = (
+            ckpt_stream.get("orbax_path")
+            if isinstance(ckpt_stream, dict)
+            else None
+        )
+        if sharded_path is not None:
+            # Need placed abstract params to restore into; init a fresh tree
+            # for shapes, then read the checkpoint over it.
+            import jax
+
+            sample_batch = next(
+                iter(self._train_or_any_loader().iter_batches(1, prefetch=0))
+            )
+            init_rng, self._rng = jax.random.split(self._rng)
+            params = self.module.init_params(init_rng, sample_batch)
+            placed = self.strategy.place_params(params)
+            from ray_lightning_tpu.trainer.checkpoint_io import (
+                OrbaxCheckpointIO,
+            )
+
+            restored, _ = OrbaxCheckpointIO().restore(
+                sharded_path, {"params": placed}
+            )
+            self.params = restored["params"]
+            return
         if ckpt_stream is not None:
             state = load_state_stream(ckpt_stream)
             params = state["params"] if "params" in state else state
@@ -421,6 +447,18 @@ class TrainingLoop:
                 "no parameters available: fit first, or pass ckpt_path"
             )
         self.params = self.strategy.place_params(params)
+
+    def _train_or_any_loader(self) -> Any:
+        """A loader usable as an init-shape probe (train if defined, else
+        val/test/predict)."""
+        if self._train_loader is not None:
+            return self._train_loader
+        source = self.datamodule if self.datamodule is not None else self.module
+        for name in ("val_dataloader", "test_dataloader", "predict_dataloader"):
+            loader = getattr(source, name, lambda: None)()
+            if loader is not None:
+                return loader
+        raise RuntimeError("no dataloader available to probe init shapes")
 
     # ------------------------------------------------------------------
     def _collect_rank_zero_results(self, results: Any) -> Optional[WorkerOutput]:

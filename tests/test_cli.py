@@ -109,6 +109,26 @@ def test_scientific_notation_coerces_to_float():
     assert isinstance(model.lr, float) and model.lr == pytest.approx(3e-4)
 
 
+def test_override_before_class_flag_still_coerces():
+    # Coercion must not depend on flag order: the class path is resolved
+    # before field typing even when it appears later on the command line.
+    _, config = cli.parse_args(
+        ["fit", "--model.lr", "3e-4", "--model", "MNISTClassifier"]
+    )
+    _, model, _ = cli.build(config)
+    assert isinstance(model.lr, float) and model.lr == pytest.approx(3e-4)
+
+
+def test_yaml_bare_string_node_with_override(tmp_path):
+    cfg = tmp_path / "run.yaml"
+    cfg.write_text("model: ray_lightning_tpu.models.MNISTClassifier\n")
+    _, config = cli.parse_args(
+        ["fit", "--config", str(cfg), "--model.hidden", "32"]
+    )
+    _, model, _ = cli.build(config)
+    assert model.hidden == 32
+
+
 def test_equals_form_and_bare_name_resolution():
     _, config = cli.parse_args(
         ["test", "--model=MNISTClassifier", "--model.hidden=64"]

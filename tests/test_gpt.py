@@ -54,7 +54,7 @@ def test_mesh_shape_validation():
     with pytest.raises(ValueError, match="covers"):
         GSPMDStrategy(num_workers=8, use_tpu=False, mesh_shape={"data": 4})
     with pytest.raises(ValueError, match="unknown mesh axis"):
-        GSPMDStrategy(num_workers=8, use_tpu=False, mesh_shape={"pp": 8})
+        GSPMDStrategy(num_workers=8, use_tpu=False, mesh_shape={"tensor": 8})
     with pytest.raises(ValueError, match="sequence_parallel"):
         GSPMDStrategy(
             num_workers=8,

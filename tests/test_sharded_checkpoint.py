@@ -150,3 +150,15 @@ def test_zero3_fit_saves_sharded_and_resumes(start_fabric, tmp_path):
     assert trainer2.current_epoch >= 1
     assert np.isfinite(np.asarray(module2.params["w1"])).all()
     assert not np.array_equal(np.asarray(module2.params["w1"]), w1_after_fit)
+
+    # Evaluation from the sharded directory (the eval restore path, not
+    # just fit-resume) must work too.
+    module3 = MNISTClassifier(batch_size=8, n_train=64)
+    trainer3 = Trainer(
+        max_epochs=1,
+        strategy=RayShardedStrategy(num_workers=2, use_tpu=False, zero_stage=3),
+        enable_checkpointing=False,
+        seed=0,
+    )
+    results = trainer3.test(module3, ckpt_path=cb.best_model_path)
+    assert results and np.isfinite(list(results[0].values())[0])
