@@ -63,10 +63,20 @@ class OrbaxCheckpointIO:
             )
 
     def restore(
-        self, path: str, placed_state: Dict[str, Any]
+        self,
+        path: str,
+        placed_state: Dict[str, Any],
+        partial: bool = False,
     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """Read into the shardings of ``placed_state`` (arrays land sharded
-        on the *current* mesh, whatever topology wrote them)."""
+        on the *current* mesh, whatever topology wrote them).
+
+        ``partial=True`` restores only the keys present in ``placed_state``
+        even when the on-disk tree has more (e.g. eval-only restore of
+        ``params`` from a checkpoint that also carries ``opt_state`` —
+        mirroring the reference's test-without-fit path,
+        test_ddp_sharded.py:118-137).
+        """
         import jax
         import orbax.checkpoint as ocp
 
@@ -76,11 +86,23 @@ class OrbaxCheckpointIO:
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
 
         abstract = jax.tree_util.tree_map(as_abstract, placed_state)
-        ckptr = ocp.StandardCheckpointer()
+        state_dir = os.path.join(path, _STATE_SUBDIR)
+        if partial:
+            ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+            restore_kwargs = {
+                "args": ocp.args.PyTreeRestore(
+                    item=abstract,
+                    restore_args=ocp.checkpoint_utils.construct_restore_args(
+                        abstract
+                    ),
+                    partial_restore=True,
+                )
+            }
+        else:
+            ckptr = ocp.StandardCheckpointer()
+            restore_kwargs = {"target": abstract}
         try:
-            restored = ckptr.restore(
-                os.path.join(path, _STATE_SUBDIR), abstract
-            )
+            restored = ckptr.restore(state_dir, **restore_kwargs)
         finally:
             ckptr.close()
         meta_path = os.path.join(path, _META_FILE)
@@ -88,4 +110,16 @@ class OrbaxCheckpointIO:
         if os.path.exists(meta_path):
             with open(meta_path, "rb") as f:
                 meta = load_state_stream(f.read())
+        elif not partial:
+            # Eval-only (partial) restores discard meta; warn only when the
+            # caller will actually consume progress state.
+            import warnings
+
+            warnings.warn(
+                f"sharded checkpoint at {path} has no {_META_FILE}; "
+                "epoch/global_step/callback progress will reset to 0 "
+                "(was the checkpoint copied without its meta file, or "
+                "written on a non-shared filesystem?)",
+                stacklevel=2,
+            )
         return restored, meta

@@ -432,8 +432,11 @@ class TrainingLoop:
                 OrbaxCheckpointIO,
             )
 
+            # On-disk tree also carries opt_state — eval only needs params,
+            # so restore partially rather than materialising optimizer
+            # shards we'd immediately drop.
             restored, _ = OrbaxCheckpointIO().restore(
-                sharded_path, {"params": placed}
+                sharded_path, {"params": placed}, partial=True
             )
             self.params = restored["params"]
             return

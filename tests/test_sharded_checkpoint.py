@@ -118,6 +118,41 @@ def test_sharded_restore_into_different_mesh(tmp_path):
     assert leaf.sharding.mesh.shape["model"] == 2
 
 
+def test_partial_restore_params_only(tmp_path):
+    """Eval-only restore: target tree is a subset ({'params'}) of the
+    on-disk tree ({'params','opt_state'}) — the trainer3.test() path."""
+    import jax
+
+    strategy = make_strategy(GSPMDStrategy, mesh_shape={"fsdp": 4, "model": 2})
+    module = GPTLM(config=TINY)
+    params, opt_state = _init_gpt_state(strategy, module)
+
+    ckpt = str(tmp_path / "ckpt")
+    io = OrbaxCheckpointIO()
+    io.save(
+        ckpt,
+        {"params": params, "opt_state": opt_state},
+        {"epoch": 1, "global_step": 7, "callbacks": {}},
+    )
+
+    # Full-tree restore of a subset target must fail loudly...
+    with pytest.raises(ValueError):
+        io.restore(ckpt, {"params": params})
+    # ...while partial=True restores just the requested subtree.
+    restored, meta = io.restore(ckpt, {"params": params}, partial=True)
+    assert set(restored.keys()) == {"params"}
+    assert meta["global_step"] == 7
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(restored["params"]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    leaf = restored["params"]["blocks"]["wqkv"]
+    assert leaf.sharding.is_equivalent_to(
+        params["blocks"]["wqkv"].sharding, leaf.ndim
+    )
+
+
 def test_zero3_fit_saves_sharded_and_resumes(start_fabric, tmp_path):
     """End to end: fit with ZeRO-3 + ModelCheckpoint(save_sharded=True),
     then resume from the sharded directory with a different worker count."""
