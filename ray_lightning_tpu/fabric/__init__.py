@@ -28,6 +28,7 @@ from ray_lightning_tpu.fabric.core import (
     TaskRef,
     available_resources,
     cluster_resources,
+    free,
     get,
     init,
     is_initialized,
@@ -48,6 +49,7 @@ __all__ = [
     "remote",
     "get",
     "put",
+    "free",
     "wait",
     "kill",
     "nodes",

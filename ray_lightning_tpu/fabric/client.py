@@ -2,15 +2,260 @@
 
 Parity target: Ray Client ("infinite laptop") usage in the reference —
 ``ray_start_client_server`` fixtures and ``ray.init("ray://...")`` examples
-(/root/reference/ray_lightning/tests/test_client.py:17-30). A driver with no
-accelerator connects to a head that owns the resources; all actor
-creation/object transport proxies over a socket.
+(/root/reference/ray_lightning/tests/test_client.py:17-30; the strategy
+docstrings advertise exactly this workflow at ray_ddp.py:46-56). The driver
+process owns no resources; ``fabric.init(address="host:port")`` connects to a
+:class:`~ray_lightning_tpu.fabric.server.FabricServer` and every fabric call
+(actor spawn, method call, put/get/wait/kill, queues) proxies over the
+socket. Actors run on the head; the client stays a thin controller, so a
+laptop can drive a TPU-host fabric.
+
+Concurrency: one TCP connection per client *thread* (the protocol is
+request/response), created lazily and cached thread-locally — the launcher's
+poll loop and a blocking ``get`` from another thread never interleave frames.
 """
 from __future__ import annotations
 
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-def connect(address: str) -> None:
-    raise NotImplementedError(
-        "fabric client mode is not wired up yet; run the driver on the head "
-        "node (fabric.init() with no address)"
-    )
+import cloudpickle
+
+
+class FabricClient:
+    def __init__(self, address: str) -> None:
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._local = threading.local()
+        self._conns: List[Any] = []
+        self._lock = threading.Lock()
+        # Validate eagerly so a bad address fails at init, not first use.
+        self.request(("ping",))
+
+    # -- transport ------------------------------------------------------
+    def _conn(self) -> Any:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            from multiprocessing.connection import Client as MPClient
+
+            from ray_lightning_tpu.fabric.server import _authkey
+
+            conn = MPClient(self._addr, family="AF_INET", authkey=_authkey())
+            self._local.conn = conn
+            with self._lock:
+                self._conns.append(conn)
+        return conn
+
+    def request(self, msg: Any) -> Any:
+        conn = self._conn()
+        conn.send_bytes(cloudpickle.dumps(msg, protocol=5))
+        status, *rest = cloudpickle.loads(conn.recv_bytes())
+        if status == "ok":
+            return rest[0]
+        if status == "timeout":
+            raise TimeoutError("fabric.get timed out (remote)")
+        raise rest[0]
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+_client: Optional[FabricClient] = None
+
+
+def connect(address: str) -> FabricClient:
+    """Connect this process to a remote fabric head (client mode)."""
+    global _client
+    if _client is not None:
+        host, _, port = address.rpartition(":")
+        if (host or "127.0.0.1", int(port)) != _client._addr:
+            raise RuntimeError(
+                f"already connected to fabric head at "
+                f"{_client._addr[0]}:{_client._addr[1]}; call "
+                f"fabric.shutdown() before connecting to {address}"
+            )
+        return _client
+    _client = FabricClient(address)
+    return _client
+
+
+def get_client() -> Optional[FabricClient]:
+    return _client
+
+
+def is_connected() -> bool:
+    return _client is not None
+
+
+def disconnect() -> None:
+    global _client
+    if _client is not None:
+        _client.close()
+        _client = None
+
+
+# ---------------------------------------------------------------------------
+# Client-side handle types mirroring core's surface
+# ---------------------------------------------------------------------------
+class _ClientRemoteMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str) -> None:
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args: Any, **kwargs: Any):
+        from ray_lightning_tpu.fabric.core import TaskRef
+
+        blob = cloudpickle.dumps((self._name, args, kwargs), protocol=5)
+        call_id = _client.request(("call", self._handle.actor_id, blob))
+        return TaskRef(actor_id=self._handle.actor_id, call_id=call_id)
+
+
+class ClientActorHandle:
+    """Client-side proxy to an actor living on the fabric head."""
+
+    def __init__(self, actor_id: str) -> None:
+        self.actor_id = actor_id
+
+    def _meta(self) -> Dict[str, Any]:
+        return _client.request(("actor_meta", self.actor_id))
+
+    @property
+    def node_id(self) -> str:
+        return self._meta()["node_id"]
+
+    @property
+    def node_ip(self) -> str:
+        return self._meta()["node_ip"]
+
+    @property
+    def allocated_resources(self) -> Dict[str, float]:
+        return self._meta()["allocated_resources"]
+
+    @property
+    def actor_options(self) -> Dict[str, Any]:
+        return self._meta()["actor_options"]
+
+    def is_alive(self) -> bool:
+        return self._meta()["is_alive"]
+
+    def __getattr__(self, name: str) -> _ClientRemoteMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientRemoteMethod(self, name)
+
+
+class ClientActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = options or {}
+
+    def options(self, **opts: Any) -> "ClientActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ClientActorClass(self._cls, merged)
+
+    def remote(self, *args: Any, **kwargs: Any) -> ClientActorHandle:
+        blob = cloudpickle.dumps((self._cls, args, kwargs), protocol=5)
+        actor_id = _client.request(("spawn", blob, self._options))
+        return ClientActorHandle(actor_id)
+
+
+# ---------------------------------------------------------------------------
+# API surface used by core's routing
+# ---------------------------------------------------------------------------
+def remote(cls: type) -> ClientActorClass:
+    return ClientActorClass(cls)
+
+
+def get(refs: Any, timeout: Optional[float] = None) -> Any:
+    from ray_lightning_tpu.fabric.core import ObjectRef, TaskRef
+
+    if isinstance(refs, (list, tuple)):
+        return type(refs)(get(r, timeout=timeout) for r in refs)
+    if isinstance(refs, (ObjectRef, TaskRef)):
+        return _client.request(("get", refs, timeout))
+    return refs
+
+
+def put(obj: Any) -> Any:
+    return _client.request(("put", cloudpickle.dumps(obj, protocol=5)))
+
+
+def free(refs: Sequence[Any]) -> None:
+    _client.request(("free", list(refs)))
+
+
+def wait(
+    refs: Sequence[Any], num_returns: int = 1, timeout: Optional[float] = None
+) -> Tuple[List[Any], List[Any]]:
+    return _client.request(("wait", list(refs), num_returns, timeout))
+
+
+def kill(handle: Any, no_restart: bool = True) -> None:  # noqa: ARG001
+    _client.request(("kill", handle.actor_id))
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return _client.request(("nodes",))
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _client.request(("cluster_resources",))
+
+
+def available_resources() -> Dict[str, float]:
+    return _client.request(("available_resources",))
+
+
+# ---------------------------------------------------------------------------
+# Client-mode queue
+# ---------------------------------------------------------------------------
+def _rebuild_worker_queue(proxy_blob: bytes) -> Any:
+    # Runs inside server-spawned workers, which carry the server's mp
+    # authkey — the manager proxy authenticates directly there.
+    return cloudpickle.loads(proxy_blob)
+
+
+class ClientQueue:
+    """Queue living on the fabric head.
+
+    The client drives it via RPC (its mp authkey differs from the server's,
+    so the manager proxy is unusable client-side); when pickled into worker
+    closures it rebuilds as the direct manager-proxy queue.
+    """
+
+    def __init__(self, maxsize: int = 0) -> None:
+        self._qid, self._proxy_blob = _client.request(("queue_create", maxsize))
+
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> None:
+        _client.request(("queue_op", self._qid, "put", (item, block, timeout)))
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        return _client.request(("queue_op", self._qid, "get", (block, timeout)))
+
+    def get_nowait(self) -> Any:
+        return _client.request(("queue_op", self._qid, "get_nowait", ()))
+
+    def empty(self) -> bool:
+        return _client.request(("queue_op", self._qid, "empty", ()))
+
+    def qsize(self) -> int:
+        return _client.request(("queue_op", self._qid, "qsize", ()))
+
+    def shutdown(self) -> None:
+        # Release the head-side queue + its registry entry; without this a
+        # long-lived head leaks one manager queue per tune trial.
+        if _client is not None:
+            try:
+                _client.request(("queue_delete", self._qid))
+            except Exception:  # noqa: BLE001 - head may already be gone
+                pass
+
+    def __reduce__(self):
+        return (_rebuild_worker_queue, (self._proxy_blob,))

@@ -68,6 +68,18 @@ class Node:
 
 
 def _detect_local_capacity() -> Dict[str, float]:
+    cap = _detect_local_capacity_inner()
+    if not cap.get("TPU") and os.environ.get("RLT_REQUIRE_TPU") == "1":
+        # Benchmarks set this so a failed probe is a hard error, never a
+        # silent fall-back onto CPU that records a bogus number.
+        raise FabricError(
+            "RLT_REQUIRE_TPU=1 but no TPU chips detected (probe failed or "
+            "none visible); set RLT_NUM_TPU_CHIPS to override"
+        )
+    return cap
+
+
+def _detect_local_capacity_inner() -> Dict[str, float]:
     cap: Dict[str, float] = {"CPU": float(os.cpu_count() or 1)}
     # TPU chips: respect an explicit override (set by tests / TPU VM metadata);
     # otherwise probe lazily via jax only if it is already imported, to keep
@@ -76,22 +88,14 @@ def _detect_local_capacity() -> Dict[str, float]:
     if env_chips is not None:
         cap["TPU"] = float(env_chips)
         return cap
-    # Probe an already-initialized backend for free...
-    import sys
-
-    jax_mod = sys.modules.get("jax")
-    if jax_mod is not None:
-        try:
-            from jax._src import xla_bridge as _xb
-
-            if _xb.backends_are_initialized():
-                cap["TPU"] = float(
-                    len([d for d in jax_mod.devices() if d.platform == "tpu"])
-                )
-                return cap
-        except Exception:  # noqa: BLE001
-            pass
-    # ...otherwise count chips in a short-lived subprocess: initializing the
+    # Fast path: an explicit JAX_PLATFORMS that excludes TPU backends means
+    # no chips without any probe (the common test configuration).
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms and not any(
+        p.strip() in ("tpu", "axon") for p in platforms.split(",")
+    ):
+        return cap
+    # Otherwise count chips in a short-lived subprocess: initializing the
     # TPU runtime in the *driver* would hold the host's chips for the whole
     # process lifetime (libtpu is exclusive), starving the worker actors —
     # and can hang outright if the device service is wedged, hence the
@@ -128,17 +132,43 @@ def _detect_local_capacity() -> Dict[str, float]:
 # Session
 # --------------------------------------------------------------------------
 class _Session:
+    # Retained finished-call results per session: enough for any realistic
+    # set of simultaneously-live futures, bounded so a long Tuner run's
+    # completed calls don't accumulate forever.
+    RESULTS_CAP = int(os.environ.get("RLT_FABRIC_RESULTS_CAP", "4096"))
+
     def __init__(self) -> None:
+        from collections import OrderedDict
+
         self.nodes: List[Node] = []
         self.actors: Dict[str, "ActorHandle"] = {}
         self.store: Dict[str, Tuple[shared_memory.SharedMemory, int]] = {}
         self.lock = threading.RLock()
         self.cv = threading.Condition(self.lock)
-        self.results: Dict[Tuple[str, int], Tuple[bool, Any]] = {}
+        self.results: "OrderedDict[Tuple[str, int], Tuple[bool, Any]]" = (
+            OrderedDict()
+        )
+        # Keys evicted from `results` (bounded ring): lets get()/wait() on a
+        # stale ref fail loudly instead of blocking forever.
+        self.evicted: "OrderedDict[Tuple[str, int], None]" = OrderedDict()
         self.dead_actors: Dict[str, str] = {}  # actor_id -> reason
         self.mp_ctx = mp.get_context("spawn")
         self._manager: Optional[Any] = None
         self._counter = itertools.count()
+
+    def add_result(self, key: Tuple[str, int], value: Tuple[bool, Any]) -> None:
+        """Record a call result, evicting the oldest beyond RESULTS_CAP.
+
+        Results stay cached so repeated get()/wait() on the same ref keep
+        working (Ray-like contract; the driver poll loop re-waits refs);
+        the cap bounds growth — refs are consumed promptly in practice, so
+        evicting ancient entries is safe."""
+        self.results[key] = value
+        while len(self.results) > self.RESULTS_CAP:
+            old_key, _ = self.results.popitem(last=False)
+            self.evicted[old_key] = None
+            while len(self.evicted) > 4 * self.RESULTS_CAP:
+                self.evicted.popitem(last=False)
 
     @property
     def manager(self):
@@ -153,8 +183,15 @@ class _Session:
 _session: Optional[_Session] = None
 
 
+def _client_mode():
+    """The connected FabricClient module, or None (local mode)."""
+    from ray_lightning_tpu.fabric import client
+
+    return client if client.is_connected() else None
+
+
 def is_initialized() -> bool:
-    return _session is not None
+    return _session is not None or _client_mode() is not None
 
 
 def init(
@@ -168,9 +205,10 @@ def init(
 
     ``resources`` adds custom logical resources (the reference tests this
     passthrough with ``ray.init(resources={"extra": 4})``, test_ddp.py:34-39).
-    ``address="host:port"`` requests client mode — connecting to a remote
-    fabric head (the Ray Client "infinite laptop" analog, SURVEY.md §4);
-    until ``fabric.client`` lands this raises NotImplementedError.
+    ``address="host:port"`` enters client mode: connect to a remote
+    :class:`~ray_lightning_tpu.fabric.server.FabricServer` head and proxy
+    every fabric call there (the Ray Client "infinite laptop" analog,
+    reference test_client.py:17-30).
     """
     global _session
     if address is not None:
@@ -178,6 +216,8 @@ def init(
 
         client.connect(address)
         return
+    if _client_mode() is not None:
+        return  # already connected to a head; local init is a no-op
     if _session is not None:
         if ignore_reinit_error:
             return
@@ -201,6 +241,12 @@ def _require_session() -> _Session:
 
 
 def shutdown() -> None:
+    _c = _client_mode()
+    if _c is not None:
+        from ray_lightning_tpu.fabric import client
+
+        client.disconnect()
+        return
     global _session
     if _session is None:
         return
@@ -242,6 +288,9 @@ def _add_node(capacity: Dict[str, float], node_ip: Optional[str] = None) -> Node
 
 
 def nodes() -> List[Dict[str, Any]]:
+    _c = _client_mode()
+    if _c is not None:
+        return _c.nodes()
     sess = _require_session()
     with sess.lock:
         return [
@@ -257,6 +306,9 @@ def nodes() -> List[Dict[str, Any]]:
 
 
 def cluster_resources() -> Dict[str, float]:
+    _c = _client_mode()
+    if _c is not None:
+        return _c.cluster_resources()
     sess = _require_session()
     with sess.lock:
         total: Dict[str, float] = {}
@@ -267,6 +319,9 @@ def cluster_resources() -> Dict[str, float]:
 
 
 def available_resources() -> Dict[str, float]:
+    _c = _client_mode()
+    if _c is not None:
+        return _c.available_resources()
     sess = _require_session()
     with sess.lock:
         total: Dict[str, float] = {}
@@ -301,6 +356,9 @@ def _objectref_from_wire(id: str, shm_name: str, size: int) -> "ObjectRef":
 
 
 def put(obj: Any) -> ObjectRef:
+    _c = _client_mode()
+    if _c is not None:
+        return _c.put(obj)
     sess = _require_session()
     payload = cloudpickle.dumps(obj, protocol=5)
     ref_id = uuid.uuid4().hex[:16]
@@ -322,12 +380,27 @@ def _get_object(ref: ObjectRef) -> Any:
     # Not the owner (we are inside a worker): attach read-only by name.
     shm = shared_memory.SharedMemory(name=ref.shm_name)
     try:
+        # Python <=3.12 registers ATTACHED segments with this process's
+        # resource_tracker as if it owned them; at worker exit the tracker
+        # would then unlink driver-owned segments and print "leaked
+        # shared_memory objects" warnings. Deregister — the creating session
+        # owns cleanup (free()/shutdown()).
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister("/" + ref.shm_name.lstrip("/"), "shared_memory")
+        except Exception:  # noqa: BLE001 - tracker API/registration varies
+            pass
         return cloudpickle.loads(bytes(shm.buf[: ref.size]))
     finally:
         shm.close()
 
 
 def free(refs: Sequence[ObjectRef]) -> None:
+    _c = _client_mode()
+    if _c is not None:
+        _c.free(refs)
+        return
     sess = _require_session()
     with sess.lock:
         for ref in refs:
@@ -353,11 +426,19 @@ class TaskRef:
 
 
 def _task_done(sess: _Session, ref: TaskRef) -> bool:
-    return (ref.actor_id, ref.call_id) in sess.results or ref.actor_id in sess.dead_actors
+    key = (ref.actor_id, ref.call_id)
+    return (
+        key in sess.results
+        or key in sess.evicted
+        or ref.actor_id in sess.dead_actors
+    )
 
 
 def get(refs: Any, timeout: Optional[float] = None) -> Any:
     """Resolve ObjectRef/TaskRef (or a list of them) to values."""
+    _c = _client_mode()
+    if _c is not None:
+        return _c.get(refs, timeout=timeout)
     if isinstance(refs, (list, tuple)):
         return type(refs)(get(r, timeout=timeout) for r in refs)
     if isinstance(refs, ObjectRef):
@@ -374,11 +455,17 @@ def get(refs: Any, timeout: Optional[float] = None) -> Any:
             sess.cv.wait(timeout=remaining if remaining is not None else 1.0)
         key = (refs.actor_id, refs.call_id)
         if key not in sess.results:
+            if key in sess.evicted:
+                raise FabricError(
+                    f"result for {refs} was evicted from the bounded results "
+                    f"cache (RLT_FABRIC_RESULTS_CAP={sess.RESULTS_CAP}) before "
+                    "it was consumed; fetch results promptly or raise the cap"
+                )
             raise ActorDiedError(
                 f"actor {refs.actor_id} died: {sess.dead_actors.get(refs.actor_id)}"
             )
-        # Results stay cached so repeated get()/wait() on the same ref keep
-        # working (Ray-like contract; the driver poll loop re-waits refs).
+        # Cached (bounded — see _Session.add_result) so repeated get()/wait()
+        # on the same ref keep working.
         ok, value = sess.results[key]
     if ok:
         return value
@@ -397,6 +484,9 @@ def wait(
     or ``timeout`` elapses. ``timeout=0`` polls — the driver's result loop uses
     this exactly like the reference's ``ray.wait(timeout=0)`` poll
     (util.py:57-70)."""
+    _c = _client_mode()
+    if _c is not None:
+        return _c.wait(refs, num_returns=num_returns, timeout=timeout)
     sess = _require_session()
     deadline = None if timeout is None else time.monotonic() + timeout
     with sess.cv:
@@ -487,14 +577,13 @@ class ActorHandle:
                 _, call_id, ok, value = msg
                 if sess is not None:
                     with sess.cv:
-                        sess.results[(self.actor_id, call_id)] = (ok, value)
+                        sess.add_result((self.actor_id, call_id), (ok, value))
                         sess.cv.notify_all()
             elif msg[0] in ("ready", "ready_error"):
                 if sess is not None:
                     with sess.cv:
-                        sess.results[(self.actor_id, -1)] = (
-                            msg[0] == "ready",
-                            msg[1],
+                        sess.add_result(
+                            (self.actor_id, -1), (msg[0] == "ready", msg[1])
                         )
                         sess.cv.notify_all()
         # Pipe closed: mark actor dead so blocked getters wake up, and release
@@ -564,8 +653,11 @@ class ActorClass:
         return _spawn_actor(self._cls, args, kwargs, self._default_options)
 
 
-def remote(cls: type) -> ActorClass:
+def remote(cls: type) -> "ActorClass":
     """Decorator/wrapper turning a class into a spawnable actor class."""
+    _c = _client_mode()
+    if _c is not None:
+        return _c.remote(cls)
     return ActorClass(cls)
 
 
@@ -735,6 +827,10 @@ def _boot_worker_process(actor_id: str, env: Dict[str, Any], node: Node):
 def kill(handle: ActorHandle, no_restart: bool = True) -> None:  # noqa: ARG001
     """Terminate an actor and release its resources (no restart semantics,
     matching ``ray.kill(no_restart=True)`` in ray_launcher.py:126)."""
+    _c = _client_mode()
+    if _c is not None:
+        _c.kill(handle)
+        return
     sess = _require_session()
     handle._shutdown(force=True)
     with sess.lock:

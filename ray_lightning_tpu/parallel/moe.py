@@ -1,17 +1,26 @@
 """Mixture-of-Experts layer with expert parallelism over a mesh axis.
 
 Beyond-parity capability (the reference has no model code at all,
-SURVEY.md §2c): a switch-style (top-1) MoE feed-forward whose expert weights
+SURVEY.md §2c): a switch-style MoE feed-forward whose expert weights
 carry a leading ``experts`` dim annotated with the "expert" logical axis —
 mapped by GSPMDStrategy to the "ep" mesh axis, so each ep rank holds
 E/ep_size experts and XLA routes tokens between ranks (the all-to-all
 pattern) from the shardings alone.
 
-The dispatch is expressed densely with einsums (one-hot combine weights)
-rather than gather/scatter: static shapes, MXU-friendly, differentiable,
-and the partitioner can optimize the routing communication. Capacity
-factoring drops overflow tokens (standard switch behavior) to keep per-
-expert compute static.
+Two dispatch implementations:
+
+- ``moe_ffn`` (default, sort-based): tokens are grouped by expert with one
+  stable argsort and moved with gather/scatter-add — O(T·K·D + E·C·D)
+  memory, supports top-1 and top-2 routing. Static shapes throughout
+  (argsort/scatter are XLA-native), so it jits and shards like any other op.
+- ``moe_ffn_dense``: the original one-hot einsum formulation, O(T·E·C)
+  dispatch tensors. Kept as the readable oracle the tests check the sparse
+  path against, and as a fallback for tiny expert counts where the dense
+  einsum fuses better.
+
+Capacity factoring drops overflow tokens (standard switch behavior) to keep
+per-expert compute static; with the stable sort, earlier tokens win expert
+slots in both implementations, so top-1 sparse == dense exactly.
 """
 from __future__ import annotations
 
@@ -60,12 +69,99 @@ def moe_ffn(
     x: jax.Array,
     capacity_factor: float = 1.25,
     compute_dtype: Any = jnp.float32,
+    top_k: int = 1,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Switch (top-1) MoE feed-forward.
+    """Sort-based MoE feed-forward with top-k routing (default top-1).
 
     x: (B, S, D) -> (B, S, D), plus aux metrics {"aux_loss", "dropped"}.
     ``aux_loss`` is the load-balancing loss of Shazeer et al. (mean expert
     load x mean router prob, scaled by E); add it to the task loss.
+
+    Dispatch memory is O(T·K·D + E·C·D): one stable argsort groups the
+    (token, expert) assignments by expert, positions within each expert
+    queue come from a searchsorted offset, and tokens move via gather +
+    scatter-add — no (T, E, C) one-hot tensors. For ``top_k=2`` every
+    first-choice assignment outranks all second choices for capacity
+    (GShard-style priority), and gates are renormalized over the kept
+    choices' router probabilities.
+    """
+    B, S, D = x.shape
+    E = params["router"].shape[1]
+    T = B * S
+    K = int(top_k)
+    tokens = x.reshape(T, D)
+    # Router in fp32 for stable softmax.
+    logits = tokens.astype(jnp.float32) @ params["router"]  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, K)  # (T, K)
+    # Switch top-1 gates with the raw router prob (dense-oracle semantics);
+    # top-k>1 renormalizes over the selected experts (GShard).
+    gates = (
+        top_p
+        if K == 1
+        else top_p / jnp.clip(top_p.sum(axis=-1, keepdims=True), 1e-9, None)
+    )
+
+    capacity = max(1, int(capacity_factor * T * K / E))
+    # Flatten choice-major: all first choices precede all second choices, so
+    # the stable sort gives first choices capacity priority within experts
+    # (and token order within the same choice rank, matching the dense
+    # oracle's cumsum order for top-1).
+    e_flat = top_e.T.reshape(-1)  # (K*T,)
+    g_flat = gates.T.reshape(-1)
+    t_flat = jnp.tile(jnp.arange(T), K)
+    order = jnp.argsort(e_flat, stable=True)
+    e_s = e_flat[order]
+    t_s = t_flat[order]
+    g_s = g_flat[order]
+    # Position of each entry in its expert's queue.
+    seg_start = jnp.searchsorted(e_s, jnp.arange(E))  # (E,)
+    pos = jnp.arange(T * K) - seg_start[e_s]
+    keep = pos < capacity
+    pos_c = jnp.clip(pos, 0, capacity - 1)
+
+    cdt = jnp.dtype(compute_dtype)
+    keep_f = keep.astype(jnp.float32)[:, None]
+    gathered = tokens.astype(jnp.float32)[t_s] * keep_f  # (K*T, D)
+    expert_in = (
+        jnp.zeros((E, capacity, D), jnp.float32).at[e_s, pos_c].add(gathered)
+    ).astype(cdt)
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["wi"].astype(cdt))
+        + params["bi"][:, None, :].astype(cdt)
+    )
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", h, params["wo"].astype(cdt)
+    ) + params["bo"][:, None, :].astype(cdt)
+    contrib = (
+        expert_out.astype(jnp.float32)[e_s, pos_c]
+        * (g_s[:, None] * keep_f)
+    )  # (K*T, D)
+    out = jnp.zeros((T, D), jnp.float32).at[t_s].add(contrib)
+
+    # Load-balance aux loss + drop-rate metric (all K choices weighted).
+    load = (
+        jnp.zeros((E,), jnp.float32).at[e_flat].add(jnp.ones(T * K)) / (T * K)
+    )
+    importance = probs.mean(axis=0)
+    aux_loss = E * jnp.sum(load * importance)
+    dropped = 1.0 - keep.astype(jnp.float32).sum() / (T * K)
+    return out.reshape(B, S, D).astype(x.dtype), {
+        "aux_loss": aux_loss,
+        "dropped": dropped,
+    }
+
+
+def moe_ffn_dense(
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    capacity_factor: float = 1.25,
+    compute_dtype: Any = jnp.float32,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Dense one-hot einsum dispatch (top-1 only) — the readable oracle.
+
+    O(T·E·C) dispatch/combine tensors; kept for equivalence tests and tiny
+    expert counts.
     """
     B, S, D = x.shape
     E = params["router"].shape[1]

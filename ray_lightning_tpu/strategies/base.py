@@ -86,19 +86,63 @@ class Strategy:
             return self._num_hosts
         if use_tpu:
             from ray_lightning_tpu import fabric
+            from ray_lightning_tpu.utils.rank_zero import rank_zero_warn
 
-            # One actor per TPU host; chips_per_host from the node with TPUs.
+            # One actor per TPU host. chips_per_host must hold on EVERY
+            # host we place on, so a heterogeneous pod (unequal per-node
+            # chip counts) plans against the minimum rather than trusting
+            # whichever node happens to be listed first.
             per_node = [
-                n["Resources"].get("TPU", 0) for n in fabric.nodes() if n["Resources"].get("TPU", 0) > 0
+                int(n["Resources"].get("TPU", 0))
+                for n in fabric.nodes()
+                if n["Resources"].get("TPU", 0) > 0
             ]
-            chips_per_host = int(per_node[0]) if per_node else 1
+            if not per_node:
+                return self.num_workers  # no TPU nodes visible yet: 1 chip/actor
+            if len(set(per_node)) > 1:
+                rank_zero_warn(
+                    f"TPU nodes report unequal chip counts {sorted(set(per_node))}; "
+                    f"planning with chips_per_host={min(per_node)} so every "
+                    "worker actor fits on any TPU node"
+                )
+            chips_per_host = min(per_node)
             if self.num_workers % chips_per_host == 0:
-                return max(1, self.num_workers // chips_per_host)
-            return self.num_workers  # fall back to 1 chip per actor
+                num_hosts = self.num_workers // chips_per_host
+                # One whole-host actor per node in this branch.
+                if num_hosts > len(per_node):
+                    rank_zero_warn(
+                        f"planning {num_hosts} TPU worker actors of "
+                        f"{chips_per_host} chips each but only "
+                        f"{len(per_node)} TPU nodes are visible; placement "
+                        "will fail unless more nodes join"
+                    )
+            else:
+                num_hosts = self.num_workers  # fall back to 1 chip per actor
+                # Single-chip actors pack many-per-node; feasibility is
+                # bounded by total chips, not node count.
+                if self.num_workers > sum(per_node):
+                    rank_zero_warn(
+                        f"planning {self.num_workers} single-chip TPU worker "
+                        f"actors but only {sum(per_node)} chips are visible; "
+                        "placement will fail unless more chips join"
+                    )
+            return max(1, num_hosts)
         return 1  # CPU: one process with N virtual devices
 
     def plan_workers(self) -> Tuple[List[WorkerPlan], bool]:
         """Compute actor placements. Returns (plans, use_tpu)."""
+        from ray_lightning_tpu.utils.rank_zero import rank_zero_warn
+
+        req_tpu = self.resources_per_worker.get("TPU")
+        if req_tpu is not None and float(req_tpu) != int(req_tpu):
+            # Reference behavior for fractional accelerators
+            # (ray_ddp.py:84-100): a fraction means chip SHARING, which PJRT
+            # cannot isolate — warn loudly rather than fail mysteriously.
+            rank_zero_warn(
+                f"requesting a fractional TPU per worker (TPU={req_tpu}): "
+                "TPU chips cannot be shared between XLA runtimes; expect "
+                "workers to contend for the same chip. Use whole chips."
+            )
         use_tpu = self._resolve_use_tpu()
         num_hosts = self._resolve_num_hosts(use_tpu)
         chips_per_host = self.num_workers // num_hosts
@@ -241,10 +285,41 @@ class Strategy:
         import jax
 
         sharding = self.batch_sharding()
+        if self.dist_env is None or not self.dist_env.is_distributed:
+            # Single-process: plain device_put carries the same semantics
+            # with less per-call bookkeeping than the multi-host assembler.
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), host_batch
+            )
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(sharding, x),
             host_batch,
         )
+
+    def stage_batches(self, host_batches: Any, depth: int = 3) -> Any:
+        """Iterate device-resident global batches, overlapping host->device
+        transfer with compute.
+
+        Over a tunneled/remote PJRT backend a blocking ``device_put`` costs a
+        full round trip; a small thread pool keeps ``depth`` transfers in
+        flight (order-preserving) so the step stream never stalls on H2D.
+        This is the TPU analog of the reference relying on torch DataLoader
+        ``pin_memory`` + async ``.cuda()`` copies in its hot loop.
+        """
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        ex = ThreadPoolExecutor(max_workers=depth, thread_name_prefix="rlt-stage")
+        pending: "collections.deque" = collections.deque()
+        try:
+            for hb in host_batches:
+                pending.append(ex.submit(self.make_global_batch, hb))
+                while len(pending) >= depth:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+        finally:
+            ex.shutdown(wait=False, cancel_futures=True)
 
     # -- compiled steps -------------------------------------------------
     def compile_train_step(self, module: Any, tx: Any) -> Callable:
@@ -288,24 +363,61 @@ class Strategy:
         return jax.jit(step, donate_argnums=(0, 1))
 
     def compile_eval_step(self, module: Any, stage: str) -> Callable:
+        """Compile the eval program.
+
+        predict: ``(params, batch, mask) -> (preds, mask)`` replicated, so
+        every host can fetch and trim padding rows.
+
+        val/test: ``(params, batch, mask) -> (sums, count)`` where ``sums``
+        holds per-key metric totals over REAL samples only and ``count`` the
+        real-sample total. The user step still computes per-batch means (the
+        reference contract); exactness comes from vmapping it over singleton
+        batches — XLA fuses the vmap back into the same batched program — and
+        mask-weighting, so wrap-around padding (trainer/data.py tail) never
+        contaminates metrics. Modules whose metrics are not per-sample means
+        can set ``supports_per_sample_eval = False`` to keep whole-batch
+        evaluation (batch-count weighted)."""
         import jax
+        import jax.numpy as jnp
 
         if stage == "predict":
-
-            def pstep(params, batch):
-                return module.predict_step(params, batch)
-
-            # Replicate predictions so every host can fetch the full result.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            def pstep(params, batch, mask):
+                return module.predict_step(params, batch), mask
+
+            # Replicate predictions so every host can fetch the full result.
             return jax.jit(
                 pstep, out_shardings=NamedSharding(self.mesh, P())
             )
 
         fn = module.validation_step if stage in ("val", "validate") else module.test_step
 
-        def estep(params, batch):
-            return dict(fn(params, batch))
+        if not getattr(module, "supports_per_sample_eval", True):
+
+            def estep_batched(params, batch, mask):
+                logs = dict(fn(params, batch))
+                count = mask.astype(jnp.float32).sum()
+                return (
+                    {k: jnp.asarray(v, jnp.float32) * count for k, v in logs.items()},
+                    count,
+                )
+
+            return jax.jit(estep_batched)
+
+        def estep(params, batch, mask):
+            def per_sample(b):
+                one = jax.tree_util.tree_map(lambda x: x[None], b)
+                return {k: jnp.asarray(v) for k, v in dict(fn(params, one)).items()}
+
+            vals = jax.vmap(per_sample)(batch)
+            m = mask.astype(jnp.float32)
+            count = m.sum()
+            sums = {
+                k: (v.astype(jnp.float32).reshape(-1) * m).sum()
+                for k, v in vals.items()
+            }
+            return sums, count
 
         return jax.jit(estep)
 

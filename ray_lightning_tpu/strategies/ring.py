@@ -62,7 +62,11 @@ class RingTPUStrategy(RayTPUStrategy):
         return jax.jit(step, donate_argnums=(0, 1))
 
     def compile_eval_step(self, module: Any, stage: str) -> Callable:
+        """Per-rank masked eval: each device reduces its real samples
+        locally, then one explicit ``psum`` merges (sums, count) — same
+        (sums, count) contract as the base strategy's GSPMD version."""
         import jax
+        import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         if stage == "predict":
@@ -70,14 +74,46 @@ class RingTPUStrategy(RayTPUStrategy):
 
         fn = module.validation_step if stage in ("val", "validate") else module.test_step
 
-        def per_rank_eval(params, batch):
-            logs = dict(fn(params, batch))
-            return jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, "data"), logs
+        if not getattr(module, "supports_per_sample_eval", True):
+
+            def per_rank_batched(params, batch, mask):
+                logs = dict(fn(params, batch))
+                count = jax.lax.psum(mask.astype(jnp.float32).sum(), "data")
+                # Whole-batch metric: weight each rank's mean by its count.
+                local = mask.astype(jnp.float32).sum()
+                sums = {
+                    k: jax.lax.psum(jnp.asarray(v, jnp.float32) * local, "data")
+                    for k, v in logs.items()
+                }
+                return sums, count
+
+            sharded = jax.shard_map(
+                per_rank_batched,
+                mesh=self.mesh,
+                in_specs=(P(), P("data"), P("data")),
+                out_specs=(P(), P()),
             )
+            return jax.jit(sharded)
+
+        def per_rank_eval(params, batch, mask):
+            def per_sample(b):
+                one = jax.tree_util.tree_map(lambda x: x[None], b)
+                return {k: jnp.asarray(v) for k, v in dict(fn(params, one)).items()}
+
+            vals = jax.vmap(per_sample)(batch)
+            m = mask.astype(jnp.float32)
+            count = jax.lax.psum(m.sum(), "data")
+            sums = {
+                k: jax.lax.psum((v.astype(jnp.float32).reshape(-1) * m).sum(), "data")
+                for k, v in vals.items()
+            }
+            return sums, count
 
         sharded = jax.shard_map(
-            per_rank_eval, mesh=self.mesh, in_specs=(P(), P("data")), out_specs=P()
+            per_rank_eval,
+            mesh=self.mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P()),
         )
         return jax.jit(sharded)
 

@@ -2,6 +2,7 @@ from ray_lightning_tpu.trainer.callbacks import (
     Callback,
     EarlyStopping,
     ModelCheckpoint,
+    JaxProfilerCallback,
     TPUStatsCallback,
 )
 from ray_lightning_tpu.trainer.data import (
@@ -23,6 +24,7 @@ __all__ = [
     "Callback",
     "ModelCheckpoint",
     "EarlyStopping",
+    "JaxProfilerCallback",
     "TPUStatsCallback",
     "DataLoader",
     "Dataset",

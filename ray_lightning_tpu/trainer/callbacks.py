@@ -97,6 +97,11 @@ class ModelCheckpoint(Callback):
         return score > self.best_model_score
 
     def on_validation_end(self, trainer: Any, module: Any) -> None:
+        # PTL semantics: the pre-train sanity pass must not checkpoint —
+        # its metrics are discarded, so a "best" score from 2 sanity batches
+        # would pin best_model_path at untrained params.
+        if getattr(trainer, "sanity_checking", False):
+            return
         self._save(trainer, module)
 
     def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
@@ -199,6 +204,8 @@ class EarlyStopping(Callback):
         return score > self.best + self.min_delta
 
     def on_validation_end(self, trainer: Any, module: Any) -> None:
+        if getattr(trainer, "sanity_checking", False):
+            return  # discarded sanity metrics must not seed best/wait
         score = _metric_value(trainer, self.monitor)
         if score is None or math.isnan(score):
             return
@@ -233,13 +240,22 @@ class TPUStatsCallback(Callback):
         self.peak_memory: list[float] = []
         self._t0 = 0.0
 
+    @staticmethod
+    def _fence(trainer: Any) -> None:
+        # Drain in-flight device work so the timer is honest. effects_barrier
+        # alone is NOT enough: it only waits for effectful ops, while the
+        # loop's async step dispatches can still be queued — blocking on the
+        # live params fences the real computation stream.
+        import jax
+
+        if getattr(trainer, "params", None) is not None:
+            jax.block_until_ready(trainer.params)
+        jax.effects_barrier()
+
     def on_train_epoch_start(self, trainer: Any, module: Any) -> None:
         import time
 
-        import jax
-
-        # Drain pending async dispatches so the timer is honest.
-        jax.effects_barrier()
+        self._fence(trainer)
         self._t0 = time.perf_counter()
 
     def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
@@ -247,7 +263,7 @@ class TPUStatsCallback(Callback):
 
         import jax
 
-        jax.effects_barrier()
+        self._fence(trainer)
         dt = time.perf_counter() - self._t0
         self.epoch_times.append(dt)
         peak = 0.0
@@ -271,3 +287,57 @@ class TPUStatsCallback(Callback):
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.epoch_times = list(state.get("epoch_times", []))
         self.peak_memory = list(state.get("peak_memory", []))
+
+
+class JaxProfilerCallback(Callback):
+    """Capture a ``jax.profiler`` trace for selected training epochs.
+
+    TPU-native profiling (SURVEY.md §5 tracing): writes TensorBoard-loadable
+    traces (XLA ops, fusion, HBM transfers, ICI collectives) under
+    ``dirpath/plugins/profile``. Runs on worker rank 0 only; epoch 1 by
+    default — epoch 0 is dominated by compilation.
+
+    View with: ``tensorboard --logdir <dirpath>`` (Profile tab), or feed the
+    ``.trace.json.gz`` to Perfetto.
+    """
+
+    def __init__(
+        self,
+        dirpath: str = "jax_trace",
+        epochs: tuple = (1,),
+        create_perfetto_trace: bool = False,
+    ) -> None:
+        self.dirpath = dirpath
+        self.epochs = tuple(epochs)
+        self.create_perfetto_trace = create_perfetto_trace
+        self.trace_dirs: list[str] = []
+        self._active = False
+
+    def on_train_epoch_start(self, trainer: Any, module: Any) -> None:
+        if trainer.global_rank != 0 or trainer.current_epoch not in self.epochs:
+            return
+        import jax
+
+        os.makedirs(self.dirpath, exist_ok=True)
+        # Fence so the trace contains only this epoch's work.
+        TPUStatsCallback._fence(trainer)
+        jax.profiler.start_trace(
+            self.dirpath, create_perfetto_trace=self.create_perfetto_trace
+        )
+        self._active = True
+
+    def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
+        if not self._active:
+            return
+        import jax
+
+        TPUStatsCallback._fence(trainer)
+        jax.profiler.stop_trace()
+        self._active = False
+        self.trace_dirs.append(self.dirpath)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"trace_dirs": self.trace_dirs}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.trace_dirs = list(state.get("trace_dirs", []))

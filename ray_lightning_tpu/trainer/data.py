@@ -81,17 +81,32 @@ class DistributedSampler:
         self.epoch = epoch
 
     def indices(self) -> np.ndarray:
+        return self.indices_and_mask()[0]
+
+    def indices_and_mask(self) -> Tuple[np.ndarray, np.ndarray]:
+        """This replica's indices plus a validity mask.
+
+        ``mask[i]`` is False for wrap-around padding entries — each real
+        sample is True on exactly one replica, so masked reductions over all
+        replicas count every dataset element exactly once (what makes eval
+        metrics exact on non-divisible datasets; the reference gets this from
+        torch's real tail batches, test_ddp.py:326-352).
+        """
         if self.shuffle:
             g = np.random.default_rng(self.seed + self.epoch)
             idx = g.permutation(self.dataset_len)
         else:
             idx = np.arange(self.dataset_len)
+        mask = np.ones(len(idx), dtype=bool)
         if not self.drop_last and len(idx) < self.total_size:
             extra = self.total_size - len(idx)
-            idx = np.concatenate([idx, idx[:extra]])
+            idx = np.concatenate([idx, np.resize(idx, extra)])
+            mask = np.concatenate([mask, np.zeros(extra, dtype=bool)])
         else:
             idx = idx[: self.total_size]
-        return idx[self.rank : self.total_size : self.num_replicas]
+            mask = mask[: self.total_size]
+        sl = slice(self.rank, self.total_size, self.num_replicas)
+        return idx[sl], mask[sl]
 
 
 class DataLoader:
@@ -160,37 +175,52 @@ class DataLoader:
         csrc/rltnative.cpp) instead of a per-item Python loop — this is what
         makes the prefetch thread actually overlap with device compute.
         """
-        if self.collate_fn is None and isinstance(self.dataset, ArrayDataset):
+        # Exact-type gate: a subclass may override __getitem__, which the
+        # whole-batch native gather would silently bypass.
+        if self.collate_fn is None and type(self.dataset) is ArrayDataset:
             from ray_lightning_tpu.utils.native import gather_rows
 
             outs = tuple(gather_rows(a, sel) for a in self.dataset.arrays)
             return outs if len(outs) > 1 else outs[0]
         return self._collate([self.dataset[int(i)] for i in sel])
 
-    def _iter_selections(self, batch_multiplier: int) -> Iterator[np.ndarray]:
+    def _iter_selections(
+        self, batch_multiplier: int
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield (row indices, validity mask) per batch. Mask is False for
+        padding rows (sampler wrap-around + tail-batch wrap-around)."""
         if self.sampler is not None:
-            idx = self.sampler.indices()
+            idx, valid = self.sampler.indices_and_mask()
         else:
             if self.shuffle:
                 g = np.random.default_rng(self.seed)
                 idx = g.permutation(len(self.dataset))
             else:
                 idx = np.arange(len(self.dataset))
+            valid = np.ones(len(idx), dtype=bool)
         bs = self.batch_size * batch_multiplier
         n_full = len(idx) // bs
         remainder = len(idx) - n_full * bs
         for b in range(n_full):
-            yield idx[b * bs : (b + 1) * bs]
+            yield idx[b * bs : (b + 1) * bs], valid[b * bs : (b + 1) * bs]
         if remainder and not self.drop_last:
             # Pad the tail batch by wrap-around so its leading dim stays
             # divisible across chips (static shapes for XLA). np.resize
             # cycles the index list, covering shards smaller than one batch.
             sel = idx[n_full * bs :]
             pad = np.resize(idx, bs - len(sel))
-            yield np.concatenate([sel, pad])
+            yield (
+                np.concatenate([sel, pad]),
+                np.concatenate(
+                    [valid[n_full * bs :], np.zeros(len(pad), dtype=bool)]
+                ),
+            )
 
     def iter_batches(
-        self, batch_multiplier: int = 1, prefetch: Optional[int] = None
+        self,
+        batch_multiplier: int = 1,
+        prefetch: Optional[int] = None,
+        with_mask: bool = False,
     ) -> Iterator[Any]:
         """Yield host-level batches of ``batch_size * batch_multiplier``.
 
@@ -198,14 +228,22 @@ class DataLoader:
         GSPMD then splits the array across them. ``prefetch`` > 0 assembles
         up to that many batches ahead in a background thread (default: 2
         when the native gather is available, else synchronous).
+        ``with_mask=True`` yields ``(batch, validity_mask)`` pairs, where the
+        bool mask marks real (non-padding) rows — the eval path uses it for
+        exact masked metric reductions.
         """
         if prefetch is None:
             from ray_lightning_tpu.utils.native import native_available
 
             prefetch = 2 if native_available() else 0
+
+        def assemble(sel: np.ndarray, mask: np.ndarray) -> Any:
+            batch = self._gather(sel)
+            return (batch, mask) if with_mask else batch
+
         if prefetch <= 0:
-            for sel in self._iter_selections(batch_multiplier):
-                yield self._gather(sel)
+            for sel, mask in self._iter_selections(batch_multiplier):
+                yield assemble(sel, mask)
             return
 
         import queue as queue_mod
@@ -217,8 +255,8 @@ class DataLoader:
 
         def producer() -> None:
             try:
-                for sel in self._iter_selections(batch_multiplier):
-                    batch = self._gather(sel)
+                for sel, mask in self._iter_selections(batch_multiplier):
+                    batch = assemble(sel, mask)
                     while not stop.is_set():
                         try:
                             q.put(batch, timeout=0.1)

@@ -40,6 +40,7 @@ class TrainerSpec:
     max_steps: Optional[int] = None
     limit_train_batches: Optional[Any] = None  # int or float fraction
     limit_val_batches: Optional[Any] = None
+    num_sanity_val_steps: int = 2
     check_val_every_n_epoch: int = 1
     log_every_n_steps: int = 50
     enable_checkpointing: bool = True
@@ -263,6 +264,38 @@ class TrainingLoop:
         self._call_callbacks("on_fit_start")
         mult = self.strategy.batch_multiplier
 
+        # Pre-train sanity validation (PTL's num_sanity_val_steps): run a few
+        # val batches so a broken eval path fails BEFORE a long train epoch.
+        # Metrics are discarded and ``sanity_checking`` gates Tune reports
+        # (tune/callbacks.py guard; reference tune.py:113-114). Skipped on
+        # resume — the restored run already validated.
+        if (
+            val_step is not None
+            and self.spec.num_sanity_val_steps
+            and self.current_epoch == 0
+            and self.global_step == 0
+        ):
+            self.sanity_checking = True
+            saved_cb = dict(self.callback_metrics)
+            saved_logged = dict(self.logged_metrics)
+            try:
+                self._run_eval_epoch(
+                    val_step,
+                    self._val_loader,
+                    "sanity",
+                    # PTL convention: -1 means run the FULL val set as sanity.
+                    max_batches=(
+                        None
+                        if self.spec.num_sanity_val_steps < 0
+                        else self.spec.num_sanity_val_steps
+                    ),
+                )
+                self._call_callbacks("on_validation_end")
+            finally:
+                self.callback_metrics = saved_cb
+                self.logged_metrics = saved_logged
+                self.sanity_checking = False
+
         stop = False
         start_epoch = self.current_epoch
         for epoch in range(start_epoch, self.spec.max_epochs):
@@ -277,32 +310,38 @@ class TrainingLoop:
                 self._train_loader.num_batches(mult), self.spec.limit_train_batches
             )
             epoch_logs: List[Dict[str, Any]] = []
-            for batch_idx, host_batch in enumerate(
+            # Device staging pipeline: host batch assembly (loader prefetch
+            # thread) -> H2D transfer (stager pool) -> step dispatch, all
+            # overlapped with device compute.
+            staged = self.strategy.stage_batches(
                 self._train_loader.iter_batches(mult)
-            ):
-                if batch_idx >= n_batches:
-                    break
-                batch = self.strategy.make_global_batch(host_batch)
-                self.params, self.opt_state, logs = train_step(
-                    self.params, self.opt_state, batch, self._rng, self.global_step
-                )
-                epoch_logs.append(logs)  # device scalars; no sync here
-                self.global_step += 1
-                if (
-                    self.global_step % self.spec.log_every_n_steps == 0
-                    or batch_idx == n_batches - 1
-                ):
-                    host_logs = {
-                        k: float(np.asarray(v)) for k, v in logs.items()
-                    }
-                    self.logged_metrics.update(host_logs)
-                    self._call_callbacks("on_train_batch_end", host_logs, batch_idx)
-                if (
-                    self.spec.max_steps is not None
-                    and self.global_step >= self.spec.max_steps
-                ):
-                    stop = True
-                    break
+            )
+            try:
+                for batch_idx, batch in enumerate(staged):
+                    if batch_idx >= n_batches:
+                        break
+                    self.params, self.opt_state, logs = train_step(
+                        self.params, self.opt_state, batch, self._rng, self.global_step
+                    )
+                    epoch_logs.append(logs)  # device scalars; no sync here
+                    self.global_step += 1
+                    if (
+                        self.global_step % self.spec.log_every_n_steps == 0
+                        or batch_idx == n_batches - 1
+                    ):
+                        host_logs = {
+                            k: float(np.asarray(v)) for k, v in logs.items()
+                        }
+                        self.logged_metrics.update(host_logs)
+                        self._call_callbacks("on_train_batch_end", host_logs, batch_idx)
+                    if (
+                        self.spec.max_steps is not None
+                        and self.global_step >= self.spec.max_steps
+                    ):
+                        stop = True
+                        break
+            finally:
+                staged.close()
 
             # One device->host fetch for the whole epoch's train metrics.
             if epoch_logs:
@@ -335,22 +374,42 @@ class TrainingLoop:
         self.strategy.teardown_worker()
         return self._collect_rank_zero_results(results=None)
 
-    def _run_eval_epoch(self, eval_step, loader, prefix: str) -> Dict[str, float]:
+    def _run_eval_epoch(
+        self,
+        eval_step,
+        loader,
+        prefix: str,
+        max_batches: Optional[int] = None,
+    ) -> Dict[str, float]:
         import jax
 
         mult = self.strategy.batch_multiplier
         n_batches = _limit(loader.num_batches(mult), self.spec.limit_val_batches)
-        all_logs: List[Dict[str, Any]] = []
-        for batch_idx, host_batch in enumerate(loader.iter_batches(mult)):
+        if max_batches is not None:
+            n_batches = min(n_batches, max_batches)
+        # Each step returns (per-key masked sums, real-sample count) — device
+        # scalars, fetched once at the end. The weighted combine makes epoch
+        # metrics exact on non-divisible datasets (padding rows carry zero
+        # weight), matching the reference's exact-value contract
+        # (test_ddp.py:326-352) without dynamic tail shapes.
+        all_pairs: List[Any] = []
+        for batch_idx, (host_batch, host_mask) in enumerate(
+            loader.iter_batches(mult, with_mask=True)
+        ):
             if batch_idx >= n_batches:
                 break
             batch = self.strategy.make_global_batch(host_batch)
-            all_logs.append(eval_step(self.params, batch))
-        if not all_logs:
+            gmask = self.strategy.make_global_batch(host_mask)
+            all_pairs.append(eval_step(self.params, batch, gmask))
+        if not all_pairs:
             return {}
-        fetched = jax.device_get(all_logs)
-        keys = fetched[0].keys()
-        means = {k: float(np.mean([float(d[k]) for d in fetched])) for k in keys}
+        fetched = jax.device_get(all_pairs)
+        total = sum(float(count) for _, count in fetched)
+        keys = fetched[0][0].keys()
+        means = {
+            k: float(sum(float(sums[k]) for sums, _ in fetched) / max(total, 1.0))
+            for k in keys
+        }
         self.callback_metrics.update(means)
         self.logged_metrics.update(means)
         if prefix in ("val", "validate"):
@@ -402,9 +461,16 @@ class TrainingLoop:
 
         mult = self.strategy.batch_multiplier
         preds = []
-        for host_batch in loader.iter_batches(mult):
+        for host_batch, host_mask in loader.iter_batches(mult, with_mask=True):
             batch = self.strategy.make_global_batch(host_batch)
-            preds.append(jax.device_get(predict_step(self.params, batch)))
+            gmask = self.strategy.make_global_batch(host_mask)
+            out, mask = jax.device_get(predict_step(self.params, batch, gmask))
+            # Trim wrap-around padding rows so predictions line up 1:1 with
+            # the dataset (mask comes back replicated alongside preds).
+            mask = np.asarray(mask).astype(bool)
+            preds.append(
+                jax.tree_util.tree_map(lambda p: np.asarray(p)[mask], out)
+            )
         self.state = {"status": "finished", "stage": "predict"}
         self.strategy.teardown_worker()
         return self._collect_rank_zero_results(results=preds)

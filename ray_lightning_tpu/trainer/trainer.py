@@ -30,6 +30,7 @@ class Trainer:
         callbacks: Optional[List[Any]] = None,
         limit_train_batches: Optional[Any] = None,
         limit_val_batches: Optional[Any] = None,
+        num_sanity_val_steps: int = 2,
         check_val_every_n_epoch: int = 1,
         log_every_n_steps: int = 50,
         enable_checkpointing: bool = True,
@@ -43,6 +44,7 @@ class Trainer:
         self.callbacks = list(callbacks or [])
         self.limit_train_batches = limit_train_batches
         self.limit_val_batches = limit_val_batches
+        self.num_sanity_val_steps = num_sanity_val_steps
         self.check_val_every_n_epoch = check_val_every_n_epoch
         self.log_every_n_steps = log_every_n_steps
         self.enable_checkpointing = enable_checkpointing
@@ -75,6 +77,7 @@ class Trainer:
             max_steps=self.max_steps,
             limit_train_batches=self.limit_train_batches,
             limit_val_batches=self.limit_val_batches,
+            num_sanity_val_steps=self.num_sanity_val_steps,
             check_val_every_n_epoch=self.check_val_every_n_epoch,
             log_every_n_steps=self.log_every_n_steps,
             enable_checkpointing=self.enable_checkpointing,

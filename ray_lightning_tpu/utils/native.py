@@ -109,12 +109,25 @@ def _n_threads(n_rows: int) -> int:
     return max(1, min(4, cpus, n_rows // 512))
 
 
+def _check_bounds(idx: np.ndarray, n_rows: int) -> None:
+    """Match numpy's fancy-indexing contract before handing indices to the
+    C memcpy loop (which would OOB-read where numpy raises)."""
+    if len(idx) and (idx.min() < -n_rows or idx.max() >= n_rows):
+        bad = idx[(idx < -n_rows) | (idx >= n_rows)][0]
+        raise IndexError(
+            f"index {int(bad)} is out of bounds for axis 0 with size {n_rows}"
+        )
+
+
 def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """out[i] = src[idx[i]] for contiguous src; GIL-free when native."""
     lib = get_lib()
     if lib is None or not src.flags.c_contiguous:
         return src[idx]
     idx = np.ascontiguousarray(idx, dtype=np.int64)
+    _check_bounds(idx, len(src))
+    if len(idx) and idx.min() < 0:  # numpy-style negative indices
+        idx = np.where(idx < 0, idx + len(src), idx)
     out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], initial=1))
     lib.rlt_gather_rows(

@@ -1,0 +1,261 @@
+"""Exact eval metrics on datasets NOT divisible by the global batch.
+
+The reference asserts exact metric values across the driver/worker boundary
+(/root/reference/ray_lightning/tests/test_ddp.py:326-352); torch gets tail
+exactness from dynamic-shape tail batches. Here static shapes are kept for
+XLA and exactness comes from masked per-sample reductions — these tests pin
+that contract for single-device, GSPMD DP, and ring (shard_map) strategies.
+"""
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import BoringModule
+from ray_lightning_tpu.strategies import HorovodRayStrategy, RayStrategy
+from ray_lightning_tpu.trainer import Trainer
+from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.trainer.module import TPUModule
+
+
+class MeanModule(TPUModule):
+    """Per-sample metric with distinct values so padding contamination is
+    unambiguous: val_mean over x = 0..n-1 must be exactly (n-1)/2."""
+
+    def __init__(self, n: int = 9, batch_size: int = 2) -> None:
+        super().__init__()
+        self.n = n
+        self.batch_size = batch_size
+
+    def init_params(self, rng, batch):
+        import jax.numpy as jnp
+
+        return {"w": jnp.zeros(())}
+
+    def training_step(self, params, batch, rng):
+        x = batch if not isinstance(batch, tuple) else batch[0]
+        loss = ((x.mean() - params["w"]) ** 2).mean()
+        return loss, {"loss": loss}
+
+    def validation_step(self, params, batch):
+        x = batch if not isinstance(batch, tuple) else batch[0]
+        return {"val_mean": x.mean(), "val_sq": (x**2).mean()}
+
+    def test_step(self, params, batch):
+        return self.validation_step(params, batch)
+
+    def configure_optimizers(self):
+        import optax
+
+        return optax.sgd(1e-2)
+
+    def _loader(self):
+        data = np.arange(self.n, dtype=np.float32)
+        return DataLoader(ArrayDataset(data), batch_size=self.batch_size)
+
+    def train_dataloader(self):
+        return self._loader()
+
+    def val_dataloader(self):
+        return self._loader()
+
+    def test_dataloader(self):
+        return self._loader()
+
+    def predict_dataloader(self):
+        return self._loader()
+
+    def predict_step(self, params, batch):
+        x = batch if not isinstance(batch, tuple) else batch[0]
+        return x * 2.0
+
+
+def exact_mean(n: int) -> float:
+    return float(np.mean(np.arange(n, dtype=np.float32)))
+
+
+def exact_sq(n: int) -> float:
+    return float(np.mean(np.arange(n, dtype=np.float32) ** 2))
+
+
+def test_sampler_mask_covers_each_sample_once():
+    from ray_lightning_tpu.trainer.data import DistributedSampler
+
+    seen = []
+    for rank in range(4):
+        s = DistributedSampler(10, num_replicas=4, rank=rank, shuffle=False)
+        idx, mask = s.indices_and_mask()
+        assert len(idx) == len(mask) == 3
+        seen.extend(idx[mask].tolist())
+    assert sorted(seen) == list(range(10))
+
+
+def test_eval_exact_single_device():
+    module = MeanModule(n=9, batch_size=2)
+    trainer = Trainer(max_epochs=1, enable_checkpointing=False, seed=0)
+    results = trainer.validate(module_with_params(module))
+    assert results[0]["val_mean"] == pytest.approx(exact_mean(9), abs=1e-6)
+    assert results[0]["val_sq"] == pytest.approx(exact_sq(9), abs=1e-5)
+
+
+def test_test_stage_exact_single_device():
+    module = MeanModule(n=7, batch_size=4)
+    trainer = Trainer(max_epochs=1, enable_checkpointing=False, seed=0)
+    results = trainer.test(module_with_params(module))
+    assert results[0]["val_mean"] == pytest.approx(exact_mean(7), abs=1e-6)
+
+
+def test_predict_trims_padding_single_device():
+    module = MeanModule(n=9, batch_size=2)
+    trainer = Trainer(max_epochs=1, enable_checkpointing=False, seed=0)
+    preds = trainer.predict(module_with_params(module))
+    flat = np.concatenate([np.atleast_1d(p) for p in preds])
+    np.testing.assert_allclose(flat, np.arange(9, dtype=np.float32) * 2.0)
+
+
+def module_with_params(module):
+    import jax.numpy as jnp
+
+    module.params = {"w": jnp.zeros(())}
+    return module
+
+
+@pytest.mark.slow
+def test_eval_exact_distributed_gspmd(start_fabric):
+    """9 samples, 2 hosts x 1 chip, per-chip batch 2: sampler pads 9->10
+    across hosts AND the per-host tail batch (5 -> 2+2+1pad) pads again;
+    both paddings must carry zero metric weight."""
+    start_fabric(num_cpus=2)
+    module = MeanModule(n=9, batch_size=2)
+    trainer = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        strategy=RayStrategy(num_workers=2, num_hosts=2, use_gpu=False),
+    )
+    trainer.fit(module)
+    assert trainer.callback_metrics["val_mean"] == pytest.approx(
+        exact_mean(9), abs=1e-6
+    )
+    assert trainer.callback_metrics["val_sq"] == pytest.approx(
+        exact_sq(9), abs=1e-5
+    )
+
+
+@pytest.mark.slow
+def test_eval_exact_distributed_ring(start_fabric):
+    """Same exactness through the shard_map/psum eval path."""
+    start_fabric(num_cpus=2)
+    module = MeanModule(n=9, batch_size=2)
+    trainer = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        strategy=HorovodRayStrategy(num_workers=2, use_gpu=False),
+    )
+    trainer.fit(module)
+    assert trainer.callback_metrics["val_mean"] == pytest.approx(
+        exact_mean(9), abs=1e-6
+    )
+
+
+def test_eval_exact_boring_still_works():
+    """Existing divisible-path behavior unchanged."""
+    module = BoringModule()
+    trainer = Trainer(max_epochs=1, enable_checkpointing=False, seed=0)
+    trainer.fit(module)
+    assert "val_loss" in trainer.callback_metrics
+
+
+class _HookRecorder:
+    """Callback recording each on_validation_end with the sanity flag."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        if not name.startswith("on_"):
+            raise AttributeError(name)
+
+        def hook(trainer, module, *a):
+            if name == "on_validation_end":
+                self.calls.append(bool(getattr(trainer, "sanity_checking", False)))
+
+        return hook
+
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, state):
+        pass
+
+
+def test_sanity_val_runs_before_training_and_gates_tune_reports():
+    """num_sanity_val_steps runs validation pre-train with sanity_checking
+    set, discards its metrics, and the TuneCallback guard suppresses reports
+    (reference tune.py:113-114)."""
+    from ray_lightning_tpu.tune import session as tune_session
+    from ray_lightning_tpu.tune.callbacks import TuneReportCallback
+
+    reports = []
+    tune_session.init_trial_session("trial-0", ".", results_queue=None)
+    try:
+
+        class _Capture:
+            def report(self, metrics, checkpoint_path=None):
+                reports.append(metrics)
+
+        tune_session._trial_session.report = lambda metrics, checkpoint_path=None: reports.append(metrics)
+        rec = _HookRecorder()
+        module = MeanModule(n=8, batch_size=2)
+        trainer = Trainer(
+            max_epochs=1,
+            enable_checkpointing=False,
+            seed=0,
+            callbacks=[rec, TuneReportCallback(metrics=["val_mean"])],
+        )
+        trainer.fit(module)
+    finally:
+        tune_session.clear_trial_session()
+    # First on_validation_end was the sanity pass, second the real epoch.
+    assert rec.calls == [True, False]
+    # The sanity pass must NOT have produced a tune report.
+    assert len(reports) == 1
+    # Sanity metrics were discarded; real val metrics present.
+    assert trainer.callback_metrics["val_mean"] == pytest.approx(exact_mean(8))
+
+
+def test_sanity_val_does_not_checkpoint_or_earlystop(tmp_path):
+    """ModelCheckpoint must not save untrained params during sanity and
+    EarlyStopping must not seed its best from discarded sanity metrics."""
+    from ray_lightning_tpu.trainer import EarlyStopping, ModelCheckpoint
+
+    ckpt = ModelCheckpoint(dirpath=str(tmp_path), monitor="val_mean")
+    es = EarlyStopping(monitor="val_mean", patience=99)
+    saves = []
+    orig = ckpt._save
+    ckpt._save = lambda tr, mod: saves.append(
+        bool(getattr(tr, "sanity_checking", False))
+    ) or orig(tr, mod)
+    module = MeanModule(n=8, batch_size=2)
+    trainer = Trainer(
+        max_epochs=1,
+        enable_checkpointing=True,
+        seed=0,
+        callbacks=[ckpt, es],
+    )
+    trainer.fit(module)
+    assert saves == [False]  # exactly one save, from the real val pass
+    assert es.best is not None  # seeded by the real epoch, not sanity
+
+
+def test_sanity_val_disabled():
+    rec = _HookRecorder()
+    module = MeanModule(n=8, batch_size=2)
+    trainer = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        callbacks=[rec],
+    )
+    trainer.fit(module)
+    assert rec.calls == [False]

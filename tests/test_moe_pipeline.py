@@ -41,6 +41,127 @@ def test_moe_ffn_math():
     assert float(aux2["dropped"]) > 0.0
 
 
+def test_moe_sparse_matches_dense_oracle():
+    """Sort-based dispatch (default moe_ffn) must reproduce the dense
+    one-hot oracle exactly for top-1: outputs, aux metrics, and grads
+    (VERDICT r2 weak #6: dispatch memory O(T*capacity), dense as oracle)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.parallel.moe import (
+        init_moe_params,
+        moe_ffn,
+        moe_ffn_dense,
+    )
+
+    rng = jax.random.PRNGKey(3)
+    params = init_moe_params(rng, n_experts=4, d_model=16, d_ff=32)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 12, 16))
+    for cf in (8.0, 1.0, 0.4):  # no drops, tight, heavy drops
+        out_s, aux_s = moe_ffn(params, x, capacity_factor=cf)
+        out_d, aux_d = moe_ffn_dense(params, x, capacity_factor=cf)
+        np.testing.assert_allclose(
+            np.asarray(out_s), np.asarray(out_d), atol=1e-5, err_msg=f"cf={cf}"
+        )
+        assert float(aux_s["dropped"]) == pytest.approx(float(aux_d["dropped"]))
+        assert float(aux_s["aux_loss"]) == pytest.approx(
+            float(aux_d["aux_loss"]), abs=1e-5
+        )
+
+    def loss(fn, p):
+        o, a = fn(p, x, capacity_factor=1.0)
+        return jnp.sum(o**2) + a["aux_loss"]
+
+    g_s = jax.grad(lambda p: loss(moe_ffn, p))(params)
+    g_d = jax.grad(lambda p: loss(moe_ffn_dense, p))(params)
+    for k in g_s:
+        np.testing.assert_allclose(
+            np.asarray(g_s[k]), np.asarray(g_d[k]), atol=1e-4, err_msg=k
+        )
+
+
+def test_moe_top2_routing():
+    """top_k=2 with ample capacity equals the explicit two-expert mixture
+    computed densely per token; grads stay finite."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.parallel.moe import init_moe_params, moe_ffn
+
+    rng = jax.random.PRNGKey(5)
+    D, F, E = 8, 16, 4
+    params = init_moe_params(rng, n_experts=E, d_model=D, d_ff=F)
+    x = jax.random.normal(jax.random.PRNGKey(6), (1, 6, D))
+    out, aux = moe_ffn(params, x, capacity_factor=8.0, top_k=2)
+    assert float(aux["dropped"]) == 0.0
+
+    # Per-token reference: run ALL experts on every token, mix the top-2.
+    tokens = np.asarray(x.reshape(-1, D), np.float32)
+    probs = np.asarray(
+        jax.nn.softmax(jnp.asarray(tokens) @ params["router"], axis=-1)
+    )
+    wi, bi = np.asarray(params["wi"]), np.asarray(params["bi"])
+    wo, bo = np.asarray(params["wo"]), np.asarray(params["bo"])
+    ref = np.zeros_like(tokens)
+    for t in range(tokens.shape[0]):
+        top2 = np.argsort(-probs[t])[:2]
+        g = probs[t][top2] / probs[t][top2].sum()
+        for gk, e in zip(g, top2):
+            h = np.asarray(jax.nn.gelu(jnp.asarray(tokens[t] @ wi[e] + bi[e])))
+            ref[t] += gk * (h @ wo[e] + bo[e])
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(-1, D), ref, atol=1e-4
+    )
+
+    def loss(p):
+        o, a = moe_ffn(p, x, capacity_factor=1.0, top_k=2)
+        return jnp.sum(o**2) + a["aux_loss"]
+
+    g = jax.grad(loss)(params)
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+
+def test_gpt_pp_grads_match_dense():
+    """Full-model check: GPT loss grads under a pp2 x model2 sharded mesh
+    equal the unsharded dense grads (VERDICT r2 weak #7: prove pipeline
+    gradients, not just outputs)."""
+    import jax
+    import jax.numpy as jnp
+
+    strategy = make_inprocess({"data": 2, "model": 2, "pp": 2})
+    module = GPTLM(config=TINY, batch_size=4)
+    strategy.bind_module(module)
+    params = init_gpt_params(jax.random.PRNGKey(0), TINY)
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(2), (4, 17), 0, TINY.vocab_size),
+        np.int32,
+    )
+    rng = jax.random.PRNGKey(7)
+
+    def loss_fn(fwd_module, p):
+        loss, _ = fwd_module.training_step(p, (jnp.asarray(toks),), rng)
+        return loss
+
+    # Dense reference: plain module, no mesh bound.
+    dense_module = GPTLM(config=TINY, batch_size=4)
+    g_dense = jax.grad(lambda p: loss_fn(dense_module, p))(params)
+
+    placed = strategy.place_params(params)
+    g_pp = jax.jit(jax.grad(lambda p: loss_fn(module, p)))(placed)
+    g_pp = jax.device_get(g_pp)
+
+    flat_d, _ = jax.tree_util.tree_flatten_with_path(g_dense)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pp)
+    for (path_d, leaf_d), (_, leaf_p) in zip(flat_d, flat_p):
+        np.testing.assert_allclose(
+            np.asarray(leaf_p),
+            np.asarray(leaf_d),
+            atol=5e-4,
+            rtol=1e-3,
+            err_msg=str(path_d),
+        )
+
+
 def test_moe_gpt_expert_parallel_step():
     """MoE GPT on an ep2 x model2 x fsdp2 mesh: expert weights shard on
     "ep", the step runs, loss decreases, aux metric is logged."""

@@ -37,6 +37,34 @@ def test_gather_u8_to_f32_fused():
     assert out.dtype == np.float32
 
 
+def test_gather_rows_bounds_checked():
+    """OOB indices must raise like numpy, not OOB-read in the C loop."""
+    src = np.arange(12, dtype=np.float32).reshape(6, 2)
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([0, 6]))
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([-7]))
+    # Negative indices within range follow numpy semantics.
+    np.testing.assert_array_equal(
+        native.gather_rows(src, np.array([-1, -6, 2])), src[[-1, -6, 2]]
+    )
+
+
+def test_array_dataset_subclass_uses_getitem():
+    """A Dataset subclass overriding __getitem__ must not be bypassed by
+    the whole-batch native fast path (exact-type gate)."""
+    from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+
+    class Doubler(ArrayDataset):
+        def __getitem__(self, idx):
+            item = super().__getitem__(idx)
+            return item * 2
+
+    ds = Doubler(np.arange(8, dtype=np.float32))
+    batch = next(iter(DataLoader(ds, batch_size=4).iter_batches(1, prefetch=0)))
+    np.testing.assert_array_equal(batch, np.array([0, 2, 4, 6], np.float32))
+
+
 def test_noncontiguous_falls_back():
     src = np.asfortranarray(np.random.default_rng(2).standard_normal((16, 4)))
     idx = np.array([3, 1, 2])
