@@ -62,6 +62,7 @@ def _fit_and_rates(
         seed=0,
         log_every_n_steps=10**9,  # no mid-epoch host syncs
         num_sanity_val_steps=0,
+        check_val_every_n_epoch=10**9,  # pure train throughput
         strategy=strategy,
     )
     trainer.fit(module)
@@ -167,12 +168,22 @@ def bench_mnist(
     }
 
 
+def _tiny() -> bool:
+    """RLT_BENCH_TINY=1 shrinks the extra configs so the full bench code
+    path can be exercised without a TPU (CI smoke)."""
+    return os.environ.get("RLT_BENCH_TINY") == "1"
+
+
 def bench_resnet(use_tpu: bool, num_workers: int, epochs: int) -> Dict[str, Any]:
     """BASELINE.md config 3: ResNet-18/CIFAR, ring collective flavor."""
     from ray_lightning_tpu.models.resnet import CIFARResNet
     from ray_lightning_tpu.strategies import RingTPUStrategy
 
-    module = CIFARResNet(batch_size=64, n_train=3072)
+    module = CIFARResNet(
+        batch_size=8 if _tiny() else 64,
+        n_train=64 if _tiny() else 3072,
+        width=8 if _tiny() else 64,
+    )
     rates, _ = _fit_and_rates(
         RingTPUStrategy(num_workers=num_workers, use_tpu=use_tpu), module, epochs
     )
@@ -191,9 +202,15 @@ def bench_gpt(
     from ray_lightning_tpu.models.gpt import GPTLM
     from ray_lightning_tpu.strategies import RayShardedStrategy
 
-    seq = 512
-    batch = 4
-    cfg = GPTConfig.gpt2_small(max_seq=seq, remat=True)
+    if _tiny():
+        seq, batch = 32, 2
+        cfg = GPTConfig(
+            vocab_size=256, n_layer=2, n_head=4, d_model=64, max_seq=seq,
+            attn_impl="reference",
+        )
+    else:
+        seq, batch = 512, 4
+        cfg = GPTConfig.gpt2_small(max_seq=seq, remat=True)
     module = GPTLM(config=cfg, batch_size=batch, n_train=batch * num_workers * 16)
     rates, trainer = _fit_and_rates(
         RayShardedStrategy(num_workers=num_workers, use_tpu=use_tpu),
@@ -221,6 +238,53 @@ def bench_gpt(
     return out, flops_per_token
 
 
+def bench_tune(use_tpu: bool, num_workers: int, num_samples: int = 2) -> Dict[str, Any]:
+    """BASELINE.md config 5: a Tune sweep over MNIST lr (nested distributed
+    fits inside trial actors); records sweep wall time and best accuracy."""
+    from ray_lightning_tpu import tune
+    from ray_lightning_tpu.models import MNISTClassifier
+    from ray_lightning_tpu.strategies import RayTPUStrategy
+    from ray_lightning_tpu.trainer import Trainer
+
+    n_train = 256 if _tiny() else 4096
+
+    def train_fn(config: Dict[str, Any]) -> None:
+        module = MNISTClassifier(
+            lr=config["lr"], batch_size=32, n_train=n_train
+        )
+        trainer = Trainer(
+            max_epochs=1,
+            enable_checkpointing=False,
+            seed=0,
+            num_sanity_val_steps=0,
+            callbacks=[
+                tune.TuneReportCallback(
+                    {"mean_accuracy": "ptl/val_accuracy"}, on="validation_end"
+                )
+            ],
+            strategy=RayTPUStrategy(num_workers=num_workers, use_tpu=use_tpu),
+        )
+        trainer.fit(module)
+
+    t0 = time.time()
+    results = tune.Tuner(
+        train_fn,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        num_samples=num_samples,
+        resources_per_trial=tune.get_tune_resources(
+            num_workers=num_workers, use_tpu=use_tpu
+        ),
+    ).fit()
+    best = results.get_best_result("mean_accuracy", mode="max")
+    return {
+        "tune_sweep_wall_s": round(time.time() - t0, 1),
+        "tune_trials": num_samples,
+        "tune_best_accuracy": round(
+            float(best.metrics.get("mean_accuracy", 0.0)), 4
+        ),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=2)
@@ -239,7 +303,9 @@ def main() -> None:
 
     # fabric.init probes TPU capacity in a short-lived subprocess; the driver
     # itself never initializes the TPU runtime (workers own the chips).
-    fabric.init()
+    # Logical CPUs are over-provisioned (like the examples' smoke mode) so
+    # the tune sweep's trial bundles fit on small hosts; chips stay real.
+    fabric.init(num_cpus=max(8.0, float(os.cpu_count() or 1)))
     use_tpu = fabric.cluster_resources().get("TPU", 0) >= 1
     num_workers = (
         max(1, int(fabric.cluster_resources().get("TPU", 0))) if use_tpu else 1
@@ -279,6 +345,10 @@ def main() -> None:
                 )
         except Exception as exc:  # noqa: BLE001
             extra["gpt_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_tune(use_tpu, num_workers))
+        except Exception as exc:  # noqa: BLE001
+            extra["tune_error"] = f"{type(exc).__name__}: {exc}"
     extra["bench_wall_s"] = round(time.time() - t0, 1)
 
     print(

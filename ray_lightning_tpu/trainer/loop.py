@@ -313,13 +313,13 @@ class TrainingLoop:
             # Device staging pipeline: host batch assembly (loader prefetch
             # thread) -> H2D transfer (stager pool) -> step dispatch, all
             # overlapped with device compute.
+            import itertools
+
             staged = self.strategy.stage_batches(
-                self._train_loader.iter_batches(mult)
+                itertools.islice(self._train_loader.iter_batches(mult), n_batches)
             )
             try:
                 for batch_idx, batch in enumerate(staged):
-                    if batch_idx >= n_batches:
-                        break
                     self.params, self.opt_state, logs = train_step(
                         self.params, self.opt_state, batch, self._rng, self.global_step
                     )
@@ -393,14 +393,22 @@ class TrainingLoop:
         # weight), matching the reference's exact-value contract
         # (test_ddp.py:326-352) without dynamic tail shapes.
         all_pairs: List[Any] = []
-        for batch_idx, (host_batch, host_mask) in enumerate(
-            loader.iter_batches(mult, with_mask=True)
-        ):
-            if batch_idx >= n_batches:
-                break
-            batch = self.strategy.make_global_batch(host_batch)
-            gmask = self.strategy.make_global_batch(host_mask)
-            all_pairs.append(eval_step(self.params, batch, gmask))
+        # (batch, mask) tuples are one pytree: the stager transfers both in
+        # the same overlapped H2D pipeline as the train path. islice bounds
+        # the HOST iterator so the stager never prefetches (and transfers)
+        # batches past the cutoff.
+        import itertools
+
+        staged = self.strategy.stage_batches(
+            itertools.islice(
+                loader.iter_batches(mult, with_mask=True), n_batches
+            )
+        )
+        try:
+            for batch, gmask in staged:
+                all_pairs.append(eval_step(self.params, batch, gmask))
+        finally:
+            staged.close()
         if not all_pairs:
             return {}
         fetched = jax.device_get(all_pairs)

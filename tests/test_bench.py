@@ -1,0 +1,50 @@
+"""bench.py smoke: the harness must produce its one JSON line on CPU.
+
+Guards the driver-run benchmark against code drift; the real numbers come
+from the TPU run (BENCH_r{N}.json)."""
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_smoke_cpu():
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "RLT_BENCH_ALLOW_CPU": "1",
+        "RLT_BENCH_TINY": "1",
+        "RLT_NUM_TPU_CHIPS": "0",
+    }
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root, env.get("PYTHONPATH", "")]
+    ).rstrip(os.pathsep)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "bench.py"),
+            "--rounds", "1", "--epochs", "2", "--n-train", "256",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=repo_root,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "mnist_steps_per_sec_per_chip"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 0
+    # Self-proving env metadata (VERDICT r2 weak #2).
+    assert out["env"]["backend"] == "cpu"
+    assert "device_kind" in out["env"]
+    assert "pair_ratios" in out["extra"]
+    # Tiny mode must exercise ALL extra configs: an API drift in the
+    # ResNet/GPT/Tune benches would otherwise be swallowed into *_error
+    # fields on the real TPU run with no test catching it.
+    assert "resnet_steps_per_sec_per_chip" in out["extra"], out["extra"]
+    assert "gpt_tokens_per_sec" in out["extra"], out["extra"]
+    assert "tune_best_accuracy" in out["extra"], out["extra"]

@@ -223,3 +223,22 @@ def test_no_shm_leak_warnings_across_process_boundary(tmp_path):
     assert "OK" in proc.stdout
     assert "resource_tracker" not in proc.stderr, proc.stderr
     assert "leaked shared_memory" not in proc.stderr, proc.stderr
+
+
+def test_evicted_result_fails_loudly(start_fabric):
+    """A ref whose result was evicted must raise, not deadlock."""
+    f = start_fabric(num_cpus=1)
+    from ray_lightning_tpu.fabric import core
+
+    actor = f.remote(Counter).options(num_cpus=1).remote()
+    old_cap = core._session.RESULTS_CAP
+    core._session.RESULTS_CAP = 4
+    try:
+        stale = actor.incr.remote()
+        f.get(stale)  # consume once; entry may be evicted below
+        for _ in range(12):
+            f.get(actor.incr.remote())
+        with pytest.raises(fabric.FabricError, match="evicted"):
+            f.get(stale, timeout=10)
+    finally:
+        core._session.RESULTS_CAP = old_cap
