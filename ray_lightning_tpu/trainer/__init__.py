@@ -1,5 +1,6 @@
 from ray_lightning_tpu.trainer.callbacks import (
     Callback,
+    CSVLogger,
     EarlyStopping,
     ModelCheckpoint,
     JaxProfilerCallback,
@@ -23,6 +24,7 @@ __all__ = [
     "TrainingLoop",
     "Callback",
     "ModelCheckpoint",
+    "CSVLogger",
     "EarlyStopping",
     "JaxProfilerCallback",
     "TPUStatsCallback",

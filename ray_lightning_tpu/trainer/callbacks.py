@@ -341,3 +341,73 @@ class JaxProfilerCallback(Callback):
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.trace_dirs = list(state.get("trace_dirs", []))
+
+
+class CSVLogger(Callback):
+    """Append one metrics row per epoch to ``dirpath/metrics.csv``.
+
+    Lightweight stand-in for the PTL loggers reference users attach to their
+    Trainer; rank-0 only, header grows with newly-seen metric keys (rows are
+    rewritten when the key set expands).
+    """
+
+    def __init__(self, dirpath: Optional[str] = None, name: str = "metrics.csv") -> None:
+        self.dirpath = dirpath
+        self.name = name
+        self.rows: list[Dict[str, Any]] = []
+        self._resolved_dir: Optional[str] = dirpath
+
+    @property
+    def log_path(self) -> str:
+        """Path of the written CSV (resolved against the trainer's root dir
+        once a fit has run)."""
+        return os.path.join(self._resolved_dir or self.dirpath or ".", self.name)
+
+    def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
+        if trainer.global_rank != 0:
+            return
+        row: Dict[str, Any] = {
+            "epoch": trainer.current_epoch,
+            "step": trainer.global_step,
+        }
+        for k, v in trainer.callback_metrics.items():
+            try:
+                row[k] = float(np.asarray(v))
+            except (TypeError, ValueError):
+                continue
+        self.rows.append(row)
+        self._write(trainer)
+
+    def _write(self, trainer: Any = None) -> None:
+        import csv
+
+        dirpath = self.dirpath or (
+            trainer.default_root_dir
+            if trainer is not None
+            else self._resolved_dir or "."
+        )
+        self._resolved_dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        path = os.path.join(dirpath, self.name)
+        keys: list[str] = []
+        for row in self.rows:
+            for k in row:
+                if k not in keys:
+                    keys.append(k)
+        with open(path, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=keys)
+            writer.writeheader()
+            writer.writerows(self.rows)
+
+    def state_dict(self) -> Dict[str, Any]:
+        # Rows ride the callback sync so the DRIVER-side logger instance can
+        # rewrite the file locally after a distributed fit.
+        return {"rows": self.rows, "dirpath": self._resolved_dir}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.rows = list(state.get("rows", []))
+        self._resolved_dir = self.dirpath or state.get("dirpath")
+        if self.rows:
+            # Rewrite locally: in client mode the worker's file lives on the
+            # remote head's filesystem; the driver needs its own copy.
+            self._write()

@@ -42,6 +42,8 @@ class TrainerSpec:
     limit_val_batches: Optional[Any] = None
     num_sanity_val_steps: int = 2
     check_val_every_n_epoch: int = 1
+    accumulate_grad_batches: int = 1
+    gradient_clip_val: Optional[float] = None
     log_every_n_steps: int = 50
     enable_checkpointing: bool = True
     default_root_dir: str = "."
@@ -161,7 +163,7 @@ class TrainingLoop:
         sample_batch = next(iter(self._train_loader.iter_batches(1, prefetch=0)))
         init_rng, self._rng = jax.random.split(self._rng)
         params = self.module.init_params(init_rng, sample_batch)
-        self._tx = self.module.configure_optimizers()
+        self._tx = self._wrap_optimizer(self.module.configure_optimizers())
         opt_state = self._tx.init(params)
         sharded_path = (
             ckpt_stream.get("orbax_path")
@@ -189,6 +191,84 @@ class TrainingLoop:
             self.params = restored["params"]
             self.opt_state = restored["opt_state"]
             self._restore_progress(meta)
+
+    def _wrap_optimizer(self, tx: Any) -> Any:
+        """Apply Trainer-level optimizer options around the module's optax
+        transform — both stay inside the one compiled step:
+
+        - ``gradient_clip_val``: global-norm clip (PTL's default
+          ``gradient_clip_algorithm="norm"``) chained before the update.
+        - ``accumulate_grad_batches=K``: ``optax.MultiSteps`` accumulates K
+          micro-batch grads on device and applies one update every K-th
+          step; grads are averaged, so K micro-batches == one K-times-larger
+          batch. ``global_step`` keeps counting micro-batches. A partial
+          window left at epoch end is flushed (PTL applies an optimizer step
+          on the last batch regardless of accumulation phase) — see
+          ``_flush_accumulation``.
+        """
+        import optax
+
+        if self.spec.gradient_clip_val:
+            tx = optax.chain(
+                optax.clip_by_global_norm(float(self.spec.gradient_clip_val)),
+                tx,
+            )
+        self._inner_tx = tx  # pre-MultiSteps transform, used by the flush
+        if self.spec.accumulate_grad_batches > 1:
+            tx = optax.MultiSteps(
+                tx, every_k_schedule=int(self.spec.accumulate_grad_batches)
+            )
+        return tx
+
+    def _flush_accumulation(self) -> None:
+        """Apply any partially-accumulated gradient window at epoch end.
+
+        ``MultiStepsState.acc_grads`` holds the running MEAN over the
+        micro-batches seen so far, so applying the inner transform to it is
+        exactly the update those micro-batches deserve — no zero-padding
+        dilution, matching PTL's last-batch-forces-a-step semantics.
+        """
+        if self.spec.accumulate_grad_batches <= 1:
+            return
+        import jax
+        import numpy as np
+
+        mini = int(np.asarray(jax.device_get(self.opt_state.mini_step)))
+        if mini == 0:
+            return
+        if getattr(self, "_flush_step", None) is None:
+            import jax.numpy as jnp
+            import optax
+
+            inner_tx = self._inner_tx
+            strategy = self.strategy
+
+            def flush(params, ms):
+                updates, inner2 = inner_tx.update(
+                    ms.acc_grads, ms.inner_opt_state, params
+                )
+                params2 = optax.apply_updates(params, updates)
+                params2 = jax.lax.with_sharding_constraint(
+                    params2, strategy.param_sharding(params2)
+                )
+                new_ms = optax.MultiStepsState(
+                    mini_step=jnp.zeros_like(ms.mini_step),
+                    gradient_step=ms.gradient_step + 1,
+                    inner_opt_state=inner2,
+                    acc_grads=jax.tree_util.tree_map(
+                        jnp.zeros_like, ms.acc_grads
+                    ),
+                    skip_state=ms.skip_state,
+                )
+                new_ms = jax.lax.with_sharding_constraint(
+                    new_ms, strategy.opt_sharding(new_ms, params2)
+                )
+                return params2, new_ms
+
+            self._flush_step = jax.jit(flush, donate_argnums=(0, 1))
+        self.params, self.opt_state = self._flush_step(
+            self.params, self.opt_state
+        )
 
     def _restore_progress(self, state: Dict[str, Any]) -> None:
         self.current_epoch = int(state.get("epoch", -1)) + 1
@@ -342,6 +422,10 @@ class TrainingLoop:
                         break
             finally:
                 staged.close()
+
+            # Apply any partial grad-accumulation window before val sees
+            # (and checkpoints capture) the epoch's params.
+            self._flush_accumulation()
 
             # One device->host fetch for the whole epoch's train metrics.
             if epoch_logs:

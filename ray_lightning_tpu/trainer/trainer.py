@@ -32,6 +32,8 @@ class Trainer:
         limit_val_batches: Optional[Any] = None,
         num_sanity_val_steps: int = 2,
         check_val_every_n_epoch: int = 1,
+        accumulate_grad_batches: int = 1,
+        gradient_clip_val: Optional[float] = None,
         log_every_n_steps: int = 50,
         enable_checkpointing: bool = True,
         default_root_dir: Optional[str] = None,
@@ -46,6 +48,8 @@ class Trainer:
         self.limit_val_batches = limit_val_batches
         self.num_sanity_val_steps = num_sanity_val_steps
         self.check_val_every_n_epoch = check_val_every_n_epoch
+        self.accumulate_grad_batches = accumulate_grad_batches
+        self.gradient_clip_val = gradient_clip_val
         self.log_every_n_steps = log_every_n_steps
         self.enable_checkpointing = enable_checkpointing
         self.default_root_dir = default_root_dir or os.path.join(
@@ -79,6 +83,8 @@ class Trainer:
             limit_val_batches=self.limit_val_batches,
             num_sanity_val_steps=self.num_sanity_val_steps,
             check_val_every_n_epoch=self.check_val_every_n_epoch,
+            accumulate_grad_batches=self.accumulate_grad_batches,
+            gradient_clip_val=self.gradient_clip_val,
             log_every_n_steps=self.log_every_n_steps,
             enable_checkpointing=self.enable_checkpointing,
             default_root_dir=self.default_root_dir,

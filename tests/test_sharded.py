@@ -149,3 +149,65 @@ def test_sharded_end_to_end_matches_ddp(start_fabric, tmp_path):
     np.testing.assert_allclose(
         np.asarray(fresh.params["w"]), np.asarray(module_b.params["w"]), rtol=1e-6
     )
+
+
+def test_zero_with_grad_accumulation_and_clip():
+    """Trainer optimizer options compose with ZeRO sharding: MultiSteps'
+    acc_grads and the clip chain state shard on the mesh and the step runs."""
+    import jax
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from ray_lightning_tpu.parallel.env import DistEnv
+    from ray_lightning_tpu.trainer.loop import TrainerSpec, TrainingLoop
+
+    strategy = RayShardedStrategy(num_workers=8, use_tpu=False)
+    strategy.dist_env = DistEnv(world_size=8, num_hosts=1, host_rank=0, local_chips=8)
+    strategy.mesh = strategy.build_mesh()
+
+    module = MNISTClassifier(batch_size=4, n_train=256)
+    spec = TrainerSpec(
+        max_epochs=1,
+        accumulate_grad_batches=2,
+        gradient_clip_val=1.0,
+        num_sanity_val_steps=0,
+        enable_checkpointing=False,
+    )
+    loop = TrainingLoop(spec, module, strategy, strategy.dist_env)
+    rng = jax.random.PRNGKey(0)
+    x = np.zeros((8, 28, 28), np.float32)
+    y = np.zeros((8,), np.int32)
+    params = module.init_params(rng, (x, y))
+    tx = loop._wrap_optimizer(module.configure_optimizers())
+    opt_state = tx.init(params)
+
+    placed_params = strategy.place_params(params)
+    placed_opt = strategy.place_opt_state(opt_state, params)
+    # MultiSteps acc_grads are params-shaped -> they must shard too.
+    sharded_leaves = [
+        l
+        for l in jax.tree_util.tree_leaves(placed_opt)
+        if hasattr(l, "sharding") and l.sharding.spec != P()
+    ]
+    assert sharded_leaves
+
+    step = strategy.compile_train_step(module, tx)
+    batch = strategy.make_global_batch(
+        (np.random.randn(32, 28, 28).astype(np.float32), np.zeros((32,), np.int32))
+    )
+    p1, o1, _ = step(placed_params, placed_opt, batch, rng, 0)
+    # First micro-step: accumulation only, params unchanged.
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(p1)[0]),
+        np.asarray(jax.tree_util.tree_leaves(params)[0]),
+    )
+    batch2 = strategy.make_global_batch(
+        (np.random.randn(32, 28, 28).astype(np.float32), np.zeros((32,), np.int32))
+    )
+    p2, o2, logs = step(p1, o1, batch2, rng, 1)
+    # Second micro-step applies the update.
+    assert not np.allclose(
+        np.asarray(jax.tree_util.tree_leaves(p2)[0]),
+        np.asarray(jax.tree_util.tree_leaves(params)[0]),
+    )
+    assert np.isfinite(float(np.asarray(logs["loss"])))
