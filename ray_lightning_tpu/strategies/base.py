@@ -321,6 +321,54 @@ class Strategy:
         finally:
             ex.shutdown(wait=False, cancel_futures=True)
 
+    # -- precision ------------------------------------------------------
+    @staticmethod
+    def _compute_dtype(module: Any):
+        """Trainer-level mixed precision: params stay fp32 masters; the
+        compute graph (params AND batch as seen by the module's step) is
+        cast to bfloat16 — grads come back fp32 through the cast transpose.
+        bf16 is TPU-native, so fp16 requests map to bf16 too (no loss
+        scaling needed)."""
+        import jax.numpy as jnp
+
+        p = str(getattr(module, "precision", "fp32") or "fp32").lower()
+        if p in ("fp32", "32", "32-true", "float32"):
+            return None
+        if p in ("bf16", "bf16-mixed", "bfloat16", "16", "16-mixed",
+                 "fp16", "float16"):
+            return jnp.bfloat16
+        if p in ("bf16-true", "16-true"):
+            # True-half (params/opt state STORED in bf16) is a memory-layout
+            # choice the module owns (e.g. GPTConfig.compute_dtype); quietly
+            # running it as mixed would break its memory promise.
+            raise ValueError(
+                f"precision {p!r} (true half) is not a trainer-level option; "
+                "use 'bf16-mixed', or store low-precision params in the "
+                "module itself"
+            )
+        raise ValueError(f"unsupported precision {p!r}")
+
+    def _prep_compute(self, module: Any) -> Callable:
+        """One shared cast policy for every compiled program: returns
+        ``prep(params, batch) -> (params, batch)`` applying the trainer's
+        mixed-precision dtype (no-op for fp32)."""
+        cdt = self._compute_dtype(module)
+        if cdt is None:
+            return lambda params, batch: (params, batch)
+        cast = self._cast_floating
+        return lambda params, batch: (cast(params, cdt), cast(batch, cdt))
+
+    @staticmethod
+    def _cast_floating(tree: Any, dtype: Any) -> Any:
+        import jax
+        import jax.numpy as jnp
+
+        def cast(x):
+            x = jnp.asarray(x)
+            return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        return jax.tree_util.tree_map(cast, tree)
+
     # -- compiled steps -------------------------------------------------
     def compile_train_step(self, module: Any, tx: Any) -> Callable:
         """Build the jitted train step.
@@ -333,6 +381,8 @@ class Strategy:
         import jax
         import optax
 
+        prep = self._prep_compute(module)
+
         def step(params, opt_state, batch, rng, step_idx):
             # Per-step rng derivation happens *inside* the compiled program
             # (the loop passes the base key + step counter), avoiding a
@@ -340,7 +390,8 @@ class Strategy:
             rng = jax.random.fold_in(rng, step_idx)
 
             def loss_fn(p):
-                loss, logs = module.training_step(p, batch, rng)
+                p, b = prep(p, batch)
+                loss, logs = module.training_step(p, b, rng)
                 return loss, dict(logs)
 
             (loss, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -380,10 +431,13 @@ class Strategy:
         import jax
         import jax.numpy as jnp
 
+        prep = self._prep_compute(module)
+
         if stage == "predict":
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             def pstep(params, batch, mask):
+                params, batch = prep(params, batch)
                 return module.predict_step(params, batch), mask
 
             # Replicate predictions so every host can fetch the full result.
@@ -396,6 +450,7 @@ class Strategy:
         if not getattr(module, "supports_per_sample_eval", True):
 
             def estep_batched(params, batch, mask):
+                params, batch = prep(params, batch)
                 logs = dict(fn(params, batch))
                 count = mask.astype(jnp.float32).sum()
                 return (
@@ -406,6 +461,8 @@ class Strategy:
             return jax.jit(estep_batched)
 
         def estep(params, batch, mask):
+            params, batch = prep(params, batch)
+
             def per_sample(b):
                 one = jax.tree_util.tree_map(lambda x: x[None], b)
                 return {k: jnp.asarray(v) for k, v in dict(fn(params, one)).items()}

@@ -29,11 +29,13 @@ class RingTPUStrategy(RayTPUStrategy):
         from jax.sharding import PartitionSpec as P
 
         mesh = self.mesh
+        prep = self._prep_compute(module)
 
         def per_rank_step(params, opt_state, batch, rng):
             # Runs per device on its batch shard; params/opt replicated in.
             def loss_fn(p):
-                loss, logs = module.training_step(p, batch, rng)
+                p, b = prep(p, batch)
+                loss, logs = module.training_step(p, b, rng)
                 return loss, dict(logs)
 
             (loss, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -73,10 +75,12 @@ class RingTPUStrategy(RayTPUStrategy):
             return super().compile_eval_step(module, stage)
 
         fn = module.validation_step if stage in ("val", "validate") else module.test_step
+        prep = self._prep_compute(module)
 
         if not getattr(module, "supports_per_sample_eval", True):
 
             def per_rank_batched(params, batch, mask):
+                params, batch = prep(params, batch)
                 logs = dict(fn(params, batch))
                 count = jax.lax.psum(mask.astype(jnp.float32).sum(), "data")
                 # Whole-batch metric: weight each rank's mean by its count.
@@ -96,6 +100,8 @@ class RingTPUStrategy(RayTPUStrategy):
             return jax.jit(sharded)
 
         def per_rank_eval(params, batch, mask):
+            params, batch = prep(params, batch)
+
             def per_sample(b):
                 one = jax.tree_util.tree_map(lambda x: x[None], b)
                 return {k: jnp.asarray(v) for k, v in dict(fn(params, one)).items()}

@@ -173,7 +173,21 @@ class TrainingLoop:
         if ckpt_stream is not None and sharded_path is None:
             state = load_state_stream(ckpt_stream)
             params = state["params"]
-            opt_state = state.get("opt_state", opt_state)
+            if "opt_state" in state:
+                restored = state["opt_state"]
+                expected = jax.tree_util.tree_structure(
+                    jax.eval_shape(self._tx.init, params)
+                )
+                if jax.tree_util.tree_structure(restored) != expected:
+                    raise RuntimeError(
+                        "checkpointed optimizer state does not match the "
+                        "current optimizer: accumulate_grad_batches/"
+                        "gradient_clip_val/configure_optimizers changed "
+                        "since the checkpoint was written. Resume with the "
+                        "same optimizer options, or load params only via "
+                        "validate/test/predict(ckpt_path=...)"
+                    )
+                opt_state = restored
             self._restore_progress(state)
         self.params = self.strategy.place_params(params)
         self.opt_state = self.strategy.place_opt_state(opt_state, params)
@@ -424,8 +438,12 @@ class TrainingLoop:
                 staged.close()
 
             # Apply any partial grad-accumulation window before val sees
-            # (and checkpoints capture) the epoch's params.
-            self._flush_accumulation()
+            # (and checkpoints capture) the epoch's params — but only when
+            # the epoch actually completed: PTL's last-batch flush is an
+            # end-of-epoch semantic, and a max_steps stop must not advance
+            # params past the requested step budget.
+            if not stop:
+                self._flush_accumulation()
 
             # One device->host fetch for the whole epoch's train metrics.
             if epoch_logs:

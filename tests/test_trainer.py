@@ -334,3 +334,163 @@ def test_accumulation_partial_window_flushed():
     np.testing.assert_allclose(
         np.asarray(m.params["w"]), np.asarray(w), atol=1e-6
     )
+
+
+def test_precision_bf16_mixed():
+    """precision='bf16' casts the compute graph (params+batch as seen by the
+    module step) to bfloat16 while master params stay float32."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+    from ray_lightning_tpu.trainer.module import TPUModule
+
+    seen = {}
+
+    class Probe(TPUModule):
+        def init_params(self, rng, batch):
+            return {"w": jnp.zeros((3,), jnp.float32)}
+
+        def training_step(self, params, batch, rng):
+            x, y = batch
+            seen["param_dtype"] = params["w"].dtype
+            seen["batch_dtype"] = x.dtype
+            loss = ((x @ params["w"] - y) ** 2).mean()
+            return loss, {"loss": loss}
+
+        def validation_step(self, params, batch):
+            x, y = batch
+            seen["eval_dtype"] = x.dtype
+            return {"val_loss": ((x @ params["w"] - y) ** 2).mean()}
+
+        def configure_optimizers(self):
+            return optax.sgd(1e-2)
+
+        def _loader(self):
+            g = np.random.default_rng(0)
+            x = g.standard_normal((64, 3)).astype(np.float32)
+            return DataLoader(
+                ArrayDataset(x, (x @ np.ones(3, np.float32))), batch_size=4
+            )
+
+        def train_dataloader(self):
+            return self._loader()
+
+        def val_dataloader(self):
+            return self._loader()
+
+    module = Probe()
+    trainer = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        precision="bf16",
+    )
+    trainer.fit(module)
+    assert seen["param_dtype"] == jnp.bfloat16
+    assert seen["batch_dtype"] == jnp.bfloat16
+    assert seen["eval_dtype"] == jnp.bfloat16
+    # Master params stay fp32 and were actually updated.
+    w = module.params["w"]
+    assert np.asarray(w).dtype == np.float32
+    assert np.abs(np.asarray(w)).sum() > 0
+    assert np.isfinite(trainer.callback_metrics["val_loss"])
+
+
+def test_precision_fp32_untouched():
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.strategies.base import Strategy
+
+    class M:
+        precision = "fp32"
+
+    assert Strategy._compute_dtype(M()) is None
+
+    class B:
+        precision = "16-mixed"
+
+    assert Strategy._compute_dtype(B()) == jnp.bfloat16
+
+
+def test_max_steps_stop_does_not_flush_partial_window():
+    """Stopping via max_steps mid-accumulation-window must NOT apply the
+    dangling micro-batch (PTL drops it; only epoch end flushes)."""
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import Trainer
+
+    m = _DetModule(batch_size=4, n=128)  # 4 micro-steps/epoch
+    t = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        accumulate_grad_batches=2,
+        max_steps=3,  # stops with one dangling micro-batch
+    )
+    t.fit(m)
+    assert t.global_step == 3
+
+    # Reference: exactly ONE update from micro-batches 1-2 (64 samples).
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    g = np.random.default_rng(0)
+    x = g.standard_normal((128, 3)).astype(np.float32)
+    y = x @ np.array([1.0, -2.0, 0.5], np.float32)
+    bx, by = jnp.asarray(x[:64]), jnp.asarray(y[:64])
+    grads = jax.grad(lambda p: ((bx @ p["w"] - by) ** 2).mean())(
+        {"w": jnp.zeros(3)}
+    )
+    tx = optax.sgd(1e-2)
+    updates, _ = tx.update(grads, tx.init({"w": jnp.zeros(3)}))
+    w_ref = optax.apply_updates({"w": jnp.zeros(3)}, updates)["w"]
+    np.testing.assert_allclose(
+        np.asarray(m.params["w"]), np.asarray(w_ref), atol=1e-6
+    )
+
+
+def test_resume_with_changed_optimizer_options_rejected(tmp_path):
+    import pytest as _pytest
+
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    m = _DetModule(batch_size=4, n=128)
+    ckpt = ModelCheckpoint(dirpath=str(tmp_path), monitor="val_loss")
+    t = Trainer(
+        max_epochs=1,
+        enable_checkpointing=True,
+        seed=0,
+        num_sanity_val_steps=0,
+        callbacks=[ckpt],
+    )
+    t.fit(m)
+    assert ckpt.best_model_path
+
+    m2 = _DetModule(batch_size=4, n=128)
+    t2 = Trainer(
+        max_epochs=2,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        accumulate_grad_batches=2,  # changes opt_state structure
+    )
+    with _pytest.raises(RuntimeError, match="optimizer"):
+        t2.fit(m2, ckpt_path=ckpt.best_model_path)
+
+
+def test_precision_true_half_rejected():
+    import pytest as _pytest
+
+    from ray_lightning_tpu.strategies.base import Strategy
+
+    class M:
+        precision = "bf16-true"
+
+    with _pytest.raises(ValueError, match="true half"):
+        Strategy._compute_dtype(M())
