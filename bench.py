@@ -305,7 +305,25 @@ def main() -> None:
     # itself never initializes the TPU runtime (workers own the chips).
     # Logical CPUs are over-provisioned (like the examples' smoke mode) so
     # the tune sweep's trial bundles fit on small hosts; chips stay real.
-    fabric.init(num_cpus=max(8.0, float(os.cpu_count() or 1)))
+    # The tunneled TPU service can wedge for minutes at a time; retry the
+    # probe with backoff before giving up on the hard RLT_REQUIRE_TPU error.
+    retries = int(os.environ.get("RLT_BENCH_TPU_RETRIES", "3"))
+    for attempt in range(retries + 1):
+        try:
+            fabric.init(num_cpus=max(8.0, float(os.cpu_count() or 1)))
+            break
+        except fabric.FabricError:
+            if attempt == retries:
+                raise
+            import sys
+
+            print(
+                f"TPU probe failed (attempt {attempt + 1}/{retries + 1}); "
+                "retrying in 120s",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(120)
     use_tpu = fabric.cluster_resources().get("TPU", 0) >= 1
     num_workers = (
         max(1, int(fabric.cluster_resources().get("TPU", 0))) if use_tpu else 1

@@ -69,7 +69,7 @@ def main() -> None:
     parser.add_argument("--smoke-test", action="store_true")
     parser.add_argument(
         "--address", type=str, default=None,
-        help="fabric head address for client mode (raises until fabric.client lands)",
+        help="fabric head address (host:port) for client mode — start one with `python -m ray_lightning_tpu.fabric.server`",
     )
     parser.add_argument(
         "--num-cpus", type=int, default=None,

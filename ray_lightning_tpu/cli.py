@@ -169,6 +169,12 @@ def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
     )
     parser.add_argument("subcommand", choices=_SUBCOMMANDS)
     parser.add_argument("--config", action="append", default=[])
+    parser.add_argument(
+        "--address",
+        default=None,
+        help="fabric head address (host:port) for client mode — start one "
+        "with `python -m ray_lightning_tpu.fabric.server`",
+    )
     known, rest = parser.parse_known_args(argv)
 
     config: Dict[str, Any] = {}
@@ -182,6 +188,13 @@ def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
                 config[section] = merged
             else:
                 config[section] = value
+
+    # CLI flag wins over any fabric: section from YAML (same precedence as
+    # the dotted overrides, which also apply after the YAML merge).
+    if known.address:
+        fabric_cfg = dict(config.get("fabric") or {})
+        fabric_cfg["address"] = known.address
+        config["fabric"] = fabric_cfg
 
     dotted: List[Tuple[str, str]] = []
     i = 0
@@ -223,6 +236,11 @@ def build(config: Dict[str, Any]) -> Tuple[Any, Any, Optional[Any]]:
 
 def main(argv: Optional[List[str]] = None) -> Any:
     subcommand, config = parse_args(argv)
+    fabric_cfg = config.pop("fabric", None) or {}
+    if fabric_cfg:
+        from ray_lightning_tpu import fabric
+
+        fabric.init(**fabric_cfg)
     trainer, model, datamodule = build(config)
     fn = getattr(trainer, subcommand)
     if datamodule is not None:

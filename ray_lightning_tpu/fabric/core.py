@@ -222,7 +222,10 @@ def init(
         if ignore_reinit_error:
             return
         raise FabricError("fabric already initialized")
-    _session = _Session()
+    # Detect BEFORE publishing the session: if detection raises (e.g.
+    # RLT_REQUIRE_TPU with a wedged probe), no half-built session must
+    # linger — a retrying caller would otherwise hit the reinit fast-path
+    # and silently run with zero resources.
     cap = _detect_local_capacity()
     if num_cpus is not None:
         cap["CPU"] = float(num_cpus)
@@ -230,7 +233,9 @@ def init(
         cap["TPU"] = float(num_tpus)
     if resources:
         cap.update({k: float(v) for k, v in resources.items()})
-    _session.nodes.append(Node("node-0", get_node_ip(), cap))
+    session = _Session()
+    session.nodes.append(Node("node-0", get_node_ip(), cap))
+    _session = session
 
 
 def _require_session() -> _Session:

@@ -412,6 +412,7 @@ class TrainingLoop:
             staged = self.strategy.stage_batches(
                 itertools.islice(self._train_loader.iter_batches(mult), n_batches)
             )
+            batch_idx = -1
             try:
                 for batch_idx, batch in enumerate(staged):
                     self.params, self.opt_state, logs = train_step(
@@ -439,10 +440,11 @@ class TrainingLoop:
 
             # Apply any partial grad-accumulation window before val sees
             # (and checkpoints capture) the epoch's params — but only when
-            # the epoch actually completed: PTL's last-batch flush is an
-            # end-of-epoch semantic, and a max_steps stop must not advance
-            # params past the requested step budget.
-            if not stop:
+            # the epoch ran all its batches: PTL's flush is a
+            # last-batch-of-epoch semantic, so a max_steps stop that landed
+            # ON the final batch still flushes, while an earlier stop must
+            # not advance params past the requested step budget.
+            if not stop or batch_idx == n_batches - 1:
                 self._flush_accumulation()
 
             # One device->host fetch for the whole epoch's train metrics.

@@ -39,6 +39,13 @@ def fabric_head():
         if proc.poll() is not None:
             raise RuntimeError("fabric server died during boot")
     assert address, "server never printed ready line"
+    # Drain the pipe in the background so the server (and workers sharing
+    # its stdout) can't block on a full pipe buffer mid-test.
+    import threading
+
+    threading.Thread(
+        target=lambda: [None for _ in proc.stdout], daemon=True
+    ).start()
     try:
         yield address
     finally:

@@ -242,3 +242,25 @@ def test_evicted_result_fails_loudly(start_fabric):
             f.get(stale, timeout=10)
     finally:
         core._session.RESULTS_CAP = old_cap
+
+
+def test_failed_init_leaves_no_stale_session(monkeypatch):
+    """If capacity detection raises (RLT_REQUIRE_TPU + wedged probe), a
+    retrying fabric.init must actually retry — not hit the reinit fast-path
+    of a half-built session with zero resources."""
+    from ray_lightning_tpu.fabric import core
+
+    assert core._session is None
+    monkeypatch.setenv("RLT_REQUIRE_TPU", "1")
+    monkeypatch.setenv("RLT_NUM_TPU_CHIPS", "0")
+    with pytest.raises(fabric.FabricError, match="RLT_REQUIRE_TPU"):
+        fabric.init()
+    assert core._session is None  # nothing published
+    # Retry with the env fixed now succeeds with real resources.
+    monkeypatch.setenv("RLT_NUM_TPU_CHIPS", "2")
+    fabric.init(num_cpus=2)
+    try:
+        assert fabric.cluster_resources()["TPU"] == 2
+        assert fabric.cluster_resources()["CPU"] == 2
+    finally:
+        fabric.shutdown()

@@ -494,3 +494,26 @@ def test_precision_true_half_rejected():
 
     with _pytest.raises(ValueError, match="true half"):
         Strategy._compute_dtype(M())
+
+
+def test_max_steps_on_final_batch_still_flushes():
+    """max_steps landing exactly on the epoch's last batch IS an epoch end:
+    the partial window must flush, matching the same run without max_steps."""
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import Trainer
+
+    def run(**kw):
+        m = _DetModule(batch_size=4, n=96)  # 3 micro-steps/epoch
+        t = Trainer(
+            max_epochs=1,
+            enable_checkpointing=False,
+            seed=0,
+            num_sanity_val_steps=0,
+            accumulate_grad_batches=2,
+            **kw,
+        )
+        t.fit(m)
+        return np.asarray(m.params["w"])
+
+    np.testing.assert_allclose(run(), run(max_steps=3), atol=0)
