@@ -43,6 +43,65 @@ def start_fabric():
     fabric.shutdown()
 
 
+@pytest.fixture
+def fabric_head():
+    """Start a fabric head server subprocess; yield its host:port address.
+
+    Shared by the client-mode suites (tests/test_client.py, test_cli.py).
+    A reader thread owns the server's stdout: the boot wait has a real
+    timeout even if the server wedges before printing its ready line, and
+    the pipe keeps draining for the whole test so the server (and workers
+    sharing its stdout) can never block on a full pipe buffer.
+    """
+    import queue
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root, env.get("PYTHONPATH", "")]
+    ).rstrip(os.pathsep)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_lightning_tpu.fabric.server",
+         "--port", "0", "--num-cpus", "8"],
+        env=env,
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    lines: "queue.Queue[str]" = queue.Queue()
+
+    def _drain() -> None:
+        for line in proc.stdout:
+            lines.put(line)
+
+    threading.Thread(target=_drain, daemon=True).start()
+
+    address = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("fabric server died during boot")
+        try:
+            line = lines.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        if line.startswith("FABRIC_SERVER_READY"):
+            address = line.split()[1]
+            break
+    assert address, "server never printed ready line"
+    try:
+        yield address
+    finally:
+        from ray_lightning_tpu.fabric import client
+
+        client.disconnect()
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
 def pytest_collection_modifyitems(config, items):
     if os.environ.get("RLT_TPU") != "1":
         skip_tpu = pytest.mark.skip(reason="needs real TPU (set RLT_TPU=1)")

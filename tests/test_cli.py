@@ -154,56 +154,29 @@ def test_cli_fit_end_to_end(start_fabric):
     assert result is not None
 
 
-def test_cli_address_enters_client_mode(tmp_path):
+def test_cli_address_enters_client_mode(fabric_head):
     """--address routes the whole CLI fit through a fabric head (the
     reference's LightningCLI-under-Ray-Client workflow)."""
     import os
     import subprocess
     import sys
-    import time
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env["PYTHONPATH"] = os.pathsep.join(
         [repo_root, env.get("PYTHONPATH", "")]
     ).rstrip(os.pathsep)
-    srv = subprocess.Popen(
-        [sys.executable, "-m", "ray_lightning_tpu.fabric.server",
-         "--port", "0", "--num-cpus", "4"],
-        env=env, stdout=subprocess.PIPE, text=True,
+    # Run the CLI in a subprocess so client-mode globals don't leak into
+    # this test process.
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_lightning_tpu.cli", "fit",
+         "--address", fabric_head,
+         "--model", "ray_lightning_tpu.models.XORModule",
+         "--strategy", "RayTPUStrategy",
+         "--strategy.num_workers", "2",
+         "--strategy.use_tpu", "false",
+         "--trainer.max_epochs", "1",
+         "--trainer.enable_checkpointing", "false"],
+        capture_output=True, text=True, timeout=300, env=env,
     )
-    try:
-        address = None
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
-            if srv.poll() is not None:
-                raise AssertionError("fabric server died during boot")
-            line = srv.stdout.readline()
-            if line.startswith("FABRIC_SERVER_READY"):
-                address = line.split()[1]
-                break
-        assert address
-        # Keep draining the pipe in the background so the server (and the
-        # workers sharing its stdout) can't block on a full pipe buffer.
-        import threading
-
-        threading.Thread(
-            target=lambda: [None for _ in srv.stdout], daemon=True
-        ).start()
-        # Run the CLI in a subprocess so client-mode globals don't leak into
-        # this test process.
-        proc = subprocess.run(
-            [sys.executable, "-m", "ray_lightning_tpu.cli", "fit",
-             "--address", address,
-             "--model", "ray_lightning_tpu.models.XORModule",
-             "--strategy", "RayTPUStrategy",
-             "--strategy.num_workers", "2",
-             "--strategy.use_tpu", "false",
-             "--trainer.max_epochs", "1",
-             "--trainer.enable_checkpointing", "false"],
-            capture_output=True, text=True, timeout=300, env=env,
-        )
-        assert proc.returncode == 0, proc.stderr[-2000:]
-    finally:
-        srv.terminate()
-        srv.wait(timeout=30)
+    assert proc.returncode == 0, proc.stderr[-2000:]

@@ -3,57 +3,14 @@
 test_client_3.py): a head server owns the resources; the driver connects
 with ``fabric.init(address=...)`` and runs the standard examples unchanged.
 """
-import os
-import subprocess
-import sys
-import time
-
 import numpy as np
 import pytest
 
 from ray_lightning_tpu import fabric
 
 
-@pytest.fixture
-def fabric_head():
-    """Start a fabric head server subprocess; yield its address."""
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-    env["PYTHONPATH"] = os.pathsep.join(
-        [repo_root, env.get("PYTHONPATH", "")]
-    ).rstrip(os.pathsep)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_lightning_tpu.fabric.server",
-         "--port", "0", "--num-cpus", "8"],
-        env=env,
-        stdout=subprocess.PIPE,
-        text=True,
-    )
-    address = None
-    deadline = time.monotonic() + 60
-    while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if line.startswith("FABRIC_SERVER_READY"):
-            address = line.split()[1]
-            break
-        if proc.poll() is not None:
-            raise RuntimeError("fabric server died during boot")
-    assert address, "server never printed ready line"
-    # Drain the pipe in the background so the server (and workers sharing
-    # its stdout) can't block on a full pipe buffer mid-test.
-    import threading
-
-    threading.Thread(
-        target=lambda: [None for _ in proc.stdout], daemon=True
-    ).start()
-    try:
-        yield address
-    finally:
-        from ray_lightning_tpu.fabric import client
-
-        client.disconnect()
-        proc.terminate()
-        proc.wait(timeout=30)
+# The fabric_head fixture (server boot + stdout drain) lives in conftest.py,
+# shared with the CLI client-mode test.
 
 
 def test_client_basic_ops(fabric_head):
