@@ -48,6 +48,13 @@ class GPTConfig:
     compute_dtype: str = "float32"  # "bfloat16" for TPU runs
     remat: bool = False
     attn_impl: str = "flash"  # "flash" | "reference"
+    # Sequence-parallel attention flavor when the mesh's seq axis is >1:
+    # "ring" = contiguous shards (ops/ring_attention.py); "zigzag" =
+    # load-balanced causal ring — the whole transformer then runs in zigzag
+    # sequence layout (tokens/positions permuted once at the embedding,
+    # hidden states un-permuted before the LM head), so the balanced
+    # attention costs no per-layer resharding.
+    seq_impl: str = "ring"
     init_std: float = 0.02
     # Mixture-of-Experts: n_experts > 0 replaces every block's dense MLP
     # with a switch (top-1) MoE layer (parallel/moe.py); expert weights
@@ -206,16 +213,66 @@ def gpt_forward(
 
     cdt = jnp.dtype(cfg.compute_dtype)
     B, S = tokens.shape
-    x = params["wte"][tokens] + params["wpe"][:S]
-    x = x.astype(cdt)
 
     use_ring = (
         mesh is not None
         and seq_axis is not None
         and mesh.shape.get(seq_axis, 1) > 1
     )
+    if cfg.seq_impl not in ("ring", "zigzag"):
+        raise ValueError(
+            f"unknown seq_impl {cfg.seq_impl!r}; use 'ring' or 'zigzag'"
+        )
+    # Zigzag layout: permute ONCE at the embedding (tokens and positional
+    # rows together) so every per-position op runs unchanged and the
+    # balanced attention needs no per-layer resharding; hidden states are
+    # un-permuted after the final LN (D-wide, cheaper than post-head V-wide).
+    use_zigzag = use_ring and cfg.seq_impl == "zigzag"
+    if use_zigzag and S % (2 * mesh.shape[seq_axis]):
+        raise ValueError(
+            f"seq_impl='zigzag' needs sequence length {S} divisible by "
+            f"2*seq_axis ({2 * mesh.shape[seq_axis]}); pad the sequence or "
+            "use seq_impl='ring'"
+        )
+
+    def _seq_sharded(h):
+        # Pin (B, S, D) activations to batch x seq sharding after layout
+        # permutes — the gathers would otherwise leave them replicated,
+        # materializing full-sequence activations on every seq rank.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch_axes = tuple(
+            ax for ax in ("data", "fsdp") if mesh.shape.get(ax, 1) > 1
+        )
+        return jax.lax.with_sharding_constraint(
+            h, NamedSharding(mesh, P(batch_axes or None, seq_axis, None))
+        )
+
+    if use_zigzag:
+        from ray_lightning_tpu.ops.zigzag_attention import (
+            inverse_permutation,
+            zigzag_permutation,
+        )
+
+        zz_perm_np = zigzag_permutation(S, mesh.shape[seq_axis])
+        zz_perm = jnp.asarray(zz_perm_np)
+        zz_inv = jnp.asarray(inverse_permutation(zz_perm_np))
+        x = _seq_sharded(
+            params["wte"][tokens[:, zz_perm]] + params["wpe"][zz_perm]
+        )
+    else:
+        x = params["wte"][tokens] + params["wpe"][:S]
+    x = x.astype(cdt)
 
     def attend(q, k, v):
+        if use_zigzag:
+            from ray_lightning_tpu.ops.zigzag_attention import (
+                zigzag_self_attention_zlayout,
+            )
+
+            return zigzag_self_attention_zlayout(
+                q, k, v, mesh, axis_name=seq_axis
+            )
         if use_ring:
             return ring_self_attention(q, k, v, mesh, axis_name=seq_axis)
         if cfg.attn_impl == "flash":
@@ -295,6 +352,11 @@ def gpt_forward(
             body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
         )
     x = _layernorm(x, params["lnf_g"], params["lnf_b"])
+    if use_zigzag:
+        # Back to natural order before the head so callers (loss, predict,
+        # logit tests) never see the internal layout; keep seq-sharded so
+        # the (B, S, V) logits stay sharded too.
+        x = _seq_sharded(x[:, zz_inv])
     # Tied output head (GPT-2 weight tying); logits reduce in fp32.
     logits = jnp.einsum(
         "bsd,vd->bsv", x.astype(jnp.float32), params["wte"].astype(jnp.float32)

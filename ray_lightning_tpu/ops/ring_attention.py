@@ -132,21 +132,12 @@ def ring_self_attention(
     The batch dim stays sharded over any nontrivial data-parallel mesh axes
     (otherwise shard_map would declare it replicated and XLA would
     all-gather activations over the dp axes at every layer)."""
-    from jax.sharding import PartitionSpec as P
+    # Shared (B, S, H, D) spec policy with the zigzag wrapper: batch rides
+    # dp axes, heads ride the tensor-parallel axis when they divide it
+    # (matches the GSPMD qkv sharding).
+    from ray_lightning_tpu.ops.zigzag_attention import _seq_specs
 
-    dp_axes = tuple(
-        ax
-        for ax in ("data", "fsdp")
-        if ax != axis_name and mesh.shape.get(ax, 1) > 1
-    )
-    # Heads ride the tensor-parallel axis when they divide it (matches the
-    # GSPMD qkv sharding; each model rank runs the ring on its own heads).
-    head_axis = None
-    model_size = mesh.shape.get("model", 1)
-    if "model" != axis_name and model_size > 1 and q.shape[2] % model_size == 0:
-        head_axis = "model"
-    spec = P(dp_axes or None, axis_name, head_axis, None)
-    vary = (axis_name,) + dp_axes + ((head_axis,) if head_axis else ())
+    spec, vary = _seq_specs(mesh, axis_name, q.shape[2])
     fn = functools.partial(
         ring_attention,
         axis_name=axis_name,

@@ -79,7 +79,6 @@ def zigzag_ring_attention(
     v: jax.Array,
     axis_name: str,
     sm_scale: Optional[float] = None,
-    vary_axes: Optional[Tuple] = None,
 ) -> jax.Array:
     """Per-rank zigzag ring attention; call inside ``shard_map``.
 
@@ -131,8 +130,10 @@ def zigzag_ring_attention(
     # every early position).
     m_l, l_l, acc_l = _online_merge(m_l, l_l, acc_l, scores(ql, ke), ve)
 
+    # Unlike ring_attention (whose fresh-zeros carry needs explicit vma
+    # annotation), the carry here derives entirely from the device-varying
+    # inputs, so no vary_axes plumbing is needed.
     perm = [(r, (r + 1) % ring) for r in range(ring)]
-    del vary_axes  # carry derives from the (already device-varying) inputs
 
     def tick(carry, t):
         k_cur, v_cur, m_e, l_e, acc_e, m_l, l_l, acc_l = carry
@@ -179,6 +180,45 @@ def zigzag_ring_attention(
     return out.astype(q.dtype)
 
 
+def _seq_specs(mesh: jax.sharding.Mesh, axis_name: str, n_heads: int):
+    """(PartitionSpec, vary_axes) for (B, S, H, D) activations on this mesh
+    — shared by the ring and zigzag wrappers."""
+    from jax.sharding import PartitionSpec as P
+
+    dp_axes = tuple(
+        ax
+        for ax in ("data", "fsdp")
+        if ax != axis_name and mesh.shape.get(ax, 1) > 1
+    )
+    head_axis = None
+    model_size = mesh.shape.get("model", 1)
+    if "model" != axis_name and model_size > 1 and n_heads % model_size == 0:
+        head_axis = "model"
+    spec = P(dp_axes or None, axis_name, head_axis, None)
+    vary = (axis_name,) + dp_axes + ((head_axis,) if head_axis else ())
+    return spec, vary
+
+
+def zigzag_self_attention_zlayout(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: jax.sharding.Mesh,
+    axis_name: str = "seq",
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Wrapper for inputs ALREADY in zigzag layout (the zero-cost model
+    integration contract): no permutes, just the balanced per-rank program
+    under ``shard_map``. Output stays in zigzag layout."""
+    spec, _ = _seq_specs(mesh, axis_name, q.shape[2])
+    fn = functools.partial(
+        zigzag_ring_attention, axis_name=axis_name, sm_scale=sm_scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
 def zigzag_ring_self_attention(
     q: jax.Array,
     k: jax.Array,
@@ -192,37 +232,20 @@ def zigzag_ring_self_attention(
     Permutes to zigzag layout, runs the balanced per-rank program under
     ``shard_map``, and un-permutes the output. The permutation is a
     resharding collective each call — models integrating zigzag should keep
-    activations in zigzag order end-to-end instead (see module docstring).
+    activations in zigzag order end-to-end instead (see module docstring
+    and :func:`zigzag_self_attention_zlayout`).
     """
-    from jax.sharding import PartitionSpec as P
-
     ring = mesh.shape[axis_name]
     S = q.shape[1]
     perm_np = zigzag_permutation(S, ring)  # static (host) indices
     perm = jnp.asarray(perm_np)
     inv = jnp.asarray(inverse_permutation(perm_np))
 
-    dp_axes = tuple(
-        ax
-        for ax in ("data", "fsdp")
-        if ax != axis_name and mesh.shape.get(ax, 1) > 1
-    )
-    head_axis = None
-    model_size = mesh.shape.get("model", 1)
-    if "model" != axis_name and model_size > 1 and q.shape[2] % model_size == 0:
-        head_axis = "model"
-    spec = P(dp_axes or None, axis_name, head_axis, None)
-    vary = (axis_name,) + dp_axes + ((head_axis,) if head_axis else ())
-    fn = functools.partial(
-        zigzag_ring_attention,
-        axis_name=axis_name,
-        sm_scale=sm_scale,
-        vary_axes=vary,
-    )
+    spec, _ = _seq_specs(mesh, axis_name, q.shape[2])
     qz, kz, vz = (x[:, perm] for x in (q, k, v))
-    out = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )(qz, kz, vz)
+    out = zigzag_self_attention_zlayout(
+        qz, kz, vz, mesh, axis_name=axis_name, sm_scale=sm_scale
+    )
     out = out[:, inv]
     # The un-permute gather would otherwise leave the result replicated;
     # pin the caller-facing sharding so downstream layers stay seq-sharded.

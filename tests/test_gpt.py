@@ -295,3 +295,55 @@ def test_gptlm_fit_end_to_end(start_fabric, tmp_path):
     )
     train_test(trainer, module)
     assert trainer.callback_metrics.get("val_loss") is not None
+
+
+def test_sequence_parallel_zigzag_matches_dense():
+    """Zigzag layout end-to-end (permuted embedding, balanced attention,
+    un-permuted before the head) reproduces the dense causal logits."""
+    import dataclasses
+
+    import jax
+
+    cfg = dataclasses.replace(TINY, seq_impl="zigzag")
+    strategy = make_inprocess({"data": 2, "seq": 4}, sequence_parallel=True)
+    module = GPTLM(config=cfg, batch_size=4)
+    strategy.bind_module(module)
+
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+    )
+    dense = gpt_forward(params, toks, TINY)  # plain config, no mesh
+    placed = strategy.place_params(params)
+    zigzagged = jax.jit(lambda p, t: module._forward(p, t))(placed, toks)
+    np.testing.assert_allclose(
+        np.asarray(zigzagged), np.asarray(dense), atol=1e-3
+    )
+
+
+def test_sequence_parallel_zigzag_train_step():
+    """One compiled zigzag train step: loss finite and decreasing."""
+    import dataclasses
+
+    import jax
+
+    cfg = dataclasses.replace(TINY, seq_impl="zigzag")
+    strategy = make_inprocess({"data": 2, "seq": 4}, sequence_parallel=True)
+    module = GPTLM(config=cfg, batch_size=4, lr=1e-2, warmup_steps=2)
+    strategy.bind_module(module)
+    data = make_fake_text(32, seq_len=32, vocab=cfg.vocab_size)
+    toks = data.arrays[0][:8]
+    rng = jax.random.PRNGKey(0)
+    params = module.init_params(rng, (toks,))
+    tx = module.configure_optimizers()
+    opt_state = tx.init(params)
+    params = strategy.place_params(params)
+    opt_state = strategy.place_opt_state(opt_state, params)
+    batch = strategy.make_global_batch((toks,))
+    step = strategy.compile_train_step(module, tx)
+    losses = []
+    for i in range(10):
+        params, opt_state, logs = step(params, opt_state, batch, rng, i)
+        losses.append(float(np.asarray(logs["loss"])))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
