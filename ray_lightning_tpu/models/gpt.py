@@ -183,6 +183,18 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
     }
 
 
+def _moe_layer_params(lp: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Per-layer MoE param remap (stacked-tree names -> moe_ffn names);
+    single source of truth for the forward and decode paths."""
+    return {
+        "router": lp["router"],
+        "wi": lp["wi"],
+        "bi": lp["bi"],
+        "wo": lp["wo2"],
+        "bo": lp["bo2"],
+    }
+
+
 def _layernorm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
     x32 = x.astype(jnp.float32)
     mu = x32.mean(-1, keepdims=True)
@@ -285,13 +297,7 @@ def gpt_forward(
             from ray_lightning_tpu.parallel.moe import moe_ffn
 
             out, aux = moe_ffn(
-                {
-                    "router": lp["router"],
-                    "wi": lp["wi"],
-                    "bi": lp["bi"],
-                    "wo": lp["wo2"],
-                    "bo": lp["bo2"],
-                },
+                _moe_layer_params(lp),
                 m,
                 capacity_factor=cfg.moe_capacity_factor,
                 compute_dtype=cdt,
@@ -398,6 +404,145 @@ def make_fake_text(
     return ArrayDataset(toks)
 
 
+def gpt_generate(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive decode with a KV cache — TPU-native shapes.
+
+    prompt (B, P) int32 -> (B, P + max_new_tokens). Everything is static:
+    the cache is a fixed (L, B, S, H, hd) buffer, the position loop is one
+    ``lax.scan`` (prompt teacher-forcing and generation share it), and each
+    step's attention masks the cache by ``position <= t``. Greedy when
+    ``temperature == 0``, else softmax sampling.
+
+    Single-program decode (replicated params); the training-side mesh
+    parallelisms (pipeline/seq/expert axes) don't apply to this path. MoE
+    configs decode through the same sparse dispatch but with capacity set
+    to never drop tokens (inference-standard): training's capacity
+    factoring pools over the whole B x S token set, which has no
+    per-position analog, and a dropped token at decode would silently make
+    one sequence's output depend on its batchmates.
+    """
+    B, P = prompt.shape
+    total = P + int(max_new_tokens)
+    if total > cfg.max_seq:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds max_seq {cfg.max_seq}"
+        )
+    cdt = jnp.dtype(cfg.compute_dtype)
+    L, H, hd = cfg.n_layer, cfg.n_head, cfg.head_dim
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    # Fitted params arrive as host numpy (gather_state); device-ify so
+    # traced indexing works.
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+
+    k_cache = jnp.zeros((L, B, total, H, hd), cdt)
+    v_cache = jnp.zeros((L, B, total, H, hd), cdt)
+    # Ring buffer of emitted tokens; prompt positions stay teacher-forced.
+    toks = jnp.concatenate(
+        [prompt, jnp.zeros((B, int(max_new_tokens)), prompt.dtype)], axis=1
+    )
+
+    def one_position(carry, t):
+        toks, k_cache, v_cache, rng = carry
+        cur = jax.lax.dynamic_slice_in_dim(toks, t, 1, axis=1)[:, 0]  # (B,)
+        x = (params["wte"][cur] + params["wpe"][t]).astype(cdt)  # (B, D)
+
+        def layer(h, args):
+            lp, kc_l, vc_l = args
+            a = _layernorm(h[:, None], lp["ln1_g"], lp["ln1_b"])[:, 0]
+            qkv = (
+                jnp.einsum("bd,dthk->bthk", a, lp["wqkv"].astype(cdt))
+                + lp["bqkv"].astype(cdt)
+            )
+            q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B, H, hd)
+            kc_l = jax.lax.dynamic_update_slice_in_dim(
+                kc_l, k_new[:, None], t, axis=1
+            )
+            vc_l = jax.lax.dynamic_update_slice_in_dim(
+                vc_l, v_new[:, None], t, axis=1
+            )
+            s = jnp.einsum(
+                "bhk,bshk->bhs",
+                q.astype(jnp.float32) * (1.0 / np.sqrt(hd)),
+                kc_l.astype(jnp.float32),
+            )
+            s = jnp.where(jnp.arange(total)[None, None] <= t, s, float("-inf"))
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhs,bshk->bhk", p, vc_l.astype(jnp.float32)).astype(cdt)
+            h = h + jnp.einsum("bhk,hkd->bd", o, lp["wo"].astype(cdt)) + lp[
+                "bo"
+            ].astype(cdt)
+            m = _layernorm(h[:, None], lp["ln2_g"], lp["ln2_b"])
+            if cfg.n_experts > 0:
+                from ray_lightning_tpu.parallel.moe import moe_ffn
+
+                m_out, _ = moe_ffn(
+                    _moe_layer_params(lp),
+                    m,
+                    # capacity >= all tokens: decode never drops (see
+                    # gpt_generate docstring).
+                    capacity_factor=float(cfg.n_experts),
+                    compute_dtype=cdt,
+                    top_k=cfg.moe_top_k,
+                )
+                m_out = m_out[:, 0]
+            else:
+                mm = jax.nn.gelu(
+                    jnp.einsum("bd,df->bf", m[:, 0], lp["wi"].astype(cdt))
+                    + lp["bi"].astype(cdt)
+                )
+                m_out = jnp.einsum("bf,fd->bd", mm, lp["wo2"].astype(cdt)) + lp[
+                    "bo2"
+                ].astype(cdt)
+            return h + m_out, (kc_l, vc_l)
+
+        h = x
+        new_k, new_v = [], []
+        # Python loop over layers: L is small and static; keeps per-layer
+        # cache threading simple (a scan would need stacked cache updates).
+        for li in range(L):
+            lp = jax.tree_util.tree_map(lambda a: a[li], params["blocks"])
+            h, (kc_l, vc_l) = layer(h, (lp, k_cache[li], v_cache[li]))
+            new_k.append(kc_l)
+            new_v.append(vc_l)
+        k_cache = jnp.stack(new_k)
+        v_cache = jnp.stack(new_v)
+        h = _layernorm(h[:, None], params["lnf_g"], params["lnf_b"])[:, 0]
+        logits = jnp.einsum(
+            "bd,vd->bv", h.astype(jnp.float32), params["wte"].astype(jnp.float32)
+        )
+        rng, sub = jax.random.split(rng)
+        if temperature > 0:
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(toks.dtype)
+        # Only write past the prompt: prompt positions stay teacher-forced.
+        write_pos = jnp.minimum(t + 1, total - 1)
+        keep_prompt = (t + 1) < P
+        cur_next = jax.lax.dynamic_slice_in_dim(toks, write_pos, 1, axis=1)[:, 0]
+        chosen = jnp.where(keep_prompt, cur_next, nxt)
+        toks = jax.lax.dynamic_update_slice_in_dim(
+            toks, chosen[:, None], write_pos, axis=1
+        )
+        return (toks, k_cache, v_cache, rng), None
+
+    (toks, _, _, _), _ = jax.lax.scan(
+        one_position,
+        (toks, k_cache, v_cache, rng),
+        jnp.arange(total - 1),
+        length=total - 1,
+    )
+    return toks
+
+
 class GPTLM(TPUModule):
     """Language-model TPUModule over :func:`gpt_forward`.
 
@@ -479,6 +624,26 @@ class GPTLM(TPUModule):
     def predict_step(self, params, batch):
         toks = batch[0] if isinstance(batch, (tuple, list)) else batch
         return jnp.argmax(self._forward(params, toks[:, :-1]), -1)
+
+    def generate(
+        self,
+        prompt: Any,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """KV-cached autoregressive decode from the fitted params
+        (:func:`gpt_generate`); greedy unless ``temperature > 0``."""
+        if self.params is None:
+            raise RuntimeError("no parameters: fit first or set module.params")
+        return gpt_generate(
+            self.params,
+            self.config,
+            jnp.asarray(prompt, jnp.int32),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            rng=rng,
+        )
 
     def configure_optimizers(self):
         sched = optax.warmup_cosine_decay_schedule(

@@ -347,3 +347,56 @@ def test_sequence_parallel_zigzag_train_step():
         losses.append(float(np.asarray(logs["loss"])))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+def test_generate_kv_cache_matches_full_forward():
+    """Greedy KV-cached decode must agree with argmax over the full-forward
+    logits at every generated position (cache correctness)."""
+    import jax
+
+    from ray_lightning_tpu.models.gpt import gpt_generate
+
+    params = init_gpt_params(jax.random.PRNGKey(3), TINY)
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(4), (2, 5), 0, TINY.vocab_size),
+        np.int32,
+    )
+    out = np.asarray(
+        jax.jit(
+            lambda p, t: gpt_generate(p, TINY, t, max_new_tokens=8)
+        )(params, prompt)
+    )
+    assert out.shape == (2, 13)
+    np.testing.assert_array_equal(out[:, :5], prompt)
+    # Teacher-forcing check: feeding the generated prefix through the full
+    # forward must reproduce each next token.
+    for p in range(5 - 1, 13 - 1):
+        logits = gpt_forward(params, out[:, : p + 1], TINY)
+        np.testing.assert_array_equal(
+            np.argmax(np.asarray(logits[:, -1]), -1), out[:, p + 1]
+        )
+
+
+def test_generate_learns_recurrence():
+    """A briefly-trained tiny GPT greedily generates the affine recurrence
+    t+1 = (5t + 7) % V it was trained on."""
+    import jax
+
+    from ray_lightning_tpu.trainer import Trainer
+
+    module = GPTLM(config=TINY, batch_size=8, lr=3e-3, warmup_steps=5,
+                   n_train=256)
+    trainer = Trainer(
+        max_epochs=6,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+    )
+    trainer.fit(module)
+    start = np.asarray([[3, (5 * 3 + 7) % 64]], np.int32)
+    out = np.asarray(module.generate(start, max_new_tokens=10))
+    expect = [3]
+    for _ in range(11):
+        expect.append((5 * expect[-1] + 7) % 64)
+    matches = sum(int(out[0, i]) == expect[i] for i in range(12))
+    assert matches >= 9, (out[0].tolist(), expect)
