@@ -38,8 +38,9 @@ def _run_example(name: str, *args: str) -> subprocess.CompletedProcess:
         ("ray_ddp_tune.py", ()),
         ("ray_horovod_example.py", ()),
         ("ray_ddp_sharded_example.py", ()),
+        ("gpt_sharded_example.py", ()),
     ],
-    ids=["ddp", "ddp-tune", "tune", "ring", "sharded"],
+    ids=["ddp", "ddp-tune", "tune", "ring", "sharded", "gpt"],
 )
 def test_example_smoke(name, args):
     proc = _run_example(name, *args)
