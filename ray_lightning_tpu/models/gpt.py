@@ -649,7 +649,12 @@ class GPTLM(TPUModule):
         sched = optax.warmup_cosine_decay_schedule(
             0.0, self.lr, self.warmup_steps, max(self.warmup_steps + 1, 10_000)
         )
-        return optax.adamw(sched, weight_decay=self.weight_decay)
+        # Dict form declares the schedule for LearningRateMonitor /
+        # trainer.current_lr; the transform itself embeds it.
+        return {
+            "optimizer": optax.adamw(sched, weight_decay=self.weight_decay),
+            "lr_schedule": sched,
+        }
 
     # -- data ------------------------------------------------------------
     def _data(self) -> ArrayDataset:

@@ -2,6 +2,7 @@ from ray_lightning_tpu.trainer.callbacks import (
     Callback,
     CSVLogger,
     EarlyStopping,
+    LearningRateMonitor,
     ModelCheckpoint,
     JaxProfilerCallback,
     TPUStatsCallback,
@@ -26,6 +27,7 @@ __all__ = [
     "ModelCheckpoint",
     "CSVLogger",
     "EarlyStopping",
+    "LearningRateMonitor",
     "JaxProfilerCallback",
     "TPUStatsCallback",
     "DataLoader",

@@ -343,6 +343,37 @@ class JaxProfilerCallback(Callback):
         self.trace_dirs = list(state.get("trace_dirs", []))
 
 
+class LearningRateMonitor(Callback):
+    """Log the schedule-driven learning rate as a ``lr`` metric.
+
+    Works with modules whose ``configure_optimizers`` declares a schedule
+    (``{"optimizer": tx, "lr_schedule": fn}`` or ``(tx, fn)`` — see
+    ``TPUModule.configure_optimizers``). optax embeds schedules inside the
+    gradient transform, so this reads the declared ``step -> lr`` callable at
+    the loop's current optimizer-update index; no device sync. PTL-parity
+    for the ``LearningRateMonitor`` users attach to the reference's Trainer.
+    """
+
+    def __init__(self, key: str = "lr") -> None:
+        self.key = key
+
+    def on_train_batch_end(
+        self, trainer: Any, module: Any, logs: Dict[str, float], batch_idx: int
+    ) -> None:
+        lr = getattr(trainer, "current_lr", None)
+        if lr is not None:
+            trainer.logged_metrics[self.key] = lr
+            # Also publish to callback_metrics here so epoch-end consumers
+            # (CSVLogger, ModelCheckpoint monitors) see this epoch's lr
+            # regardless of their position in the callbacks list.
+            trainer.callback_metrics[self.key] = lr
+
+    def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
+        lr = getattr(trainer, "current_lr", None)
+        if lr is not None:
+            trainer.callback_metrics[self.key] = lr
+
+
 class CSVLogger(Callback):
     """Append one metrics row per epoch to ``dirpath/metrics.csv``.
 

@@ -92,6 +92,12 @@ class TrainingLoop:
         self._tx = None
         self._rng = None
         self.sanity_checking = False
+        # Host mirror of optax.MultiSteps progress (accumulation only):
+        # _update_count = inner updates applied (windows + flushes),
+        # _mini_host = micro-batches since the last update. Kept in sync
+        # deterministically so current_lr never costs a device fetch.
+        self._update_count: Optional[int] = None
+        self._mini_host = 0
 
     # -- facade properties used by callbacks ---------------------------
     @property
@@ -163,7 +169,7 @@ class TrainingLoop:
         sample_batch = next(iter(self._train_loader.iter_batches(1, prefetch=0)))
         init_rng, self._rng = jax.random.split(self._rng)
         params = self.module.init_params(init_rng, sample_batch)
-        self._tx = self._wrap_optimizer(self.module.configure_optimizers())
+        self._tx = self._wrap_optimizer(self._unpack_optimizers())
         opt_state = self._tx.init(params)
         sharded_path = (
             ckpt_stream.get("orbax_path")
@@ -205,6 +211,54 @@ class TrainingLoop:
             self.params = restored["params"]
             self.opt_state = restored["opt_state"]
             self._restore_progress(meta)
+        if self.spec.accumulate_grad_batches > 1:
+            # Seed the host mirror from the (possibly restored) MultiSteps
+            # counters — one fetch at init, none per step.
+            self._mini_host = int(np.asarray(jax.device_get(self.opt_state.mini_step)))
+            self._update_count = int(
+                np.asarray(jax.device_get(self.opt_state.gradient_step))
+            )
+
+    def _unpack_optimizers(self) -> Any:
+        """Unpack ``configure_optimizers()`` return forms.
+
+        Accepted (Lightning's dict convention, adapted to optax — the
+        schedule lives INSIDE the transform, so the extra entry is for
+        monitoring only):
+
+        - ``optax.GradientTransformation``
+        - ``{"optimizer": tx, "lr_schedule": step -> lr}``
+        - ``(tx, lr_schedule)``
+        """
+        from ray_lightning_tpu.trainer.module import unpack_optimizers
+
+        opt, self._lr_schedule = unpack_optimizers(
+            self.module.configure_optimizers()
+        )
+        return opt
+
+    @property
+    def current_lr(self) -> Optional[float]:
+        """Learning rate the NEXT optimizer update will use, from the
+        module's declared ``lr_schedule`` (None when not declared).
+
+        optax applies ``sched(update_count)`` with a 0-based count, so the
+        next update after ``global_step`` micro-batches uses index
+        ``global_step // K`` (one update per K micro-batches under
+        ``accumulate_grad_batches=K`` / ``optax.MultiSteps``) — the same
+        next-update convention PTL's LearningRateMonitor reports after
+        ``scheduler.step()``.
+        """
+        from ray_lightning_tpu.trainer.module import schedule_lr
+
+        # With accumulation the host mirror counts ACTUAL inner updates
+        # (full windows + epoch-end partial-window flushes, both of which
+        # advance the embedded schedule).
+        return schedule_lr(
+            getattr(self, "_lr_schedule", None),
+            global_step=self.global_step,
+            update_count=getattr(self, "_update_count", None),
+        )
 
     def _wrap_optimizer(self, tx: Any) -> Any:
         """Apply Trainer-level optimizer options around the module's optax
@@ -245,11 +299,13 @@ class TrainingLoop:
         if self.spec.accumulate_grad_batches <= 1:
             return
         import jax
-        import numpy as np
 
-        mini = int(np.asarray(jax.device_get(self.opt_state.mini_step)))
-        if mini == 0:
+        # The host mirror tracks mini_step exactly (incremented per step,
+        # reset at window/flush) — no device sync needed here.
+        if self._mini_host == 0:
             return
+        self._mini_host = 0
+        self._update_count += 1
         if getattr(self, "_flush_step", None) is None:
             import jax.numpy as jnp
             import optax
@@ -420,6 +476,11 @@ class TrainingLoop:
                     )
                     epoch_logs.append(logs)  # device scalars; no sync here
                     self.global_step += 1
+                    if self._update_count is not None:
+                        self._mini_host += 1
+                        if self._mini_host == self.spec.accumulate_grad_batches:
+                            self._mini_host = 0
+                            self._update_count += 1
                     if (
                         self.global_step % self.spec.log_every_n_steps == 0
                         or batch_idx == n_batches - 1
@@ -663,7 +724,12 @@ class TrainingLoop:
         return WorkerOutput(
             best_model_path=best_model_path,
             state_stream=state_stream,
-            trainer_state=dict(self.state, epoch=self.current_epoch, global_step=self.global_step),
+            trainer_state=dict(
+                self.state,
+                epoch=self.current_epoch,
+                global_step=self.global_step,
+                update_count=self._update_count,
+            ),
             results=results,
             callback_metrics={
                 k: np.asarray(v) for k, v in self.callback_metrics.items()

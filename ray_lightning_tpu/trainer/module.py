@@ -19,6 +19,58 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 import jax
 
 
+def unpack_optimizers(opt: Any) -> Tuple[Any, Optional[Any]]:
+    """Normalize ``configure_optimizers()`` return forms.
+
+    Returns ``(transform, lr_schedule_or_None)``. Accepted forms: a bare
+    ``optax.GradientTransformation``, ``{"optimizer": tx, "lr_schedule":
+    fn}``, or ``(tx, fn)``. The schedule entry is monitoring-only (optax
+    embeds schedules inside the transform); a bare GradientTransformation —
+    itself a NamedTuple of two callables — is NOT treated as the tuple form.
+    """
+    if isinstance(opt, dict):
+        # Accept PTL's actual key name too — a ported module returning
+        # "lr_scheduler" should get monitoring, not silent None. A PTL
+        # scheduler OBJECT (not a step->lr callable) can't be evaluated;
+        # treat it as undeclared rather than crashing current_lr.
+        sched = opt.get("lr_schedule", opt.get("lr_scheduler"))
+        return opt["optimizer"], sched if callable(sched) else None
+    if type(opt) is tuple and len(opt) == 2:
+        if callable(opt[1]):
+            return opt
+        # e.g. PTL's `return [optimizer], [scheduler]` — fail here with the
+        # accepted shapes rather than deep in tx.init.
+        raise TypeError(
+            "configure_optimizers returned a 2-tuple whose second element "
+            "is not a step->lr callable. Accepted forms: an optax "
+            "GradientTransformation, {'optimizer': tx, 'lr_schedule': fn}, "
+            "or (tx, fn)."
+        )
+    return opt, None
+
+
+def schedule_lr(
+    sched: Any,
+    *,
+    global_step: int,
+    update_count: Optional[int] = None,
+    accumulate_grad_batches: int = 1,
+) -> Optional[float]:
+    """Evaluate a declared lr schedule at the next-update index.
+
+    Single source of truth for ``TrainingLoop.current_lr`` and the driver
+    ``Trainer.current_lr`` mirror: prefer the exact inner-update count
+    (windows + epoch-end flushes) when known; otherwise approximate with
+    ``global_step // accumulate_grad_batches``.
+    """
+    if sched is None:
+        return None
+    if update_count is not None:
+        return float(sched(update_count))
+    k = max(1, int(accumulate_grad_batches))
+    return float(sched(global_step // k))
+
+
 class TPUModule:
     """Base class for user models.
 
@@ -29,7 +81,11 @@ class TPUModule:
         under jit. ``logs`` is a flat dict of scalar jnp arrays. The loss must
         be the mean over the *local* batch shard; global averaging across the
         data axis is inserted by the strategy/XLA.
-      - ``configure_optimizers() -> optax.GradientTransformation``
+      - ``configure_optimizers() -> optax.GradientTransformation``. May
+        also return ``{"optimizer": tx, "lr_schedule": step -> lr}`` (or
+        ``(tx, lr_schedule)``): optax schedules live inside the transform,
+        so the extra entry just declares the schedule for monitoring
+        (``LearningRateMonitor``, ``trainer.current_lr``).
       - ``train_dataloader() -> DataLoader``
 
     Optional: ``validation_step``, ``test_step``, ``predict_step``

@@ -72,6 +72,7 @@ class Trainer:
         self.state: Dict[str, Any] = {"status": "initialized", "stage": None}
         self.current_epoch = 0
         self.global_step = 0
+        self._update_count: Optional[int] = None
         self._module: Any = None
 
     # ------------------------------------------------------------------
@@ -98,6 +99,32 @@ class Trainer:
         return self._module
 
     @property
+    def current_lr(self) -> Optional[float]:
+        """Learning rate the next optimizer update would use, from the
+        module's declared ``lr_schedule`` (None when not declared).
+
+        Driver-side mirror of ``TrainingLoop.current_lr``: evaluates the
+        schedule at the recovered ``global_step`` (divided by
+        ``accumulate_grad_batches`` — one update per K micro-batches).
+        """
+        if self._module is None:
+            return None
+        sched = getattr(self, "_lr_sched_cache", False)
+        if sched is False:  # unpack once; configure_optimizers is user code
+            from ray_lightning_tpu.trainer.module import unpack_optimizers
+
+            _, sched = unpack_optimizers(self._module.configure_optimizers())
+            self._lr_sched_cache = sched
+        from ray_lightning_tpu.trainer.module import schedule_lr
+
+        return schedule_lr(
+            sched,
+            global_step=self.global_step,
+            update_count=getattr(self, "_update_count", None),
+            accumulate_grad_batches=self.accumulate_grad_batches,
+        )
+
+    @property
     def checkpoint_callback(self) -> Optional[Any]:
         for cb in self.callbacks:
             if hasattr(cb, "best_model_path"):
@@ -113,6 +140,7 @@ class Trainer:
         ckpt_path: Optional[str] = None,
     ) -> Any:
         self._module = module
+        self._lr_sched_cache: Any = False  # re-unpack for the new module
         module.trainer = self
         ckpt_stream = self._read_ckpt(ckpt_path)
         if self.strategy is None or isinstance(self.strategy, SingleDeviceStrategy):
@@ -197,6 +225,10 @@ class Trainer:
         self.state = dict(output.trainer_state)
         self.current_epoch = int(self.state.pop("epoch", 0))
         self.global_step = int(self.state.pop("global_step", 0))
+        # Actual optimizer-update count under accumulation (windows +
+        # epoch-end flushes) — None when accumulation is off.
+        uc = self.state.pop("update_count", None)
+        self._update_count = None if uc is None else int(uc)
         # Metrics cross the boundary as numpy and are re-exposed as floats
         # (reference re-tensorizes at ray_launcher.py:374-379).
         self.callback_metrics = {

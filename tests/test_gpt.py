@@ -11,6 +11,7 @@ import pytest
 from ray_lightning_tpu.models import GPTConfig, GPTLM, make_fake_text
 from ray_lightning_tpu.models.gpt import gpt_forward, init_gpt_params
 from ray_lightning_tpu.strategies import GSPMDStrategy
+from ray_lightning_tpu.trainer.module import unpack_optimizers
 
 TINY = GPTConfig(
     vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq=32,
@@ -82,7 +83,7 @@ def test_param_shardings_land_on_mesh_axes():
     assert shardings["wte"].spec == P("model", "fsdp")
     assert shardings["lnf_g"].spec == P(None)
 
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
     opt_sh = strategy.opt_sharding(opt_state, params)
     flat = jax.tree_util.tree_leaves(opt_sh)
@@ -150,7 +151,7 @@ def test_gspmd_compiled_step_trains():
     toks = data.arrays[0][:16]
     rng = jax.random.PRNGKey(0)
     params = module.init_params(rng, (toks,))
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
 
     params = strategy.place_params(params)
@@ -239,7 +240,7 @@ def test_opt_sharding_no_shape_collision():
     module = GPTLM(config=cfg)
     strategy.bind_module(module)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
     psh = strategy.param_sharding(params)
     osh = strategy.opt_sharding(opt_state, params)
@@ -335,7 +336,7 @@ def test_sequence_parallel_zigzag_train_step():
     toks = data.arrays[0][:8]
     rng = jax.random.PRNGKey(0)
     params = module.init_params(rng, (toks,))
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
     params = strategy.place_params(params)
     opt_state = strategy.place_opt_state(opt_state, params)

@@ -12,6 +12,7 @@ from ray_lightning_tpu.strategies import (
     RingTPUStrategy,
 )
 from tests.utils import get_trainer
+from ray_lightning_tpu.trainer.module import unpack_optimizers
 
 
 def test_ctor_parity_surface():
@@ -46,7 +47,7 @@ def test_ring_step_in_process_matches_gspmd():
     )
     y = np.tile(np.array([0, 1, 1, 0], np.int32), 4)
     params = module.init_params(rng, (x, y))
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
 
     outs = {}

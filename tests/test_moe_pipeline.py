@@ -8,6 +8,7 @@ from ray_lightning_tpu.models import GPTConfig, GPTLM
 from ray_lightning_tpu.models.gpt import gpt_forward, init_gpt_params
 from ray_lightning_tpu.strategies import GSPMDStrategy
 from tests.test_gpt import TINY, make_inprocess
+from ray_lightning_tpu.trainer.module import unpack_optimizers
 
 MOE_CFG = dataclasses.replace(TINY, n_experts=4, d_ff=64)
 
@@ -181,7 +182,7 @@ def test_moe_gpt_expert_parallel_step():
     data = make_fake_text(32, seq_len=16, vocab=MOE_CFG.vocab_size)
     toks = data.arrays[0][:8]
     rng = jax.random.PRNGKey(0)
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
     params = strategy.place_params(params)
     opt_state = strategy.place_opt_state(opt_state, params)
@@ -264,7 +265,7 @@ def test_gpt_pipeline_train_step():
     toks = data.arrays[0][:16]
     rng = jax.random.PRNGKey(0)
     params = module.init_params(rng, (toks,))
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
     params = strategy.place_params(params)
     opt_state = strategy.place_opt_state(opt_state, params)

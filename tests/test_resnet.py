@@ -4,6 +4,7 @@ import pytest
 
 from ray_lightning_tpu.models import CIFARResNet, make_fake_cifar
 from ray_lightning_tpu.strategies import RingTPUStrategy
+from ray_lightning_tpu.trainer.module import unpack_optimizers
 
 
 def small_module(**kw):
@@ -52,7 +53,7 @@ def test_training_step_decreases_loss():
     x, y = data.arrays[0][:16], data.arrays[1][:16]
     rng = jax.random.PRNGKey(0)
     params = module.init_params(rng, (x, y))
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
     params = strategy.place_params(params)
     opt_state = strategy.place_opt_state(opt_state, params)

@@ -12,6 +12,7 @@ from ray_lightning_tpu.models import BoringModule, MNISTClassifier
 from ray_lightning_tpu.strategies import RayShardedStrategy, RayStrategy
 from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
 from tests.utils import get_trainer
+from ray_lightning_tpu.trainer.module import unpack_optimizers
 
 
 def test_strategy_recognition():
@@ -54,7 +55,7 @@ def test_opt_state_is_sharded_on_mesh():
     x = np.zeros((8, 28, 28), np.float32)
     y = np.zeros((8,), np.int32)
     params = module.init_params(rng, (x, y))
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
 
     placed_opt = strategy.place_opt_state(opt_state, params)

@@ -13,6 +13,7 @@ from ray_lightning_tpu.trainer.checkpoint_io import (
     OrbaxCheckpointIO,
     is_sharded_checkpoint,
 )
+from ray_lightning_tpu.trainer.module import unpack_optimizers
 
 TINY = GPTConfig(
     vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq=32,
@@ -37,7 +38,7 @@ def _init_gpt_state(strategy, module):
     strategy.bind_module(module)
     toks = np.zeros((8, 17), np.int32)
     params = module.init_params(jax.random.PRNGKey(0), (toks,))
-    tx = module.configure_optimizers()
+    tx, _ = unpack_optimizers(module.configure_optimizers())
     opt_state = tx.init(params)
     placed_p = strategy.place_params(params)
     placed_o = strategy.place_opt_state(opt_state, params)

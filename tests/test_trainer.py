@@ -517,3 +517,150 @@ def test_max_steps_on_final_batch_still_flushes():
         return np.asarray(m.params["w"])
 
     np.testing.assert_allclose(run(), run(max_steps=3), atol=0)
+
+
+class _SchedModule:
+    """Linear-regression module declaring an lr schedule for monitoring.
+
+    ``form`` selects the configure_optimizers return shape: "dict",
+    "tuple", or "plain" (no declared schedule).
+    """
+
+    def __new__(cls, form="dict", batch_size=4, n=96):
+        import optax
+
+        base = _DetModule(batch_size=batch_size, n=n)
+        sched = optax.linear_schedule(1e-2, 0.0, 100)
+
+        def configure_optimizers():
+            tx = optax.sgd(sched)
+            if form == "dict":
+                return {"optimizer": tx, "lr_schedule": sched}
+            if form == "tuple":
+                return (tx, sched)
+            return tx
+
+        base.configure_optimizers = configure_optimizers
+        base._sched = sched
+        return base
+
+
+def test_lr_monitor_follows_schedule():
+    """LearningRateMonitor logs the schedule value at the loop's current
+    optimizer-update index (epoch end -> callback_metrics['lr'])."""
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import LearningRateMonitor, Trainer
+
+    m = _SchedModule(form="dict")
+    t = Trainer(
+        max_epochs=2,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        callbacks=[LearningRateMonitor()],
+    )
+    t.fit(m)
+    assert t.global_step == 6  # 96 / (4 * 8 devices) = 3 steps x 2 epochs
+    np.testing.assert_allclose(
+        t.callback_metrics["lr"], float(m._sched(6)), rtol=1e-6
+    )
+    assert "lr" in t.logged_metrics
+
+
+def test_lr_monitor_tuple_form_and_plain():
+    from ray_lightning_tpu.trainer import LearningRateMonitor, Trainer
+
+    m = _SchedModule(form="tuple")
+    t = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        callbacks=[LearningRateMonitor()],
+    )
+    t.fit(m)
+    assert "lr" in t.callback_metrics
+
+    # Plain GradientTransformation (itself a 2-tuple of callables) must NOT
+    # be mistaken for the (tx, schedule) form: fit works, no lr metric.
+    m2 = _SchedModule(form="plain")
+    t2 = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        callbacks=[LearningRateMonitor()],
+    )
+    t2.fit(m2)
+    assert "lr" not in t2.callback_metrics
+
+
+def test_lr_monitor_accumulation_indexes_updates():
+    """With accumulate_grad_batches=K the schedule is indexed by the ACTUAL
+    optimizer-update count: full windows plus epoch-end partial-window
+    flushes, both of which advance the embedded schedule."""
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import LearningRateMonitor, Trainer
+
+    m = _SchedModule(form="dict")
+    t = Trainer(
+        max_epochs=2,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        accumulate_grad_batches=2,
+        callbacks=[LearningRateMonitor()],
+    )
+    t.fit(m)
+    assert t.global_step == 6
+    # 3 micro-steps/epoch, K=2: each epoch = 1 window update + 1 flush
+    # update -> 4 inner updates total (global_step // K = 3 would lag).
+    np.testing.assert_allclose(
+        t.callback_metrics["lr"], float(m._sched(4)), rtol=1e-6
+    )
+    np.testing.assert_allclose(t.current_lr, float(m._sched(4)), rtol=1e-6)
+
+
+def test_driver_trainer_current_lr_and_ptl_key():
+    """Driver-side Trainer.current_lr mirrors the loop's; the PTL dict key
+    'lr_scheduler' is accepted as an alias of 'lr_schedule'."""
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.trainer.module import unpack_optimizers
+
+    m = _SchedModule(form="dict")
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0, num_sanity_val_steps=0
+    )
+    t.fit(m)
+    np.testing.assert_allclose(t.current_lr, float(m._sched(t.global_step)))
+
+    sched = optax.linear_schedule(1.0, 0.0, 10)
+    tx, s = unpack_optimizers({"optimizer": optax.sgd(sched), "lr_scheduler": sched})
+    assert s is sched and hasattr(tx, "init")
+
+
+def test_unpack_optimizers_rejects_ptl_tuple_and_trainer_reuse():
+    import optax
+    import pytest
+
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.trainer.module import unpack_optimizers
+
+    with pytest.raises(TypeError, match="Accepted forms"):
+        unpack_optimizers(([optax.sgd(1e-2)], ["not-a-schedule"]))
+
+    # Reusing one Trainer across modules must not report a stale schedule.
+    m1 = _SchedModule(form="dict")
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0, num_sanity_val_steps=0
+    )
+    t.fit(m1)
+    assert t.current_lr is not None
+    m2 = _SchedModule(form="plain")
+    t.fit(m2)
+    assert t.current_lr is None
