@@ -21,6 +21,7 @@ Key properties:
   usage in the reference test suite (test_ddp.py:54-61).
 """
 from ray_lightning_tpu.fabric.core import (
+    ActorDiedError,
     ActorHandle,
     FabricError,
     InsufficientResourcesError,
@@ -59,6 +60,7 @@ __all__ = [
     "TaskRef",
     "ActorHandle",
     "Queue",
+    "ActorDiedError",
     "FabricError",
     "InsufficientResourcesError",
     "cluster_utils",

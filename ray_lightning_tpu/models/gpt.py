@@ -404,6 +404,42 @@ def make_fake_text(
     return ArrayDataset(toks)
 
 
+def sample_logits(
+    rng: jax.Array,
+    logits: jax.Array,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Sample token ids from (B, V) logits — jit/scan-friendly.
+
+    ``temperature``, ``top_k``, ``top_p`` are static Python values (the
+    decode loop is traced once). ``temperature == 0`` is greedy argmax.
+    top-k keeps the k highest logits; top-p (nucleus) keeps the smallest
+    prefix of the sorted distribution whose mass reaches p (the first
+    token crossing p is included). Filters compose: k first, then p —
+    both are O(V log V) sorts, MXU-free and fused by XLA.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / float(temperature)
+    neg = jnp.asarray(float("-inf"), logits.dtype)
+    if top_k is not None and 0 < int(top_k) < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, int(top_k))[0][..., -1:]  # (B, 1)
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None and 0.0 < float(top_p) < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # desc
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # Exclusive prefix mass: a token is cut only when the mass BEFORE
+        # it already reaches p (so the crossing token stays).
+        before = jnp.cumsum(probs, axis=-1) - probs
+        cutoff_logit = jnp.min(
+            jnp.where(before < float(top_p), sorted_logits, -neg), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff_logit, neg, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
 def gpt_generate(
     params: Dict[str, Any],
     cfg: GPTConfig,
@@ -411,6 +447,8 @@ def gpt_generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jax.Array:
     """Autoregressive decode with a KV cache — TPU-native shapes.
 
@@ -418,7 +456,8 @@ def gpt_generate(
     the cache is a fixed (L, B, S, H, hd) buffer, the position loop is one
     ``lax.scan`` (prompt teacher-forcing and generation share it), and each
     step's attention masks the cache by ``position <= t``. Greedy when
-    ``temperature == 0``, else softmax sampling.
+    ``temperature == 0``; otherwise softmax sampling with optional top-k /
+    nucleus (top-p) filtering (:func:`sample_logits`).
 
     Single-program decode (replicated params); the training-side mesh
     parallelisms (pipeline/seq/expert axes) don't apply to this path. MoE
@@ -519,11 +558,9 @@ def gpt_generate(
             "bd,vd->bv", h.astype(jnp.float32), params["wte"].astype(jnp.float32)
         )
         rng, sub = jax.random.split(rng)
-        if temperature > 0:
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
-        nxt = nxt.astype(toks.dtype)
+        nxt = sample_logits(
+            sub, logits, temperature=temperature, top_k=top_k, top_p=top_p
+        ).astype(toks.dtype)
         # Only write past the prompt: prompt positions stay teacher-forced.
         write_pos = jnp.minimum(t + 1, total - 1)
         keep_prompt = (t + 1) < P
@@ -631,9 +668,12 @@ class GPTLM(TPUModule):
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         rng: Optional[jax.Array] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
     ) -> jax.Array:
         """KV-cached autoregressive decode from the fitted params
-        (:func:`gpt_generate`); greedy unless ``temperature > 0``."""
+        (:func:`gpt_generate`); greedy unless ``temperature > 0``, with
+        optional top-k / nucleus filtering."""
         if self.params is None:
             raise RuntimeError("no parameters: fit first or set module.params")
         return gpt_generate(
@@ -643,6 +683,8 @@ class GPTLM(TPUModule):
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             rng=rng,
+            top_k=top_k,
+            top_p=top_p,
         )
 
     def configure_optimizers(self):

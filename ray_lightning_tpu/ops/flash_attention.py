@@ -1,13 +1,16 @@
-"""Flash attention: Pallas online-softmax kernel for the TPU MXU.
+"""Flash attention: Pallas online-softmax kernels for the TPU MXU.
 
 The forward pass is a Pallas kernel (one grid cell per (batch*head,
 q-block); K/V stream through an online-softmax ``fori_loop`` so the (Sq, Sk)
-score matrix never materializes in HBM). The backward pass uses the
-flash-attention gradient identities on recomputed scores — plain XLA, which
-fuses it into a few MXU matmuls.
+score matrix never materializes in HBM). The backward pass is two Pallas
+kernels using the flash-attention gradient identities on block-recomputed
+scores — a dk/dv kernel gridded over key blocks and a dq kernel gridded
+over query blocks — so the backward never materializes (Sq, Sk) either
+(the naive recompute costs B*H*S^2*4 bytes of HBM: 400 MB at B=8, H=12,
+S=1024).
 
-On non-TPU backends the same kernel runs in Pallas interpret mode (tests),
-or falls back to ``attention_reference``.
+On non-TPU backends the same kernels run in Pallas interpret mode (tests),
+or fall back to ``attention_reference``.
 """
 from __future__ import annotations
 
@@ -18,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ray_lightning_tpu.ops.attention import attention_reference, causal_mask_allowed
+from ray_lightning_tpu.ops.attention import attention_reference
 
 _NEG_INF = float("-inf")
 
@@ -147,30 +150,182 @@ def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
-    """Flash-attention backward: recompute P from saved lse, then the
-    standard dq/dk/dv identities — a handful of MXU matmuls under XLA."""
-    q, k, v, out, lse = res
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", qf, kf, preferred_element_type=jnp.float32
-    ) * sm_scale
-    if causal:
-        s = jnp.where(
-            causal_mask_allowed(q.shape[1], k.shape[1]), s, _NEG_INF
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q: int, causal: bool, sm_scale: float,
+):
+    """One (batch*head, k-block) cell: accumulate dk/dv over q blocks.
+
+    Causal skips q blocks strictly above this k block's diagonal.
+    """
+    seq_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    ik = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    k_offset = ik * block_k
+    start_qb = k_offset // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qs = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dos = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0][:, None]
+        s = jax.lax.dot_general(
+            qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col <= row, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # (bq, bk), rows of the full P sum to 1
+        dv2 = dv + jax.lax.dot_general(
+            p, dos, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-    p = jnp.exp(s - lse[..., None])  # (B, H, Sq, Sk), rows sum to 1
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
-    # delta = rowsum(do * o) = rowsum(dp * p)
-    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B, Sq, H)
-    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * sm_scale
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        dp = jax.lax.dot_general(
+            dos, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk2 = dk + jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk2, dv2
+
+    init = (
+        jnp.zeros((block_k, k.shape[1]), jnp.float32),
+        jnp.zeros((block_k, v.shape[1]), jnp.float32),
+    )
+    dk, dv = jax.lax.fori_loop(start_qb, seq_q // block_q, body, init)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k: int, causal: bool, sm_scale: float,
+):
+    """One (batch*head, q-block) cell: accumulate dq over k blocks."""
+    block_q = q_ref.shape[1]
+    seq_k = k_ref.shape[1]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0][:, None]
+    delta = delta_ref[0, :, 0][:, None]
+    q_offset = iq * block_q
+    if causal:
+        num_kb = jax.lax.div(q_offset + block_q + block_k - 1, block_k)
+    else:
+        num_kb = seq_k // block_k
+
+    def body(i, dq):
+        ks = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vs = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            row = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            col = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col <= row, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, ks, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(
+        0, num_kb, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    """Flash-attention backward: two Pallas kernels over recomputed score
+    blocks (never the full (Sq, Sk) matrix). delta = rowsum(do * o) is the
+    softmax-jacobian correction term."""
+    q, k, v, out, lse = res
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    bq, bk = min(block_q, seq_q), min(block_k, seq_k)
+
+    qf = q.transpose(0, 2, 1, 3).reshape(batch * heads, seq_q, head_dim)
+    kf = k.transpose(0, 2, 1, 3).reshape(batch * heads, seq_k, head_dim)
+    vf = v.transpose(0, 2, 1, 3).reshape(batch * heads, seq_k, head_dim)
+    dof = do.transpose(0, 2, 1, 3).reshape(batch * heads, seq_q, head_dim)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (B, Sq, H)
+    delta = delta.transpose(0, 2, 1).reshape(batch * heads, seq_q)
+    lsef = lse.reshape(batch * heads, seq_q)
+    # Stats rows padded to 8 lanes (TPU block-shape conformance, as in fwd).
+    lse8 = jnp.broadcast_to(lsef[..., None], (batch * heads, seq_q, 8))
+    delta8 = jnp.broadcast_to(delta[..., None], (batch * heads, seq_q, 8))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=bq, causal=causal, sm_scale=sm_scale
+        ),
+        grid=(batch * heads, seq_k // bk),
+        in_specs=[
+            pl.BlockSpec((1, seq_q, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_q, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 8), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 8), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, head_dim), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, seq_k, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((batch * heads, seq_k, head_dim), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse8, delta8)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, block_k=bk, causal=causal, sm_scale=sm_scale
+        ),
+        grid=(batch * heads, seq_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 8), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 8), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, seq_q, head_dim), q.dtype)
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse8, delta8)[0]
+
+    unflatten = lambda x, s: x.reshape(  # noqa: E731
+        batch, heads, s, head_dim
+    ).transpose(0, 2, 1, 3)
+    return (
+        unflatten(dq, seq_q).astype(q.dtype),
+        unflatten(dk, seq_k).astype(k.dtype),
+        unflatten(dv, seq_k).astype(v.dtype),
+    )
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Any, Dict, List, Optional
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -39,6 +41,7 @@ class Trainer:
         default_root_dir: Optional[str] = None,
         seed: Optional[int] = None,
         precision: str = "fp32",
+        max_restarts: int = 0,
     ) -> None:
         self.max_epochs = max_epochs
         self.max_steps = max_steps
@@ -58,12 +61,18 @@ class Trainer:
         # Lightning semantics: enable_checkpointing adds a default
         # ModelCheckpoint when the user supplied none; False means no
         # implicit checkpointing (explicit callbacks still run).
+        self.max_restarts = int(max_restarts)
         if enable_checkpointing and not any(
             hasattr(cb, "best_model_path") for cb in self.callbacks
         ):
             from ray_lightning_tpu.trainer.callbacks import ModelCheckpoint
 
-            self.callbacks.append(ModelCheckpoint())
+            # Fault-tolerant fits resume from the newest checkpoint, so the
+            # implicit callback keeps a rolling "last.ckpt" when restarts
+            # are enabled (a user-supplied callback's config is respected).
+            self.callbacks.append(
+                ModelCheckpoint(save_last=self.max_restarts > 0)
+            )
         self.seed = seed_everything(seed)
         self.precision = precision
         # Post-run state (restored from rank-0 worker output)
@@ -138,11 +147,13 @@ class Trainer:
         module: Any,
         datamodule: Any = None,
         ckpt_path: Optional[str] = None,
+        ckpt_stream: Optional[Any] = None,
     ) -> Any:
         self._module = module
         self._lr_sched_cache: Any = False  # re-unpack for the new module
         module.trainer = self
-        ckpt_stream = self._read_ckpt(ckpt_path)
+        if ckpt_stream is None:
+            ckpt_stream = self._read_ckpt(ckpt_path)
         if self.strategy is None or isinstance(self.strategy, SingleDeviceStrategy):
             output = self._run_in_process(stage, module, datamodule, ckpt_stream)
         else:
@@ -193,8 +204,112 @@ class Trainer:
         datamodule: Any = None,
         ckpt_path: Optional[str] = None,
     ) -> "Trainer":
-        self._run("fit", module, datamodule, ckpt_path)
-        return self
+        """Run the fit stage; with ``max_restarts > 0``, worker-group
+        failures (a dead actor mid-fit) relaunch the group and resume from
+        the newest on-disk checkpoint (or the original ``ckpt_path``/scratch
+        when none was written yet). Checkpoints must be reachable from the
+        driver — true on single-host fits and shared filesystems; the
+        reference gets the same property from Ray Tune's trial-level
+        restore rather than the trainer (SURVEY.md §5 failure detection).
+        """
+        from ray_lightning_tpu.fabric.core import ActorDiedError
+
+        fit_started = time.time()
+        attempts = self.max_restarts
+        ckpt_data: Optional[Any] = None  # pre-read payload for retries
+        while True:
+            try:
+                self._run("fit", module, datamodule, ckpt_path, ckpt_data)
+                return self
+            except ActorDiedError as exc:
+                if attempts <= 0:
+                    raise
+                attempts -= 1
+                resume, resume_data = self._restart_checkpoint(fit_started)
+                warnings.warn(
+                    f"worker died mid-fit ({exc}); restarting "
+                    f"({attempts} restart(s) left) from "
+                    f"{resume or ckpt_path or 'scratch'}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                if resume is not None:
+                    # Reuse the validation read — no second read+unpickle.
+                    ckpt_path, ckpt_data = resume, resume_data
+                else:
+                    ckpt_data = None  # fall back to original ckpt_path
+
+    def _restart_checkpoint(
+        self, fit_started: float
+    ) -> Tuple[Optional[str], Optional[Any]]:
+        """Newest LOADABLE checkpoint written by THIS fit (mtime after the
+        fit started — a shared checkpoint dir may hold files from earlier,
+        unrelated runs whose param trees don't match). Prefers the rolling
+        ``last`` checkpoint; a candidate that fails validation (e.g. the
+        save in flight when the worker died, or a sharded dir missing its
+        finalizing meta file) falls through to the next newest instead of
+        aborting the restart. Returns ``(path, read_payload)`` so the
+        retry does not read + unpickle the checkpoint a second time."""
+        from ray_lightning_tpu.trainer.checkpoint_io import (
+            _META_FILE,
+            is_sharded_checkpoint,
+        )
+
+        cb = self.checkpoint_callback
+        search_dirs = []
+        if cb is not None and getattr(cb, "dirpath", None):
+            search_dirs.append(cb.dirpath)
+        search_dirs.append(os.path.join(self.default_root_dir, "checkpoints"))
+        for d in search_dirs:
+            if not os.path.isdir(d):
+                continue
+            candidates = [
+                p
+                for name in os.listdir(d)
+                for p in [os.path.join(d, name)]
+                if (
+                    name.endswith(".ckpt")
+                    or is_sharded_checkpoint(p)
+                )
+                and os.path.getmtime(p) >= fit_started - 1.0
+            ]
+            if not candidates:
+                continue
+            last = [
+                p for p in candidates if os.path.basename(p).startswith("last")
+            ]
+            ordered = sorted(
+                last, key=os.path.getmtime, reverse=True
+            ) + sorted(
+                [p for p in candidates if p not in last],
+                key=os.path.getmtime,
+                reverse=True,
+            )
+            for path in ordered:
+                try:
+                    data = self._read_ckpt(path)
+                    from ray_lightning_tpu.utils.state_stream import (
+                        load_state_stream,
+                    )
+
+                    if isinstance(data, bytes):
+                        load_state_stream(data)  # full unpickle check
+                    else:
+                        # Sharded dir: orbax renames the state tree into
+                        # place atomically, and meta.ckpt is written (also
+                        # atomically) only after that finishes — so a
+                        # loadable meta file marks a finalized checkpoint.
+                        with open(os.path.join(path, _META_FILE), "rb") as f:
+                            load_state_stream(f.read())
+                except Exception as exc:  # noqa: BLE001 - fall to older ckpt
+                    warnings.warn(
+                        f"skipping unreadable checkpoint {path}: {exc}",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    continue
+                return path, data
+        return None, None
 
     def validate(
         self, module: Any, datamodule: Any = None, ckpt_path: Optional[str] = None

@@ -108,12 +108,28 @@ def load_state_stream(stream: bytes, sharding: Optional[Any] = None) -> Any:
 
 
 def state_stream_to_file(stream: bytes, path: str) -> None:
-    """Write a state stream to ``path`` via fsspec (remote URIs supported)."""
-    try:
-        import fsspec
+    """Write a state stream to ``path`` via fsspec (remote URIs supported).
 
-        with fsspec.open(path, "wb") as f:
-            f.write(stream)
-    except ImportError:  # pragma: no cover
-        with io.open(path, "wb") as f:
-            f.write(stream)
+    Local writes are atomic (tmp + rename): a process killed mid-save —
+    the exact event ``max_restarts`` recovery exists for — must never
+    leave a truncated checkpoint as the newest file in the directory.
+    """
+    if "://" not in path:
+        import os
+
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with io.open(tmp, "wb") as f:
+                f.write(stream)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)  # don't orphan partial temp files
+            except OSError:
+                pass
+            raise
+        return
+    import fsspec
+
+    with fsspec.open(path, "wb") as f:
+        f.write(stream)

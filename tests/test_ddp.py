@@ -234,3 +234,122 @@ def test_plan_workers_fractional_tpu_warns(start_fabric, caplog):
     with caplog.at_level(logging.WARNING):
         strategy.plan_workers()
     assert "fractional TPU" in caplog.text
+
+
+class _CrashOnceModule(BoringModule):
+    """Dies (os._exit) at epoch-1 start unless the marker file exists —
+    exactly one crash per marker path, so restarted fits succeed."""
+
+    def __init__(self, marker: str) -> None:
+        super().__init__()
+        self.marker = marker
+
+    def on_train_epoch_start(self, epoch: int) -> None:
+        if epoch == 1 and not os.path.exists(self.marker):
+            try:
+                fd = os.open(self.marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+            except FileExistsError:
+                return  # another rank already claimed the crash
+            os._exit(1)
+
+
+def test_fit_restarts_after_worker_death(start_fabric, tmp_path):
+    """max_restarts: a worker killed mid-fit relaunches the group and
+    resumes from the newest checkpoint (beyond-parity failure recovery;
+    the reference only surfaces the dead actor, SURVEY.md §5)."""
+    import warnings as _warnings
+
+    start_fabric(num_cpus=4)
+    module = _CrashOnceModule(str(tmp_path / "crashed.marker"))
+    ckpt = ModelCheckpoint(dirpath=str(tmp_path / "ckpts"), save_last=True)
+    trainer = Trainer(
+        max_epochs=3,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+        callbacks=[ckpt],
+        enable_checkpointing=True,
+        num_sanity_val_steps=0,
+        seed=0,
+        max_restarts=1,
+    )
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        trainer.fit(module)
+    assert any("restarting" in str(w.message) for w in caught)
+    assert trainer.state["status"] == "finished"
+    # Epoch 0 ran once (pre-crash), epochs 1-2 after resume; the resumed
+    # run restored epoch-0 progress from last.ckpt rather than starting over.
+    assert trainer.current_epoch == 2
+    # 64 samples / (2 per-worker batch x 2 workers) = 16 steps/epoch x 3.
+    assert trainer.global_step == 48
+    assert os.path.exists(module.marker)
+    assert np.isfinite(
+        float(np.asarray(trainer.callback_metrics["val_loss"]))
+    )
+
+
+def test_fit_exhausted_restarts_raises(start_fabric, tmp_path):
+    """With max_restarts=0 a dead worker still surfaces ActorDiedError."""
+    start_fabric(num_cpus=4)
+
+    class _AlwaysCrash(BoringModule):
+        def on_train_epoch_start(self, epoch: int) -> None:
+            os._exit(1)
+
+    trainer = Trainer(
+        max_epochs=2,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+        enable_checkpointing=False,
+        num_sanity_val_steps=0,
+        seed=0,
+    )
+    with pytest.raises(fabric.ActorDiedError):
+        trainer.fit(_AlwaysCrash())
+
+
+def test_restart_ignores_stale_and_corrupt_checkpoints(start_fabric, tmp_path):
+    """The restart picker must skip (a) checkpoints predating this fit
+    (shared dirs hold unrelated runs' files) and (b) unreadable files,
+    falling back to the next-newest loadable candidate."""
+    import time as _time
+
+    start_fabric(num_cpus=4)
+    ckdir = tmp_path / "ckpts"
+    ckdir.mkdir()
+    # Stale: a valid-looking checkpoint from "a previous run".
+    (ckdir / "epoch=9-step=99.ckpt").write_bytes(b"old-run-bytes")
+    old = _time.time() - 3600
+    os.utime(ckdir / "epoch=9-step=99.ckpt", (old, old))
+
+    module = _CrashOnceModule(str(tmp_path / "crashed.marker"))
+    ckpt = ModelCheckpoint(dirpath=str(ckdir), save_last=True)
+    trainer = Trainer(
+        max_epochs=3,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+        callbacks=[ckpt],
+        num_sanity_val_steps=0,
+        seed=0,
+        max_restarts=1,
+    )
+    # Corrupt the rolling last.ckpt the moment it exists? Simpler: after the
+    # crash the picker runs; pre-plant a FUTURE-dated corrupt file so it is
+    # the newest candidate and must be skipped in favor of the real save.
+    import threading
+
+    def plant_corrupt():
+        # wait for the real checkpoints to appear (epoch 0 save)
+        for _ in range(600):
+            if any(p.name.startswith("epoch=0") for p in ckdir.iterdir()):
+                break
+            _time.sleep(0.05)
+        (ckdir / "last.ckpt.bak.ckpt").write_bytes(b"\x80corrupt")
+        fut = _time.time() + 3600
+        os.utime(ckdir / "last.ckpt.bak.ckpt", (fut, fut))
+
+    t = threading.Thread(target=plant_corrupt)
+    t.start()
+    trainer.fit(module)
+    t.join()
+    assert trainer.state["status"] == "finished"
+    assert trainer.current_epoch == 2
+    assert trainer.global_step == 48  # resumed, not restarted from scratch

@@ -401,3 +401,79 @@ def test_generate_learns_recurrence():
         expect.append((5 * expect[-1] + 7) % 64)
     matches = sum(int(out[0, i]) == expect[i] for i in range(12))
     assert matches >= 9, (out[0].tolist(), expect)
+
+
+def test_sample_logits_topk_topp():
+    """top-k/top-p filters: membership, greedy limits, determinism."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.models.gpt import sample_logits
+
+    logits = jnp.asarray(
+        [[4.0, 3.0, 2.0, 1.0, 0.0, -1.0, -2.0, -3.0]], jnp.float32
+    )
+
+    # temperature 0 -> argmax regardless of filters
+    assert int(sample_logits(jax.random.PRNGKey(0), logits, 0.0, top_k=3)[0]) == 0
+
+    # top_k=1 and tiny top_p both collapse to the argmax even at high temp
+    for kw in ({"top_k": 1}, {"top_p": 1e-6}):
+        ids = [
+            int(sample_logits(jax.random.PRNGKey(s), logits, 5.0, **kw)[0])
+            for s in range(20)
+        ]
+        assert set(ids) == {0}, (kw, ids)
+
+    # top_k=3: every draw lands in the 3 highest-logit ids
+    draws = [
+        int(sample_logits(jax.random.PRNGKey(s), logits, 2.0, top_k=3)[0])
+        for s in range(50)
+    ]
+    assert set(draws) <= {0, 1, 2} and len(set(draws)) > 1
+
+    # top_p: mass of [4,3,2,...] softmax is ~0.64/0.24/0.09; p=0.7 keeps
+    # {0,1} (token crossing p included)
+    draws = [
+        int(sample_logits(jax.random.PRNGKey(s), logits, 1.0, top_p=0.7)[0])
+        for s in range(60)
+    ]
+    assert set(draws) == {0, 1}, sorted(set(draws))
+
+    # same rng -> same sample (pure function)
+    a = sample_logits(jax.random.PRNGKey(3), logits, 1.0, top_k=4, top_p=0.9)
+    b = sample_logits(jax.random.PRNGKey(3), logits, 1.0, top_k=4, top_p=0.9)
+    assert int(a[0]) == int(b[0])
+
+    # batch shape preserved
+    batch = jnp.tile(logits, (5, 1))
+    out = sample_logits(jax.random.PRNGKey(1), batch, 1.0, top_k=2, top_p=0.9)
+    assert out.shape == (5,)
+    assert np.all(np.asarray(out) < 8)
+
+
+def test_generate_with_sampling_filters():
+    """gpt_generate composes with top-k/top-p; output shape and prompt
+    teacher-forcing hold; greedy run unchanged by filters."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.models.gpt import gpt_generate, init_gpt_params
+
+    params = init_gpt_params(jax.random.PRNGKey(0), TINY)
+    prompt = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    out = gpt_generate(
+        params, TINY, prompt, max_new_tokens=5,
+        temperature=0.8, rng=jax.random.PRNGKey(1), top_k=8, top_p=0.95,
+    )
+    assert out.shape == (2, 8)
+    np.testing.assert_array_equal(np.asarray(out[:, :3]), np.asarray(prompt))
+    assert np.all(np.asarray(out) >= 0) and np.all(np.asarray(out) < TINY.vocab_size)
+
+    greedy = gpt_generate(params, TINY, prompt, max_new_tokens=5)
+    greedy_filtered = gpt_generate(
+        params, TINY, prompt, max_new_tokens=5, top_k=4, top_p=0.5
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(greedy_filtered))
