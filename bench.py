@@ -209,8 +209,11 @@ def bench_gpt(
             attn_impl="reference",
         )
     else:
-        seq, batch = 512, 4
-        cfg = GPTConfig.gpt2_small(max_seq=seq, remat=True)
+        # batch 16 / no remat: the v5e probe showed throughput scaling
+        # ~linearly in batch up to 32 at this model size (PERF.md); remat
+        # only burns recompute FLOPs when activations fit comfortably.
+        seq, batch = 512, 16
+        cfg = GPTConfig.gpt2_small(max_seq=seq, remat=False)
     module = GPTLM(config=cfg, batch_size=batch, n_train=batch * num_workers * 16)
     rates, trainer = _fit_and_rates(
         RayShardedStrategy(num_workers=num_workers, use_tpu=use_tpu),

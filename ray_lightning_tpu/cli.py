@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
 
-_SUBCOMMANDS = ("fit", "validate", "test", "predict")
+_SUBCOMMANDS = ("fit", "validate", "test", "predict", "generate")
 
 
 def import_class(path: str) -> type:
@@ -128,7 +128,7 @@ def _apply_dotted(
     field_overrides: List[Tuple[str, str, str]] = []
     for key, raw in dotted:
         section, _, field = key.partition(".")
-        if section not in ("model", "strategy", "trainer", "data"):
+        if section not in ("model", "strategy", "trainer", "data", "generate"):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
         if isinstance(node, str):  # YAML bare class-path form
@@ -142,7 +142,7 @@ def _apply_dotted(
     # Pass 2: typed field values.
     for section, field, raw in field_overrides:
         node = config[section]
-        if section == "trainer":
+        if section in ("trainer", "generate"):  # plain-dict sections
             node[field] = yaml.safe_load(raw)
             continue
         init_args = node.setdefault("init_args", {})
@@ -234,6 +234,70 @@ def build(config: Dict[str, Any]) -> Tuple[Any, Any, Optional[Any]]:
     return trainer, model, datamodule
 
 
+def run_generate(config: Dict[str, Any]) -> Any:
+    """``generate``: restore params from a checkpoint and decode.
+
+    Config section (``--generate.<key>`` or ``generate:`` in YAML):
+      ckpt_path (required, state-stream checkpoint), prompt (token ids —
+      "1,2,3" or a YAML list), max_new_tokens, temperature, top_k, top_p,
+      seed. Prints one comma-separated id line per sequence and returns
+      the (B, P+N) array. Sharded checkpoint dirs need a live mesh — use
+      ``validate``/``test`` for those; generation is a single-program path.
+    """
+    import numpy as np
+
+    gen = dict(config.pop("generate", None) or {})
+    model = instantiate_class(config["model"])
+    if not hasattr(model, "generate"):
+        raise ValueError(
+            f"{type(model).__name__} has no generate(); the generate "
+            "subcommand needs an autoregressive model (e.g. GPTLM)"
+        )
+    ckpt_path = gen.pop("ckpt_path", None)
+    if ckpt_path is None:
+        raise ValueError("generate requires --generate.ckpt_path")
+    from ray_lightning_tpu.trainer.checkpoint_io import is_sharded_checkpoint
+    from ray_lightning_tpu.utils.state_stream import load_state_stream
+
+    if is_sharded_checkpoint(ckpt_path):
+        raise ValueError(
+            "generate restores state-stream checkpoints only; restore "
+            "sharded dirs through validate/test first"
+        )
+    from ray_lightning_tpu.trainer.trainer import Trainer
+
+    model.load_state_dict(load_state_stream(Trainer._read_ckpt(ckpt_path)))
+    prompt = gen.pop("prompt", None)
+    if prompt is None:
+        raise ValueError("generate requires --generate.prompt (token ids)")
+    if isinstance(prompt, str):
+        prompt = [int(t) for t in prompt.replace(",", " ").split()]
+    arr = np.atleast_2d(np.asarray(prompt, np.int32))
+    # Pop every known option BEFORE decoding so a typo'd flag fails
+    # instantly instead of after a long decode.
+    seed = int(gen.pop("seed", 0))
+    max_new_tokens = int(gen.pop("max_new_tokens", 32))
+    temperature = float(gen.pop("temperature", 0.0))
+    top_k = gen.pop("top_k", None)
+    top_p = gen.pop("top_p", None)
+    if gen:
+        raise ValueError(f"unknown generate options: {sorted(gen)}")
+    import jax
+
+    out = model.generate(
+        arr,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        rng=jax.random.PRNGKey(seed),
+        top_k=top_k,
+        top_p=top_p,
+    )
+    out = np.asarray(out)
+    for row in out:
+        print(",".join(str(int(t)) for t in row))
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> Any:
     subcommand, config = parse_args(argv)
     fabric_cfg = config.pop("fabric", None) or {}
@@ -241,6 +305,8 @@ def main(argv: Optional[List[str]] = None) -> Any:
         from ray_lightning_tpu import fabric
 
         fabric.init(**fabric_cfg)
+    if subcommand == "generate":
+        return run_generate(config)
     trainer, model, datamodule = build(config)
     fn = getattr(trainer, subcommand)
     if datamodule is not None:
@@ -248,5 +314,19 @@ def main(argv: Optional[List[str]] = None) -> Any:
     return fn(model)
 
 
+def cli_entry(argv: Optional[List[str]] = None) -> Any:
+    """Actual command-line entrypoint (console script / ``python -m``).
+
+    Re-applies ``JAX_PLATFORMS`` over any sitecustomize-forced plugin
+    platform config — on the command line the env var IS the user's
+    intent. Programmatic callers use :func:`main`, which never clobbers
+    an application's own ``jax.config`` pins.
+    """
+    from ray_lightning_tpu.utils.platform import apply_jax_platform_env
+
+    apply_jax_platform_env()
+    return main(argv)
+
+
 if __name__ == "__main__":
-    main()
+    cli_entry()

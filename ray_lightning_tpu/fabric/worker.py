@@ -33,16 +33,11 @@ def _worker_main(conn):
     # (a trial's training workers) instead of orphaning them.
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
 
-    # Honor an explicit JAX platform choice even when a PJRT plugin loaded at
-    # interpreter boot (via sitecustomize) has already forced its own
-    # ``jax_platforms`` config, which silently overrides the env var.
-    if os.environ.get("JAX_PLATFORMS"):
-        try:
-            import jax
+    # Honor an explicit JAX platform choice even when a PJRT plugin loaded
+    # at interpreter boot (sitecustomize) already forced its own config.
+    from ray_lightning_tpu.utils.platform import apply_jax_platform_env
 
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:  # noqa: BLE001 - jax may be absent in pure actors
-            pass
+    apply_jax_platform_env()
 
     import cloudpickle  # after env setup; cheap, no jax dependency
 

@@ -599,6 +599,9 @@ class GPTLM(TPUModule):
         weight_decay: float = 0.01,
     ) -> None:
         super().__init__()
+        if isinstance(config, dict):
+            # YAML/CLI form: model.init_args.config is a plain mapping.
+            config = GPTConfig(**config)
         self.config = config or GPTConfig()
         self.lr = lr
         self.warmup_steps = warmup_steps
@@ -701,9 +704,13 @@ class GPTLM(TPUModule):
     # -- data ------------------------------------------------------------
     def _data(self) -> ArrayDataset:
         if self._dataset is None:
+            # FULL max_seq-length sequences: a benchmark computing tokens/s
+            # as steps * batch * max_seq must actually train on max_seq
+            # tokens per sample (a shorter fake corpus silently inflates
+            # every throughput/MFU number derived from it).
             self._dataset = make_fake_text(
                 self.n_train,
-                seq_len=min(self.config.max_seq, 64),
+                seq_len=self.config.max_seq,
                 vocab=self.config.vocab_size,
             )
         return self._dataset
@@ -715,7 +722,7 @@ class GPTLM(TPUModule):
         return DataLoader(
             make_fake_text(
                 64,
-                seq_len=min(self.config.max_seq, 64),
+                seq_len=self.config.max_seq,
                 vocab=self.config.vocab_size,
                 seed=7,
             ),

@@ -260,6 +260,15 @@ class Trainer:
         if cb is not None and getattr(cb, "dirpath", None):
             search_dirs.append(cb.dirpath)
         search_dirs.append(os.path.join(self.default_root_dir, "checkpoints"))
+        def _mtime(p: str) -> float:
+            # A concurrent run may prune files between listdir and stat;
+            # treat vanished paths as too old rather than crashing the
+            # restart this scan exists to enable.
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return -1.0
+
         for d in search_dirs:
             if not os.path.isdir(d):
                 continue
@@ -271,18 +280,16 @@ class Trainer:
                     name.endswith(".ckpt")
                     or is_sharded_checkpoint(p)
                 )
-                and os.path.getmtime(p) >= fit_started - 1.0
+                and _mtime(p) >= fit_started - 1.0
             ]
             if not candidates:
                 continue
             last = [
                 p for p in candidates if os.path.basename(p).startswith("last")
             ]
-            ordered = sorted(
-                last, key=os.path.getmtime, reverse=True
-            ) + sorted(
+            ordered = sorted(last, key=_mtime, reverse=True) + sorted(
                 [p for p in candidates if p not in last],
-                key=os.path.getmtime,
+                key=_mtime,
                 reverse=True,
             )
             for path in ordered:

@@ -1,0 +1,28 @@
+"""Honoring an explicit JAX platform choice.
+
+This environment's PJRT TPU plugin registers itself at interpreter boot
+(sitecustomize) and force-sets the ``jax_platforms`` config, which silently
+overrides the ``JAX_PLATFORMS`` env var. Anywhere the framework runs user
+compute in the CURRENT process (worker actors, the in-process Trainer path,
+the CLI) must therefore re-apply the env var through jax.config before the
+first backend touch — otherwise ``JAX_PLATFORMS=cpu`` still initializes the
+(possibly remote and wedged) TPU backend and can hang outright.
+"""
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platform_env() -> None:
+    """Re-apply ``JAX_PLATFORMS`` over any plugin-forced platform config.
+
+    No-op when the env var is unset or jax is unavailable; safe to call
+    repeatedly, but must run before the first ``jax.devices()``.
+    """
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:  # noqa: BLE001 - jax absent / backend already live
+            pass
