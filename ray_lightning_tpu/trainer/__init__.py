@@ -7,6 +7,7 @@ from ray_lightning_tpu.trainer.callbacks import (
     JaxProfilerCallback,
     TPUStatsCallback,
 )
+from ray_lightning_tpu.trainer.ema import ema_params, params_ema
 from ray_lightning_tpu.trainer.data import (
     ArrayDataset,
     DataLoader,
@@ -30,6 +31,8 @@ __all__ = [
     "LearningRateMonitor",
     "JaxProfilerCallback",
     "TPUStatsCallback",
+    "params_ema",
+    "ema_params",
     "DataLoader",
     "Dataset",
     "ArrayDataset",

@@ -49,6 +49,10 @@ class TrainerSpec:
     default_root_dir: str = "."
     seed: Optional[int] = None
     precision: str = "fp32"
+    # EMA of model weights (trainer/ema.py): decay enables the in-step
+    # averaged copy riding opt_state; eval_ema evaluates with it.
+    ema_decay: Optional[float] = None
+    eval_ema: bool = False
     callbacks: List[Any] = field(default_factory=list)
 
 
@@ -188,10 +192,10 @@ class TrainingLoop:
                     raise RuntimeError(
                         "checkpointed optimizer state does not match the "
                         "current optimizer: accumulate_grad_batches/"
-                        "gradient_clip_val/configure_optimizers changed "
-                        "since the checkpoint was written. Resume with the "
-                        "same optimizer options, or load params only via "
-                        "validate/test/predict(ckpt_path=...)"
+                        "gradient_clip_val/ema_decay/configure_optimizers "
+                        "changed since the checkpoint was written. Resume "
+                        "with the same optimizer options, or load params "
+                        "only via validate/test/predict(ckpt_path=...)"
                     )
                 opt_state = restored
             self._restore_progress(state)
@@ -218,6 +222,21 @@ class TrainingLoop:
             self._update_count = int(
                 np.asarray(jax.device_get(self.opt_state.gradient_step))
             )
+        if self.spec.ema_decay:
+            # A restored EMA sum only continues correctly under the decay
+            # it was accumulated with (stored in the state).
+            from ray_lightning_tpu.trainer.ema import find_ema_state
+
+            st = find_ema_state(self.opt_state)
+            if st is not None:
+                stored = float(np.asarray(jax.device_get(st.decay)))
+                # The state stores float32; compare at that precision.
+                if abs(stored - float(np.float32(self.spec.ema_decay))) > 1e-7:
+                    raise RuntimeError(
+                        f"checkpoint EMA was accumulated with decay "
+                        f"{stored}, but this Trainer has ema_decay="
+                        f"{self.spec.ema_decay}; resume with the same value"
+                    )
 
     def _unpack_optimizers(self) -> Any:
         """Unpack ``configure_optimizers()`` return forms.
@@ -281,6 +300,12 @@ class TrainingLoop:
                 optax.clip_by_global_norm(float(self.spec.gradient_clip_val)),
                 tx,
             )
+        if self.spec.ema_decay:
+            from ray_lightning_tpu.trainer.ema import params_ema
+
+            # After the optimizer so the EMA absorbs post-update weights;
+            # inside _inner_tx so accumulation flushes update it too.
+            tx = optax.chain(tx, params_ema(float(self.spec.ema_decay)))
         self._inner_tx = tx  # pre-MultiSteps transform, used by the flush
         if self.spec.accumulate_grad_batches > 1:
             tx = optax.MultiSteps(
@@ -539,6 +564,45 @@ class TrainingLoop:
         self.strategy.teardown_worker()
         return self._collect_rank_zero_results(results=None)
 
+    def _ema_params(self) -> Optional[Any]:
+        """Debias-corrected EMA weights from opt_state (None when EMA is
+        off, no update has run, or opt_state is absent — eval-only restores
+        ship params alone)."""
+        if not self.spec.ema_decay or self.opt_state is None:
+            return None
+        from ray_lightning_tpu.trainer.ema import ema_params
+
+        return ema_params(self.opt_state, float(self.spec.ema_decay))
+
+    def _eval_params(self) -> Any:
+        """Weights the eval/predict steps should see: the EMA copy when
+        ``eval_ema`` is set, else the live params.
+
+        In standalone validate/test/predict the EMA arrives from the
+        checkpoint (module-state ``ema_params`` or the resume-format
+        ``opt_state``) or the module's own recovered copy; asking for
+        ``eval_ema`` with no EMA anywhere is an error, not a silent
+        live-weights eval. During fit, a zero-update EMA (sanity val)
+        falls back to live weights.
+        """
+        if not self.spec.eval_ema:
+            return self.params
+        ema = self._ema_params()
+        if ema is None and getattr(self, "_eval_ema_src", None) is not None:
+            ema = self.strategy.place_params(self._eval_ema_src)
+        if ema is not None:
+            return ema
+        if self.spec.ema_decay and self.opt_state is not None:
+            # Fit-time EMA pending its first update (sanity val): live
+            # weights ARE the average so far.
+            return self.params
+        raise RuntimeError(
+            "eval_ema=True but no EMA weights are available (fit with "
+            "ema_decay=... first, or evaluate a checkpoint that carries "
+            "the average; sharded eval-only restores don't materialize "
+            "optimizer state, so use a state-stream checkpoint)"
+        )
+
     def _run_eval_epoch(
         self,
         eval_step,
@@ -569,9 +633,10 @@ class TrainingLoop:
                 loader.iter_batches(mult, with_mask=True), n_batches
             )
         )
+        eval_params = self._eval_params()
         try:
             for batch, gmask in staged:
-                all_pairs.append(eval_step(self.params, batch, gmask))
+                all_pairs.append(eval_step(eval_params, batch, gmask))
         finally:
             staged.close()
         if not all_pairs:
@@ -634,10 +699,11 @@ class TrainingLoop:
 
         mult = self.strategy.batch_multiplier
         preds = []
+        eval_params = self._eval_params()
         for host_batch, host_mask in loader.iter_batches(mult, with_mask=True):
             batch = self.strategy.make_global_batch(host_batch)
             gmask = self.strategy.make_global_batch(host_mask)
-            out, mask = jax.device_get(predict_step(self.params, batch, gmask))
+            out, mask = jax.device_get(predict_step(eval_params, batch, gmask))
             # Trim wrap-around padding rows so predictions line up 1:1 with
             # the dataset (mask comes back replicated alongside preds).
             mask = np.asarray(mask).astype(bool)
@@ -682,8 +748,20 @@ class TrainingLoop:
         if ckpt_stream is not None:
             state = load_state_stream(ckpt_stream)
             params = state["params"] if "params" in state else state
+            if isinstance(state, dict):
+                if state.get("ema_params") is not None:
+                    self._eval_ema_src = state["ema_params"]
+                elif self.spec.eval_ema and "opt_state" in state:
+                    # Resume-format checkpoints carry the EMA inside the
+                    # optimizer state; debiasing materializes a full
+                    # param-sized copy, so only do it when eval will
+                    # actually read it.
+                    from ray_lightning_tpu.trainer.ema import ema_params
+
+                    self._eval_ema_src = ema_params(state["opt_state"])
         elif self.module.params is not None:
             params = self.module.params
+            self._eval_ema_src = self.module.ema_params
         else:
             raise RuntimeError(
                 "no parameters available: fit first, or pass ckpt_path"
@@ -714,6 +792,13 @@ class TrainingLoop:
         if self.params is not None:
             module_state = dict(self.module.state_dict())
             module_state["params"] = self.strategy.gather_state(self.params)
+            ema_dev = self._ema_params()
+            if ema_dev is not None:
+                module_state["ema_params"] = self.strategy.gather_state(ema_dev)
+            elif getattr(self, "_eval_ema_src", None) is not None:
+                # Eval-only run restored the average from a checkpoint:
+                # re-ship it (already host-side) so recovery keeps it.
+                module_state["ema_params"] = self._eval_ema_src
             state_stream = to_state_stream(module_state)
         best_model_path = None
         callback_states: Dict[str, Any] = {}

@@ -100,6 +100,7 @@ class TPUModule:
 
     def __init__(self) -> None:
         self.params: Any = None  # populated after fit()/restore
+        self.ema_params: Any = None  # populated when Trainer(ema_decay=...)
         self.trainer: Any = None  # back-reference set by Trainer
 
     # ------------------------------------------------------------------
@@ -163,6 +164,10 @@ class TPUModule:
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.params = state["params"]
+        # Unconditional: a state without an average CLEARS any stale one
+        # from a previous fit (eval-only round-trips re-ship the average
+        # through the worker output, so it survives those).
+        self.ema_params = state.get("ema_params")
 
 
 class DataModule:

@@ -42,6 +42,8 @@ class Trainer:
         seed: Optional[int] = None,
         precision: str = "fp32",
         max_restarts: int = 0,
+        ema_decay: Optional[float] = None,
+        eval_ema: bool = False,
     ) -> None:
         self.max_epochs = max_epochs
         self.max_steps = max_steps
@@ -62,6 +64,14 @@ class Trainer:
         # ModelCheckpoint when the user supplied none; False means no
         # implicit checkpointing (explicit callbacks still run).
         self.max_restarts = int(max_restarts)
+        if ema_decay is not None and not 0.0 < float(ema_decay) < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+        # eval_ema without ema_decay stays legal: standalone validate/test
+        # can source the average from a checkpoint that carries one; the
+        # loop raises if no EMA exists anywhere (never a silent live-weight
+        # eval).
+        self.ema_decay = ema_decay
+        self.eval_ema = bool(eval_ema)
         if enable_checkpointing and not any(
             hasattr(cb, "best_model_path") for cb in self.callbacks
         ):
@@ -100,6 +110,8 @@ class Trainer:
             default_root_dir=self.default_root_dir,
             seed=self.seed,
             precision=self.precision,
+            ema_decay=self.ema_decay,
+            eval_ema=self.eval_ema,
             callbacks=self.callbacks,
         )
 
@@ -132,6 +144,11 @@ class Trainer:
             update_count=getattr(self, "_update_count", None),
             accumulate_grad_batches=self.accumulate_grad_batches,
         )
+
+    @property
+    def ema_params(self) -> Optional[Any]:
+        """EMA weights recovered from the fit (None when ema_decay unset)."""
+        return getattr(self._module, "ema_params", None)
 
     @property
     def checkpoint_callback(self) -> Optional[Any]:
@@ -385,4 +402,6 @@ class Trainer:
                 type(cb).__name__: cb.state_dict() for cb in self.callbacks
             },
         }
+        if getattr(self._module, "ema_params", None) is not None:
+            state["ema_params"] = self._module.ema_params  # serves eval_ema
         state_stream_to_file(to_state_stream(state), path)

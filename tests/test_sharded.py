@@ -212,3 +212,29 @@ def test_zero_with_grad_accumulation_and_clip():
         np.asarray(jax.tree_util.tree_leaves(params)[0]),
     )
     assert np.isfinite(float(np.asarray(logs["loss"])))
+
+
+def test_sharded_ema(start_fabric):
+    """EMA state shards with the rest of opt_state under ZeRO and the
+    gathered average reaches the driver."""
+    import numpy as np
+
+    from ray_lightning_tpu.models import BoringModule
+    from ray_lightning_tpu.trainer import Trainer
+
+    start_fabric(num_cpus=4)
+    m = BoringModule()
+    t = Trainer(
+        max_epochs=1,
+        strategy=RayShardedStrategy(num_workers=2, use_tpu=False),
+        enable_checkpointing=False,
+        num_sanity_val_steps=0,
+        seed=0,
+        ema_decay=0.9,
+    )
+    t.fit(m)
+    assert m.ema_params is not None
+    w = np.asarray(m.params["w"])
+    we = np.asarray(m.ema_params["w"])
+    assert we.shape == w.shape and np.isfinite(we).all()
+    assert not np.allclose(w, we)

@@ -664,3 +664,214 @@ def test_unpack_optimizers_rejects_ptl_tuple_and_trainer_reuse():
     m2 = _SchedModule(form="plain")
     t.fit(m2)
     assert t.current_lr is None
+
+
+def test_params_ema_transform_math():
+    """params_ema tracks the post-update weights: closed-form check."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.trainer.ema import ema_params, params_ema
+
+    d = 0.9
+    tx = optax.chain(optax.sgd(0.5), params_ema(d))
+    params = {"w": jnp.asarray([1.0, 2.0])}
+    state = tx.init(params)
+    grads = [{"w": jnp.asarray([1.0, 0.0])}, {"w": jnp.asarray([0.0, 2.0])}]
+    seen = []
+    for g in grads:
+        updates, state = tx.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+        seen.append(np.asarray(params["w"]))
+    # debiased EMA after t updates = (sum_i (1-d) d^(t-1-i) p_i) / (1-d^t)
+    t = len(seen)
+    num = sum((1 - d) * d ** (t - 1 - i) * p for i, p in enumerate(seen))
+    expected = num / (1 - d**t)
+    got = ema_params(state, d)
+    np.testing.assert_allclose(np.asarray(got["w"]), expected, rtol=1e-6)
+
+
+def test_trainer_ema_fit_and_eval():
+    """Trainer(ema_decay=...): averaged weights recovered on the driver;
+    eval_ema evaluates with them (different val_loss than live weights)."""
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import Trainer
+
+    def run(**kw):
+        m = _DetModule(batch_size=4, n=96)
+        t = Trainer(
+            max_epochs=2,
+            enable_checkpointing=False,
+            seed=0,
+            num_sanity_val_steps=0,
+            **kw,
+        )
+        t.fit(m)
+        return t, m
+
+    t_ema, m_ema = run(ema_decay=0.8)
+    assert t_ema.ema_params is not None and m_ema.ema_params is not None
+    w = np.asarray(m_ema.params["w"])
+    we = np.asarray(m_ema.ema_params["w"])
+    assert np.isfinite(we).all() and not np.allclose(w, we)
+    # Same seed without EMA: identical training trajectory (EMA is an
+    # observer, not a modifier).
+    t_plain, m_plain = run()
+    np.testing.assert_allclose(w, np.asarray(m_plain.params["w"]), atol=0)
+    assert t_plain.ema_params is None
+
+    # eval_ema: val_loss computed with the (lagging) averaged weights
+    # differs from the live-weight val_loss.
+    t_ev, _ = run(ema_decay=0.8, eval_ema=True)
+    assert (
+        abs(
+            t_ev.callback_metrics["val_loss"]
+            - t_ema.callback_metrics["val_loss"]
+        )
+        > 1e-9
+    )
+
+
+def test_trainer_ema_survives_resume(tmp_path):
+    """EMA state rides opt_state, so checkpoint resume keeps the average."""
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    m = _DetModule(batch_size=4, n=96)
+    ck = ModelCheckpoint(dirpath=str(tmp_path), save_last=True)
+    t = Trainer(
+        max_epochs=1,
+        enable_checkpointing=True,
+        callbacks=[ck],
+        seed=0,
+        num_sanity_val_steps=0,
+        ema_decay=0.8,
+    )
+    t.fit(m)
+
+    m2 = _DetModule(batch_size=4, n=96)
+    t2 = Trainer(
+        max_epochs=2,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        ema_decay=0.8,
+    )
+    t2.fit(m2, ckpt_path=ck.last_model_path)
+
+    # Reference: straight 2-epoch run with EMA from scratch.
+    m3 = _DetModule(batch_size=4, n=96)
+    t3 = Trainer(
+        max_epochs=2,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        ema_decay=0.8,
+    )
+    t3.fit(m3)
+    np.testing.assert_allclose(
+        np.asarray(m2.ema_params["w"]), np.asarray(m3.ema_params["w"]),
+        rtol=1e-6,
+    )
+
+
+def test_ema_guards_and_standalone_eval(tmp_path):
+    """decay-mismatch resume is rejected; standalone validate honors
+    eval_ema from a checkpoint; eval_ema with no EMA anywhere raises."""
+    import numpy as np
+    import pytest
+
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    with pytest.raises(ValueError, match="ema_decay"):
+        Trainer(ema_decay=1.5)
+
+    m = _DetModule(batch_size=4, n=96)
+    ck = ModelCheckpoint(dirpath=str(tmp_path), save_last=True)
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=True, callbacks=[ck], seed=0,
+        num_sanity_val_steps=0, ema_decay=0.8,
+    )
+    t.fit(m)
+
+    # Resume with a different decay must fail loudly.
+    t_bad = Trainer(
+        max_epochs=2, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, ema_decay=0.9,
+    )
+    with pytest.raises(RuntimeError, match="decay"):
+        t_bad.fit(_DetModule(batch_size=4, n=96), ckpt_path=ck.last_model_path)
+
+    # Standalone validate from the resume-format checkpoint: EMA lives in
+    # its opt_state; eval_ema picks it up even with ema_decay unset.
+    t_eval = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, eval_ema=True,
+    )
+    res_ema = t_eval.validate(
+        _DetModule(batch_size=4, n=96), ckpt_path=ck.last_model_path
+    )
+    t_live = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    res_live = t_live.validate(
+        _DetModule(batch_size=4, n=96), ckpt_path=ck.last_model_path
+    )
+    assert abs(res_ema[0]["val_loss"] - res_live[0]["val_loss"]) > 1e-12
+
+    # eval_ema with nothing to average from: loud error.
+    m_plain = _DetModule(batch_size=4, n=96)
+    t_plain = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    t_plain.fit(m_plain)
+    t_none = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, eval_ema=True,
+    )
+    with pytest.raises(RuntimeError, match="no EMA"):
+        t_none.validate(m_plain)
+
+
+def test_ema_driver_save_and_stale_clear(tmp_path):
+    """Driver-side save_checkpoint carries the average; re-fitting without
+    EMA clears the stale one from the module."""
+    import numpy as np
+    import pytest
+
+    from ray_lightning_tpu.trainer import Trainer
+
+    m = _DetModule(batch_size=4, n=96)
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, ema_decay=0.8,
+    )
+    t.fit(m)
+    path = str(tmp_path / "driver.ckpt")
+    t.save_checkpoint(path)
+
+    # eval_ema straight from the driver-saved checkpoint
+    t_eval = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, eval_ema=True,
+    )
+    res = t_eval.validate(_DetModule(batch_size=4, n=96), ckpt_path=path)
+    assert np.isfinite(res[0]["val_loss"])
+
+    # Re-fit the same module WITHOUT ema: stale average must not survive.
+    t2 = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    t2.fit(m)
+    assert m.ema_params is None and t2.ema_params is None
+    with pytest.raises(RuntimeError, match="no EMA"):
+        Trainer(
+            max_epochs=1, enable_checkpointing=False, seed=0,
+            num_sanity_val_steps=0, eval_ema=True,
+        ).validate(m)
