@@ -48,6 +48,14 @@ class GPTConfig:
     compute_dtype: str = "float32"  # "bfloat16" for TPU runs
     remat: bool = False
     attn_impl: str = "flash"  # "flash" | "reference"
+    # Grouped-query attention: 0 -> n_head (MHA); 1 -> MQA. K/V projections
+    # and the decode cache carry n_kv_head heads (cache shrinks by
+    # n_head/n_kv_head); queries group onto them.
+    n_kv_head: int = 0
+    # "learned" (GPT-2 wpe table) or "rope" (rotary, no position params;
+    # positions follow the zigzag permutation under sequence parallelism).
+    pos_embed: str = "learned"
+    rope_theta: float = 10000.0
     # Sequence-parallel attention flavor when the mesh's seq axis is >1:
     # "ring" = contiguous shards (ops/ring_attention.py); "zigzag" =
     # load-balanced causal ring — the whole transformer then runs in zigzag
@@ -71,6 +79,15 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_head
+
+    @property
+    def kv_head(self) -> int:
+        kv = self.n_kv_head or self.n_head
+        if self.n_head % kv:
+            raise ValueError(
+                f"n_head ({self.n_head}) must be divisible by n_kv_head ({kv})"
+            )
+        return kv
 
     @property
     def ff_dim(self) -> int:
@@ -126,14 +143,27 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
             "bo2": jnp.zeros((L, D)),
         }
 
-    return {
+    Hkv = cfg.kv_head
+    if Hkv == H:
+        attn = {
+            "wqkv": norm(keys[2], (L, D, 3, H, hd), std),
+            "bqkv": jnp.zeros((L, 3, H, hd)),
+        }
+    else:
+        # GQA: separate projections; K/V carry only Hkv heads.
+        kq, kkv = jax.random.split(keys[2])
+        attn = {
+            "wq": norm(kq, (L, D, H, hd), std),
+            "bq": jnp.zeros((L, H, hd)),
+            "wkv": norm(kkv, (L, D, 2, Hkv, hd), std),
+            "bkv": jnp.zeros((L, 2, Hkv, hd)),
+        }
+    out = {
         "wte": norm(keys[0], (cfg.vocab_size, D), std),
-        "wpe": norm(keys[1], (cfg.max_seq, D), std),
         "blocks": {
             "ln1_g": jnp.ones((L, D)),
             "ln1_b": jnp.zeros((L, D)),
-            "wqkv": norm(keys[2], (L, D, 3, H, hd), std),
-            "bqkv": jnp.zeros((L, 3, H, hd)),
+            **attn,
             "wo": norm(keys[3], (L, H, hd, D), res_std),
             "bo": jnp.zeros((L, D)),
             "ln2_g": jnp.ones((L, D)),
@@ -143,6 +173,13 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
         "lnf_g": jnp.ones((D,)),
         "lnf_b": jnp.zeros((D,)),
     }
+    if cfg.pos_embed == "learned":
+        out["wpe"] = norm(keys[1], (cfg.max_seq, D), std)
+    elif cfg.pos_embed != "rope":
+        raise ValueError(
+            f"unknown pos_embed {cfg.pos_embed!r}; use 'learned' or 'rope'"
+        )
+    return out
 
 
 def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
@@ -164,14 +201,26 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
             "wo2": ("layers", "mlp", "embed"),
             "bo2": ("layers", None),
         }
-    return {
+    if cfg.kv_head == cfg.n_head:
+        attn = {
+            "wqkv": ("layers", "embed", None, "heads", "kv"),
+            "bqkv": ("layers", None, "heads", "kv"),
+        }
+    else:
+        # GQA: kv heads shard over "heads" too (requires n_kv_head
+        # divisible by the model-axis size, like n_head).
+        attn = {
+            "wq": ("layers", "embed", "heads", "kv"),
+            "bq": ("layers", "heads", "kv"),
+            "wkv": ("layers", "embed", None, "heads", "kv"),
+            "bkv": ("layers", None, "heads", "kv"),
+        }
+    out = {
         "wte": ("vocab", "embed"),
-        "wpe": (None, "embed"),
         "blocks": {
             "ln1_g": ("layers", None),
             "ln1_b": ("layers", None),
-            "wqkv": ("layers", "embed", None, "heads", "kv"),
-            "bqkv": ("layers", None, "heads", "kv"),
+            **attn,
             "wo": ("layers", "heads", "kv", "embed"),
             "bo": ("layers", None),
             "ln2_g": ("layers", None),
@@ -181,6 +230,9 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
         "lnf_g": (None,),
         "lnf_b": (None,),
     }
+    if cfg.pos_embed == "learned":
+        out["wpe"] = (None, "embed")
+    return out
 
 
 def _moe_layer_params(lp: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
@@ -200,6 +252,77 @@ def _layernorm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
     mu = x32.mean(-1, keepdims=True)
     var = x32.var(-1, keepdims=True)
     return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5) * g + b).astype(x.dtype)
+
+
+def _rope_tables(
+    pos: jax.Array, theta: float, head_dim: int
+) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables (S, hd/2) for explicit positions (S,).
+
+    Positions are passed (not implied by index) so permuted layouts —
+    zigzag sequence parallelism — rotate by the TRUE token position.
+    Computed ONCE per forward and closed over by the layer scan: the trig
+    is position-only, recomputing it per layer (and again under remat)
+    would be pure waste at long context.
+    """
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None]  # (S, half)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rope(x: jax.Array, tables: Tuple[jax.Array, jax.Array]) -> jax.Array:
+    """Apply the half-split (NeoX-style) rotation to (B, S, H, hd) — two
+    multiplies and two adds, fused by XLA; fp32 compute, x.dtype out."""
+    cos, sin = tables
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    half = x.shape[-1] // 2
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _project_qkv(
+    a: jax.Array,
+    lp: Dict[str, jax.Array],
+    cfg: GPTConfig,
+    cdt: Any,
+    rope_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(B, S, D) -> q, k, v each (B, S, H, hd).
+
+    Fused MHA projection, or separate q / grouped-kv projections (GQA) with
+    kv heads repeated up to H — compute matches MHA, while params and the
+    decode cache stay Hkv-sized. RoPE (when configured) rotates q/k here,
+    BEFORE the kv repeat, so the rotation runs at Hkv width.
+    """
+    if cfg.kv_head == cfg.n_head:
+        qkv = (
+            jnp.einsum("bsd,dthk->bsthk", a, lp["wqkv"].astype(cdt))
+            + lp["bqkv"].astype(cdt)
+        )
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    else:
+        q = (
+            jnp.einsum("bsd,dhk->bshk", a, lp["wq"].astype(cdt))
+            + lp["bq"].astype(cdt)
+        )
+        kv = (
+            jnp.einsum("bsd,dthk->bsthk", a, lp["wkv"].astype(cdt))
+            + lp["bkv"].astype(cdt)
+        )
+        k, v = kv[:, :, 0], kv[:, :, 1]
+    if rope_tables is not None:
+        q = _rope(q, rope_tables)
+        k = _rope(k, rope_tables)
+    if cfg.kv_head != cfg.n_head:
+        rep = cfg.n_head // cfg.kv_head
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return q, k, v
 
 
 def gpt_forward(
@@ -269,12 +392,22 @@ def gpt_forward(
         zz_perm_np = zigzag_permutation(S, mesh.shape[seq_axis])
         zz_perm = jnp.asarray(zz_perm_np)
         zz_inv = jnp.asarray(inverse_permutation(zz_perm_np))
-        x = _seq_sharded(
-            params["wte"][tokens[:, zz_perm]] + params["wpe"][zz_perm]
-        )
+        x = params["wte"][tokens[:, zz_perm]]
+        if cfg.pos_embed == "learned":
+            x = x + params["wpe"][zz_perm]
+        x = _seq_sharded(x)
+        positions = zz_perm  # true token positions in the permuted layout
     else:
-        x = params["wte"][tokens] + params["wpe"][:S]
+        x = params["wte"][tokens]
+        if cfg.pos_embed == "learned":
+            x = x + params["wpe"][:S]
+        positions = jnp.arange(S)
     x = x.astype(cdt)
+    rope_tables = (
+        _rope_tables(positions, cfg.rope_theta, cfg.head_dim)
+        if cfg.pos_embed == "rope"
+        else None
+    )
 
     def attend(q, k, v):
         if use_zigzag:
@@ -318,11 +451,7 @@ def gpt_forward(
     ) -> Tuple[Tuple[jax.Array, jax.Array], None]:
         h, aux_acc = carry
         a = _layernorm(h, lp["ln1_g"], lp["ln1_b"])
-        qkv = (
-            jnp.einsum("bsd,dthk->bsthk", a, lp["wqkv"].astype(cdt))
-            + lp["bqkv"].astype(cdt)
-        )
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,S,H,hd)
+        q, k, v = _project_qkv(a, lp, cfg, cdt, rope_tables)  # (B,S,H,hd)
         o = attend(q, k, v)
         h = h + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cdt)) + lp[
             "bo"
@@ -481,8 +610,12 @@ def gpt_generate(
     # traced indexing works.
     params = jax.tree_util.tree_map(jnp.asarray, params)
 
-    k_cache = jnp.zeros((L, B, total, H, hd), cdt)
-    v_cache = jnp.zeros((L, B, total, H, hd), cdt)
+    Hkv = cfg.kv_head
+    rep = H // Hkv
+    # GQA: the cache carries only Hkv heads — the whole point at decode
+    # (HBM traffic per token shrinks by H/Hkv).
+    k_cache = jnp.zeros((L, B, total, Hkv, hd), cdt)
+    v_cache = jnp.zeros((L, B, total, Hkv, hd), cdt)
     # Ring buffer of emitted tokens; prompt positions stay teacher-forced.
     toks = jnp.concatenate(
         [prompt, jnp.zeros((B, int(max_new_tokens)), prompt.dtype)], axis=1
@@ -491,30 +624,60 @@ def gpt_generate(
     def one_position(carry, t):
         toks, k_cache, v_cache, rng = carry
         cur = jax.lax.dynamic_slice_in_dim(toks, t, 1, axis=1)[:, 0]  # (B,)
-        x = (params["wte"][cur] + params["wpe"][t]).astype(cdt)  # (B, D)
+        x = params["wte"][cur]
+        if cfg.pos_embed == "learned":
+            x = x + params["wpe"][t]
+        x = x.astype(cdt)  # (B, D)
+        rope_tables = (
+            _rope_tables(jnp.reshape(t, (1,)), cfg.rope_theta, hd)
+            if cfg.pos_embed == "rope"
+            else None
+        )  # once per position, shared by all layers
 
         def layer(h, args):
             lp, kc_l, vc_l = args
             a = _layernorm(h[:, None], lp["ln1_g"], lp["ln1_b"])[:, 0]
-            qkv = (
-                jnp.einsum("bd,dthk->bthk", a, lp["wqkv"].astype(cdt))
-                + lp["bqkv"].astype(cdt)
-            )
-            q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B, H, hd)
+            if Hkv == H:
+                qkv = (
+                    jnp.einsum("bd,dthk->bthk", a, lp["wqkv"].astype(cdt))
+                    + lp["bqkv"].astype(cdt)
+                )
+                q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B,H,hd)
+            else:
+                q = (
+                    jnp.einsum("bd,dhk->bhk", a, lp["wq"].astype(cdt))
+                    + lp["bq"].astype(cdt)
+                )
+                kv = (
+                    jnp.einsum("bd,dthk->bthk", a, lp["wkv"].astype(cdt))
+                    + lp["bkv"].astype(cdt)
+                )
+                k_new, v_new = kv[:, 0], kv[:, 1]  # (B, Hkv, hd)
+            if rope_tables is not None:
+                q = _rope(q[:, None], rope_tables)[:, 0]
+                k_new = _rope(k_new[:, None], rope_tables)[:, 0]
             kc_l = jax.lax.dynamic_update_slice_in_dim(
                 kc_l, k_new[:, None], t, axis=1
             )
             vc_l = jax.lax.dynamic_update_slice_in_dim(
                 vc_l, v_new[:, None], t, axis=1
             )
+            # Grouped attention against the Hkv-headed cache: q heads fold
+            # to (Hkv, rep) groups (head h reads kv head h // rep, matching
+            # _project_qkv's jnp.repeat layout).
+            qg = q.reshape(B, Hkv, rep, hd).astype(jnp.float32)
             s = jnp.einsum(
-                "bhk,bshk->bhs",
-                q.astype(jnp.float32) * (1.0 / np.sqrt(hd)),
+                "bgrk,bsgk->bgrs",
+                qg * (1.0 / np.sqrt(hd)),
                 kc_l.astype(jnp.float32),
             )
-            s = jnp.where(jnp.arange(total)[None, None] <= t, s, float("-inf"))
+            s = jnp.where(
+                jnp.arange(total)[None, None, None] <= t, s, float("-inf")
+            )
             p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhs,bshk->bhk", p, vc_l.astype(jnp.float32)).astype(cdt)
+            o = jnp.einsum(
+                "bgrs,bsgk->bgrk", p, vc_l.astype(jnp.float32)
+            ).reshape(B, H, hd).astype(cdt)
             h = h + jnp.einsum("bhk,hkd->bd", o, lp["wo"].astype(cdt)) + lp[
                 "bo"
             ].astype(cdt)
