@@ -135,6 +135,8 @@ class ModelCheckpoint(Callback):
         if self.monitor is None:
             # No monitor: latest checkpoint is "best" (Lightning behavior)
             # and the previous one is pruned so only save_top_k remain.
+            # (prev predates the save that just ran, so with async IO it
+            # was finalized when this save started — safe to delete.)
             prev = self.best_model_path
             self.best_model_path = path
             if (
@@ -149,20 +151,27 @@ class ModelCheckpoint(Callback):
                 self.best_model_score = score
                 self.best_model_path = path
             self._saved.append((score, path))
-            self._prune()
+            self._prune(trainer)
         if self.save_last and not self.save_sharded:
             last = os.path.join(dirpath, "last.ckpt")
             trainer.save_checkpoint(last)
             self.last_model_path = last
 
-    def _prune(self) -> None:
+    def _prune(self, trainer: Any = None) -> None:
         if self.save_top_k < 0:
             return
         reverse = self.mode == "max"
         self._saved.sort(key=lambda t: t[0], reverse=reverse)
+        drained = False
         while len(self._saved) > self.save_top_k:
             _, path = self._saved.pop()
             if path != self.best_model_path and os.path.exists(path):
+                if not drained and trainer is not None:
+                    # The worst-scoring checkpoint may be the save still in
+                    # flight (async IO); rmtree under a live tensorstore
+                    # write corrupts it and crashes the NEXT save's drain.
+                    getattr(trainer, "finalize_checkpoints", lambda: None)()
+                    drained = True
                 _remove_checkpoint(path)
 
     def state_dict(self) -> Dict[str, Any]:

@@ -62,6 +62,9 @@ class OrbaxCheckpointIO:
                 to_state_stream(meta), os.path.join(path, _META_FILE)
             )
 
+    def finalize(self) -> None:
+        """No-op for the synchronous IO (see AsyncOrbaxCheckpointIO)."""
+
     def restore(
         self,
         path: str,
@@ -123,3 +126,67 @@ class OrbaxCheckpointIO:
                 stacklevel=2,
             )
         return restored, meta
+
+
+class AsyncOrbaxCheckpointIO(OrbaxCheckpointIO):
+    """Sharded save that overlaps tensorstore writes with training.
+
+    ``StandardCheckpointer.save`` is async under the hood: it returns once
+    device shards are snapshotted to host, and the filesystem writes run in
+    a background thread. The synchronous IO immediately blocks on
+    ``wait_until_finished``; this one defers that to ``finalize()`` —
+    called before the NEXT save (at most one save in flight) and at fit
+    end — so an epoch of compute hides the write latency.
+
+    Crash-consistency is unchanged: ``meta.ckpt`` (the finalization marker
+    the restart scanner requires) is only written inside ``finalize()``,
+    after the state tree is fully on disk. A process killed mid-write
+    leaves an unfinalized directory that resume logic already skips.
+    """
+
+    def __init__(self) -> None:
+        self._pending: Optional[Tuple[Any, str, bytes, bool]] = None
+
+    def save(
+        self,
+        path: str,
+        state: Dict[str, Any],
+        meta: Dict[str, Any],
+        is_rank_zero: bool = True,
+    ) -> None:
+        import orbax.checkpoint as ocp
+
+        self.finalize()  # at most one save in flight
+        path = os.path.abspath(path)
+        # A reused path (rolling "last") still holds the PREVIOUS save's
+        # meta marker; drop it before dispatching so a crash during the
+        # write window leaves an UNFINALIZED directory (old meta + new
+        # state would read as a finalized checkpoint with mismatched
+        # progress).
+        if is_rank_zero:
+            try:
+                os.remove(os.path.join(path, _META_FILE))
+            except OSError:
+                pass
+        ckptr = ocp.StandardCheckpointer()
+        try:
+            ckptr.save(os.path.join(path, _STATE_SUBDIR), state, force=True)
+        except BaseException:
+            ckptr.close()  # don't leak the async machinery on dispatch failure
+            raise
+        self._pending = (ckptr, path, to_state_stream(meta), is_rank_zero)
+
+    def finalize(self) -> None:
+        """Block until the in-flight save (if any) is durable, then write
+        the meta marker. Every rank must call this (the orbax save is
+        collective); rank 0 writes the marker."""
+        if self._pending is None:
+            return
+        ckptr, path, meta_stream, is_rank_zero = self._pending
+        self._pending = None
+        try:
+            ckptr.wait_until_finished()
+        finally:
+            ckptr.close()
+        if is_rank_zero:
+            state_stream_to_file(meta_stream, os.path.join(path, _META_FILE))

@@ -53,6 +53,9 @@ class TrainerSpec:
     # averaged copy riding opt_state; eval_ema evaluates with it.
     ema_decay: Optional[float] = None
     eval_ema: bool = False
+    # Sharded (orbax) saves overlap tensorstore writes with the next epoch;
+    # the finalization marker still gates restartability (checkpoint_io.py).
+    async_checkpointing: bool = False
     callbacks: List[Any] = field(default_factory=list)
 
 
@@ -394,7 +397,17 @@ class TrainingLoop:
                     type(cb).__name__: cb.state_dict() for cb in self.callbacks
                 },
             }
-            OrbaxCheckpointIO().save(
+            if getattr(self, "_sharded_io", None) is None:
+                from ray_lightning_tpu.trainer.checkpoint_io import (
+                    AsyncOrbaxCheckpointIO,
+                )
+
+                self._sharded_io = (
+                    AsyncOrbaxCheckpointIO()
+                    if self.spec.async_checkpointing
+                    else OrbaxCheckpointIO()
+                )
+            self._sharded_io.save(
                 path,
                 {"params": self.params, "opt_state": self.opt_state},
                 meta,
@@ -407,6 +420,15 @@ class TrainingLoop:
         from ray_lightning_tpu.utils.state_stream import state_stream_to_file
 
         state_stream_to_file(stream, path)
+
+    def finalize_checkpoints(self) -> None:
+        """Drain any in-flight async sharded save (no-op otherwise).
+
+        Callbacks call this before deleting checkpoint directories that
+        could still be mid-write.
+        """
+        if getattr(self, "_sharded_io", None) is not None:
+            self._sharded_io.finalize()
 
     def checkpoint_state(self) -> Dict[str, Any]:
         return {
@@ -561,6 +583,10 @@ class TrainingLoop:
         self.module.params = self.params
         self.module.on_fit_end()
         self._call_callbacks("on_fit_end")
+        if getattr(self, "_sharded_io", None) is not None:
+            # Drain any in-flight async save (collective: every rank) so
+            # the last checkpoint is finalized before workers exit.
+            self._sharded_io.finalize()
         self.strategy.teardown_worker()
         return self._collect_rank_zero_results(results=None)
 

@@ -44,6 +44,7 @@ class Trainer:
         max_restarts: int = 0,
         ema_decay: Optional[float] = None,
         eval_ema: bool = False,
+        async_checkpointing: bool = False,
     ) -> None:
         self.max_epochs = max_epochs
         self.max_steps = max_steps
@@ -72,6 +73,7 @@ class Trainer:
         # eval).
         self.ema_decay = ema_decay
         self.eval_ema = bool(eval_ema)
+        self.async_checkpointing = bool(async_checkpointing)
         if enable_checkpointing and not any(
             hasattr(cb, "best_model_path") for cb in self.callbacks
         ):
@@ -112,6 +114,7 @@ class Trainer:
             precision=self.precision,
             ema_decay=self.ema_decay,
             eval_ema=self.eval_ema,
+            async_checkpointing=self.async_checkpointing,
             callbacks=self.callbacks,
         )
 

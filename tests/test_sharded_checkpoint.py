@@ -4,6 +4,8 @@ Parity anchor: the reference verifies checkpoint param-equality and resume
 with a *different* worker count (test_ddp_sharded.py:27-137); the sharded IO
 must reproduce both without ever gathering full state on one host.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -198,3 +200,117 @@ def test_zero3_fit_saves_sharded_and_resumes(start_fabric, tmp_path):
     )
     results = trainer3.test(module3, ckpt_path=cb.best_model_path)
     assert results and np.isfinite(list(results[0].values())[0])
+
+
+def test_async_orbax_io_defers_meta_until_finalize(tmp_path):
+    """The meta marker (restartability gate) appears only at finalize."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.trainer.checkpoint_io import (
+        AsyncOrbaxCheckpointIO,
+        is_sharded_checkpoint,
+    )
+
+    io = AsyncOrbaxCheckpointIO()
+    state = {"params": {"w": jnp.arange(8.0)}}
+    path = str(tmp_path / "async_ck")
+    io.save(path, state, {"epoch": 3, "global_step": 7})
+    assert not os.path.exists(os.path.join(path, "meta.ckpt"))
+    io.finalize()
+    assert is_sharded_checkpoint(path)
+    assert os.path.exists(os.path.join(path, "meta.ckpt"))
+    restored, meta = OrbaxCheckpointIO().restore(
+        path, {"params": {"w": jax.device_put(jnp.zeros(8))}}
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.arange(8.0)
+    )
+    assert meta["epoch"] == 3 and meta["global_step"] == 7
+    io.finalize()  # idempotent
+
+
+def test_async_checkpointing_fit_and_resume(tmp_path):
+    """async_checkpointing=True: the rolling sharded last checkpoint is
+    finalized by fit end and resumes exactly like the sync path."""
+    import numpy as np
+
+    from ray_lightning_tpu.models import MNISTClassifier
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    def fit(async_ck, tag, epochs=1, resume=None):
+        m = MNISTClassifier(batch_size=8, n_train=64)
+        ck = ModelCheckpoint(
+            dirpath=str(tmp_path / tag), save_sharded=True, save_last=True
+        )
+        t = Trainer(
+            max_epochs=epochs,
+            enable_checkpointing=True,
+            callbacks=[ck],
+            seed=0,
+            num_sanity_val_steps=0,
+            async_checkpointing=async_ck,
+        )
+        t.fit(m, ckpt_path=resume)
+        return t, m, ck
+
+    t1, m1, ck1 = fit(True, "async")
+    assert os.path.exists(os.path.join(ck1.last_model_path, "meta.ckpt"))
+    t2, m2, _ = fit(True, "async2", epochs=2, resume=ck1.last_model_path)
+    assert t2.current_epoch == 1 and t2.global_step == 2 * t1.global_step
+
+    # Sync run over identical data: same final weights.
+    t3, m3, _ = fit(False, "sync", epochs=2)
+    np.testing.assert_allclose(
+        np.asarray(m2.params["w1"]), np.asarray(m3.params["w1"]), atol=1e-6
+    )
+
+
+def test_async_io_unfinalizes_reused_path_during_write(tmp_path):
+    """Re-saving into a reused dir (rolling last) removes the stale meta
+    marker for the whole write window: a crash mid-write leaves an
+    UNFINALIZED directory, never new-state-with-old-meta."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.trainer.checkpoint_io import AsyncOrbaxCheckpointIO
+
+    io = AsyncOrbaxCheckpointIO()
+    path = str(tmp_path / "last")
+    meta_path = os.path.join(path, "meta.ckpt")
+    io.save(path, {"w": jnp.zeros(4)}, {"epoch": 0})
+    io.finalize()
+    assert os.path.exists(meta_path)
+    io.save(path, {"w": jnp.ones(4)}, {"epoch": 1})
+    assert not os.path.exists(meta_path)  # unfinalized while in flight
+    io.finalize()
+    assert os.path.exists(meta_path)
+
+
+def test_async_checkpointing_with_monitor_prune(tmp_path):
+    """async IO + monitored top-k pruning: the prune drains the in-flight
+    save before rmtree, so a worsening-metric epoch can't corrupt it."""
+    from ray_lightning_tpu.models import BoringModule
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    m = BoringModule(lr=0.0)  # loss never improves -> epoch 1+ are pruned
+    ck = ModelCheckpoint(
+        dirpath=str(tmp_path / "ck"),
+        save_sharded=True,
+        monitor="val_loss",
+        save_top_k=1,
+    )
+    t = Trainer(
+        max_epochs=3,
+        enable_checkpointing=True,
+        callbacks=[ck],
+        seed=0,
+        num_sanity_val_steps=0,
+        async_checkpointing=True,
+    )
+    t.fit(m)
+    assert ck.best_model_path and os.path.exists(
+        os.path.join(ck.best_model_path, "meta.ckpt")
+    )
+    # Only top-1 remains on disk.
+    kept = [p for p in os.listdir(tmp_path / "ck")]
+    assert len(kept) == 1, kept
