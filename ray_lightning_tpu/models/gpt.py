@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader, Dataset
 from ray_lightning_tpu.trainer.module import TPUModule
 
 
@@ -758,7 +758,7 @@ class GPTLM(TPUModule):
         warmup_steps: int = 20,
         batch_size: int = 8,
         n_train: int = 256,
-        dataset: Optional[ArrayDataset] = None,
+        dataset: Optional[Dataset] = None,
         weight_decay: float = 0.01,
     ) -> None:
         super().__init__()
@@ -865,7 +865,7 @@ class GPTLM(TPUModule):
         }
 
     # -- data ------------------------------------------------------------
-    def _data(self) -> ArrayDataset:
+    def _data(self) -> Dataset:
         if self._dataset is None:
             # FULL max_seq-length sequences: a benchmark computing tokens/s
             # as steps * batch * max_seq must actually train on max_seq

@@ -370,13 +370,19 @@ class Strategy:
         return jax.tree_util.tree_map(cast, tree)
 
     # -- compiled steps -------------------------------------------------
-    def compile_train_step(self, module: Any, tx: Any) -> Callable:
+    def compile_train_step(
+        self, module: Any, tx: Any, log_grad_norm: bool = False
+    ) -> Callable:
         """Build the jitted train step.
 
         The whole optimization step — fwd, bwd, (XLA-inserted) grad
         all-reduce, optimizer update — is one compiled program, the TPU
         equivalent of the reference's ★ HOT LOOP (SURVEY.md §3.1) where
         DDP hooks overlap allreduce with backward.
+
+        ``log_grad_norm`` adds the pre-clip global gradient norm to the
+        step's logs — computed in-graph (one reduction XLA fuses into the
+        backward), not a host-side hook.
         """
         import jax
         import optax
@@ -395,6 +401,8 @@ class Strategy:
                 return loss, dict(logs)
 
             (loss, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if log_grad_norm:
+                logs["grad_norm"] = optax.global_norm(grads)
             updates, opt_state2 = tx.update(grads, opt_state, params)
             params2 = optax.apply_updates(params, updates)
             # Pin outputs to the strategy's shardings: without the

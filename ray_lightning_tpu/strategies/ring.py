@@ -22,7 +22,9 @@ from ray_lightning_tpu.utils.rank_zero import rank_zero_warn
 class RingTPUStrategy(RayTPUStrategy):
     strategy_name = "horovod_ray"
 
-    def compile_train_step(self, module: Any, tx: Any) -> Callable:
+    def compile_train_step(
+        self, module: Any, tx: Any, log_grad_norm: bool = False
+    ) -> Callable:
         import jax
         import jax.numpy as jnp
         import optax
@@ -42,6 +44,9 @@ class RingTPUStrategy(RayTPUStrategy):
             # Explicit ring/tree all-reduce over the data axis — the
             # hvd.DistributedOptimizer analog (ray_horovod_launcher.py:202).
             grads = jax.lax.pmean(grads, "data")
+            if log_grad_norm:
+                # Post-allreduce: the same global norm every rank logs.
+                logs["grad_norm"] = optax.global_norm(grads)
             logs.setdefault("loss", loss)
             logs = jax.tree_util.tree_map(
                 lambda x: jax.lax.pmean(x, "data"), logs

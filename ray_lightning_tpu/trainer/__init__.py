@@ -13,6 +13,8 @@ from ray_lightning_tpu.trainer.data import (
     DataLoader,
     Dataset,
     DistributedSampler,
+    TokenBinDataset,
+    write_token_bin,
 )
 from ray_lightning_tpu.trainer.loop import TrainerSpec, TrainingLoop
 from ray_lightning_tpu.trainer.module import DataModule, TPUModule
@@ -37,4 +39,6 @@ __all__ = [
     "Dataset",
     "ArrayDataset",
     "DistributedSampler",
+    "TokenBinDataset",
+    "write_token_bin",
 ]

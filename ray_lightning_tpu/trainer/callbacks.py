@@ -126,6 +126,15 @@ class ModelCheckpoint(Callback):
                 last = os.path.join(dirpath, "last")
                 trainer.save_checkpoint(last, sharded=True)
                 self.last_model_path = last
+            if self.monitor is not None and self.save_top_k >= 0:
+                # Pruning may delete the save just dispatched (worst
+                # score). EVERY rank must drain its async writes BEFORE
+                # rank 0 rmtree's — only rank 0 reaches _prune, so a
+                # drain there would leave ranks >0 writing into a
+                # deleted directory. (No-monitor mode prunes only the
+                # PREVIOUS save, which the next dispatch already
+                # finalized — full overlap is kept there.)
+                getattr(trainer, "finalize_checkpoints", lambda: None)()
             if trainer.global_rank != 0:
                 return
         else:
@@ -158,20 +167,16 @@ class ModelCheckpoint(Callback):
             self.last_model_path = last
 
     def _prune(self, trainer: Any = None) -> None:
+        # Deletion targets are always durable here: the monitored sharded
+        # path drains every rank's async writes in _save before rank 0
+        # gets this far.
         if self.save_top_k < 0:
             return
         reverse = self.mode == "max"
         self._saved.sort(key=lambda t: t[0], reverse=reverse)
-        drained = False
         while len(self._saved) > self.save_top_k:
             _, path = self._saved.pop()
             if path != self.best_model_path and os.path.exists(path):
-                if not drained and trainer is not None:
-                    # The worst-scoring checkpoint may be the save still in
-                    # flight (async IO); rmtree under a live tensorstore
-                    # write corrupts it and crashes the NEXT save's drain.
-                    getattr(trainer, "finalize_checkpoints", lambda: None)()
-                    drained = True
                 _remove_checkpoint(path)
 
     def state_dict(self) -> Dict[str, Any]:

@@ -51,6 +51,16 @@ class OrbaxCheckpointIO:
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(path)
+        # Unfinalize a reused path (rolling "last") for the whole write:
+        # orbax renames the new state tree into place atomically, so a
+        # crash between that rename and the meta rewrite would otherwise
+        # leave new state under the PREVIOUS save's meta — which resume
+        # logic would accept as finalized with off-by-one progress.
+        if is_rank_zero:
+            try:
+                os.remove(os.path.join(path, _META_FILE))
+            except OSError:
+                pass
         ckptr = ocp.StandardCheckpointer()
         try:
             ckptr.save(os.path.join(path, _STATE_SUBDIR), state, force=True)
@@ -158,11 +168,8 @@ class AsyncOrbaxCheckpointIO(OrbaxCheckpointIO):
 
         self.finalize()  # at most one save in flight
         path = os.path.abspath(path)
-        # A reused path (rolling "last") still holds the PREVIOUS save's
-        # meta marker; drop it before dispatching so a crash during the
-        # write window leaves an UNFINALIZED directory (old meta + new
-        # state would read as a finalized checkpoint with mismatched
-        # progress).
+        # Unfinalize the reused path for the (now epoch-long) write window;
+        # same reasoning as the sync save, bigger window.
         if is_rank_zero:
             try:
                 os.remove(os.path.join(path, _META_FILE))

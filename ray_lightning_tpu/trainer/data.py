@@ -15,6 +15,7 @@ the per-chip microbatch, matching the reference's per-worker semantics.
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
@@ -43,6 +44,72 @@ class ArrayDataset(Dataset):
     def __getitem__(self, idx):
         item = tuple(a[idx] for a in self.arrays)
         return item if len(item) > 1 else item[0]
+
+
+class TokenBinDataset(Dataset):
+    """Memory-mapped token corpus: a flat binary file of token ids.
+
+    The standard LLM-pretraining on-disk format (nanoGPT/llm.c style): one
+    file, fixed-width unsigned ints, no framing. Items are overlapping
+    ``seq_len + 1``-token windows (``stride`` tokens apart, default
+    non-overlapping), returned as int32 — the (input, shifted-target) pair
+    GPT-style modules train on. The map is opened lazily PER PROCESS and
+    dropped on pickle, so the dataset ships to worker actors as a path +
+    shape, and each worker pages only the windows it actually touches —
+    a 100 GB corpus costs no RAM up front on any host.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        dtype: str = "uint16",
+        stride: int = 0,
+    ) -> None:
+        self.path = path
+        self.seq_len = int(seq_len)
+        self.dtype = np.dtype(dtype)
+        self.stride = int(stride) or self.seq_len
+        n_tokens = os.path.getsize(path) // self.dtype.itemsize
+        self._len = max(0, (n_tokens - self.seq_len - 1) // self.stride + 1)
+        if self._len == 0:
+            raise ValueError(
+                f"{path}: {n_tokens} tokens < one {self.seq_len + 1}-token window"
+            )
+        self._mm: Optional[np.memmap] = None
+
+    def _map(self) -> np.memmap:
+        if self._mm is None:
+            self._mm = np.memmap(self.path, dtype=self.dtype, mode="r")
+        return self._mm
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        start = idx * self.stride
+        return np.asarray(
+            self._map()[start : start + self.seq_len + 1], dtype=np.int32
+        )
+
+    def __getstate__(self):
+        # The mmap handle is process-local; re-open lazily on the worker.
+        state = dict(self.__dict__)
+        state["_mm"] = None
+        return state
+
+
+def write_token_bin(path: str, tokens: Any, dtype: str = "uint16") -> str:
+    """Write a token id sequence as a TokenBinDataset-compatible flat file."""
+    arr = np.asarray(tokens)
+    dt = np.dtype(dtype)
+    info = np.iinfo(dt)
+    if arr.min() < info.min or arr.max() > info.max:
+        raise ValueError(
+            f"token ids [{arr.min()}, {arr.max()}] don't fit dtype {dtype}"
+        )
+    arr.astype(dt).ravel().tofile(path)
+    return path
 
 
 class DistributedSampler:

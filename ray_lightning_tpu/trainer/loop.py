@@ -56,6 +56,8 @@ class TrainerSpec:
     # Sharded (orbax) saves overlap tensorstore writes with the next epoch;
     # the finalization marker still gates restartability (checkpoint_io.py).
     async_checkpointing: bool = False
+    # Log the pre-clip global grad norm each step (in-graph reduction).
+    log_grad_norm: bool = False
     callbacks: List[Any] = field(default_factory=list)
 
 
@@ -450,7 +452,9 @@ class TrainingLoop:
         if self._train_loader is None:
             raise RuntimeError("fit requires train_dataloader()")
         self._init_state(ckpt_stream)
-        train_step = self.strategy.compile_train_step(self.module, self._tx)
+        train_step = self.strategy.compile_train_step(
+            self.module, self._tx, log_grad_norm=self.spec.log_grad_norm
+        )
         val_step = (
             self.strategy.compile_eval_step(self.module, "val")
             if self._val_loader is not None
@@ -583,10 +587,9 @@ class TrainingLoop:
         self.module.params = self.params
         self.module.on_fit_end()
         self._call_callbacks("on_fit_end")
-        if getattr(self, "_sharded_io", None) is not None:
-            # Drain any in-flight async save (collective: every rank) so
-            # the last checkpoint is finalized before workers exit.
-            self._sharded_io.finalize()
+        # Drain any in-flight async save (collective: every rank) so the
+        # last checkpoint is finalized before workers exit.
+        self.finalize_checkpoints()
         self.strategy.teardown_worker()
         return self._collect_rank_zero_results(results=None)
 

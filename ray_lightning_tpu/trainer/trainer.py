@@ -45,6 +45,7 @@ class Trainer:
         ema_decay: Optional[float] = None,
         eval_ema: bool = False,
         async_checkpointing: bool = False,
+        log_grad_norm: bool = False,
     ) -> None:
         self.max_epochs = max_epochs
         self.max_steps = max_steps
@@ -74,6 +75,7 @@ class Trainer:
         self.ema_decay = ema_decay
         self.eval_ema = bool(eval_ema)
         self.async_checkpointing = bool(async_checkpointing)
+        self.log_grad_norm = bool(log_grad_norm)
         if enable_checkpointing and not any(
             hasattr(cb, "best_model_path") for cb in self.callbacks
         ):
@@ -115,6 +117,7 @@ class Trainer:
             ema_decay=self.ema_decay,
             eval_ema=self.eval_ema,
             async_checkpointing=self.async_checkpointing,
+            log_grad_norm=self.log_grad_norm,
             callbacks=self.callbacks,
         )
 

@@ -80,3 +80,24 @@ def test_ring_train_end_to_end(start_fabric):
     # predict parity (reference test_horovod.py predict suite)
     preds = trainer.predict(module)
     assert preds and preds[0].shape[-1] == 2
+
+
+def test_ring_log_grad_norm(start_fabric):
+    """Ring strategy logs the post-allreduce global grad norm."""
+    import numpy as np
+
+    from ray_lightning_tpu.models import BoringModule
+    from ray_lightning_tpu.trainer import Trainer
+
+    start_fabric(num_cpus=4)
+    t = Trainer(
+        max_epochs=1,
+        strategy=RingTPUStrategy(num_workers=2, use_tpu=False),
+        enable_checkpointing=False,
+        num_sanity_val_steps=0,
+        seed=0,
+        log_grad_norm=True,
+    )
+    t.fit(BoringModule())
+    gn = t.callback_metrics.get("grad_norm")
+    assert gn is not None and np.isfinite(gn) and gn > 0

@@ -238,3 +238,38 @@ def test_sharded_ema(start_fabric):
     we = np.asarray(m.ema_params["w"])
     assert we.shape == w.shape and np.isfinite(we).all()
     assert not np.allclose(w, we)
+
+
+def test_async_monitored_prune_multirank(start_fabric, tmp_path):
+    """2-rank async sharded fit with a monitored, worsening metric: every
+    rank drains its in-flight writes before rank 0 prunes, so training
+    survives top-k deletion of the just-dispatched save."""
+    import os
+
+    import numpy as np
+
+    from ray_lightning_tpu.models import BoringModule
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    start_fabric(num_cpus=4)
+    m = BoringModule(lr=0.0)  # never improves -> every later save pruned
+    ck = ModelCheckpoint(
+        dirpath=str(tmp_path / "ck"),
+        save_sharded=True,
+        monitor="val_loss",
+        save_top_k=1,
+    )
+    t = Trainer(
+        max_epochs=3,
+        strategy=RayShardedStrategy(num_workers=2, use_tpu=False),
+        callbacks=[ck],
+        num_sanity_val_steps=0,
+        seed=0,
+        async_checkpointing=True,
+    )
+    t.fit(m)
+    assert t.state["status"] == "finished"
+    assert ck.best_model_path
+    assert os.path.exists(os.path.join(ck.best_model_path, "meta.ckpt"))
+    assert len(os.listdir(tmp_path / "ck")) == 1
+    assert np.isfinite(t.callback_metrics["val_loss"])
