@@ -42,6 +42,9 @@ class TrainerSpec:
     limit_val_batches: Optional[Any] = None
     num_sanity_val_steps: int = 2
     check_val_every_n_epoch: int = 1
+    # Mid-epoch validation (PTL semantics): int = every N train batches,
+    # float in (0, 1) = that fraction of an epoch. None = epoch end only.
+    val_check_interval: Optional[Any] = None
     accumulate_grad_batches: int = 1
     gradient_clip_val: Optional[float] = None
     log_every_n_steps: int = 50
@@ -516,6 +519,20 @@ class TrainingLoop:
             # overlapped with device compute.
             import itertools
 
+            # Mid-epoch validation cadence (PTL's val_check_interval):
+            # int = every N batches; float fraction = that share of the
+            # epoch's batches.
+            vci = self.spec.val_check_interval
+            if isinstance(vci, float) and vci == 1.0:
+                vci = None  # PTL: 1.0 == once per epoch (the default path)
+            elif vci is not None and 0 < float(vci) < 1:
+                vci = max(1, int(n_batches * float(vci)))
+            elif vci is not None:
+                vci = int(vci)
+            # Mid-epoch vals obey the same epoch cadence as epoch-end ones.
+            val_epoch = (epoch + 1) % self.spec.check_val_every_n_epoch == 0
+            last_val_step = -1
+
             staged = self.strategy.stage_batches(
                 itertools.islice(self._train_loader.iter_batches(mult), n_batches)
             )
@@ -542,6 +559,15 @@ class TrainingLoop:
                         self.logged_metrics.update(host_logs)
                         self._call_callbacks("on_train_batch_end", host_logs, batch_idx)
                     if (
+                        val_step is not None
+                        and vci
+                        and val_epoch
+                        and (batch_idx + 1) % vci == 0
+                    ):
+                        self._run_eval_epoch(val_step, self._val_loader, "val")
+                        self._call_callbacks("on_validation_end")
+                        last_val_step = self.global_step
+                    if (
                         self.spec.max_steps is not None
                         and self.global_step >= self.spec.max_steps
                     ):
@@ -556,7 +582,9 @@ class TrainingLoop:
             # last-batch-of-epoch semantic, so a max_steps stop that landed
             # ON the final batch still flushes, while an earlier stop must
             # not advance params past the requested step budget.
+            flushed = False
             if not stop or batch_idx == n_batches - 1:
+                flushed = self._mini_host > 0  # flush will change params
                 self._flush_accumulation()
 
             # One device->host fetch for the whole epoch's train metrics.
@@ -575,7 +603,11 @@ class TrainingLoop:
 
             if (
                 val_step is not None
-                and (epoch + 1) % self.spec.check_val_every_n_epoch == 0
+                and val_epoch
+                # A mid-epoch val that landed exactly on the final batch
+                # already validated these params — unless the accumulation
+                # flush just changed them.
+                and (last_val_step != self.global_step or flushed)
             ):
                 self._run_eval_epoch(val_step, self._val_loader, "val")
                 self._call_callbacks("on_validation_end")

@@ -34,6 +34,7 @@ class Trainer:
         limit_val_batches: Optional[Any] = None,
         num_sanity_val_steps: int = 2,
         check_val_every_n_epoch: int = 1,
+        val_check_interval: Optional[Any] = None,
         accumulate_grad_batches: int = 1,
         gradient_clip_val: Optional[float] = None,
         log_every_n_steps: int = 50,
@@ -55,6 +56,16 @@ class Trainer:
         self.limit_val_batches = limit_val_batches
         self.num_sanity_val_steps = num_sanity_val_steps
         self.check_val_every_n_epoch = check_val_every_n_epoch
+        if val_check_interval is not None:
+            v = float(val_check_interval)
+            is_float = isinstance(val_check_interval, float)
+            if v <= 0 or (is_float and v > 1) or (not is_float and v != int(v)):
+                raise ValueError(
+                    "val_check_interval must be a positive int (batches) or "
+                    "a float in (0, 1] (epoch fraction; 1.0 = epoch end), "
+                    f"got {val_check_interval!r}"
+                )
+        self.val_check_interval = val_check_interval
         self.accumulate_grad_batches = accumulate_grad_batches
         self.gradient_clip_val = gradient_clip_val
         self.log_every_n_steps = log_every_n_steps
@@ -107,6 +118,7 @@ class Trainer:
             limit_val_batches=self.limit_val_batches,
             num_sanity_val_steps=self.num_sanity_val_steps,
             check_val_every_n_epoch=self.check_val_every_n_epoch,
+            val_check_interval=self.val_check_interval,
             accumulate_grad_batches=self.accumulate_grad_batches,
             gradient_clip_val=self.gradient_clip_val,
             log_every_n_steps=self.log_every_n_steps,

@@ -922,3 +922,77 @@ def test_token_bin_dataset_roundtrip_and_fit(tmp_path):
     assert t.global_step > 0
     assert np.isfinite(t.callback_metrics["grad_norm"])
     assert t.callback_metrics["grad_norm"] > 0
+
+
+def test_val_check_interval():
+    """Mid-epoch validation: int = every N batches; the epoch-end val is
+    skipped only when an interval val already covered the final params."""
+    import numpy as np
+    import pytest
+
+    from ray_lightning_tpu.trainer import Callback, Trainer
+
+    class CountVal(Callback):
+        def __init__(self):
+            self.steps_at_val = []
+
+        def on_validation_end(self, trainer, module):
+            if not trainer.sanity_checking:
+                self.steps_at_val.append(trainer.global_step)
+
+    def run(n=96, **kw):
+        # 96 / (4 * 8 devices) = 3 batches per epoch
+        cb = CountVal()
+        m = _DetModule(batch_size=4, n=n)
+        t = Trainer(
+            max_epochs=2, enable_checkpointing=False, seed=0,
+            num_sanity_val_steps=0, callbacks=[cb], **kw,
+        )
+        t.fit(m)
+        return cb.steps_at_val
+
+    # Baseline: epoch-end only.
+    assert run() == [3, 6]
+    # Every batch: 3 per epoch, epoch-end dedup'd (batch 3 == epoch end).
+    assert run(val_check_interval=1) == [1, 2, 3, 4, 5, 6]
+    # Every 2 batches: mid-epoch at step 2/5, epoch end still runs.
+    assert run(val_check_interval=2) == [2, 3, 5, 6]
+    # Fraction: int(3 * 0.67) = 2 -> same as the every-2 cadence.
+    assert run(val_check_interval=0.67) == [2, 3, 5, 6]
+    # Tiny fraction clamps to every batch (max(1, int(3*0.1)=0)).
+    assert run(val_check_interval=0.1) == [1, 2, 3, 4, 5, 6]
+    # PTL: float 1.0 means once per epoch, NOT every batch.
+    assert run(val_check_interval=1.0) == [3, 6]
+    # Mid-epoch vals obey check_val_every_n_epoch (only epoch 2 here).
+    assert run(val_check_interval=1, check_val_every_n_epoch=2) == [4, 5, 6]
+
+    with pytest.raises(ValueError, match="val_check_interval"):
+        Trainer(val_check_interval=1.5)
+    with pytest.raises(ValueError, match="val_check_interval"):
+        Trainer(val_check_interval=0)
+
+
+def test_val_check_interval_flush_revalidates():
+    """A final-batch mid-epoch val does NOT suppress the epoch-end val when
+    the accumulation flush changes params right after it."""
+    from ray_lightning_tpu.trainer import Callback, Trainer
+
+    class CountVal(Callback):
+        def __init__(self):
+            self.steps_at_val = []
+
+        def on_validation_end(self, trainer, module):
+            if not trainer.sanity_checking:
+                self.steps_at_val.append(trainer.global_step)
+
+    cb = CountVal()
+    # 3 batches/epoch, K=2: batch 3 leaves a partial window -> flush.
+    m = _DetModule(batch_size=4, n=96)
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, accumulate_grad_batches=2,
+        val_check_interval=3, callbacks=[cb],
+    )
+    t.fit(m)
+    # Interval val at step 3 (pre-flush) AND epoch-end val (post-flush).
+    assert cb.steps_at_val == [3, 3]
