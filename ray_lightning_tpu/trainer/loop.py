@@ -40,6 +40,8 @@ class TrainerSpec:
     max_steps: Optional[int] = None
     limit_train_batches: Optional[Any] = None  # int or float fraction
     limit_val_batches: Optional[Any] = None
+    limit_test_batches: Optional[Any] = None
+    limit_predict_batches: Optional[Any] = None
     num_sanity_val_steps: int = 2
     check_val_every_n_epoch: int = 1
     # Mid-epoch validation (PTL semantics): int = every N train batches,
@@ -374,7 +376,11 @@ class TrainingLoop:
         )
 
     def _restore_progress(self, state: Dict[str, Any]) -> None:
-        self.current_epoch = int(state.get("epoch", -1)) + 1
+        # A checkpoint saved mid-epoch (val_check_interval save, or a
+        # max_steps/should_stop break) resumes by re-running that epoch —
+        # re-trained batches beat silently skipping the epoch's remainder.
+        bump = 0 if state.get("mid_epoch") else 1
+        self.current_epoch = int(state.get("epoch", -1)) + bump
         self.global_step = int(state.get("global_step", 0))
         for cb in self.callbacks:
             cb_state = state.get("callbacks", {}).get(type(cb).__name__)
@@ -397,6 +403,7 @@ class TrainingLoop:
 
             meta = {
                 "epoch": self.current_epoch,
+                "mid_epoch": not getattr(self, "_epoch_complete", True),
                 "global_step": self.global_step,
                 "callbacks": {
                     type(cb).__name__: cb.state_dict() for cb in self.callbacks
@@ -440,6 +447,7 @@ class TrainingLoop:
             "params": self.strategy.gather_state(self.params),
             "opt_state": self.strategy.gather_state(self.opt_state),
             "epoch": self.current_epoch,
+            "mid_epoch": not getattr(self, "_epoch_complete", True),
             "global_step": self.global_step,
             "callbacks": {
                 type(cb).__name__: cb.state_dict() for cb in self.callbacks
@@ -506,6 +514,9 @@ class TrainingLoop:
             if stop or self.should_stop:
                 break
             self.current_epoch = epoch
+            self._epoch_complete = False  # checkpoints saved mid-epoch
+            # (val_check_interval) must resume by RE-RUNNING this epoch,
+            # not skipping its remaining batches.
             self._train_loader.set_epoch(epoch)
             self.module.on_train_epoch_start(epoch)
             self._call_callbacks("on_train_epoch_start")
@@ -529,6 +540,12 @@ class TrainingLoop:
                 vci = max(1, int(n_batches * float(vci)))
             elif vci is not None:
                 vci = int(vci)
+                if vci > n_batches > 0:
+                    raise ValueError(
+                        f"val_check_interval ({vci}) exceeds the number of "
+                        f"training batches per epoch ({n_batches}); use a "
+                        "smaller interval or a float epoch fraction"
+                    )
             # Mid-epoch vals obey the same epoch cadence as epoch-end ones.
             val_epoch = (epoch + 1) % self.spec.check_val_every_n_epoch == 0
             last_val_step = -1
@@ -564,13 +581,21 @@ class TrainingLoop:
                         and val_epoch
                         and (batch_idx + 1) % vci == 0
                     ):
+                        if batch_idx == n_batches - 1 and self._mini_host == 0:
+                            # Final batch, nothing left to flush: any
+                            # checkpoint this val writes is epoch-complete.
+                            self._epoch_complete = True
                         self._run_eval_epoch(val_step, self._val_loader, "val")
                         self._call_callbacks("on_validation_end")
                         last_val_step = self.global_step
                     if (
                         self.spec.max_steps is not None
                         and self.global_step >= self.spec.max_steps
-                    ):
+                    ) or self.should_stop:
+                        # should_stop: a mid-epoch val's EarlyStopping must
+                        # end training NOW, not at the epoch boundary —
+                        # stopping inside very long epochs is the point of
+                        # val_check_interval.
                         stop = True
                         break
             finally:
@@ -586,6 +611,7 @@ class TrainingLoop:
             if not stop or batch_idx == n_batches - 1:
                 flushed = self._mini_host > 0  # flush will change params
                 self._flush_accumulation()
+                self._epoch_complete = True
 
             # One device->host fetch for the whole epoch's train metrics.
             if epoch_logs:
@@ -674,7 +700,12 @@ class TrainingLoop:
         import jax
 
         mult = self.strategy.batch_multiplier
-        n_batches = _limit(loader.num_batches(mult), self.spec.limit_val_batches)
+        limit = (
+            self.spec.limit_test_batches
+            if prefix == "test"
+            else self.spec.limit_val_batches
+        )
+        n_batches = _limit(loader.num_batches(mult), limit)
         if max_batches is not None:
             n_batches = min(n_batches, max_batches)
         # Each step returns (per-key masked sums, real-sample count) — device
@@ -758,10 +789,17 @@ class TrainingLoop:
         predict_step = self.strategy.compile_eval_step(self.module, "predict")
         import jax
 
+        import itertools
+
         mult = self.strategy.batch_multiplier
+        n_batches = _limit(
+            loader.num_batches(mult), self.spec.limit_predict_batches
+        )
         preds = []
         eval_params = self._eval_params()
-        for host_batch, host_mask in loader.iter_batches(mult, with_mask=True):
+        for host_batch, host_mask in itertools.islice(
+            loader.iter_batches(mult, with_mask=True), n_batches
+        ):
             batch = self.strategy.make_global_batch(host_batch)
             gmask = self.strategy.make_global_batch(host_mask)
             out, mask = jax.device_get(predict_step(eval_params, batch, gmask))

@@ -32,6 +32,8 @@ class Trainer:
         callbacks: Optional[List[Any]] = None,
         limit_train_batches: Optional[Any] = None,
         limit_val_batches: Optional[Any] = None,
+        limit_test_batches: Optional[Any] = None,
+        limit_predict_batches: Optional[Any] = None,
         num_sanity_val_steps: int = 2,
         check_val_every_n_epoch: int = 1,
         val_check_interval: Optional[Any] = None,
@@ -54,12 +56,21 @@ class Trainer:
         self.callbacks = list(callbacks or [])
         self.limit_train_batches = limit_train_batches
         self.limit_val_batches = limit_val_batches
+        self.limit_test_batches = limit_test_batches
+        self.limit_predict_batches = limit_predict_batches
         self.num_sanity_val_steps = num_sanity_val_steps
         self.check_val_every_n_epoch = check_val_every_n_epoch
         if val_check_interval is not None:
+            import math
+
             v = float(val_check_interval)
             is_float = isinstance(val_check_interval, float)
-            if v <= 0 or (is_float and v > 1) or (not is_float and v != int(v)):
+            if (
+                not math.isfinite(v)
+                or v <= 0
+                or (is_float and v > 1)
+                or (not is_float and v != int(v))
+            ):
                 raise ValueError(
                     "val_check_interval must be a positive int (batches) or "
                     "a float in (0, 1] (epoch fraction; 1.0 = epoch end), "
@@ -116,6 +127,8 @@ class Trainer:
             max_steps=self.max_steps,
             limit_train_batches=self.limit_train_batches,
             limit_val_batches=self.limit_val_batches,
+            limit_test_batches=self.limit_test_batches,
+            limit_predict_batches=self.limit_predict_batches,
             num_sanity_val_steps=self.num_sanity_val_steps,
             check_val_every_n_epoch=self.check_val_every_n_epoch,
             val_check_interval=self.val_check_interval,
@@ -187,6 +200,8 @@ class Trainer:
         self._module = module
         self._lr_sched_cache: Any = False  # re-unpack for the new module
         module.trainer = self
+        if ckpt_path == "last":
+            ckpt_path = self._resolve_last_ckpt()
         if ckpt_stream is None:
             ckpt_stream = self._read_ckpt(ckpt_path)
         if self.strategy is None or isinstance(self.strategy, SingleDeviceStrategy):
@@ -274,59 +289,89 @@ class Trainer:
                 else:
                     ckpt_data = None  # fall back to original ckpt_path
 
+    def _ckpt_search_dirs(self) -> List[str]:
+        cb = self.checkpoint_callback
+        dirs = []
+        if cb is not None and getattr(cb, "dirpath", None):
+            dirs.append(cb.dirpath)
+        dirs.append(os.path.join(self.default_root_dir, "checkpoints"))
+        return dirs
+
+    @staticmethod
+    def _ckpt_candidates(d: str) -> List[Tuple[str, float]]:
+        """(path, mtime) checkpoint candidates in a directory; entries that
+        vanish between listdir and stat (a concurrent prune) are skipped
+        rather than crashing the scan."""
+        from ray_lightning_tpu.trainer.checkpoint_io import (
+            is_sharded_checkpoint,
+        )
+
+        out = []
+        if not os.path.isdir(d):
+            return out
+        for name in os.listdir(d):
+            p = os.path.join(d, name)
+            if not (name.endswith(".ckpt") or is_sharded_checkpoint(p)):
+                continue
+            try:
+                out.append((p, os.path.getmtime(p)))
+            except OSError:
+                continue
+        return out
+
+    def _resolve_last_ckpt(self) -> str:
+        """Resolve ``ckpt_path="last"`` (PTL convention): the checkpoint
+        callback's rolling last path, else the newest LOADABLE checkpoint
+        in its dir / the default checkpoints dir (an unfinalized dir left
+        by a crashed async save falls through to the next newest)."""
+        cb = self.checkpoint_callback
+        if cb is not None and getattr(cb, "last_model_path", ""):
+            return cb.last_model_path
+        path, _ = self._validated_ckpt_scan(min_mtime=None)
+        if path is None:
+            raise FileNotFoundError(
+                "ckpt_path='last': no loadable checkpoint found in "
+                f"{self._ckpt_search_dirs()} (fit with checkpointing "
+                "enabled first)"
+            )
+        return path
+
     def _restart_checkpoint(
         self, fit_started: float
     ) -> Tuple[Optional[str], Optional[Any]]:
         """Newest LOADABLE checkpoint written by THIS fit (mtime after the
         fit started — a shared checkpoint dir may hold files from earlier,
-        unrelated runs whose param trees don't match). Prefers the rolling
-        ``last`` checkpoint; a candidate that fails validation (e.g. the
-        save in flight when the worker died, or a sharded dir missing its
-        finalizing meta file) falls through to the next newest instead of
-        aborting the restart. Returns ``(path, read_payload)`` so the
-        retry does not read + unpickle the checkpoint a second time."""
-        from ray_lightning_tpu.trainer.checkpoint_io import (
-            _META_FILE,
-            is_sharded_checkpoint,
-        )
+        unrelated runs whose param trees don't match)."""
+        return self._validated_ckpt_scan(min_mtime=fit_started - 1.0)
 
-        cb = self.checkpoint_callback
-        search_dirs = []
-        if cb is not None and getattr(cb, "dirpath", None):
-            search_dirs.append(cb.dirpath)
-        search_dirs.append(os.path.join(self.default_root_dir, "checkpoints"))
-        def _mtime(p: str) -> float:
-            # A concurrent run may prune files between listdir and stat;
-            # treat vanished paths as too old rather than crashing the
-            # restart this scan exists to enable.
-            try:
-                return os.path.getmtime(p)
-            except OSError:
-                return -1.0
+    def _validated_ckpt_scan(
+        self, min_mtime: Optional[float]
+    ) -> Tuple[Optional[str], Optional[Any]]:
+        """Newest loadable checkpoint across the search dirs. Prefers the
+        rolling ``last`` checkpoint; a candidate that fails validation
+        (e.g. a save in flight when a worker died, or a sharded dir
+        missing its finalizing meta file) falls through to the next newest
+        instead of aborting. Returns ``(path, read_payload)`` so callers
+        don't read + unpickle a second time."""
+        from ray_lightning_tpu.trainer.checkpoint_io import _META_FILE
 
-        for d in search_dirs:
-            if not os.path.isdir(d):
-                continue
+        for d in self._ckpt_search_dirs():
             candidates = [
-                p
-                for name in os.listdir(d)
-                for p in [os.path.join(d, name)]
-                if (
-                    name.endswith(".ckpt")
-                    or is_sharded_checkpoint(p)
-                )
-                and _mtime(p) >= fit_started - 1.0
+                (p, m)
+                for p, m in self._ckpt_candidates(d)
+                if min_mtime is None or m >= min_mtime
             ]
             if not candidates:
                 continue
             last = [
-                p for p in candidates if os.path.basename(p).startswith("last")
+                pm
+                for pm in candidates
+                if os.path.basename(pm[0]).startswith("last")
             ]
-            ordered = sorted(last, key=_mtime, reverse=True) + sorted(
-                [p for p in candidates if p not in last],
-                key=_mtime,
-                reverse=True,
-            )
+            rest = [pm for pm in candidates if pm not in last]
+            newest_first = sorted(last, key=lambda t: t[1], reverse=True)
+            newest_first += sorted(rest, key=lambda t: t[1], reverse=True)
+            ordered = [p for p, _ in newest_first]
             for path in ordered:
                 try:
                     data = self._read_ckpt(path)

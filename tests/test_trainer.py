@@ -996,3 +996,125 @@ def test_val_check_interval_flush_revalidates():
     t.fit(m)
     # Interval val at step 3 (pre-flush) AND epoch-end val (post-flush).
     assert cb.steps_at_val == [3, 3]
+
+
+def test_ckpt_path_last_and_stage_limits(tmp_path):
+    """ckpt_path='last' resolves the rolling/newest checkpoint; test and
+    predict honor their own batch limits."""
+    import numpy as np
+    import pytest
+
+    from ray_lightning_tpu.models import BoringModule
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    m = BoringModule()
+    ck = ModelCheckpoint(dirpath=str(tmp_path), save_last=True)
+    t = Trainer(
+        max_epochs=2, enable_checkpointing=True, callbacks=[ck], seed=0,
+        num_sanity_val_steps=0,
+    )
+    t.fit(m)
+
+    m2 = BoringModule()
+    t2 = Trainer(
+        max_epochs=3, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+        callbacks=[ModelCheckpoint(dirpath=str(tmp_path), save_top_k=0)],
+    )
+    t2.fit(m2, ckpt_path="last")
+    assert t2.current_epoch == 2  # resumed at epoch 2 of 3
+    np.testing.assert_array_equal(
+        np.asarray(m2.params["w"]).shape, np.asarray(m.params["w"]).shape
+    )
+
+    with pytest.raises(FileNotFoundError, match="last"):
+        Trainer(
+            max_epochs=1, enable_checkpointing=False, seed=0,
+            num_sanity_val_steps=0,
+            default_root_dir=str(tmp_path / "empty"),
+        ).fit(BoringModule(), ckpt_path="last")
+
+    # Stage limits: 64 samples / batch 2 / 8 devices = 4 batches total.
+    t3 = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+        limit_test_batches=2,
+        limit_predict_batches=1,
+    )
+    m3 = BoringModule()
+    t3.fit(m3)
+    t3.test(m3)  # runs (bounded); metrics finite
+    preds = t3.predict(m3)
+    # 1 global batch x (2 per-chip x 8 devices) = 16 rows
+    assert sum(len(p) for p in preds) == 16
+
+
+def test_val_check_interval_early_stop_mid_epoch():
+    """EarlyStopping triggered by a mid-epoch val ends training inside the
+    epoch (the point of val_check_interval on very long epochs)."""
+    import pytest
+
+    from ray_lightning_tpu.trainer import EarlyStopping, Trainer
+
+    es = EarlyStopping(monitor="val_loss", patience=0)
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, val_check_interval=2, callbacks=[es],
+    )
+    # Frozen model (lr 0): val_loss never improves, so patience=0 trips
+    # on the second mid-epoch val.
+    m_frozen = _DetModule(batch_size=4, n=512)  # 16 batches/epoch
+    m_frozen.configure_optimizers = lambda: __import__("optax").sgd(0.0)
+    t.fit(m_frozen)
+    # Stopped after the patience ran out mid-epoch, well before 16 steps.
+    assert t.global_step < 16, t.global_step
+
+    with pytest.raises(ValueError, match="exceeds"):
+        Trainer(
+            max_epochs=1, enable_checkpointing=False, seed=0,
+            num_sanity_val_steps=0, val_check_interval=99,
+        ).fit(_DetModule(batch_size=4, n=96))
+
+    with pytest.raises(ValueError, match="val_check_interval"):
+        Trainer(val_check_interval=float("nan"))
+
+
+def test_mid_epoch_checkpoint_reruns_epoch(tmp_path):
+    """A checkpoint written by a mid-epoch val resumes by RE-RUNNING that
+    epoch (never skipping its remaining batches)."""
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    # 3 batches/epoch; interval val at batch 1 saves mid-epoch.
+    m = _DetModule(batch_size=4, n=96)
+    ck = ModelCheckpoint(
+        dirpath=str(tmp_path), monitor="val_loss", save_top_k=-1
+    )
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=True, callbacks=[ck], seed=0,
+        num_sanity_val_steps=0, val_check_interval=1,
+    )
+    t.fit(m)
+    # Saves at steps 1, 2, 3 (epoch end). The step-1 checkpoint is
+    # mid-epoch: resuming from it re-runs epoch 0.
+    mid = sorted(
+        p for p in os.listdir(tmp_path) if p.endswith("step=1.ckpt")
+    )
+    assert mid, os.listdir(tmp_path)
+    m2 = _DetModule(batch_size=4, n=96)
+    t2 = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    t2.fit(m2, ckpt_path=str(tmp_path / mid[0]))
+    assert t2.current_epoch == 0  # re-ran epoch 0, did not skip to "done"
+    assert t2.global_step == 1 + 3  # restored step + full epoch re-run
+
+    # The epoch-END checkpoint still resumes at the next epoch.
+    end = [p for p in os.listdir(tmp_path) if p.endswith("step=3.ckpt")]
+    m3 = _DetModule(batch_size=4, n=96)
+    t3 = Trainer(
+        max_epochs=2, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    t3.fit(m3, ckpt_path=str(tmp_path / end[0]))
+    assert t3.current_epoch == 1 and t3.global_step == 6
