@@ -392,7 +392,30 @@ def gpt_forward(
         zz_perm_np = zigzag_permutation(S, mesh.shape[seq_axis])
         zz_perm = jnp.asarray(zz_perm_np)
         zz_inv = jnp.asarray(inverse_permutation(zz_perm_np))
-        x = params["wte"][tokens[:, zz_perm]]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch_axes = tuple(
+            ax for ax in ("data", "fsdp") if mesh.shape.get(ax, 1) > 1
+        )
+        # Pin the PERMUTED INDICES to batch x seq sharding so the embedding
+        # gather lands already sharded the way the blocks want it; letting
+        # the partitioner pick a sharding for the gather output and then
+        # reshard triggers "involuntary full rematerialization" (the gather
+        # result gets replicated on every seq rank first).
+        toks_z = jax.lax.with_sharding_constraint(
+            tokens[:, zz_perm],
+            NamedSharding(mesh, P(batch_axes or None, seq_axis)),
+        )
+        # Explicitly all-gather the (vocab/embed-sharded) table before the
+        # lookup: a gather FROM a sharded table into a seq-sharded output
+        # has no efficient SPMD lowering (the partitioner falls back to
+        # "involuntary full rematerialization"); from a replicated table
+        # it's a clean shard-local gather. The all-gather happens either
+        # way — this just routes it through the cheap path.
+        wte_rep = jax.lax.with_sharding_constraint(
+            params["wte"], NamedSharding(mesh, P(None, None))
+        )
+        x = wte_rep[toks_z]
         if cfg.pos_embed == "learned":
             x = x + params["wpe"][zz_perm]
         x = _seq_sharded(x)

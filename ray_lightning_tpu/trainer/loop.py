@@ -232,6 +232,27 @@ class TrainingLoop:
             self._update_count = int(
                 np.asarray(jax.device_get(self.opt_state.gradient_step))
             )
+            if getattr(self, "_resumed_mid_epoch", False) and self._mini_host:
+                # Mid-epoch resume re-runs the epoch from batch 0: keeping
+                # the restored partial window would accumulate those
+                # batches' gradients a second time into the same update.
+                import jax.numpy as jnp
+                import optax
+
+                ms = self.opt_state
+                self.opt_state = self.strategy.place_opt_state(
+                    optax.MultiStepsState(
+                        mini_step=jnp.zeros_like(ms.mini_step),
+                        gradient_step=ms.gradient_step,
+                        inner_opt_state=ms.inner_opt_state,
+                        acc_grads=jax.tree_util.tree_map(
+                            jnp.zeros_like, ms.acc_grads
+                        ),
+                        skip_state=ms.skip_state,
+                    ),
+                    params,
+                )
+                self._mini_host = 0
         if self.spec.ema_decay:
             # A restored EMA sum only continues correctly under the decay
             # it was accumulated with (stored in the state).
@@ -380,6 +401,7 @@ class TrainingLoop:
         # max_steps/should_stop break) resumes by re-running that epoch —
         # re-trained batches beat silently skipping the epoch's remainder.
         bump = 0 if state.get("mid_epoch") else 1
+        self._resumed_mid_epoch = bool(state.get("mid_epoch"))
         self.current_epoch = int(state.get("epoch", -1)) + bump
         self.global_step = int(state.get("global_step", 0))
         for cb in self.callbacks:
@@ -634,6 +656,10 @@ class TrainingLoop:
                 # already validated these params — unless the accumulation
                 # flush just changed them.
                 and (last_val_step != self.global_step or flushed)
+                # A callback-requested stop means stop NOW — don't pay a
+                # final val epoch on the way out (max_steps stops keep it:
+                # the budgeted run still wants its terminal metrics).
+                and not self.should_stop
             ):
                 self._run_eval_epoch(val_step, self._val_loader, "val")
                 self._call_callbacks("on_validation_end")

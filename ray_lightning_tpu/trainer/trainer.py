@@ -325,8 +325,9 @@ class Trainer:
         in its dir / the default checkpoints dir (an unfinalized dir left
         by a crashed async save falls through to the next newest)."""
         cb = self.checkpoint_callback
-        if cb is not None and getattr(cb, "last_model_path", ""):
-            return cb.last_model_path
+        last = getattr(cb, "last_model_path", "") if cb is not None else ""
+        if last and os.path.exists(last):
+            return last  # may be stale (restored from another run's dir)
         path, _ = self._validated_ckpt_scan(min_mtime=None)
         if path is None:
             raise FileNotFoundError(

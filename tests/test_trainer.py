@@ -1118,3 +1118,43 @@ def test_mid_epoch_checkpoint_reruns_epoch(tmp_path):
     )
     t3.fit(m3, ckpt_path=str(tmp_path / end[0]))
     assert t3.current_epoch == 1 and t3.global_step == 6
+
+
+def test_mid_epoch_resume_resets_accumulation_window(tmp_path):
+    """Resuming a mid-epoch checkpoint re-runs the epoch from batch 0, so
+    the restored partial accumulation window must be cleared — keeping it
+    shifts the window phase (and with non-deterministic data would
+    double-count gradients)."""
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    common = dict(
+        max_epochs=1, seed=0, num_sanity_val_steps=0,
+        accumulate_grad_batches=2,
+    )
+    # Straight run: 3 batches -> window {b1,b2} updates, b3 flushes.
+    m_ref = _DetModule(batch_size=4, n=96)
+    Trainer(enable_checkpointing=False, **common).fit(m_ref)
+
+    # Save mid-epoch at batch 1 (mini_step=1 pending in opt_state).
+    m1 = _DetModule(batch_size=4, n=96)
+    ck = ModelCheckpoint(
+        dirpath=str(tmp_path), monitor="val_loss", save_top_k=-1
+    )
+    Trainer(
+        enable_checkpointing=True, callbacks=[ck], val_check_interval=1,
+        **common,
+    ).fit(m1)
+    mid = [p for p in os.listdir(tmp_path) if p.endswith("step=1.ckpt")]
+    assert mid
+
+    # Resume: re-runs the epoch from init params; with the window cleared
+    # the result is identical to the straight run.
+    m2 = _DetModule(batch_size=4, n=96)
+    Trainer(enable_checkpointing=False, **common).fit(
+        m2, ckpt_path=str(tmp_path / mid[0])
+    )
+    np.testing.assert_allclose(
+        np.asarray(m2.params["w"]), np.asarray(m_ref.params["w"]), atol=0
+    )
