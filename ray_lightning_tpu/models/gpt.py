@@ -48,6 +48,10 @@ class GPTConfig:
     compute_dtype: str = "float32"  # "bfloat16" for TPU runs
     remat: bool = False
     attn_impl: str = "flash"  # "flash" | "reference"
+    # Sliding-window (local) attention: W > 0 limits each query to its W
+    # most recent positions (Mistral-style). Single-program attention only
+    # (flash/reference); not composed with ring/zigzag sequence parallelism.
+    attn_window: int = 0
     # Grouped-query attention: 0 -> n_head (MHA); 1 -> MQA. K/V projections
     # and the decode cache carry n_kv_head heads (cache shrinks by
     # n_head/n_kv_head); queries group onto them.
@@ -433,6 +437,11 @@ def gpt_forward(
     )
 
     def attend(q, k, v):
+        if cfg.attn_window and use_ring:
+            raise NotImplementedError(
+                "attn_window is not supported with sequence parallelism "
+                "(ring/zigzag); drop the seq mesh axis or the window"
+            )
         if use_zigzag:
             from ray_lightning_tpu.ops.zigzag_attention import (
                 zigzag_self_attention_zlayout,
@@ -444,8 +453,12 @@ def gpt_forward(
         if use_ring:
             return ring_self_attention(q, k, v, mesh, axis_name=seq_axis)
         if cfg.attn_impl == "flash":
-            return flash_attention(q, k, v, causal=True)
-        return attention_reference(q, k, v, causal=True)
+            return flash_attention(
+                q, k, v, causal=True, window=cfg.attn_window
+            )
+        return attention_reference(
+            q, k, v, causal=True, window=cfg.attn_window
+        )
 
     def mlp(h: jax.Array, lp: Dict[str, jax.Array]) -> Tuple[jax.Array, jax.Array]:
         m = _layernorm(h, lp["ln2_g"], lp["ln2_b"])
@@ -694,8 +707,11 @@ def gpt_generate(
                 qg * (1.0 / np.sqrt(hd)),
                 kc_l.astype(jnp.float32),
             )
+            from ray_lightning_tpu.ops.attention import band_allowed
+
+            pos_ids = jnp.arange(total)[None, None, None]
             s = jnp.where(
-                jnp.arange(total)[None, None, None] <= t, s, float("-inf")
+                band_allowed(t, pos_ids, cfg.attn_window), s, float("-inf")
             )
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum(

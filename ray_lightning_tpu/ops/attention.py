@@ -13,15 +13,31 @@ import jax
 import jax.numpy as jnp
 
 
+def band_allowed(row: jax.Array, col: jax.Array, window: int = 0) -> jax.Array:
+    """The causal (+optional sliding-window) band predicate on position
+    index arrays: key ``col`` is visible to query ``row`` iff
+    ``col <= row`` and, with ``window=W > 0``, ``col > row - W``. Single
+    source of truth shared by the reference mask, the flash kernels, and
+    the decode mask."""
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    allowed = col <= row
+    if window:
+        allowed = allowed & (col > row - window)
+    return allowed
+
+
 def causal_mask_allowed(
-    sq: int, sk: int, row_offset: int = 0, col_offset: int = 0
+    sq: int, sk: int, row_offset: int = 0, col_offset: int = 0, window: int = 0
 ) -> jax.Array:
     """Bool (sq, sk) matrix, True where attention is allowed.
 
     With no offsets the diagonal is aligned to the *end* of the key sequence
     (decode-style Sq < Sk: queries are the last Sq positions). Ring/blockwise
-    callers pass global row/col offsets instead. Single source of truth for
-    masking semantics across the reference, flash backward, and ring paths.
+    callers pass global row/col offsets instead. ``window=W > 0`` restricts
+    each query to its W most recent positions (itself included) —
+    sliding-window/local attention. Single source of truth for masking
+    semantics across the reference, flash backward, and ring paths.
     """
     if (
         isinstance(row_offset, int)
@@ -32,7 +48,7 @@ def causal_mask_allowed(
         row_offset = sk - sq
     row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + row_offset
     col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1) + col_offset
-    return col <= row
+    return band_allowed(row, col, window)
 
 
 def attention_reference(
@@ -41,8 +57,9 @@ def attention_reference(
     v: jax.Array,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: int = 0,
 ) -> jax.Array:
-    """softmax(q k^T / sqrt(d)) v with optional causal mask.
+    """softmax(q k^T / sqrt(d)) v with optional causal (+sliding-window) mask.
 
     Shapes: q (B, Sq, H, D); k, v (B, Sk, H, D) -> (B, Sq, H, D).
     Softmax statistics are computed in float32 regardless of input dtype
@@ -50,11 +67,17 @@ def attention_reference(
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if window and not causal:
+        raise ValueError("window attention requires causal=True")
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * sm_scale
     if causal:
-        s = jnp.where(causal_mask_allowed(q.shape[1], k.shape[1]), s, -jnp.inf)
+        s = jnp.where(
+            causal_mask_allowed(q.shape[1], k.shape[1], window=window),
+            s,
+            -jnp.inf,
+        )
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum(
         "bhqk,bkhd->bqhd", p.astype(v.dtype), v

@@ -21,13 +21,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ray_lightning_tpu.ops.attention import attention_reference
+from ray_lightning_tpu.ops.attention import attention_reference, band_allowed
 
 _NEG_INF = float("-inf")
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float
+    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    *, block_k: int, causal: bool, sm_scale: float, window: int,
 ):
     # Block shapes: q (1, block_q, d); k, v (1, Sk, d); o like q;
     # lse (1, block_q, 8) — the stats row is padded to 8 lanes because TPU
@@ -45,6 +46,12 @@ def _fwd_kernel(
         num_kb = jax.lax.div(q_offset + block_q + block_k - 1, block_k)
     else:
         num_kb = seq_k // block_k
+    if window:
+        # Sliding window: key blocks entirely below row_max - window + 1
+        # contribute nothing for ANY row in this q block.
+        first_kb = jnp.maximum(0, q_offset - window + 1) // block_k
+    else:
+        first_kb = 0
 
     def body(i, carry):
         m_prev, l_prev, acc_prev = carry
@@ -60,11 +67,16 @@ def _fwd_kernel(
             col = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(col <= row, s, _NEG_INF)
+            s = jnp.where(band_allowed(row, col, window), s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # -inf - -inf = nan: a row can be FULLY masked in a visited block
+        # when a sliding window is narrower than the block (its stats are
+        # still the init values then, so 0 is the correct contribution).
+        alpha = jnp.where(
+            m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_new)
+        )
+        p = jnp.where(s == _NEG_INF, 0.0, jnp.exp(s - m_new))
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc_prev * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -76,7 +88,7 @@ def _fwd_kernel(
         jnp.zeros((block_q, 1), jnp.float32),
         jnp.zeros((block_q, head_dim), jnp.float32),
     )
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, init)
+    m, l, acc = jax.lax.fori_loop(first_kb, num_kb, body, init)
     # Rows with no unmasked keys (can't happen for causal self-attention with
     # aligned blocks, but keep the kernel total) produce l=0 -> output 0.
     l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -94,6 +106,7 @@ def _flash_fwd(
     block_q: int,
     block_k: int,
     interpret: bool,
+    window: int = 0,
 ):
     """Run the kernel on (B, S, H, D) inputs; returns (out, lse)."""
     batch, seq_q, heads, head_dim = q.shape
@@ -114,7 +127,11 @@ def _flash_fwd(
 
     grid = (batch * heads, seq_q // block_q)
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
+        _fwd_kernel,
+        block_k=block_k,
+        causal=causal,
+        sm_scale=sm_scale,
+        window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -139,20 +156,24 @@ def _flash_fwd(
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+    out, _ = _flash_fwd(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window
+    )
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+    out, lse = _flash_fwd(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window
+    )
     return out, (q, k, v, out, lse)
 
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, causal: bool, sm_scale: float,
+    *, block_q: int, causal: bool, sm_scale: float, window: int,
 ):
     """One (batch*head, k-block) cell: accumulate dk/dv over q blocks.
 
@@ -165,6 +186,12 @@ def _dkv_kernel(
     v = v_ref[0].astype(jnp.float32)
     k_offset = ik * block_k
     start_qb = k_offset // block_q if causal else 0
+    end_qb = seq_q // block_q
+    if window:
+        # Rows beyond col_max + window - 1 can't see any key in this block.
+        end_qb = jnp.minimum(
+            end_qb, (k_offset + block_k - 1 + window - 1) // block_q + 1
+        )
 
     def body(i, carry):
         dk, dv = carry
@@ -182,7 +209,7 @@ def _dkv_kernel(
             col = k_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(col <= row, s, _NEG_INF)
+            s = jnp.where(band_allowed(row, col, window), s, _NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk), rows of the full P sum to 1
         dv2 = dv + jax.lax.dot_general(
             p, dos, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -200,14 +227,14 @@ def _dkv_kernel(
         jnp.zeros((block_k, k.shape[1]), jnp.float32),
         jnp.zeros((block_k, v.shape[1]), jnp.float32),
     )
-    dk, dv = jax.lax.fori_loop(start_qb, seq_q // block_q, body, init)
+    dk, dv = jax.lax.fori_loop(start_qb, end_qb, body, init)
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k: int, causal: bool, sm_scale: float,
+    *, block_k: int, causal: bool, sm_scale: float, window: int,
 ):
     """One (batch*head, q-block) cell: accumulate dq over k blocks."""
     block_q = q_ref.shape[1]
@@ -222,6 +249,9 @@ def _dq_kernel(
         num_kb = jax.lax.div(q_offset + block_q + block_k - 1, block_k)
     else:
         num_kb = seq_k // block_k
+    first_kb = (
+        jnp.maximum(0, q_offset - window + 1) // block_k if window else 0
+    )
 
     def body(i, dq):
         ks = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
@@ -236,7 +266,7 @@ def _dq_kernel(
             col = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(col <= row, s, _NEG_INF)
+            s = jnp.where(band_allowed(row, col, window), s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -247,12 +277,12 @@ def _dq_kernel(
         )
 
     dq = jax.lax.fori_loop(
-        0, num_kb, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
+        first_kb, num_kb, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
     )
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, do):
     """Flash-attention backward: two Pallas kernels over recomputed score
     blocks (never the full (Sq, Sk) matrix). delta = rowsum(do * o) is the
     softmax-jacobian correction term."""
@@ -276,7 +306,11 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=bq, causal=causal, sm_scale=sm_scale
+            _dkv_kernel,
+            block_q=bq,
+            causal=causal,
+            sm_scale=sm_scale,
+            window=window,
         ),
         grid=(batch * heads, seq_k // bk),
         in_specs=[
@@ -300,7 +334,11 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, block_k=bk, causal=causal, sm_scale=sm_scale
+            _dq_kernel,
+            block_k=bk,
+            causal=causal,
+            sm_scale=sm_scale,
+            window=window,
         ),
         grid=(batch * heads, seq_q // bq),
         in_specs=[
@@ -340,19 +378,31 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Pallas flash attention on (B, S, H, D) tensors.
 
     ``interpret=None`` auto-selects: compiled kernel on TPU, interpret mode
-    elsewhere (so the same code path is testable on CPU). Falls back to
+    elsewhere (so the same code path is testable on CPU). ``window=W > 0``
+    is causal sliding-window (local) attention: each query sees its W most
+    recent positions; whole key blocks outside the band are skipped, so
+    compute scales with S*W instead of S^2. Falls back to
     ``attention_reference`` for shapes the kernel does not support.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if window and not causal:
+        raise ValueError("window attention requires causal=True")
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     seq_q, seq_k = q.shape[1], k.shape[1]
     bq, bk = min(block_q, seq_q), min(block_k, seq_k)
     if seq_q % bq or seq_k % bk or (causal and seq_q != seq_k):
-        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+        return attention_reference(
+            q, k, v, causal=causal, sm_scale=sm_scale, window=int(window)
+        )
+    return _flash(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, int(window)
+    )

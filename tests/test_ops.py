@@ -204,3 +204,55 @@ def test_flash_backward_matches_reference_grads():
                 np.asarray(a), np.asarray(b), atol=5e-3, rtol=1e-3,
                 err_msg=f"causal={causal} {name}",
             )
+
+
+def test_sliding_window_attention_matches_masked_reference():
+    """flash window kernels == dense masked reference, forward and grads,
+    including windows narrower than the block size (fully-masked blocks
+    must not NaN the online softmax)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.ops.attention import (
+        attention_reference, causal_mask_allowed,
+    )
+    from ray_lightning_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(7)
+    B, S, H, D = 2, 256, 2, 32
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.5, jnp.float32)
+    do = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+
+    # W=64 < block 128 forces fully-masked visited blocks for late rows.
+    for W in (64, 128, 300):
+        ref_out, ref_vjp = jax.vjp(
+            lambda q, k, v: attention_reference(q, k, v, window=W), q, k, v
+        )
+        fl_out, fl_vjp = jax.vjp(
+            lambda q, k, v: flash_attention(
+                q, k, v, window=W, interpret=True
+            ),
+            q, k, v,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fl_out), np.asarray(ref_out), atol=2e-5,
+            err_msg=f"W={W} forward",
+        )
+        assert np.isfinite(np.asarray(fl_out)).all()
+        for name, a, b in zip(("dq", "dk", "dv"), fl_vjp(do), ref_vjp(do)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3, rtol=1e-3,
+                err_msg=f"W={W} {name}",
+            )
+
+    # W >= S is exactly full causal attention.
+    full = attention_reference(q, k, v, causal=True)
+    wide = flash_attention(q, k, v, window=4096, interpret=True)
+    np.testing.assert_allclose(np.asarray(wide), np.asarray(full), atol=2e-5)
+
+    # mask helper semantics: row attends to itself and W-1 predecessors
+    m = np.asarray(causal_mask_allowed(8, 8, window=3))
+    assert m[5].tolist() == [False, False, False, True, True, True, False, False]
