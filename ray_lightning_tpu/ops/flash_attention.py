@@ -47,8 +47,10 @@ def _fwd_kernel(
     else:
         num_kb = seq_k // block_k
     if window:
-        # Sliding window: key blocks entirely below row_max - window + 1
-        # contribute nothing for ANY row in this q block.
+        # Sliding window: the earliest in-band column for ANY row in this
+        # q block is row_min - window + 1 = q_offset - window + 1; key
+        # blocks entirely before it contribute nothing. (row_min, not
+        # row_max — later rows still need these blocks' columns.)
         first_kb = jnp.maximum(0, q_offset - window + 1) // block_k
     else:
         first_kb = 0

@@ -39,8 +39,9 @@ def _run_example(name: str, *args: str) -> subprocess.CompletedProcess:
         ("ray_horovod_example.py", ()),
         ("ray_ddp_sharded_example.py", ()),
         ("gpt_sharded_example.py", ()),
+        ("gpt_sharded_example.py", ("--modern",)),
     ],
-    ids=["ddp", "ddp-tune", "tune", "ring", "sharded", "gpt"],
+    ids=["ddp", "ddp-tune", "tune", "ring", "sharded", "gpt", "gpt-modern"],
 )
 def test_example_smoke(name, args):
     proc = _run_example(name, *args)
