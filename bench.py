@@ -37,15 +37,8 @@ import statistics
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-# Per-chip peak dense bf16 FLOP/s for MFU (public figures).
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# Per-chip peak dense bf16 FLOP/s for MFU (single source of truth).
+from ray_lightning_tpu.utils.flops import PEAK_BF16_FLOPS as PEAK_FLOPS  # noqa: E402
 
 
 def _fit_and_rates(

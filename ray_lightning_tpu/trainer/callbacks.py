@@ -246,13 +246,27 @@ class TPUStatsCallback(Callback):
     (examples/ray_ddp_sharded_example.py:16-46), which measured epoch time and
     peak CUDA memory. Uses ``device.memory_stats()`` where the PJRT backend
     exposes it.
+
+    ``flops_per_step`` — model FLOPs per EXECUTED training step, i.e. per
+    micro-batch across all workers (e.g. ``6 * n_params *
+    tokens_per_global_micro_batch`` for a transformer; with
+    ``accumulate_grad_batches`` each micro-batch still runs a full
+    fwd+bwd, so this is the honest compute unit) — additionally reports
+    per-epoch MFU against the published bf16 peak of ALL the run's chips
+    (``trainer.world_size``; ``utils/flops.py``). Skipped on devices with
+    no known peak (CPU).
     """
 
-    def __init__(self, verbose: bool = True) -> None:
+    def __init__(
+        self, verbose: bool = True, flops_per_step: Optional[float] = None
+    ) -> None:
+        self.flops_per_step = flops_per_step
         self.verbose = verbose
         self.epoch_times: list[float] = []
         self.peak_memory: list[float] = []
+        self.mfu: list[float] = []
         self._t0 = 0.0
+        self._step0 = 0
 
     @staticmethod
     def _fence(trainer: Any) -> None:
@@ -271,6 +285,7 @@ class TPUStatsCallback(Callback):
 
         self._fence(trainer)
         self._t0 = time.perf_counter()
+        self._step0 = trainer.global_step
 
     def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
         import time
@@ -288,19 +303,44 @@ class TPUStatsCallback(Callback):
             except Exception:  # noqa: BLE001 - CPU backend has no stats
                 pass
         self.peak_memory.append(peak)
+        mfu = None
+        if self.flops_per_step and dt > 0:
+            from ray_lightning_tpu.utils.flops import peak_flops_for
+
+            devs = jax.local_devices()
+            peak_fl = peak_flops_for(devs[0].device_kind) if devs else None
+            if peak_fl:
+                # flops_per_step covers the GLOBAL micro-batch, so the
+                # denominator is the peak of every chip in the run, not
+                # just this process's.
+                chips = max(
+                    int(getattr(trainer, "world_size", 0) or 0), len(devs)
+                )
+                steps = trainer.global_step - self._step0
+                mfu = (steps * float(self.flops_per_step) / dt) / (
+                    peak_fl * chips
+                )
+                self.mfu.append(mfu)
+                trainer.callback_metrics["mfu"] = mfu
         if self.verbose and trainer.global_rank == 0:
             print(
                 f"[epoch {trainer.current_epoch}] time {dt:.3f}s"
                 + (f", peak device mem {peak / 2**20:.1f} MiB" if peak else "")
+                + (f", MFU {mfu:.3f}" if mfu is not None else "")
             )
 
     def state_dict(self) -> Dict[str, Any]:
         # Measurements ride the callback-state sync back to the driver.
-        return {"epoch_times": self.epoch_times, "peak_memory": self.peak_memory}
+        return {
+            "epoch_times": self.epoch_times,
+            "peak_memory": self.peak_memory,
+            "mfu": self.mfu,
+        }
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.epoch_times = list(state.get("epoch_times", []))
         self.peak_memory = list(state.get("peak_memory", []))
+        self.mfu = list(state.get("mfu", []))
 
 
 class JaxProfilerCallback(Callback):

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import math
 import os
-from typing import Any, Iterator, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -47,15 +47,17 @@ class ArrayDataset(Dataset):
 
 
 class TokenBinDataset(Dataset):
-    """Memory-mapped token corpus: a flat binary file of token ids.
+    """Memory-mapped token corpus: flat binary file(s) of token ids.
 
-    The standard LLM-pretraining on-disk format (nanoGPT/llm.c style): one
-    file, fixed-width unsigned ints, no framing. Items are overlapping
+    The standard LLM-pretraining on-disk format (nanoGPT/llm.c style):
+    fixed-width unsigned ints, no framing. ``path`` may be one file or a
+    directory of ``*.bin`` shards (sorted by name, treated as one corpus;
+    windows never straddle shard boundaries). Items are overlapping
     ``seq_len + 1``-token windows (``stride`` tokens apart, default
     non-overlapping), returned as int32 — the (input, shifted-target) pair
-    GPT-style modules train on. The map is opened lazily PER PROCESS and
-    dropped on pickle, so the dataset ships to worker actors as a path +
-    shape, and each worker pages only the windows it actually touches —
+    GPT-style modules train on. Maps are opened lazily PER PROCESS and
+    dropped on pickle, so the dataset ships to worker actors as paths +
+    shapes, and each worker pages only the windows it actually touches —
     a 100 GB corpus costs no RAM up front on any host.
     """
 
@@ -70,32 +72,54 @@ class TokenBinDataset(Dataset):
         self.seq_len = int(seq_len)
         self.dtype = np.dtype(dtype)
         self.stride = int(stride) or self.seq_len
-        n_tokens = os.path.getsize(path) // self.dtype.itemsize
-        self._len = max(0, (n_tokens - self.seq_len - 1) // self.stride + 1)
+        if os.path.isdir(path):
+            self.files = sorted(
+                os.path.join(path, n)
+                for n in os.listdir(path)
+                if n.endswith(".bin")
+            )
+            if not self.files:
+                raise ValueError(f"{path}: no *.bin shards found")
+        else:
+            self.files = [path]
+
+        def windows(f: str) -> int:
+            n_tokens = os.path.getsize(f) // self.dtype.itemsize
+            return max(0, (n_tokens - self.seq_len - 1) // self.stride + 1)
+
+        self._file_windows = [windows(f) for f in self.files]
+        # Cumulative offsets for global-index -> (shard, local) mapping.
+        self._cum = np.cumsum([0] + self._file_windows)
+        self._len = int(self._cum[-1])
         if self._len == 0:
             raise ValueError(
-                f"{path}: {n_tokens} tokens < one {self.seq_len + 1}-token window"
+                f"{path}: no shard holds one {self.seq_len + 1}-token window"
             )
-        self._mm: Optional[np.memmap] = None
+        self._mms: Dict[int, np.memmap] = {}
 
-    def _map(self) -> np.memmap:
-        if self._mm is None:
-            self._mm = np.memmap(self.path, dtype=self.dtype, mode="r")
-        return self._mm
+    def _map(self, fi: int) -> np.memmap:
+        if fi not in self._mms:
+            self._mms[fi] = np.memmap(
+                self.files[fi], dtype=self.dtype, mode="r"
+            )
+        return self._mms[fi]
 
     def __len__(self) -> int:
         return self._len
 
     def __getitem__(self, idx: int) -> np.ndarray:
-        start = idx * self.stride
+        if not 0 <= idx < self._len:
+            raise IndexError(idx)
+        fi = int(np.searchsorted(self._cum, idx, side="right")) - 1
+        start = (idx - int(self._cum[fi])) * self.stride
         return np.asarray(
-            self._map()[start : start + self.seq_len + 1], dtype=np.int32
+            self._map(fi)[start : start + self.seq_len + 1], dtype=np.int32
         )
 
     def __getstate__(self):
-        # The mmap handle is process-local; re-open lazily on the worker.
+        # mmap handles are process-local; re-open lazily on the worker.
         state = dict(self.__dict__)
-        state["_mm"] = None
+        state["_mms"] = {}
         return state
 
 

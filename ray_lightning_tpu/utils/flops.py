@@ -1,0 +1,20 @@
+"""Published per-chip peak dense bf16 FLOP/s, for MFU arithmetic."""
+from __future__ import annotations
+
+from typing import Optional
+
+# Public figures (per chip). Keys match jax Device.device_kind strings.
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_for(device_kind: str) -> Optional[float]:
+    """Peak bf16 FLOP/s for a device kind; None when unknown (CPU, new
+    chips) — callers should then skip MFU rather than fabricate one."""
+    return PEAK_BF16_FLOPS.get(device_kind)

@@ -1158,3 +1158,47 @@ def test_mid_epoch_resume_resets_accumulation_window(tmp_path):
     np.testing.assert_allclose(
         np.asarray(m2.params["w"]), np.asarray(m_ref.params["w"]), atol=0
     )
+
+
+def test_token_bin_sharded_dir_and_stats_mfu(tmp_path):
+    """Directory-of-shards corpora concatenate without straddling shard
+    boundaries; TPUStatsCallback computes MFU only on known chips."""
+    import cloudpickle
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import (
+        TokenBinDataset, TPUStatsCallback, Trainer, write_token_bin,
+    )
+
+    d = tmp_path / "corpus"
+    d.mkdir()
+    a = np.arange(0, 500) % 64
+    b = np.arange(500, 1000) % 64
+    write_token_bin(str(d / "00.bin"), a)
+    write_token_bin(str(d / "01.bin"), b)
+    ds = TokenBinDataset(str(d), seq_len=16)
+    per = (500 - 17) // 16 + 1  # windows per shard
+    assert len(ds) == 2 * per
+    np.testing.assert_array_equal(ds[0], a[:17])
+    np.testing.assert_array_equal(ds[per], b[:17])  # first window of shard 2
+    # Last window of shard 1 stays inside shard 1 (no straddle).
+    np.testing.assert_array_equal(
+        ds[per - 1], a[(per - 1) * 16 : (per - 1) * 16 + 17]
+    )
+    clone = cloudpickle.loads(cloudpickle.dumps(ds))
+    np.testing.assert_array_equal(clone[per + 3], ds[per + 3])
+    import pytest
+
+    with pytest.raises(IndexError):
+        ds[len(ds)]
+
+    # MFU: on CPU there's no known peak -> skipped, everything else intact.
+    stats = TPUStatsCallback(verbose=False, flops_per_step=1e9)
+    m = _DetModule(batch_size=4, n=96)
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, callbacks=[stats],
+    )
+    t.fit(m)
+    assert stats.epoch_times and stats.mfu == []
+    assert "mfu" not in t.callback_metrics
