@@ -52,6 +52,9 @@ class GPTConfig:
     # most recent positions (Mistral-style). Single-program attention only
     # (flash/reference); not composed with ring/zigzag sequence parallelism.
     attn_window: int = 0
+    # StreamingLLM attention sinks: with a window, keep the first N
+    # positions visible to every query (stabilizes long-context windows).
+    attn_sinks: int = 0
     # Grouped-query attention: 0 -> n_head (MHA); 1 -> MQA. K/V projections
     # and the decode cache carry n_kv_head heads (cache shrinks by
     # n_head/n_kv_head); queries group onto them.
@@ -454,10 +457,12 @@ def gpt_forward(
             return ring_self_attention(q, k, v, mesh, axis_name=seq_axis)
         if cfg.attn_impl == "flash":
             return flash_attention(
-                q, k, v, causal=True, window=cfg.attn_window
+                q, k, v, causal=True, window=cfg.attn_window,
+                sinks=cfg.attn_sinks,
             )
         return attention_reference(
-            q, k, v, causal=True, window=cfg.attn_window
+            q, k, v, causal=True, window=cfg.attn_window,
+            sinks=cfg.attn_sinks,
         )
 
     def mlp(h: jax.Array, lp: Dict[str, jax.Array]) -> Tuple[jax.Array, jax.Array]:
@@ -711,7 +716,9 @@ def gpt_generate(
 
             pos_ids = jnp.arange(total)[None, None, None]
             s = jnp.where(
-                band_allowed(t, pos_ids, cfg.attn_window), s, float("-inf")
+                band_allowed(t, pos_ids, cfg.attn_window, cfg.attn_sinks),
+                s,
+                float("-inf"),
             )
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum(

@@ -13,22 +13,41 @@ import jax
 import jax.numpy as jnp
 
 
-def band_allowed(row: jax.Array, col: jax.Array, window: int = 0) -> jax.Array:
+def band_allowed(
+    row: jax.Array, col: jax.Array, window: int = 0, sinks: int = 0
+) -> jax.Array:
     """The causal (+optional sliding-window) band predicate on position
     index arrays: key ``col`` is visible to query ``row`` iff
-    ``col <= row`` and, with ``window=W > 0``, ``col > row - W``. Single
-    source of truth shared by the reference mask, the flash kernels, and
-    the decode mask."""
+    ``col <= row`` and, with ``window=W > 0``, ``col > row - W`` OR
+    ``col < sinks`` (StreamingLLM-style attention sinks: the first
+    ``sinks`` positions stay visible to every query). Single source of
+    truth shared by the reference mask, the flash kernels, and the decode
+    mask."""
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
+    if sinks < 0:
+        raise ValueError(f"sinks must be >= 0, got {sinks}")
+    if sinks and not window:
+        # Without a window every query already sees the first positions; a
+        # sinks-only config is a no-op the user almost certainly didn't
+        # mean — fail identically on every attention path.
+        raise ValueError("sinks only apply with a sliding window")
     allowed = col <= row
     if window:
-        allowed = allowed & (col > row - window)
+        in_band = col > row - window
+        if sinks:
+            in_band = in_band | (col < sinks)
+        allowed = allowed & in_band
     return allowed
 
 
 def causal_mask_allowed(
-    sq: int, sk: int, row_offset: int = 0, col_offset: int = 0, window: int = 0
+    sq: int,
+    sk: int,
+    row_offset: int = 0,
+    col_offset: int = 0,
+    window: int = 0,
+    sinks: int = 0,
 ) -> jax.Array:
     """Bool (sq, sk) matrix, True where attention is allowed.
 
@@ -48,7 +67,7 @@ def causal_mask_allowed(
         row_offset = sk - sq
     row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + row_offset
     col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1) + col_offset
-    return band_allowed(row, col, window)
+    return band_allowed(row, col, window, sinks)
 
 
 def attention_reference(
@@ -58,6 +77,7 @@ def attention_reference(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     window: int = 0,
+    sinks: int = 0,
 ) -> jax.Array:
     """softmax(q k^T / sqrt(d)) v with optional causal (+sliding-window) mask.
 
@@ -74,7 +94,9 @@ def attention_reference(
     ) * sm_scale
     if causal:
         s = jnp.where(
-            causal_mask_allowed(q.shape[1], k.shape[1], window=window),
+            causal_mask_allowed(
+                q.shape[1], k.shape[1], window=window, sinks=sinks
+            ),
             s,
             -jnp.inf,
         )

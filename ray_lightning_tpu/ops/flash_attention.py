@@ -28,7 +28,7 @@ _NEG_INF = float("-inf")
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref,
-    *, block_k: int, causal: bool, sm_scale: float, window: int,
+    *, block_k: int, causal: bool, sm_scale: float, window: int, sinks: int,
 ):
     # Block shapes: q (1, block_q, d); k, v (1, Sk, d); o like q;
     # lse (1, block_q, 8) — the stats row is padded to 8 lanes because TPU
@@ -50,7 +50,9 @@ def _fwd_kernel(
         # Sliding window: the earliest in-band column for ANY row in this
         # q block is row_min - window + 1 = q_offset - window + 1; key
         # blocks entirely before it contribute nothing. (row_min, not
-        # row_max — later rows still need these blocks' columns.)
+        # row_max — later rows still need these blocks' columns.) Sink
+        # blocks are visited by a separate prefix loop below, so the
+        # S*W scaling survives sinks.
         first_kb = jnp.maximum(0, q_offset - window + 1) // block_k
     else:
         first_kb = 0
@@ -69,7 +71,7 @@ def _fwd_kernel(
             col = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(band_allowed(row, col, window), s, _NEG_INF)
+            s = jnp.where(band_allowed(row, col, window, sinks), s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)
         # -inf - -inf = nan: a row can be FULLY masked in a visited block
@@ -90,6 +92,13 @@ def _fwd_kernel(
         jnp.zeros((block_q, 1), jnp.float32),
         jnp.zeros((block_q, head_dim), jnp.float32),
     )
+    if window and sinks:
+        # Visit the sink block(s) not already covered by the band loop
+        # (online softmax is order-agnostic, so two loops compose).
+        n_sink_kb = (sinks + block_k - 1) // block_k
+        init = jax.lax.fori_loop(
+            0, jnp.minimum(n_sink_kb, first_kb), body, init
+        )
     m, l, acc = jax.lax.fori_loop(first_kb, num_kb, body, init)
     # Rows with no unmasked keys (can't happen for causal self-attention with
     # aligned blocks, but keep the kernel total) produce l=0 -> output 0.
@@ -109,6 +118,7 @@ def _flash_fwd(
     block_k: int,
     interpret: bool,
     window: int = 0,
+    sinks: int = 0,
 ):
     """Run the kernel on (B, S, H, D) inputs; returns (out, lse)."""
     batch, seq_q, heads, head_dim = q.shape
@@ -134,6 +144,7 @@ def _flash_fwd(
         causal=causal,
         sm_scale=sm_scale,
         window=window,
+        sinks=sinks,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -158,24 +169,26 @@ def _flash_fwd(
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret, window, sinks):
     out, _ = _flash_fwd(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret, window
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, sinks
     )
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+def _flash_vjp_fwd(
+    q, k, v, causal, sm_scale, block_q, block_k, interpret, window, sinks
+):
     out, lse = _flash_fwd(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret, window
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, sinks
     )
     return out, (q, k, v, out, lse)
 
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, causal: bool, sm_scale: float, window: int,
+    *, block_q: int, causal: bool, sm_scale: float, window: int, sinks: int,
 ):
     """One (batch*head, k-block) cell: accumulate dk/dv over q blocks.
 
@@ -190,9 +203,13 @@ def _dkv_kernel(
     start_qb = k_offset // block_q if causal else 0
     end_qb = seq_q // block_q
     if window:
-        # Rows beyond col_max + window - 1 can't see any key in this block.
-        end_qb = jnp.minimum(
+        # Rows beyond col_max + window - 1 can't see any key in this block
+        # — except blocks holding sink columns, which every row sees.
+        banded = jnp.minimum(
             end_qb, (k_offset + block_k - 1 + window - 1) // block_q + 1
+        )
+        end_qb = (
+            jnp.where(k_offset < sinks, end_qb, banded) if sinks else banded
         )
 
     def body(i, carry):
@@ -211,7 +228,7 @@ def _dkv_kernel(
             col = k_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(band_allowed(row, col, window), s, _NEG_INF)
+            s = jnp.where(band_allowed(row, col, window, sinks), s, _NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk), rows of the full P sum to 1
         dv2 = dv + jax.lax.dot_general(
             p, dos, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -236,7 +253,7 @@ def _dkv_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k: int, causal: bool, sm_scale: float, window: int,
+    *, block_k: int, causal: bool, sm_scale: float, window: int, sinks: int,
 ):
     """One (batch*head, q-block) cell: accumulate dq over k blocks."""
     block_q = q_ref.shape[1]
@@ -268,7 +285,7 @@ def _dq_kernel(
             col = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(band_allowed(row, col, window), s, _NEG_INF)
+            s = jnp.where(band_allowed(row, col, window, sinks), s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -278,13 +295,17 @@ def _dq_kernel(
             ds, ks, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    dq = jax.lax.fori_loop(
-        first_kb, num_kb, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    )
+    dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    if window and sinks:
+        n_sink_kb = (sinks + block_k - 1) // block_k
+        dq0 = jax.lax.fori_loop(0, jnp.minimum(n_sink_kb, first_kb), body, dq0)
+    dq = jax.lax.fori_loop(first_kb, num_kb, body, dq0)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, do):
+def _flash_vjp_bwd(
+    causal, sm_scale, block_q, block_k, interpret, window, sinks, res, do
+):
     """Flash-attention backward: two Pallas kernels over recomputed score
     blocks (never the full (Sq, Sk) matrix). delta = rowsum(do * o) is the
     softmax-jacobian correction term."""
@@ -313,6 +334,7 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, d
             causal=causal,
             sm_scale=sm_scale,
             window=window,
+            sinks=sinks,
         ),
         grid=(batch * heads, seq_k // bk),
         in_specs=[
@@ -341,6 +363,7 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, d
             causal=causal,
             sm_scale=sm_scale,
             window=window,
+            sinks=sinks,
         ),
         grid=(batch * heads, seq_q // bq),
         in_specs=[
@@ -381,6 +404,7 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
     window: int = 0,
+    sinks: int = 0,
 ) -> jax.Array:
     """Pallas flash attention on (B, S, H, D) tensors.
 
@@ -388,8 +412,11 @@ def flash_attention(
     elsewhere (so the same code path is testable on CPU). ``window=W > 0``
     is causal sliding-window (local) attention: each query sees its W most
     recent positions; whole key blocks outside the band are skipped, so
-    compute scales with S*W instead of S^2. Falls back to
-    ``attention_reference`` for shapes the kernel does not support.
+    compute scales with S*W instead of S^2. ``sinks=N`` keeps the first N
+    positions visible to every query (StreamingLLM attention sinks; the
+    block-skip optimization is disabled since early blocks stay live).
+    Falls back to ``attention_reference`` for shapes the kernel does not
+    support.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -397,14 +424,18 @@ def flash_attention(
         raise ValueError("window attention requires causal=True")
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
+    if sinks and not window:
+        raise ValueError("sinks only apply with a sliding window")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     seq_q, seq_k = q.shape[1], k.shape[1]
     bq, bk = min(block_q, seq_q), min(block_k, seq_k)
     if seq_q % bq or seq_k % bk or (causal and seq_q != seq_k):
         return attention_reference(
-            q, k, v, causal=causal, sm_scale=sm_scale, window=int(window)
+            q, k, v, causal=causal, sm_scale=sm_scale, window=int(window),
+            sinks=int(sinks),
         )
     return _flash(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret, int(window)
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, int(window),
+        int(sinks),
     )

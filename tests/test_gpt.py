@@ -631,3 +631,25 @@ def test_gpt_sliding_window():
     placed = strategy.place_params(params)
     with pytest.raises(NotImplementedError, match="attn_window"):
         jax.jit(lambda p, t: module._forward(p, t))(placed, toks)
+
+
+def test_gpt_window_with_sinks_decode():
+    """attn_sinks + attn_window: decode matches the full forward."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt_generate
+
+    cfg = dataclasses.replace(TINY, attn_window=8, attn_sinks=2)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray([[1, 2, 3, 4, 5]], np.int32)
+    out = np.asarray(
+        gpt_generate(params, cfg, jnp.asarray(prompt), max_new_tokens=10)
+    )
+    for p in range(4, 14):
+        logits = gpt_forward(params, out[:, : p + 1], cfg)
+        np.testing.assert_array_equal(
+            np.argmax(np.asarray(logits[:, -1]), -1), out[:, p + 1]
+        )

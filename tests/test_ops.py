@@ -256,3 +256,56 @@ def test_sliding_window_attention_matches_masked_reference():
     # mask helper semantics: row attends to itself and W-1 predecessors
     m = np.asarray(causal_mask_allowed(8, 8, window=3))
     assert m[5].tolist() == [False, False, False, True, True, True, False, False]
+
+
+def test_attention_sinks_match_masked_reference():
+    """window + sinks == dense masked reference (fwd + grads); sinks keep
+    the first tokens visible to every query."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.ops.attention import (
+        attention_reference, causal_mask_allowed,
+    )
+    from ray_lightning_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(9)
+    B, S, H, D = 2, 256, 2, 32
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.5, jnp.float32)
+    do = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+
+    for W, N in ((64, 4), (48, 130)):  # sinks crossing a block boundary too
+        ref_out, ref_vjp = jax.vjp(
+            lambda q, k, v: attention_reference(q, k, v, window=W, sinks=N),
+            q, k, v,
+        )
+        fl_out, fl_vjp = jax.vjp(
+            lambda q, k, v: flash_attention(
+                q, k, v, window=W, sinks=N, interpret=True
+            ),
+            q, k, v,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fl_out), np.asarray(ref_out), atol=2e-5,
+            err_msg=f"W={W} N={N}",
+        )
+        for name, a, b in zip(("dq", "dk", "dv"), fl_vjp(do), ref_vjp(do)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3, rtol=1e-3,
+                err_msg=f"W={W} N={N} {name}",
+            )
+
+    # Mask semantics: row 100, window 8, sinks 2 -> cols {0,1} + (92..100].
+    m = np.asarray(causal_mask_allowed(128, 128, window=8, sinks=2))
+    cols = set(np.nonzero(m[100])[0].tolist())
+    assert cols == {0, 1} | set(range(93, 101)), sorted(cols)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="sinks"):
+        flash_attention(q, k, v, sinks=4)  # sinks require a window
+    with pytest.raises(ValueError, match="sinks"):
+        attention_reference(q, k, v, sinks=4)  # same contract on every path
