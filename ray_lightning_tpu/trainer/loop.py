@@ -939,6 +939,10 @@ class TrainingLoop:
                 epoch=self.current_epoch,
                 global_step=self.global_step,
                 update_count=self._update_count,
+                # Evaluated HERE because the worker owns a live backend;
+                # the driver must not init one (on TPU hosts the chips
+                # belong to worker processes — driver init would bind them).
+                current_lr=self.current_lr,
             ),
             results=results,
             callback_metrics={

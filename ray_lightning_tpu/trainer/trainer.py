@@ -118,6 +118,7 @@ class Trainer:
         self.current_epoch = 0
         self.global_step = 0
         self._update_count: Optional[int] = None
+        self._recovered_lr: Optional[float] = None
         self._module: Any = None
 
     # ------------------------------------------------------------------
@@ -155,10 +156,21 @@ class Trainer:
         """Learning rate the next optimizer update would use, from the
         module's declared ``lr_schedule`` (None when not declared).
 
-        Driver-side mirror of ``TrainingLoop.current_lr``: evaluates the
-        schedule at the recovered ``global_step`` (divided by
-        ``accumulate_grad_batches`` — one update per K micro-batches).
+        After a run this returns the value the rank-0 WORKER evaluated
+        (shipped in the fit output; eval-only runs report None), so reading
+        it never initializes a jax backend in the driver — on TPU hosts
+        the chips belong to worker processes and a driver backend init
+        would try to bind them. Before ANY run, the property evaluates the
+        schedule locally (pre-run introspection on a dev box) — that path
+        does touch the default backend.
         """
+        recovered = getattr(self, "_recovered_lr", None)
+        if recovered is not None:
+            return recovered
+        if self.state.get("stage") is not None:
+            # A run happened and shipped no lr (no declared schedule, or an
+            # eval-only stage): answer without touching a backend.
+            return None
         if self._module is None:
             return None
         sched = getattr(self, "_lr_sched_cache", False)
@@ -199,6 +211,9 @@ class Trainer:
     ) -> Any:
         self._module = module
         self._lr_sched_cache: Any = False  # re-unpack for the new module
+        if stage == "fit":
+            # A failed fit must not leave the PREVIOUS module's lr behind.
+            self._recovered_lr = None
         module.trainer = self
         if ckpt_path == "last":
             ckpt_path = self._resolve_last_ckpt()
@@ -432,6 +447,11 @@ class Trainer:
         # epoch-end flushes) — None when accumulation is off.
         uc = self.state.pop("update_count", None)
         self._update_count = None if uc is None else int(uc)
+        lr = self.state.pop("current_lr", None)
+        if lr is not None or self.state.get("stage") == "fit":
+            # Fits always reset (plain transforms legitimately have no lr);
+            # eval stages never carry one, so they preserve the fit's value.
+            self._recovered_lr = None if lr is None else float(lr)
         # Metrics cross the boundary as numpy and are re-exposed as floats
         # (reference re-tensorizes at ray_launcher.py:374-379).
         self.callback_metrics = {
