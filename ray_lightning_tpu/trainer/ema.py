@@ -102,12 +102,14 @@ def ema_params(
     state = find_ema_state(opt_state)
     if state is None:
         return None
-    count = int(np.asarray(jax.device_get(state.count)))
+    # .ravel()[0]: these may arrive as 0-d or replicated 1-d arrays; plain
+    # int()/float() on an ndim>0 array is a NumPy deprecation.
+    count = int(np.asarray(jax.device_get(state.count)).ravel()[0])
     if count == 0:
         return None
     if not debias:
         return state.ema
     if decay is None:
-        decay = float(np.asarray(jax.device_get(state.decay)))
+        decay = float(np.asarray(jax.device_get(state.decay)).ravel()[0])
     correction = 1.0 - float(decay) ** count
     return jax.tree_util.tree_map(lambda e: e / correction, state.ema)

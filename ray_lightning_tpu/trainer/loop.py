@@ -14,6 +14,7 @@ device->host syncs (SURVEY.md §7 "No mid-step Python").
 from __future__ import annotations
 
 import os
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -63,6 +64,11 @@ class TrainerSpec:
     async_checkpointing: bool = False
     # Log the pre-clip global grad norm each step (in-graph reduction).
     log_grad_norm: bool = False
+    # Ship gathered optimizer state in the fit output so the driver's
+    # save_checkpoint() writes fully-resumable files. Off = skip the
+    # ~2x-params gather/transfer for Adam when worker-side ModelCheckpoint
+    # is the only checkpoint path.
+    ship_optimizer_state: bool = True
     callbacks: List[Any] = field(default_factory=list)
 
 
@@ -208,6 +214,18 @@ class TrainingLoop:
                         "only via validate/test/predict(ckpt_path=...)"
                     )
                 opt_state = restored
+            elif int(state.get("global_step", 0) or 0) > 0:
+                warnings.warn(
+                    "resuming fit from a checkpoint that carries training "
+                    "progress (global_step="
+                    f"{state['global_step']}) but no optimizer state — "
+                    "Adam moments and any embedded LR schedule restart "
+                    "from scratch. Prefer a worker-written checkpoint "
+                    "(ModelCheckpoint) or a driver save_checkpoint() taken "
+                    "after a fit (which now includes optimizer state).",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
             self._restore_progress(state)
         self.params = self.strategy.place_params(params)
         self.opt_state = self.strategy.place_opt_state(opt_state, params)
@@ -228,9 +246,13 @@ class TrainingLoop:
         if self.spec.accumulate_grad_batches > 1:
             # Seed the host mirror from the (possibly restored) MultiSteps
             # counters — one fetch at init, none per step.
-            self._mini_host = int(np.asarray(jax.device_get(self.opt_state.mini_step)))
+            # .ravel()[0]: counters may arrive as 0-d or replicated 1-d
+            # arrays; plain int(ndim>0 array) is a NumPy deprecation.
+            self._mini_host = int(
+                np.asarray(jax.device_get(self.opt_state.mini_step)).ravel()[0]
+            )
             self._update_count = int(
-                np.asarray(jax.device_get(self.opt_state.gradient_step))
+                np.asarray(jax.device_get(self.opt_state.gradient_step)).ravel()[0]
             )
             if getattr(self, "_resumed_mid_epoch", False) and self._mini_host:
                 # Mid-epoch resume re-runs the epoch from batch 0: keeping
@@ -260,7 +282,7 @@ class TrainingLoop:
 
             st = find_ema_state(self.opt_state)
             if st is not None:
-                stored = float(np.asarray(jax.device_get(st.decay)))
+                stored = float(np.asarray(jax.device_get(st.decay)).ravel()[0])
                 # The state stores float32; compare at that precision.
                 if abs(stored - float(np.float32(self.spec.ema_decay))) > 1e-7:
                     raise RuntimeError(
@@ -924,6 +946,17 @@ class TrainingLoop:
                 # Eval-only run restored the average from a checkpoint:
                 # re-ship it (already host-side) so recovery keeps it.
                 module_state["ema_params"] = self._eval_ema_src
+            if (
+                self.opt_state is not None
+                and self.state.get("stage") == "fit"
+                and self.spec.ship_optimizer_state
+            ):
+                # Ship optimizer state so the driver's save_checkpoint()
+                # writes resumable files (Adam moments + embedded LR
+                # schedule continue instead of silently restarting).
+                module_state["opt_state"] = self.strategy.gather_state(
+                    self.opt_state
+                )
             state_stream = to_state_stream(module_state)
         best_model_path = None
         callback_states: Dict[str, Any] = {}
@@ -931,14 +964,25 @@ class TrainingLoop:
             callback_states[type(cb).__name__] = cb.state_dict()
             if hasattr(cb, "best_model_path") and cb.best_model_path:
                 best_model_path = cb.best_model_path
+        trainer_state = dict(
+            self.state,
+            epoch=self.current_epoch,
+            global_step=self.global_step,
+            update_count=self._update_count,
+        )
+        if self.state.get("stage") == "fit":
+            # Whether the fit stopped mid-epoch (max_steps/should_stop):
+            # the driver records it so its save_checkpoint() files resume
+            # with the same re-run-the-epoch semantics as worker-written
+            # checkpoints (incl. the MultiSteps window reset).
+            trainer_state["mid_epoch"] = not getattr(
+                self, "_epoch_complete", True
+            )
         return WorkerOutput(
             best_model_path=best_model_path,
             state_stream=state_stream,
             trainer_state=dict(
-                self.state,
-                epoch=self.current_epoch,
-                global_step=self.global_step,
-                update_count=self._update_count,
+                trainer_state,
                 # Evaluated HERE because the worker owns a live backend;
                 # the driver must not init one (on TPU hosts the chips
                 # belong to worker processes — driver init would bind them).

@@ -101,6 +101,7 @@ class TPUModule:
     def __init__(self) -> None:
         self.params: Any = None  # populated after fit()/restore
         self.ema_params: Any = None  # populated when Trainer(ema_decay=...)
+        self.opt_state: Any = None  # gathered optimizer state after fit()
         self.trainer: Any = None  # back-reference set by Trainer
 
     # ------------------------------------------------------------------
@@ -168,6 +169,9 @@ class TPUModule:
         # from a previous fit (eval-only round-trips re-ship the average
         # through the worker output, so it survives those).
         self.ema_params = state.get("ema_params")
+        # Fit outputs carry gathered optimizer state so the driver's
+        # save_checkpoint() writes files that resume with momentum intact.
+        self.opt_state = state.get("opt_state")
 
 
 class DataModule:

@@ -49,6 +49,7 @@ class Trainer:
         eval_ema: bool = False,
         async_checkpointing: bool = False,
         log_grad_norm: bool = False,
+        ship_optimizer_state: bool = True,
     ) -> None:
         self.max_epochs = max_epochs
         self.max_steps = max_steps
@@ -98,6 +99,10 @@ class Trainer:
         self.eval_ema = bool(eval_ema)
         self.async_checkpointing = bool(async_checkpointing)
         self.log_grad_norm = bool(log_grad_norm)
+        # Ship gathered opt_state in fit outputs (driver save_checkpoint
+        # resumability); turn off to skip the ~2x-params transfer when only
+        # worker-side ModelCheckpoint files are used.
+        self.ship_optimizer_state = bool(ship_optimizer_state)
         if enable_checkpointing and not any(
             hasattr(cb, "best_model_path") for cb in self.callbacks
         ):
@@ -117,6 +122,7 @@ class Trainer:
         self.state: Dict[str, Any] = {"status": "initialized", "stage": None}
         self.current_epoch = 0
         self.global_step = 0
+        self._mid_epoch = False  # did the last fit stop mid-epoch?
         self._update_count: Optional[int] = None
         self._recovered_lr: Optional[float] = None
         self._module: Any = None
@@ -144,6 +150,7 @@ class Trainer:
             eval_ema=self.eval_ema,
             async_checkpointing=self.async_checkpointing,
             log_grad_norm=self.log_grad_norm,
+            ship_optimizer_state=self.ship_optimizer_state,
             callbacks=self.callbacks,
         )
 
@@ -219,6 +226,7 @@ class Trainer:
             ckpt_path = self._resolve_last_ckpt()
         if ckpt_stream is None:
             ckpt_stream = self._read_ckpt(ckpt_path)
+        prev_opt_state = getattr(module, "opt_state", None)
         if self.strategy is None or isinstance(self.strategy, SingleDeviceStrategy):
             output = self._run_in_process(stage, module, datamodule, ckpt_stream)
         else:
@@ -226,7 +234,20 @@ class Trainer:
             output = launcher.launch(
                 stage, module, datamodule=datamodule, ckpt_stream=ckpt_stream
             )
-        return self._recover_results_in_main_process(output, module)
+        result = self._recover_results_in_main_process(output, module)
+        if (
+            stage != "fit"
+            and ckpt_stream is None
+            and getattr(module, "opt_state", None) is None
+        ):
+            # Eval outputs never carry opt_state and load_state_dict clears
+            # it; an eval WITHOUT a checkpoint leaves params untouched, so
+            # the fit's gathered optimizer state is still consistent — keep
+            # it resumable via save_checkpoint(). (An eval that DID load a
+            # checkpoint replaced params; the stale opt_state stays
+            # cleared.)
+            module.opt_state = prev_opt_state
+        return result
 
     def _run_in_process(
         self, stage: str, module: Any, datamodule: Any, ckpt_stream: Optional[bytes]
@@ -441,12 +462,21 @@ class Trainer:
             state = load_state_stream(output.state_stream)
             module.load_state_dict(state)
         self.state = dict(output.trainer_state)
-        self.current_epoch = int(self.state.pop("epoch", 0))
-        self.global_step = int(self.state.pop("global_step", 0))
-        # Actual optimizer-update count under accumulation (windows +
-        # epoch-end flushes) — None when accumulation is off.
+        epoch = int(self.state.pop("epoch", 0))
+        step = int(self.state.pop("global_step", 0))
         uc = self.state.pop("update_count", None)
-        self._update_count = None if uc is None else int(uc)
+        me = self.state.pop("mid_epoch", None)
+        if self.state.get("stage") == "fit":
+            # Only fits advance training progress: a validate/test/predict
+            # after a fit must not clobber the fit's counters (its loop
+            # legitimately reports epoch=0/step=0), or save_checkpoint()
+            # would write resume metadata that restarts from scratch.
+            self.current_epoch = epoch
+            self.global_step = step
+            # Actual optimizer-update count under accumulation (windows +
+            # epoch-end flushes) — None when accumulation is off.
+            self._update_count = None if uc is None else int(uc)
+            self._mid_epoch = bool(me)
         lr = self.state.pop("current_lr", None)
         if lr is not None or self.state.get("stage") == "fit":
             # Fits always reset (plain transforms legitimately have no lr);
@@ -482,10 +512,17 @@ class Trainer:
             "params": self._module.params,
             "epoch": self.current_epoch,
             "global_step": self.global_step,
+            # Same re-run-the-epoch resume semantics as worker-written
+            # checkpoints (incl. the MultiSteps partial-window reset).
+            "mid_epoch": self._mid_epoch,
             "callbacks": {
                 type(cb).__name__: cb.state_dict() for cb in self.callbacks
             },
         }
+        if getattr(self._module, "opt_state", None) is not None:
+            # Fit outputs ship gathered optimizer state back; including it
+            # makes this file fully resumable (momentum + LR schedule).
+            state["opt_state"] = self._module.opt_state
         if getattr(self._module, "ema_params", None) is not None:
             state["ema_params"] = self._module.ema_params  # serves eval_ema
         state_stream_to_file(to_state_stream(state), path)

@@ -129,6 +129,109 @@ def test_trainer_save_checkpoint_driver_side(tmp_path):
     )
 
 
+def test_driver_save_checkpoint_resumes_optimizer_state(tmp_path):
+    """Driver-side save_checkpoint carries gathered optimizer state, so a
+    fit resumed from it continues Adam momentum exactly (equals an
+    uninterrupted run); a legacy params-only file warns loudly instead of
+    silently restarting the optimizer."""
+    import optax
+
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.utils import load_state_stream, to_state_stream
+
+    def adam_module():
+        m = _DetModule(batch_size=4, n=96)
+        m.configure_optimizers = lambda: optax.adam(1e-2)
+        return m
+
+    m1 = adam_module()
+    t1 = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    t1.fit(m1)
+    # Eval WITHOUT a checkpoint leaves params untouched, so the fit's
+    # gathered opt_state must survive it (save_checkpoint stays resumable).
+    t1.validate(m1)
+    assert m1.opt_state is not None
+    path = str(tmp_path / "driver.ckpt")
+    t1.save_checkpoint(path)
+
+    m2 = adam_module()
+    t2 = Trainer(
+        max_epochs=2, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    t2.fit(m2, ckpt_path=path)
+
+    m3 = adam_module()
+    t3 = Trainer(
+        max_epochs=2, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    t3.fit(m3)
+    np.testing.assert_allclose(
+        np.asarray(m2.params["w"]), np.asarray(m3.params["w"]), rtol=1e-6
+    )
+
+    # Legacy params-only file (pre-opt_state format): resume must warn.
+    with open(path, "rb") as f:
+        state = load_state_stream(f.read())
+    assert "opt_state" in state  # the fix under test
+    del state["opt_state"]
+    legacy = str(tmp_path / "legacy.ckpt")
+    with open(legacy, "wb") as f:
+        f.write(to_state_stream(state))
+    t4 = Trainer(
+        max_epochs=2, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    )
+    with pytest.warns(RuntimeWarning, match="no optimizer state"):
+        t4.fit(adam_module(), ckpt_path=legacy)
+
+    # Opt-out skips the gather/transfer entirely.
+    m5 = adam_module()
+    t5 = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, ship_optimizer_state=False,
+    )
+    t5.fit(m5)
+    assert m5.opt_state is None
+
+
+def test_driver_save_checkpoint_mid_epoch_semantics(tmp_path):
+    """A driver file saved after a mid-epoch stop records mid_epoch, so
+    resume re-runs the epoch with the partial accumulation window cleared —
+    identical to the worker-written-checkpoint semantics."""
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.utils import load_state_stream
+
+    common = dict(
+        max_epochs=1, seed=0, num_sanity_val_steps=0,
+        accumulate_grad_batches=2, enable_checkpointing=False,
+    )
+    m_ref = _DetModule(batch_size=4, n=96)
+    Trainer(**common).fit(m_ref)
+
+    # Stop after batch 1: mini_step=1 pending in opt_state.
+    m1 = _DetModule(batch_size=4, n=96)
+    t1 = Trainer(max_steps=1, **common)
+    t1.fit(m1)
+    path = str(tmp_path / "mid.ckpt")
+    t1.save_checkpoint(path)
+    with open(path, "rb") as f:
+        st = load_state_stream(f.read())
+    assert st["mid_epoch"] is True and "opt_state" in st
+
+    # Resume re-runs the epoch from batch 0; with the restored partial
+    # window cleared the result equals the straight run exactly.
+    m2 = _DetModule(batch_size=4, n=96)
+    Trainer(**common).fit(m2, ckpt_path=path)
+    np.testing.assert_allclose(
+        np.asarray(m2.params["w"]), np.asarray(m_ref.params["w"]), atol=0
+    )
+
+
 def test_jax_profiler_callback(tmp_path):
     """JaxProfilerCallback writes a TensorBoard-loadable trace for the
     selected epoch (SURVEY.md §5 tracing/profiling coverage)."""
