@@ -370,6 +370,18 @@ def gpt_forward(
     # balanced attention needs no per-layer resharding; hidden states are
     # un-permuted after the final LN (D-wide, cheaper than post-head V-wide).
     use_zigzag = use_ring and cfg.seq_impl == "zigzag"
+    if cfg.attn_window and use_zigzag:
+        # Fail fast, before any mesh-dependent closures are built. The
+        # zigzag permutation scatters each query's window across ranks, so
+        # a banded ring step can't skip out-of-window shards — and with a
+        # sliding window the per-row work is already uniform, so zigzag's
+        # causal load balancing buys nothing. Plain ring IS the balanced
+        # layout for windowed attention.
+        raise ValueError(
+            "attn_window does not compose with seq_impl='zigzag'; use "
+            "seq_impl='ring' — the window makes per-rank attention work "
+            "uniform, so the ring path is both supported and load-balanced"
+        )
     if use_zigzag and S % (2 * mesh.shape[seq_axis]):
         raise ValueError(
             f"seq_impl='zigzag' needs sequence length {S} divisible by "
@@ -441,9 +453,12 @@ def gpt_forward(
 
     def attend(q, k, v):
         if cfg.attn_window and use_ring:
-            raise NotImplementedError(
-                "attn_window is not supported with sequence parallelism "
-                "(ring/zigzag); drop the seq mesh axis or the window"
+            # Band-limited ring: only ceil((W-1)/S_local)+1 K/V rotations
+            # run (out-of-window shards are never received), and attention
+            # sinks ride one tiny all-gathered block.
+            return ring_self_attention(
+                q, k, v, mesh, axis_name=seq_axis,
+                window=cfg.attn_window, sinks=cfg.attn_sinks,
             )
         if use_zigzag:
             from ray_lightning_tpu.ops.zigzag_attention import (

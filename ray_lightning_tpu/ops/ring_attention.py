@@ -33,6 +33,8 @@ def ring_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     vary_axes: Optional[tuple] = None,
+    window: int = 0,
+    sinks: int = 0,
 ) -> jax.Array:
     """Per-rank ring attention; call inside ``shard_map``/``pmap``.
 
@@ -44,14 +46,35 @@ def ring_attention(
       vary_axes: every mesh axis the inputs are sharded (device-varying)
         over — needed to type the scan carry when batch/heads ride dp/tp
         axes in addition to the ring axis. Defaults to (axis_name,).
+      window: sliding-window width W > 0 restricts each query to its W most
+        recent positions. The ring becomes BAND-LIMITED: only
+        ``ceil((W-1)/S_local) + 1`` K/V rotations run instead of the full
+        ring — out-of-window source shards are never even received, so the
+        window is a communication *and* FLOPs win, not just a mask.
+      sinks: StreamingLLM attention sinks — the first ``sinks`` global
+        positions stay visible to every query. Handled as one extra
+        (B, sinks) block all-gathered from the ring once (sink tokens live
+        on the rank holding the sequence start), NOT by widening the band.
+        Exactly partitions the dense mask: band steps own ``col > row - W``,
+        the sink block owns ``col < sinks and col <= row - W``.
 
     Returns the local output shard (B, S_local, H, D).
     """
+    from ray_lightning_tpu.ops.attention import causal_mask_allowed
+
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if window and not causal:
+        raise ValueError("window attention requires causal=True")
+    if sinks and not window:
+        raise ValueError("sinks only apply with a sliding window")
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     batch, s_local, heads, head_dim = q.shape
+    if sinks > s_local:
+        raise ValueError(
+            f"sinks ({sinks}) must fit in one sequence shard ({s_local})"
+        )
     qf = q.astype(jnp.float32) * sm_scale
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -67,12 +90,11 @@ def ring_attention(
             preferred_element_type=jnp.float32,
         )  # (B, H, Sq_local, Sk_local)
         if causal:
-            from ray_lightning_tpu.ops.attention import causal_mask_allowed
-
             allowed = causal_mask_allowed(
                 s_local, s_local,
                 row_offset=my_idx * s_local,
                 col_offset=src_idx * s_local,
+                window=window,
             )
             s = jnp.where(allowed[None, None], s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)  # (B, H, Sq)
@@ -109,9 +131,45 @@ def ring_attention(
         _varying(jnp.zeros((batch, heads, s_local), jnp.float32)),
         _varying(jnp.zeros((batch, heads, s_local, head_dim), jnp.float32)),
     )
-    (_, _, _, l, acc), _ = jax.lax.scan(
-        step, init, jnp.arange(axis_size), length=axis_size
+    # Band limit: a query's window spans at most ceil((W-1)/S_local) shards
+    # before its own, so later rotations would deliver only fully-masked
+    # shards — skip them entirely.
+    if window:
+        n_steps = min(axis_size, (window + s_local - 2) // s_local + 1)
+    else:
+        n_steps = axis_size
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, init, jnp.arange(n_steps), length=n_steps
     )
+    if sinks:
+        # One extra block for the always-visible sequence start. The sink
+        # K/V live on the rank holding global positions [0, sinks); the
+        # all-gather is tiny (B, sinks, H, D) and happens once per call.
+        sink_k = jax.lax.all_gather(k[:, :sinks], axis_name, tiled=False)[0]
+        sink_v = jax.lax.all_gather(v[:, :sinks], axis_name, tiled=False)[0]
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            qf,
+            sink_k.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )  # (B, H, Sq_local, sinks)
+        # Only the part of the mask the band steps did NOT cover:
+        # col < sinks AND col <= row - W (outside the window, but a sink).
+        row = (
+            jax.lax.broadcasted_iota(jnp.int32, (s_local, sinks), 0)
+            + my_idx * s_local
+        )
+        col = jax.lax.broadcasted_iota(jnp.int32, (s_local, sinks), 1)
+        s = jnp.where((col <= row - window)[None, None], s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.exp(m - m_safe)
+        p = jnp.exp(s - m_safe[..., None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, sink_v.astype(jnp.float32)
+        )
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = acc / l_safe[..., None]  # (B, H, Sq, D)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
@@ -125,6 +183,8 @@ def ring_self_attention(
     axis_name: str = "seq",
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: int = 0,
+    sinks: int = 0,
 ) -> jax.Array:
     """Global-view wrapper: shards (B, S, H, D) over ``axis_name`` and runs
     the per-rank ring program under ``shard_map``.
@@ -144,6 +204,8 @@ def ring_self_attention(
         causal=causal,
         sm_scale=sm_scale,
         vary_axes=vary,
+        window=window,
+        sinks=sinks,
     )
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec

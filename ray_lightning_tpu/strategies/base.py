@@ -499,6 +499,22 @@ class Strategy:
 
         return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
 
+    def barrier(self, name: str = "barrier") -> None:
+        """Block until every process reaches this point.
+
+        Cross-process ordering (e.g. "all ranks finished their checkpoint
+        writes before rank 0 deletes a directory") must not rest on
+        library-internal synchronization; this is the explicit primitive.
+        TPU-native: a named tiny collective over all global devices
+        (``sync_global_devices``); single-process runs need no sync.
+        """
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
     def sampler_kwargs(self) -> Dict[str, int]:
         """Dataset sharding is per *host process*; in-host distribution across
         chips happens via the batch sharding (contrast with the reference's

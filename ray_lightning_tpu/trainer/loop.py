@@ -481,10 +481,14 @@ class TrainingLoop:
         """Drain any in-flight async sharded save (no-op otherwise).
 
         Callbacks call this before deleting checkpoint directories that
-        could still be mid-write.
+        could still be mid-write. The explicit barrier makes the cross-rank
+        ordering guaranteed by THIS call — not inherited from orbax's
+        wait_until_finished internals — so rank 0 can only reach a
+        directory deletion after every rank's writes are durable.
         """
         if getattr(self, "_sharded_io", None) is not None:
             self._sharded_io.finalize()
+            self.strategy.barrier("finalize_checkpoints")
 
     def checkpoint_state(self) -> Dict[str, Any]:
         return {

@@ -625,12 +625,38 @@ def test_gpt_sliding_window():
             np.argmax(np.asarray(logits[:, -1]), -1), out[:, p + 1]
         )
 
+    # Window + sequence parallelism composes on the ring path: the ring is
+    # band-limited to ceil((W-1)/S_local)+1 rotations and reproduces the
+    # dense windowed logits.
     strategy = make_inprocess({"data": 2, "seq": 4}, sequence_parallel=True)
     module = GPTLM(config=cfg, batch_size=4)
     strategy.bind_module(module)
     placed = strategy.place_params(params)
-    with pytest.raises(NotImplementedError, match="attn_window"):
-        jax.jit(lambda p, t: module._forward(p, t))(placed, toks)
+    ringed = jax.jit(lambda p, t: module._forward(p, t))(placed, toks)
+    np.testing.assert_allclose(
+        np.asarray(ringed), np.asarray(windowed), atol=1e-3
+    )
+
+    # Sinks ride the seq-parallel path too (the "--modern" config).
+    sink_cfg = dataclasses.replace(cfg, attn_sinks=2)
+    sink_params = init_gpt_params(jax.random.PRNGKey(0), sink_cfg)
+    dense_sink = gpt_forward(sink_params, toks, sink_cfg)
+    module_s = GPTLM(config=sink_cfg, batch_size=4)
+    strategy.bind_module(module_s)
+    placed_s = strategy.place_params(sink_params)
+    ringed_sink = jax.jit(lambda p, t: module_s._forward(p, t))(
+        placed_s, toks
+    )
+    np.testing.assert_allclose(
+        np.asarray(ringed_sink), np.asarray(dense_sink), atol=1e-3
+    )
+
+    # zigzag + window: fails fast at forward entry, pointing at ring.
+    zz_cfg = dataclasses.replace(cfg, seq_impl="zigzag")
+    module_z = GPTLM(config=zz_cfg, batch_size=4)
+    strategy.bind_module(module_z)
+    with pytest.raises(ValueError, match="seq_impl='ring'"):
+        jax.jit(lambda p, t: module_z._forward(p, t))(placed, toks)
 
 
 def test_gpt_window_with_sinks_decode():
