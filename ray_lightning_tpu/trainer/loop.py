@@ -572,7 +572,30 @@ class TrainingLoop:
             n_batches = _limit(
                 self._train_loader.num_batches(mult), self.spec.limit_train_batches
             )
-            epoch_logs: List[Dict[str, Any]] = []
+            # Per-step device scalars buffer only until the next
+            # log_every_n_steps boundary, where they drain into host float
+            # lists — live device buffers stay O(log interval), not
+            # O(steps), so 100k-step epochs don't pin 100k live scalars
+            # for one giant end-of-epoch fetch.
+            pending_logs: List[Dict[str, Any]] = []
+            epoch_host_vals: Dict[str, List[float]] = {}
+
+            def _drain_logs() -> Dict[str, float]:
+                """Fetch buffered device scalars (one device_get), append
+                to the epoch's host accumulators, return the LATEST step's
+                host values (what on_train_batch_end logs)."""
+                if not pending_logs:
+                    return {}
+                fetched = jax.device_get(pending_logs)
+                pending_logs.clear()
+                for d in fetched:
+                    for k, v in d.items():
+                        epoch_host_vals.setdefault(k, []).append(
+                            float(np.asarray(v))
+                        )
+                return {
+                    k: float(np.asarray(v)) for k, v in fetched[-1].items()
+                }
             # Device staging pipeline: host batch assembly (loader prefetch
             # thread) -> H2D transfer (stager pool) -> step dispatch, all
             # overlapped with device compute.
@@ -607,7 +630,7 @@ class TrainingLoop:
                     self.params, self.opt_state, logs = train_step(
                         self.params, self.opt_state, batch, self._rng, self.global_step
                     )
-                    epoch_logs.append(logs)  # device scalars; no sync here
+                    pending_logs.append(logs)  # device scalars; no sync here
                     self.global_step += 1
                     if self._update_count is not None:
                         self._mini_host += 1
@@ -618,9 +641,7 @@ class TrainingLoop:
                         self.global_step % self.spec.log_every_n_steps == 0
                         or batch_idx == n_batches - 1
                     ):
-                        host_logs = {
-                            k: float(np.asarray(v)) for k, v in logs.items()
-                        }
+                        host_logs = _drain_logs()
                         self.logged_metrics.update(host_logs)
                         self._call_callbacks("on_train_batch_end", host_logs, batch_idx)
                     if (
@@ -661,12 +682,13 @@ class TrainingLoop:
                 self._flush_accumulation()
                 self._epoch_complete = True
 
-            # One device->host fetch for the whole epoch's train metrics.
-            if epoch_logs:
-                fetched = jax.device_get(epoch_logs)
-                keys = fetched[0].keys()
+            # Drain any steps since the last boundary (early max_steps/
+            # should_stop breaks), then reduce the epoch means on host.
+            _drain_logs()
+            if epoch_host_vals:
                 epoch_means = {
-                    k: float(np.mean([float(d[k]) for d in fetched])) for k in keys
+                    k: float(np.mean(vals))
+                    for k, vals in epoch_host_vals.items()
                 }
                 self.callback_metrics.update(epoch_means)
                 # _step-forked keys, like PTL's `loss_step`/`loss_epoch`

@@ -199,6 +199,24 @@ def test_driver_save_checkpoint_resumes_optimizer_state(tmp_path):
     assert m5.opt_state is None
 
 
+def test_epoch_metrics_identical_across_log_cadences():
+    """Windowed draining of step logs (at the log_every_n_steps boundary)
+    must not change the epoch reduction: per-step values accumulate on the
+    host, so every cadence yields the same epoch mean."""
+    from ray_lightning_tpu.trainer import Trainer
+
+    results = {}
+    for cadence in (1, 2, 10**9):
+        m = _DetModule(batch_size=4, n=96)
+        t = Trainer(
+            max_epochs=2, enable_checkpointing=False, seed=0,
+            num_sanity_val_steps=0, log_every_n_steps=cadence,
+        )
+        t.fit(m)
+        results[cadence] = t.callback_metrics["loss_epoch"]
+    assert results[1] == results[2] == results[10**9]
+
+
 def test_driver_save_checkpoint_mid_epoch_semantics(tmp_path):
     """A driver file saved after a mid-epoch stop records mid_epoch, so
     resume re-runs the epoch with the partial accumulation window cleared —
