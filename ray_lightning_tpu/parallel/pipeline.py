@@ -17,7 +17,11 @@ collected outputs are broadcast with a masked ``psum``. Built entirely from
 schedule by transposition — no hand-written backward needed.
 
 The bubble fraction is the textbook (P-1)/(M+P-1); raise
-``num_microbatches`` to amortize it.
+``num_microbatches`` to amortize it. Fill/drain ticks where a rank holds
+no real microbatch SKIP the layer compute via a per-rank ``lax.cond``
+(the predicate is uniform across the model/data groups sharing a pp
+stage, so GSPMD collectives inside the stage stay coherent) — the bubble
+costs idle time, not redundant FLOPs.
 """
 from __future__ import annotations
 
@@ -26,6 +30,13 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def bubble_fraction(pp: int, num_microbatches: Optional[int] = None) -> float:
+    """Textbook GPipe bubble: the share of the M+P-1 schedule ticks a rank
+    spends without a real microbatch, (P-1)/(M+P-1)."""
+    m = int(num_microbatches or pp)
+    return (pp - 1) / (m + pp - 1)
 
 
 def pipeline_apply(
@@ -83,7 +94,13 @@ def pipeline_apply(
             recv, outs = carry
             feed = mb[jnp.clip(t, 0, M - 1)]
             inp = jnp.where(stage == 0, feed, recv)
-            out = apply_local(inp)
+            # Rank ``stage`` holds microbatch (t - stage) this tick; outside
+            # [0, M) it's fill/drain garbage — skip the layer compute so the
+            # bubble is idle time, not wasted FLOPs. Devices sharing a pp
+            # stage (model/data groups) share the predicate, so collectives
+            # inside stage_fn stay coherent across the branch.
+            valid = jnp.logical_and(t >= stage, t - stage <= M - 1)
+            out = jax.lax.cond(valid, apply_local, lambda h: h, inp)
             slot = t - (pp - 1)
             idx = jnp.clip(slot, 0, M - 1)
             collect = jnp.logical_and(stage == pp - 1, slot >= 0)

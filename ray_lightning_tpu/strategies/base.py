@@ -487,6 +487,13 @@ class Strategy:
         return jax.jit(estep)
 
     # -- state movement -------------------------------------------------
+    #: Whether gather_state is a COLLECTIVE every process must enter
+    #: (sharded/GSPMD override with True). Callers use this to decide
+    #: whether non-zero ranks must participate in checkpoint gathers
+    #: (collective: skipping deadlocks) or can skip them (plain
+    #: device_get: participating is wasted D2H traffic).
+    gather_is_collective = False
+
     def gather_state(self, tree: Any) -> Any:
         """Device pytree -> host numpy pytree (full, unsharded).
 

@@ -167,6 +167,9 @@ class GSPMDStrategy(RayTPUStrategy):
         )
 
     # -- state movement -------------------------------------------------
+    # The jitted all-gather must run on every process (see base attr).
+    gather_is_collective = True
+
     def gather_state(self, tree: Any) -> Any:
         from ray_lightning_tpu.parallel.zero import gather_to_host
 

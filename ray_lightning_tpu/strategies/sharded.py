@@ -49,6 +49,9 @@ class RayShardedStrategy(RayTPUStrategy):
         return tree_shardings(opt_state, self.mesh)
 
     # -- state movement -------------------------------------------------
+    # The jitted all-gather must run on every process (see base attr).
+    gather_is_collective = True
+
     def gather_state(self, tree: Any) -> Any:
         """All-gather sharded leaves to full host arrays for checkpointing
         (SURVEY.md §7 'checkpoint of sharded state' hard part)."""

@@ -112,7 +112,13 @@ class ModelCheckpoint(Callback):
     def _save(self, trainer: Any, module: Any) -> None:
         if self.save_top_k == 0:
             return
-        if trainer.global_rank != 0 and not self.save_sharded:
+        if (
+            trainer.global_rank != 0
+            and not self.save_sharded
+            and not getattr(trainer, "gather_is_collective", False)
+        ):
+            # Plain-device_get strategies: nothing for non-zero ranks to
+            # do. (Collective gathers need every rank below.)
             return
         dirpath = self.dirpath or os.path.join(trainer.default_root_dir, "checkpoints")
         os.makedirs(dirpath, exist_ok=True)
@@ -138,8 +144,19 @@ class ModelCheckpoint(Callback):
             if trainer.global_rank != 0:
                 return
         else:
+            # EVERY rank enters save_checkpoint: its state gather is a
+            # collective under multi-process sharding (a rank-0-only call
+            # deadlocks); rank 0 alone writes bytes and keeps bookkeeping.
             path = os.path.join(dirpath, name + ".ckpt")
             trainer.save_checkpoint(path)
+            last = None
+            if self.save_last:
+                last = os.path.join(dirpath, "last.ckpt")
+                trainer.save_checkpoint(last)
+            if trainer.global_rank != 0:
+                return
+            if last:
+                self.last_model_path = last
         score = _metric_value(trainer, self.monitor) if self.monitor else None
         if self.monitor is None:
             # No monitor: latest checkpoint is "best" (Lightning behavior)
@@ -161,10 +178,8 @@ class ModelCheckpoint(Callback):
                 self.best_model_path = path
             self._saved.append((score, path))
             self._prune(trainer)
-        if self.save_last and not self.save_sharded:
-            last = os.path.join(dirpath, "last.ckpt")
-            trainer.save_checkpoint(last)
-            self.last_model_path = last
+        # (Non-sharded save_last happens above, before the rank gate — the
+        # collective gather needs every rank.)
 
     def _prune(self, trainer: Any = None) -> None:
         # Deletion targets are always durable here: the monitored sharded

@@ -470,12 +470,23 @@ class TrainingLoop:
                 is_rank_zero=self.global_rank == 0,
             )
             return
+        # checkpoint_state's gathers are collective under multi-process
+        # sharding — every rank must run them; only rank 0 writes. (For
+        # plain-device_get strategies non-zero ranks skip the gather.)
+        if self.global_rank != 0 and not self.strategy.gather_is_collective:
+            return
+        state = self.checkpoint_state()
         if self.global_rank != 0:
             return
-        stream = to_state_stream(self.checkpoint_state())
+        stream = to_state_stream(state)
         from ray_lightning_tpu.utils.state_stream import state_stream_to_file
 
         state_stream_to_file(stream, path)
+
+    @property
+    def gather_is_collective(self) -> bool:
+        """Do checkpoint-state gathers require every rank (see Strategy)?"""
+        return bool(getattr(self.strategy, "gather_is_collective", False))
 
     def finalize_checkpoints(self) -> None:
         """Drain any in-flight async sharded save (no-op otherwise).
@@ -953,37 +964,59 @@ class TrainingLoop:
                 return loader
         raise RuntimeError("no dataloader available to probe init shapes")
 
+    def _gathered_module_state_stream(self) -> Optional[bytes]:
+        """Gather module state on EVERY rank; serialize on rank 0 only.
+
+        ``gather_state`` is a jitted all-gather — under multi-process
+        sharding (ZeRO/GSPMD spanning hosts) it is a collective that every
+        rank must enter. For plain-device_get strategies (DP/ring) the
+        non-zero ranks skip the gather entirely: participating would only
+        copy full state to host and discard it.
+        """
+        if self.params is None:
+            return None
+        if self.global_rank != 0 and not self.strategy.gather_is_collective:
+            return None
+        module_state = dict(self.module.state_dict())
+        module_state["params"] = self.strategy.gather_state(self.params)
+        ema_dev = self._ema_params()
+        if ema_dev is not None:
+            module_state["ema_params"] = self.strategy.gather_state(ema_dev)
+        elif getattr(self, "_eval_ema_src", None) is not None:
+            # Eval-only run restored the average from a checkpoint:
+            # re-ship it (already host-side) so recovery keeps it.
+            module_state["ema_params"] = self._eval_ema_src
+        if (
+            self.opt_state is not None
+            and self.state.get("stage") == "fit"
+            and self.spec.ship_optimizer_state
+        ):
+            # Ship optimizer state so the driver's save_checkpoint()
+            # writes resumable files (Adam moments + embedded LR
+            # schedule continue instead of silently restarting).
+            module_state["opt_state"] = self.strategy.gather_state(
+                self.opt_state
+            )
+        if self.global_rank != 0:
+            return None
+        return to_state_stream(module_state)
+
     # ------------------------------------------------------------------
     def _collect_rank_zero_results(self, results: Any) -> Optional[WorkerOutput]:
         """Package rank-0 state for the driver (the reference's
         ``_collect_rank_zero_results``, ray_launcher.py:312-349: rank!=0
         returns None; weights go host-side as bytes; metrics cross as
-        numpy)."""
+        numpy).
+
+        The state gathers run on EVERY rank before the rank gate:
+        ``gather_state`` is a jitted all-gather, which under multi-process
+        sharding (ZeRO/GSPMD spanning hosts) is a collective — a
+        rank-0-only call would deadlock waiting for peers that already
+        moved on.
+        """
+        state_stream = self._gathered_module_state_stream()
         if self.global_rank != 0:
             return None
-        state_stream = None
-        if self.params is not None:
-            module_state = dict(self.module.state_dict())
-            module_state["params"] = self.strategy.gather_state(self.params)
-            ema_dev = self._ema_params()
-            if ema_dev is not None:
-                module_state["ema_params"] = self.strategy.gather_state(ema_dev)
-            elif getattr(self, "_eval_ema_src", None) is not None:
-                # Eval-only run restored the average from a checkpoint:
-                # re-ship it (already host-side) so recovery keeps it.
-                module_state["ema_params"] = self._eval_ema_src
-            if (
-                self.opt_state is not None
-                and self.state.get("stage") == "fit"
-                and self.spec.ship_optimizer_state
-            ):
-                # Ship optimizer state so the driver's save_checkpoint()
-                # writes resumable files (Adam moments + embedded LR
-                # schedule continue instead of silently restarting).
-                module_state["opt_state"] = self.strategy.gather_state(
-                    self.opt_state
-                )
-            state_stream = to_state_stream(module_state)
         best_model_path = None
         callback_states: Dict[str, Any] = {}
         for cb in self.callbacks:

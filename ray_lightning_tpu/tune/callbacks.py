@@ -54,13 +54,27 @@ class TuneCallback(Callback):
         if self._on == "fit_end":
             self._maybe_handle(trainer, module)
 
+    #: Subclasses that snapshot ``trainer.checkpoint_state()`` set this so
+    #: the (collective) state gathers run on EVERY rank before the rank
+    #: gate — a rank-0-only gather deadlocks under multi-process sharding.
+    needs_checkpoint_state = False
+
     def _maybe_handle(self, trainer: Any, module: Any) -> None:
-        if trainer.global_rank != 0:
-            return
         if getattr(trainer, "sanity_checking", False):
             # Skip the pre-train sanity check (reference tune.py:113-114).
             return
-        self._handle(trainer, module)
+        gather = self.needs_checkpoint_state and (
+            trainer.global_rank == 0
+            or getattr(trainer, "gather_is_collective", False)
+        )
+        self._gathered_state = trainer.checkpoint_state() if gather else None
+        try:
+            if trainer.global_rank != 0:
+                return
+            self._handle(trainer, module)
+        finally:
+            # Don't pin a full host copy of params+opt_state between hooks.
+            self._gathered_state = None
 
     def _handle(self, trainer: Any, module: Any) -> None:
         raise NotImplementedError
@@ -117,6 +131,8 @@ def _checkpoint_closure(stream: bytes, step: int, filename: str):
 class _TuneCheckpointCallback(TuneCallback):
     """Dump a full checkpoint and deliver it into the trial dir."""
 
+    needs_checkpoint_state = True
+
     def __init__(self, filename: str = "checkpoint.ckpt", on: str = "validation_end") -> None:
         super().__init__(on=on)
         self._filename = filename
@@ -124,7 +140,7 @@ class _TuneCheckpointCallback(TuneCallback):
     def _handle(self, trainer: Any, module: Any) -> None:
         from ray_lightning_tpu.utils.state_stream import to_state_stream
 
-        stream = to_state_stream(trainer.checkpoint_state())
+        stream = to_state_stream(self._gathered_state)
         _dispatch(_checkpoint_closure(stream, trainer.global_step, self._filename))
 
 
@@ -142,11 +158,13 @@ class TuneReportCheckpointCallback(TuneCallback):
         self._metrics = metrics
         self._filename = filename
 
+    needs_checkpoint_state = True
+
     def _handle(self, trainer: Any, module: Any) -> None:
         report = _resolve_metrics(self._metrics, dict(trainer.callback_metrics))
         from ray_lightning_tpu.utils.state_stream import to_state_stream
 
-        stream = to_state_stream(trainer.checkpoint_state())
+        stream = to_state_stream(self._gathered_state)
         write_checkpoint = _checkpoint_closure(
             stream, trainer.global_step, self._filename
         )
