@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -42,6 +44,7 @@ def _json_line(proc):
     )
 
 
+@pytest.mark.slow
 def test_bench_smoke_cpu():
     proc = _run_bench(
         {"RLT_BENCH_ALLOW_CPU": "1"},
@@ -65,6 +68,7 @@ def test_bench_smoke_cpu():
     assert "tune_best_accuracy" in out["extra"], out["extra"]
 
 
+@pytest.mark.slow
 def test_bench_probe_exhaustion_records_flagged_cpu_run():
     """A dead TPU at bench time must leave a structured record: the probe
     exhausts (bench-DEFAULTED requirement, no operator override), the bench

@@ -154,6 +154,7 @@ def test_cli_fit_end_to_end(start_fabric):
     assert result is not None
 
 
+@pytest.mark.slow
 def test_cli_address_enters_client_mode(fabric_head):
     """--address routes the whole CLI fit through a fabric head (the
     reference's LightningCLI-under-Ray-Client workflow)."""
@@ -182,6 +183,7 @@ def test_cli_address_enters_client_mode(fabric_head):
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+@pytest.mark.slow
 def test_cli_generate_from_checkpoint(tmp_path, capsys):
     """generate subcommand: fit a tiny GPT in-process, checkpoint it, then
     decode from the CLI with sampling flags."""

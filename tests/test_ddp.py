@@ -254,6 +254,7 @@ class _CrashOnceModule(BoringModule):
             os._exit(1)
 
 
+@pytest.mark.slow
 def test_fit_restarts_after_worker_death(start_fabric, tmp_path):
     """max_restarts: a worker killed mid-fit relaunches the group and
     resumes from the newest checkpoint (beyond-parity failure recovery;
@@ -307,6 +308,7 @@ def test_fit_exhausted_restarts_raises(start_fabric, tmp_path):
         trainer.fit(_AlwaysCrash())
 
 
+@pytest.mark.slow
 def test_restart_ignores_stale_and_corrupt_checkpoints(start_fabric, tmp_path):
     """The restart picker must skip (a) checkpoints predating this fit
     (shared dirs hold unrelated runs' files) and (b) unreadable files,

@@ -279,6 +279,7 @@ def test_gspmd_sampler_follows_dp_extent():
         s2.sampler_kwargs()
 
 
+@pytest.mark.slow
 def test_gptlm_fit_end_to_end(start_fabric, tmp_path):
     """Trainer.fit(GPTLM, GSPMDStrategy) through the actor fabric: the full
     driver->worker->driver path with a tp-sharded transformer."""

@@ -122,6 +122,7 @@ def test_moe_top2_routing():
     assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
 
 
+@pytest.mark.slow
 def test_gpt_pp_grads_match_dense():
     """Full-model check: GPT loss grads under a pp2 x model2 sharded mesh
     equal the unsharded dense grads (VERDICT r2 weak #7: prove pipeline
@@ -173,6 +174,7 @@ def test_bubble_fraction_formula():
     assert bubble_fraction(1, 8) == 0.0
 
 
+@pytest.mark.slow
 def test_pp_composes_with_grad_accumulation():
     """Pipeline parallelism x accumulate_grad_batches (VERDICT r3 weak #5):
     two accumulated micro-steps on a pp2 x model2 mesh produce the same

@@ -73,6 +73,7 @@ def test_ring_attention_matches_reference(causal):
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients_match():
     q, k, v = _make_qkv(seq=32, batch=1)
     mesh = _seq_mesh()
@@ -110,6 +111,7 @@ def test_ring_attention_window_matches_reference(window, sinks):
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_window_gradients_match():
     q, k, v = _make_qkv(seq=32, batch=1)
     mesh = _seq_mesh()
@@ -202,6 +204,7 @@ def test_zigzag_ring_matches_reference():
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_gradients_match():
     from ray_lightning_tpu.ops.zigzag_attention import zigzag_ring_self_attention
 

@@ -66,6 +66,7 @@ def test_training_step_decreases_loss():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_fit_end_to_end_ring_strategy(start_fabric):
     """Config-3 shape: ResNet on the ring (Horovod-flavor) strategy."""
     fabric = start_fabric(num_cpus=2)

@@ -156,6 +156,7 @@ def test_partial_restore_params_only(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_zero3_fit_saves_sharded_and_resumes(start_fabric, tmp_path):
     """End to end: fit with ZeRO-3 + ModelCheckpoint(save_sharded=True),
     then resume from the sharded directory with a different worker count."""
