@@ -23,24 +23,52 @@ import cloudpickle
 
 
 class FabricClient:
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str, authkey: Optional[str] = None) -> None:
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
+        self._authkey = self._resolve_authkey(authkey)
         self._local = threading.local()
         self._conns: List[Any] = []
         self._lock = threading.Lock()
         # Validate eagerly so a bad address fails at init, not first use.
         self.request(("ping",))
 
+    @staticmethod
+    def _resolve_authkey(explicit: Optional[str]) -> bytes:
+        """Explicit arg > RLT_FABRIC_AUTHKEY. There is no static default:
+        servers generate a per-instance key (printed in their ready line)
+        precisely so reaching the port is not enough to own the fabric."""
+        import os
+
+        key = explicit or os.environ.get("RLT_FABRIC_AUTHKEY")
+        if not key:
+            raise RuntimeError(
+                "fabric client mode needs the server's authkey: pass "
+                "fabric.init(address=..., authkey=...) or set "
+                "RLT_FABRIC_AUTHKEY. The server prints a generated key in "
+                "its 'FABRIC_SERVER_READY <addr> key=<key>' line (an "
+                "operator-set RLT_FABRIC_AUTHKEY on the server side must "
+                "be used instead when present)."
+            )
+        return key.encode()
+
     # -- transport ------------------------------------------------------
     def _conn(self) -> Any:
         conn = getattr(self._local, "conn", None)
         if conn is None:
+            from multiprocessing import AuthenticationError
             from multiprocessing.connection import Client as MPClient
 
-            from ray_lightning_tpu.fabric.server import _authkey
-
-            conn = MPClient(self._addr, family="AF_INET", authkey=_authkey())
+            try:
+                conn = MPClient(
+                    self._addr, family="AF_INET", authkey=self._authkey
+                )
+            except AuthenticationError as exc:
+                raise RuntimeError(
+                    f"fabric head at {self._addr[0]}:{self._addr[1]} "
+                    "rejected the authkey; use the key from the server's "
+                    "ready line (or its RLT_FABRIC_AUTHKEY)"
+                ) from exc
             self._local.conn = conn
             with self._lock:
                 self._conns.append(conn)
@@ -69,7 +97,7 @@ class FabricClient:
 _client: Optional[FabricClient] = None
 
 
-def connect(address: str) -> FabricClient:
+def connect(address: str, authkey: Optional[str] = None) -> FabricClient:
     """Connect this process to a remote fabric head (client mode)."""
     global _client
     if _client is not None:
@@ -81,7 +109,7 @@ def connect(address: str) -> FabricClient:
                 f"fabric.shutdown() before connecting to {address}"
             )
         return _client
-    _client = FabricClient(address)
+    _client = FabricClient(address, authkey=authkey)
     return _client
 
 

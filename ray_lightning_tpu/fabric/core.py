@@ -200,6 +200,7 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     ignore_reinit_error: bool = True,
     address: Optional[str] = None,
+    authkey: Optional[str] = None,
 ) -> None:
     """Start the fabric session with a single local head node.
 
@@ -208,13 +209,15 @@ def init(
     ``address="host:port"`` enters client mode: connect to a remote
     :class:`~ray_lightning_tpu.fabric.server.FabricServer` head and proxy
     every fabric call there (the Ray Client "infinite laptop" analog,
-    reference test_client.py:17-30).
+    reference test_client.py:17-30). ``authkey`` is the server's shared
+    secret (from its ready line or its ``RLT_FABRIC_AUTHKEY``); defaults
+    to this process's ``RLT_FABRIC_AUTHKEY``.
     """
     global _session
     if address is not None:
         from ray_lightning_tpu.fabric import client
 
-        client.connect(address)
+        client.connect(address, authkey=authkey)
         return
     if _client_mode() is not None:
         return  # already connected to a head; local init is a no-op

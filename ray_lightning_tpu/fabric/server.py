@@ -34,22 +34,31 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
-# Shared-secret default: fabric client mode (like Ray Client) is for trusted
-# networks; override with RLT_FABRIC_AUTHKEY on both ends for anything else.
-DEFAULT_AUTHKEY = b"rlt-fabric-v1"
 
-
-def _authkey() -> bytes:
+def _env_authkey() -> Optional[bytes]:
     import os
 
     key = os.environ.get("RLT_FABRIC_AUTHKEY")
-    return key.encode() if key else DEFAULT_AUTHKEY
+    return key.encode() if key else None
 
 
 class FabricServer:
-    """Owns a real local fabric session and serves it over a socket."""
+    """Owns a real local fabric session and serves it over a socket.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    Authentication: a shared secret over ``multiprocessing.connection``'s
+    HMAC challenge. Resolution order: explicit ``authkey`` ctor arg, then
+    ``RLT_FABRIC_AUTHKEY``, else a per-server random key is GENERATED
+    (``secrets.token_hex``) and printed with the ready line — out of the
+    box, a process that can merely reach the port no longer owns the
+    fabric (Jupyter-token model).
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        authkey: Optional[bytes] = None,
+    ) -> None:
         from multiprocessing.connection import Listener
 
         from ray_lightning_tpu.fabric import core
@@ -60,8 +69,15 @@ class FabricServer:
         self._owns_session = not core.is_initialized()
         if self._owns_session:
             core.init()
+        key = authkey or _env_authkey()
+        self.authkey_generated = key is None
+        if key is None:
+            import secrets
+
+            key = secrets.token_hex(16).encode()
+        self.authkey = key.decode()
         self._listener = Listener(
-            address=(host, port), family="AF_INET", authkey=_authkey()
+            address=(host, port), family="AF_INET", authkey=key
         )
         self.address = f"{self._listener.address[0]}:{self._listener.address[1]}"
         self._queues: Dict[str, Any] = {}
@@ -85,11 +101,23 @@ class FabricServer:
         self._accept_thread.start()
 
     def _accept_loop(self) -> None:
+        from multiprocessing import AuthenticationError
+
         while not self._stop.is_set():
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError):
-                break
+            except (AuthenticationError, EOFError, ConnectionError):
+                # Bad key, port scanner, or half-open handshake: the
+                # misbehaving CLIENT must not kill the server — drop the
+                # connection and keep listening.
+                continue
+            except OSError:
+                if self._stop.is_set():
+                    break  # listener closed by shutdown()
+                # Transient socket error: back off briefly so a dead
+                # listener cannot spin this loop hot.
+                self._stop.wait(0.1)
+                continue
             t = threading.Thread(
                 target=self._client_loop, args=(conn,), daemon=True
             )
@@ -242,8 +270,16 @@ def main(argv: Any = None) -> None:
 
     core.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
     server = FabricServer(host=args.host, port=args.port)
-    # Parseable ready line for launch scripts/tests.
-    print(f"FABRIC_SERVER_READY {server.address}", flush=True)
+    # Parseable ready line for launch scripts/tests. A GENERATED key is
+    # printed so the operator can hand it to clients (Jupyter-token
+    # model); an operator-provided key (env/ctor) is never echoed.
+    if server.authkey_generated:
+        print(
+            f"FABRIC_SERVER_READY {server.address} key={server.authkey}",
+            flush=True,
+        )
+    else:
+        print(f"FABRIC_SERVER_READY {server.address}", flush=True)
     server.serve_forever()
 
 

@@ -89,12 +89,28 @@ def fabric_head():
         except queue.Empty:
             continue
         if line.startswith("FABRIC_SERVER_READY"):
-            address = line.split()[1]
+            parts = line.split()
+            address = parts[1]
+            # Per-server generated key (Jupyter-token model): hand it to
+            # the client side via the env var, which also flows into CLI
+            # subprocess tests that copy os.environ.
+            key = next(
+                (p[len("key=") :] for p in parts[2:] if p.startswith("key=")),
+                None,
+            )
             break
     assert address, "server never printed ready line"
+    prev_key = os.environ.get("RLT_FABRIC_AUTHKEY")
+    if key:
+        os.environ["RLT_FABRIC_AUTHKEY"] = key
     try:
         yield address
     finally:
+        if key:
+            if prev_key is None:
+                os.environ.pop("RLT_FABRIC_AUTHKEY", None)
+            else:
+                os.environ["RLT_FABRIC_AUTHKEY"] = prev_key
         from ray_lightning_tpu.fabric import client
 
         client.disconnect()

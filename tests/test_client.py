@@ -49,6 +49,32 @@ def test_client_basic_ops(fabric_head):
     assert not client.is_connected()
 
 
+def test_client_auth_required_and_rejected(fabric_head):
+    """Reaching the port is not enough: a missing key fails with guidance,
+    a wrong key is rejected by the HMAC challenge, and the fixture's
+    generated key (from the server's ready line) works."""
+    import os
+
+    from ray_lightning_tpu.fabric.client import FabricClient
+
+    key = os.environ.get("RLT_FABRIC_AUTHKEY")
+    assert key, "fixture should have captured the generated key"
+
+    with pytest.raises(RuntimeError, match="rejected the authkey"):
+        FabricClient(fabric_head, authkey="wrong-" + key)
+
+    del os.environ["RLT_FABRIC_AUTHKEY"]
+    try:
+        with pytest.raises(RuntimeError, match="needs the server's authkey"):
+            FabricClient(fabric_head)
+    finally:
+        os.environ["RLT_FABRIC_AUTHKEY"] = key
+
+    c = FabricClient(fabric_head, authkey=key)
+    assert c.request(("cluster_resources",))["CPU"] == 8
+    c.close()
+
+
 def test_client_exception_propagates(fabric_head):
     from ray_lightning_tpu.launchers.utils import TrainWorker
 
