@@ -69,13 +69,18 @@ class FabricServer:
         self._owns_session = not core.is_initialized()
         if self._owns_session:
             core.init()
-        key = authkey or _env_authkey()
+        key = authkey if authkey is not None else _env_authkey()
+        if key is not None and not key:
+            raise ValueError("authkey must be non-empty")
         self.authkey_generated = key is None
         if key is None:
             import secrets
 
             key = secrets.token_hex(16).encode()
-        self.authkey = key.decode()
+        # Printable form for the ready line. Generated keys are always
+        # hex; an operator-passed binary key stays usable (it is never
+        # echoed) and only its display form is escaped.
+        self.authkey = key.decode("utf-8", "backslashreplace")
         self._listener = Listener(
             address=(host, port), family="AF_INET", authkey=key
         )

@@ -16,6 +16,7 @@ from ray_lightning_tpu.models.gpt import (
 )
 from ray_lightning_tpu.models.mnist import MNISTClassifier, make_fake_mnist
 from ray_lightning_tpu.models.resnet import CIFARResNet, make_fake_cifar
+from ray_lightning_tpu.models.vit import ViTClassifier, ViTConfig, vit_forward
 from ray_lightning_tpu.models.xor import XORModule
 
 __all__ = [
@@ -28,6 +29,9 @@ __all__ = [
     "GPTLM",
     "CIFARResNet",
     "make_fake_cifar",
+    "ViTClassifier",
+    "ViTConfig",
+    "vit_forward",
     "gpt_forward",
     "init_gpt_params",
     "make_fake_text",

@@ -31,18 +31,95 @@ from ray_lightning_tpu.trainer.module import TPUModule
 
 
 def make_fake_cifar(
-    n: int = 512, seed: int = 0, num_classes: int = 10
+    n: int = 512, seed: int = 0, num_classes: int = 10, size: int = 32
 ) -> ArrayDataset:
     """Synthetic separable CIFAR-shaped dataset (uint8 NHWC), mirroring the
     fake-MNIST fixture: class-dependent prototype images + noise."""
     g = np.random.default_rng(seed)
     labels = g.integers(0, num_classes, size=n).astype(np.int32)
     proto = np.random.default_rng(4321).integers(
-        0, 256, size=(num_classes, 32, 32, 3)
+        0, 256, size=(num_classes, size, size, 3)
     )
-    noise = g.normal(0.0, 32.0, size=(n, 32, 32, 3))
+    noise = g.normal(0.0, 32.0, size=(n, size, size, 3))
     images = np.clip(proto[labels] + noise, 0, 255).astype(np.uint8)
     return ArrayDataset(images, labels)
+
+
+class ImageClassifierModule(TPUModule):
+    """Shared surface of the image-classifier families (ResNet, ViT):
+    on-device uint8 normalization, cross-entropy/accuracy steps, and
+    fake-CIFAR dataloaders sized to the subclass's ``image_size``.
+    Subclasses implement ``_forward(params, x)``."""
+
+    num_classes: int = 10
+    image_size: int = 32
+    batch_size: int = 32
+    n_train: int = 512
+    _dataset: Optional[ArrayDataset] = None
+
+    def _forward(self, params: Any, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    @staticmethod
+    def _prep(x: jax.Array) -> jax.Array:
+        """uint8 NHWC -> normalized f32, on device."""
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 255.0
+        return (x - 0.5) / 0.25
+
+    def _loss_acc(self, params: Any, batch: Tuple) -> Tuple[jax.Array, jax.Array]:
+        x, y = batch
+        logits = self._forward(params, self._prep(x))
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, acc
+
+    # -- steps -----------------------------------------------------------
+    def training_step(self, params, batch, rng):
+        loss, acc = self._loss_acc(params, batch)
+        return loss, {"loss": loss, "acc": acc}
+
+    def validation_step(self, params, batch):
+        loss, acc = self._loss_acc(params, batch)
+        return {"val_loss": loss, "val_accuracy": acc}
+
+    def predict_step(self, params, batch):
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return jnp.argmax(self._forward(params, self._prep(x)), -1)
+
+    # -- data ------------------------------------------------------------
+    def _check_dataset(self, ds: ArrayDataset) -> ArrayDataset:
+        shape = np.shape(ds[0][0])
+        expect = (self.image_size, self.image_size)
+        if shape[:2] != expect:
+            raise ValueError(
+                f"dataset images are {shape[:2]}, but this model expects "
+                f"{expect} (config image_size); resize the data or the "
+                "config"
+            )
+        return ds
+
+    def _fake(self, n: int, seed: int = 0) -> ArrayDataset:
+        return make_fake_cifar(
+            n, seed=seed, num_classes=self.num_classes, size=self.image_size
+        )
+
+    def _data(self) -> ArrayDataset:
+        if self._dataset is None:
+            self._dataset = self._fake(self.n_train)
+        return self._check_dataset(self._dataset)
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(self._data(), batch_size=self.batch_size, shuffle=True)
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(self._fake(128, seed=7), batch_size=self.batch_size)
+
+    def test_dataloader(self) -> DataLoader:
+        return DataLoader(self._fake(128, seed=8), batch_size=self.batch_size)
+
+    def predict_dataloader(self) -> DataLoader:
+        return DataLoader(self._fake(128, seed=9), batch_size=self.batch_size)
 
 
 try:
@@ -96,7 +173,7 @@ except ImportError:  # pragma: no cover - flax is baked into this image
     FLAX_AVAILABLE = False
 
 
-class CIFARResNet(TPUModule):
+class CIFARResNet(ImageClassifierModule):
     """ResNet-18/CIFAR-10 TPUModule (BASELINE.md config 3)."""
 
     def __init__(
@@ -128,58 +205,11 @@ class CIFARResNet(TPUModule):
         x = self._prep(batch[0][:1])
         return self.model.init(rng, x)
 
-    @staticmethod
-    def _prep(x: jax.Array) -> jax.Array:
-        """uint8 NHWC -> normalized f32, on device."""
-        if x.dtype == jnp.uint8:
-            x = x.astype(jnp.float32) / 255.0
-        return (x - 0.5) / 0.25
-
-    def _loss_acc(self, params: Any, batch: Tuple) -> Tuple[jax.Array, jax.Array]:
-        x, y = batch
-        logits = self.model.apply(params, self._prep(x))
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
-        return loss, acc
-
-    # -- steps -----------------------------------------------------------
-    def training_step(self, params, batch, rng):
-        loss, acc = self._loss_acc(params, batch)
-        return loss, {"loss": loss, "acc": acc}
-
-    def validation_step(self, params, batch):
-        loss, acc = self._loss_acc(params, batch)
-        return {"val_loss": loss, "val_accuracy": acc}
-
-    def predict_step(self, params, batch):
-        x = batch[0] if isinstance(batch, (tuple, list)) else batch
-        return jnp.argmax(self.model.apply(params, self._prep(x)), -1)
+    def _forward(self, params: Any, x: jax.Array) -> jax.Array:
+        return self.model.apply(params, x)
 
     def configure_optimizers(self):
         return optax.chain(
             optax.add_decayed_weights(self.weight_decay),
             optax.sgd(self.lr, momentum=self.momentum),
-        )
-
-    # -- data ------------------------------------------------------------
-    def _data(self) -> ArrayDataset:
-        if self._dataset is None:
-            self._dataset = make_fake_cifar(
-                self.n_train, num_classes=self.num_classes
-            )
-        return self._dataset
-
-    def train_dataloader(self) -> DataLoader:
-        return DataLoader(self._data(), batch_size=self.batch_size, shuffle=True)
-
-    def val_dataloader(self) -> DataLoader:
-        return DataLoader(
-            make_fake_cifar(128, seed=7, num_classes=self.num_classes),
-            batch_size=self.batch_size,
-        )
-
-    def test_dataloader(self) -> DataLoader:
-        return DataLoader(
-            make_fake_cifar(128, seed=8, num_classes=self.num_classes),
-            batch_size=self.batch_size,
         )
