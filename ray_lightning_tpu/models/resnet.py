@@ -52,7 +52,10 @@ class ImageClassifierModule(TPUModule):
     Subclasses implement ``_forward(params, x)``."""
 
     num_classes: int = 10
-    image_size: int = 32
+    # None = size-agnostic (ResNet's global pool accepts any input size);
+    # size-bound models (ViT: positional embeddings) set an int, which
+    # also sizes the fake data and enables the dataset check.
+    image_size: Optional[int] = None
     batch_size: int = 32
     n_train: int = 512
     _dataset: Optional[ArrayDataset] = None
@@ -89,6 +92,8 @@ class ImageClassifierModule(TPUModule):
 
     # -- data ------------------------------------------------------------
     def _check_dataset(self, ds: ArrayDataset) -> ArrayDataset:
+        if self.image_size is None:
+            return ds  # size-agnostic model: any image size trains
         shape = np.shape(ds[0][0])
         expect = (self.image_size, self.image_size)
         if shape[:2] != expect:
@@ -101,7 +106,10 @@ class ImageClassifierModule(TPUModule):
 
     def _fake(self, n: int, seed: int = 0) -> ArrayDataset:
         return make_fake_cifar(
-            n, seed=seed, num_classes=self.num_classes, size=self.image_size
+            n,
+            seed=seed,
+            num_classes=self.num_classes,
+            size=self.image_size or 32,
         )
 
     def _data(self) -> ArrayDataset:

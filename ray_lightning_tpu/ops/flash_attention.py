@@ -430,7 +430,18 @@ def flash_attention(
         interpret = jax.default_backend() != "tpu"
     seq_q, seq_k = q.shape[1], k.shape[1]
     bq, bk = min(block_q, seq_q), min(block_k, seq_k)
-    if seq_q % bq or seq_k % bk or (causal and seq_q != seq_k):
+    if (
+        seq_q % bq
+        or seq_k % bk
+        or (causal and seq_q != seq_k)
+        # TPU tiling wants the blocks' second-minor dim 8-aligned (the
+        # kernel's own lse row is padded to 8 lanes for the same reason);
+        # a clipped block like bq=65 (ViT's n_patches+1) would otherwise
+        # reach Mosaic unaligned. Interpret mode doesn't tile, but keep
+        # ONE rule so CPU tests exercise the same path selection as TPU.
+        or bq % 8
+        or bk % 8
+    ):
         return attention_reference(
             q, k, v, causal=causal, sm_scale=sm_scale, window=int(window),
             sinks=int(sinks),

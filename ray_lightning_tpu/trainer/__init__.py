@@ -5,6 +5,7 @@ from ray_lightning_tpu.trainer.callbacks import (
     LearningRateMonitor,
     ModelCheckpoint,
     JaxProfilerCallback,
+    TensorBoardLogger,
     TPUStatsCallback,
 )
 from ray_lightning_tpu.trainer.ema import ema_params, params_ema
@@ -29,6 +30,7 @@ __all__ = [
     "Callback",
     "ModelCheckpoint",
     "CSVLogger",
+    "TensorBoardLogger",
     "EarlyStopping",
     "LearningRateMonitor",
     "JaxProfilerCallback",

@@ -443,6 +443,113 @@ class LearningRateMonitor(Callback):
             trainer.callback_metrics[self.key] = lr
 
 
+class TensorBoardLogger(Callback):
+    """Scalar metrics to TensorBoard event files (rank 0 only).
+
+    The PTL-style logger reference users attach for dashboards; pairs
+    with ``JaxProfilerCallback``, whose traces land in the same
+    TensorBoard UI. Per-step train metrics are written at the
+    ``log_every_n_steps`` cadence (the host values the loop already
+    fetched — no extra device syncs); validation metrics at each val end.
+    Requires the ``tensorboard`` package (present in this image); raises
+    a clear ImportError otherwise.
+    """
+
+    def __init__(
+        self, dirpath: Optional[str] = None, name: str = "tb"
+    ) -> None:
+        try:
+            from tensorboard.summary.writer.event_file_writer import (  # noqa: F401
+                EventFileWriter,
+            )
+        except ImportError as exc:  # pragma: no cover - baked into image
+            raise ImportError(
+                "TensorBoardLogger needs the 'tensorboard' package"
+            ) from exc
+        self.dirpath = dirpath
+        self.name = name
+        self._writer: Any = None
+        self._log_dir: Optional[str] = None
+
+    @property
+    def log_dir(self) -> Optional[str]:
+        """Directory holding the event file (resolved at fit start)."""
+        return self._log_dir
+
+    def _ensure_writer(self, trainer: Any) -> Any:
+        if self._writer is None:
+            from tensorboard.summary.writer.event_file_writer import (
+                EventFileWriter,
+            )
+
+            base = self.dirpath or os.path.join(
+                trainer.default_root_dir, "tensorboard"
+            )
+            self._log_dir = os.path.join(base, self.name)
+            os.makedirs(self._log_dir, exist_ok=True)
+            self._writer = EventFileWriter(self._log_dir)
+        return self._writer
+
+    def _write_scalars(
+        self, trainer: Any, metrics: Dict[str, Any], step: int
+    ) -> None:
+        import time
+
+        from tensorboard.compat.proto.event_pb2 import Event
+        from tensorboard.compat.proto.summary_pb2 import Summary
+
+        values = []
+        for k, v in metrics.items():
+            try:
+                values.append(
+                    Summary.Value(tag=k, simple_value=float(np.asarray(v)))
+                )
+            except (TypeError, ValueError):
+                continue
+        if not values:
+            return
+        self._ensure_writer(trainer).add_event(
+            Event(
+                wall_time=time.time(), step=step, summary=Summary(value=values)
+            )
+        )
+
+    def on_train_batch_end(
+        self, trainer: Any, module: Any, logs: Dict[str, float], batch_idx: int
+    ) -> None:
+        if trainer.global_rank == 0 and logs:
+            self._write_scalars(trainer, logs, trainer.global_step)
+
+    def on_validation_end(self, trainer: Any, module: Any) -> None:
+        if trainer.global_rank != 0 or getattr(
+            trainer, "sanity_checking", False
+        ):
+            return
+        # "val_loss" and namespaced forms like "ptl/val_loss" — but NOT
+        # train metrics that merely contain the substring (eval_loss,
+        # interval_loss).
+        val = {
+            k: v
+            for k, v in trainer.callback_metrics.items()
+            if k.split("/")[-1].startswith("val")
+        }
+        self._write_scalars(trainer, val, trainer.global_step)
+
+    def on_fit_end(self, trainer: Any, module: Any) -> None:
+        if self._writer is not None:
+            self._writer.flush()
+            self._writer.close()
+            self._writer = None
+
+    def state_dict(self) -> Dict[str, Any]:
+        # The log dir rides the callback sync so the DRIVER-side object
+        # can point users at the files the worker wrote.
+        return {"log_dir": self._log_dir}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._log_dir = state.get("log_dir") or self._log_dir
+
+
 class CSVLogger(Callback):
     """Append one metrics row per epoch to ``dirpath/metrics.csv``.
 

@@ -54,6 +54,49 @@ def test_config_validation():
         ViTConfig(d_model=30, n_head=4)
 
 
+def test_image_size_guard_and_agnostic_resnet():
+    """ViT (size-bound: positional embeddings) rejects mismatched datasets
+    with a clear error; ResNet (global pool) stays size-agnostic and
+    trains on any image size."""
+    from ray_lightning_tpu.models import CIFARResNet
+    from ray_lightning_tpu.models.resnet import make_fake_cifar
+    from ray_lightning_tpu.trainer import Trainer
+
+    bad = make_fake_cifar(32, size=16)
+    vit = ViTClassifier(
+        config=dataclasses.replace(TINY, image_size=32), batch_size=8,
+        dataset=bad,
+    )
+    with pytest.raises(ValueError, match="image_size"):
+        vit.train_dataloader()
+
+    resnet = CIFARResNet(
+        batch_size=8, n_train=32, width=8,
+        dataset=make_fake_cifar(32, size=48),
+    )
+    t = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, limit_val_batches=1,
+    )
+    t.fit(resnet)
+    assert np.isfinite(t.callback_metrics["loss_epoch"])
+
+
+def test_flash_falls_back_on_unaligned_vit_seq():
+    """seq = n_patches+1 = 65 is not 8-aligned: the flash path must select
+    the reference fallback (TPU tiling) and still match it exactly."""
+    from ray_lightning_tpu.ops import attention_reference, flash_attention
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 65, 2, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 65, 2, 8))
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 65, 2, 8))
+    out = flash_attention(q, k, v, causal=False)
+    ref = attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
 def test_vit_trains_in_process():
     """Single-process fit: loss decreases on the separable fake CIFAR."""
     from ray_lightning_tpu.trainer import Trainer
