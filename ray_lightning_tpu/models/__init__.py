@@ -14,6 +14,7 @@ from ray_lightning_tpu.models.gpt import (
     init_gpt_params,
     make_fake_text,
 )
+from ray_lightning_tpu.models.hf_import import load_hf_gpt2
 from ray_lightning_tpu.models.mnist import MNISTClassifier, make_fake_mnist
 from ray_lightning_tpu.models.resnet import CIFARResNet, make_fake_cifar
 from ray_lightning_tpu.models.vit import ViTClassifier, ViTConfig, vit_forward
@@ -35,4 +36,5 @@ __all__ = [
     "gpt_forward",
     "init_gpt_params",
     "make_fake_text",
+    "load_hf_gpt2",
 ]

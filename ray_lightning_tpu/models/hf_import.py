@@ -1,0 +1,156 @@
+"""Import Hugging Face GPT-2 weights into the native GPT family.
+
+The migration bridge for reference users with existing torch checkpoints:
+``load_hf_gpt2`` maps a ``transformers`` GPT-2 (model instance or local
+checkpoint path) onto :func:`~ray_lightning_tpu.models.gpt.gpt_forward`'s
+parameter pytree — stacked per-layer leaves (leading ``layers`` dim, the
+layout every mesh axis shards) instead of torch's per-module tensors.
+
+Numerical parity with the canonical implementation is asserted in
+``tests/test_hf_import.py`` (converted logits == HF torch logits). torch
+and transformers are imported lazily so the training path never pays for
+them.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+def load_hf_gpt2(model_or_path: Any, **cfg_overrides: Any):
+    """HF GPT-2 -> (params pytree, GPTConfig).
+
+    Args:
+      model_or_path: a ``transformers`` ``GPT2LMHeadModel``/``GPT2Model``
+        instance, or a local checkpoint path for ``from_pretrained``.
+      cfg_overrides: GPTConfig fields to override (e.g. ``attn_impl``,
+        ``compute_dtype``, a mesh-ready ``seq_impl``). Architecture fields
+        (sizes, head counts) come from the HF config and cannot be
+        overridden.
+
+    Returns params compatible with ``gpt_forward``/``GPTLM`` and the
+    matching :class:`GPTConfig` (learned positions, tied head, gelu-tanh —
+    GPT-2's exact architecture).
+    """
+    from ray_lightning_tpu.models.gpt import GPTConfig
+
+    model = _resolve_model(model_or_path)
+    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    # Both GPT2Model ("wte.weight") and GPT2LMHeadModel ("transformer.wte
+    # .weight") layouts.
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+    def t(name: str) -> np.ndarray:
+        return np.asarray(sd[prefix + name], np.float32)
+
+    hf_cfg = model.config
+    # The native forward hardcodes GPT-2's defaults (gelu-tanh, LN eps
+    # 1e-5, 1/sqrt(hd) scaling). Non-default family variants would convert
+    # silently with WRONG numerics — fail fast instead.
+    unsupported = {
+        "activation_function": (
+            getattr(hf_cfg, "activation_function", "gelu_new"),
+            ("gelu_new",),
+        ),
+        "layer_norm_epsilon": (
+            float(getattr(hf_cfg, "layer_norm_epsilon", 1e-5)),
+            (1e-5,),
+        ),
+        "scale_attn_by_inverse_layer_idx": (
+            bool(getattr(hf_cfg, "scale_attn_by_inverse_layer_idx", False)),
+            (False,),
+        ),
+        "reorder_and_upcast_attn": (
+            bool(getattr(hf_cfg, "reorder_and_upcast_attn", False)),
+            (False,),
+        ),
+    }
+    bad = {
+        k: got for k, (got, ok) in unsupported.items() if got not in ok
+    }
+    if bad:
+        raise ValueError(
+            f"HF config options {bad} are not supported by the native "
+            "GPT forward (it implements stock GPT-2: gelu_new, LN eps "
+            "1e-5, 1/sqrt(head_dim) attention scaling)"
+        )
+    L, D = hf_cfg.n_layer, hf_cfg.n_embd
+    H = hf_cfg.n_head
+    hd = D // H
+    F = t("h.0.mlp.c_fc.weight").shape[1]
+
+    arch = dict(
+        vocab_size=hf_cfg.vocab_size,
+        n_layer=L,
+        n_head=H,
+        d_model=D,
+        d_ff=F,
+        max_seq=hf_cfg.n_positions,
+        pos_embed="learned",
+    )
+    # Shape fields come from the checkpoint; structure fields (GQA, MoE)
+    # would change the param LAYOUT the converted tree doesn't have.
+    locked = set(arch) | {"n_kv_head", "n_experts"}
+    clash = set(cfg_overrides) & locked
+    if clash:
+        raise ValueError(
+            f"architecture fields {sorted(clash)} are defined by the HF "
+            "checkpoint and cannot be overridden"
+        )
+    cfg = GPTConfig(**arch, **cfg_overrides)
+
+    def stack(name: str, reshape=None) -> np.ndarray:
+        leaves = [t(f"h.{i}.{name}") for i in range(L)]
+        out = np.stack(leaves)
+        return out.reshape((L,) + reshape) if reshape else out
+
+    params: Dict[str, Any] = {
+        "wte": t("wte.weight"),
+        "wpe": t("wpe.weight"),
+        "blocks": {
+            "ln1_g": stack("ln_1.weight"),
+            "ln1_b": stack("ln_1.bias"),
+            # HF Conv1D stores (in, out); c_attn out dim is [q|k|v] each
+            # D wide with heads-major, head_dim-minor layout.
+            "wqkv": stack("attn.c_attn.weight", (D, 3, H, hd)),
+            "bqkv": stack("attn.c_attn.bias", (3, H, hd)),
+            "wo": stack("attn.c_proj.weight", (H, hd, D)),
+            "bo": stack("attn.c_proj.bias"),
+            "ln2_g": stack("ln_2.weight"),
+            "ln2_b": stack("ln_2.bias"),
+            "wi": stack("mlp.c_fc.weight"),
+            "bi": stack("mlp.c_fc.bias"),
+            "wo2": stack("mlp.c_proj.weight"),
+            "bo2": stack("mlp.c_proj.bias"),
+        },
+        "lnf_g": t("ln_f.weight"),
+        "lnf_b": t("ln_f.bias"),
+    }
+    return params, cfg
+
+
+def _resolve_model(model_or_path: Any):
+    import os
+
+    if isinstance(model_or_path, (str, os.PathLike)):
+        from transformers import GPT2LMHeadModel
+
+        # local_files_only: this is an import bridge, not a downloader —
+        # point it at a checkout/export you already have on disk.
+        return GPT2LMHeadModel.from_pretrained(
+            os.fspath(model_or_path), local_files_only=True
+        )
+    return model_or_path
+
+
+def hf_gpt2_logits(model: Any, tokens: np.ndarray) -> np.ndarray:
+    """Reference logits from the HF model (eval mode, no grad) — the
+    parity oracle the tests compare against."""
+    import torch
+
+    model = model.eval()
+    with torch.no_grad():
+        out = model(torch.from_numpy(np.asarray(tokens, np.int64)))
+    logits = out.logits if hasattr(out, "logits") else out.last_hidden_state
+    return np.asarray(logits.float().numpy())
