@@ -24,12 +24,26 @@ def train_gpt(
     use_tpu: bool = False,
     smoke_test: bool = False,
     modern: bool = False,
+    from_hf: str = None,
 ) -> Trainer:
     """``modern=True`` enables the Mistral-style variant: RoPE positions,
     grouped-query attention (12 -> 4 kv heads: a 3x smaller decode cache;
     MQA in smoke mode), and a sliding attention window — same
-    trainer/strategy surface, one config change."""
-    if smoke_test:
+    trainer/strategy surface, one config change. ``from_hf`` fine-tunes a
+    local Hugging Face GPT-2 checkpoint instead of training from scratch
+    (weights imported via :func:`load_hf_gpt2`)."""
+    if from_hf:
+        if modern:
+            raise SystemExit(
+                "--from-hf imports a stock GPT-2 (learned positions, MHA); "
+                "it cannot be combined with --modern"
+            )
+        from ray_lightning_tpu.models import load_hf_gpt2
+
+        params, cfg = load_hf_gpt2(from_hf)
+        module = GPTLM(config=cfg, batch_size=4 if smoke_test else 16,
+                       n_train=64 if smoke_test else 2048, lr=1e-4)
+    elif smoke_test:
         extra = dict(pos_embed="rope", n_kv_head=1, attn_window=16) if modern else {}
         cfg = GPTConfig(
             vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq=32,
@@ -51,7 +65,19 @@ def train_gpt(
         seed=0,
         log_grad_norm=True,
     )
-    trainer.fit(module)
+    ckpt_path = None
+    if from_hf:
+        # fit() always initializes from the module's init_params; imported
+        # weights enter through the resume path (params-only checkpoint).
+        import tempfile
+
+        from ray_lightning_tpu.utils import to_state_stream
+
+        f = tempfile.NamedTemporaryFile(suffix=".ckpt", delete=False)
+        f.write(to_state_stream({"params": params}))
+        f.close()
+        ckpt_path = f.name
+    trainer.fit(module, ckpt_path=ckpt_path)
     print("val loss:", trainer.callback_metrics.get("val_loss"))
 
     # KV-cached greedy generation from the recovered rank-0 weights — run
@@ -103,6 +129,11 @@ def main() -> None:
         help="RoPE + grouped-query attention + sliding window variant",
     )
     parser.add_argument(
+        "--from-hf", type=str, default=None, metavar="PATH",
+        help="fine-tune a LOCAL Hugging Face GPT-2 checkpoint directory "
+        "instead of training from scratch (load_hf_gpt2 bridge)",
+    )
+    parser.add_argument(
         "--address", type=str, default=None,
         help="fabric head address (host:port) for client mode — start one "
         "with `python -m ray_lightning_tpu.fabric.server`",
@@ -120,6 +151,7 @@ def main() -> None:
         use_tpu=args.use_tpu,
         smoke_test=args.smoke_test,
         modern=args.modern,
+        from_hf=args.from_hf,
     )
     fabric.shutdown()
 

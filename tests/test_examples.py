@@ -48,3 +48,26 @@ def test_example_smoke(name, args):
     assert proc.returncode == 0, (
         f"{name} {args} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
     )
+
+
+def test_example_gpt_from_hf(tmp_path):
+    """--from-hf fine-tunes an imported (tiny, random-init) local HF GPT-2
+    checkpoint through the sharded strategy."""
+    pytest.importorskip("transformers")
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(0)
+    GPT2LMHeadModel(
+        GPT2Config(
+            vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=2,
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+    ).save_pretrained(str(tmp_path))
+    proc = _run_example(
+        "gpt_sharded_example.py", "--from-hf", str(tmp_path)
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "val loss:" in proc.stdout and "generated:" in proc.stdout
