@@ -14,6 +14,7 @@ from ray_lightning_tpu.trainer.data import (
     DataLoader,
     Dataset,
     DistributedSampler,
+    IterableDataset,
     TokenBinDataset,
     write_token_bin,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "ema_params",
     "DataLoader",
     "Dataset",
+    "IterableDataset",
     "ArrayDataset",
     "DistributedSampler",
     "TokenBinDataset",

@@ -31,6 +31,26 @@ class Dataset:
         raise NotImplementedError
 
 
+class IterableDataset:
+    """Streaming dataset protocol: ``__iter__`` yields items; no length.
+
+    For sources that don't fit the map-style contract — unbounded streams,
+    network readers, on-the-fly generators. Distributed contract: the
+    DataLoader STRIDES the stream (item ``i`` goes to replica
+    ``i % num_replicas``), so every host must construct an identical
+    iterator; shuffling belongs at the source (``shuffle=True`` on the
+    loader is rejected — there is nothing to index-permute).
+
+    Batch-shape contract (XLA static shapes): in the train path a partial
+    tail batch is DROPPED; in the eval path it is padded by repeating the
+    last item with the validity mask False, so masked eval metrics stay
+    exact on non-divisible streams.
+    """
+
+    def __iter__(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+
 class ArrayDataset(Dataset):
     """Dataset over parallel numpy arrays (features, labels, ...)."""
 
@@ -210,7 +230,7 @@ class DataLoader:
 
     def __init__(
         self,
-        dataset: Dataset | Sequence,
+        dataset: Dataset | IterableDataset | Sequence,
         batch_size: int = 1,
         shuffle: bool = False,
         drop_last: bool = False,
@@ -223,8 +243,16 @@ class DataLoader:
         self.drop_last = drop_last
         self.seed = seed
         self.collate_fn = collate_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable and shuffle:
+            raise ValueError(
+                "shuffle=True is undefined for IterableDataset: there are "
+                "no indices to permute — shuffle at the stream source"
+            )
         # Injected by the worker loop (distributed_sampler_kwargs analog).
         self.sampler: Optional[DistributedSampler] = None
+        # Stream sharding (IterableDataset): (num_replicas, rank) stride.
+        self._stride: Optional[Tuple[int, int]] = None
 
     def with_sampler(self, num_replicas: int, rank: int, seed: int) -> "DataLoader":
         loader = DataLoader(
@@ -235,6 +263,11 @@ class DataLoader:
             seed=self.seed,
             collate_fn=self.collate_fn,
         )
+        if self._iterable:
+            # Streams shard by striding: item i -> replica i % num_replicas
+            # (every host runs the same iterator, keeps its residue class).
+            loader._stride = (num_replicas, rank)
+            return loader
         loader.sampler = DistributedSampler(
             len(self.dataset),
             num_replicas=num_replicas,
@@ -307,6 +340,74 @@ class DataLoader:
                 ),
             )
 
+    def _iter_stream_batches(
+        self, batch_multiplier: int, with_mask: bool
+    ) -> Iterator[Any]:
+        """Batch a (possibly strided) IterableDataset stream.
+
+        SPMD invariant: every replica MUST emit the same number of
+        batches (each batch is assembled collectively by
+        ``make_array_from_process_local_data``; a rank with one extra
+        batch deadlocks the others). Batches are therefore aligned to
+        stride GROUPS of ``batch_size * num_replicas`` global items —
+        replica r yields its k-th batch only once the whole group is
+        known complete, and the tail handling is count-identical on
+        every rank: dropped for training (no mask to hide padding rows
+        from gradients), one padded+masked batch for eval (exact masked
+        reductions).
+        """
+        bs = self.batch_size * batch_multiplier
+        num_replicas, rank = self._stride if self._stride else (1, 0)
+        group = bs * num_replicas
+        buffer: list = []
+        last_item: Any = None
+        n_total = 0
+        yielded = 0
+        for i, item in enumerate(iter(self.dataset)):
+            n_total = i + 1
+            if i % num_replicas == rank:
+                buffer.append(item)
+                last_item = item
+            if n_total % group == 0:
+                batch = self._collate(buffer[:bs])
+                buffer = buffer[bs:]
+                yielded += 1
+                if with_mask:
+                    yield batch, np.ones(bs, dtype=bool)
+                else:
+                    yield batch
+        leftover = n_total % group
+        if leftover and not self.drop_last and with_mask:
+            # Every rank emits exactly one padded tail batch (leftover > 0
+            # is a GLOBAL fact, so the count stays equal) with its real
+            # rows — possibly zero of them — marked in the mask.
+            if last_item is None:
+                raise ValueError(
+                    f"stream yielded {n_total} items for {num_replicas} "
+                    "replicas: at least one replica saw nothing, so it "
+                    "cannot shape a padded eval batch — provide at least "
+                    "num_replicas items"
+                )
+            mask = np.zeros(bs, dtype=bool)
+            mask[: len(buffer)] = True
+            buffer = buffer + [last_item] * (bs - len(buffer))
+            yielded += 1
+            yield self._collate(buffer), mask
+        if yielded == 0:
+            if getattr(self, "_stream_saw_items", False):
+                raise RuntimeError(
+                    "IterableDataset produced no items on re-iteration: "
+                    "__iter__ must return a FRESH iterator per epoch (a "
+                    "one-shot generator was exhausted by a previous epoch "
+                    "or the init-shape probe)"
+                )
+            raise ValueError(
+                f"stream produced {n_total} items — fewer than one "
+                f"global batch (batch_size*batch_multiplier*replicas = "
+                f"{group}); shrink batch_size or provide more items"
+            )
+        self._stream_saw_items = True
+
     def iter_batches(
         self,
         batch_multiplier: int = 1,
@@ -328,13 +429,16 @@ class DataLoader:
 
             prefetch = 2 if native_available() else 0
 
-        def assemble(sel: np.ndarray, mask: np.ndarray) -> Any:
-            batch = self._gather(sel)
-            return (batch, mask) if with_mask else batch
+        def batches() -> Iterator[Any]:
+            if self._iterable:
+                yield from self._iter_stream_batches(batch_multiplier, with_mask)
+                return
+            for sel, mask in self._iter_selections(batch_multiplier):
+                batch = self._gather(sel)
+                yield (batch, mask) if with_mask else batch
 
         if prefetch <= 0:
-            for sel, mask in self._iter_selections(batch_multiplier):
-                yield assemble(sel, mask)
+            yield from batches()
             return
 
         import queue as queue_mod
@@ -346,8 +450,7 @@ class DataLoader:
 
         def producer() -> None:
             try:
-                for sel, mask in self._iter_selections(batch_multiplier):
-                    batch = assemble(sel, mask)
+                for batch in batches():
                     while not stop.is_set():
                         try:
                             q.put(batch, timeout=0.1)
@@ -379,7 +482,11 @@ class DataLoader:
         finally:
             stop.set()
 
-    def num_batches(self, batch_multiplier: int = 1) -> int:
+    def num_batches(self, batch_multiplier: int = 1) -> Optional[int]:
+        """Batches per epoch — None for streaming (IterableDataset)
+        loaders, whose length is unknown until exhaustion."""
+        if self._iterable:
+            return None
         n = (
             self.sampler.num_samples
             if self.sampler is not None
@@ -392,4 +499,7 @@ class DataLoader:
         return self.iter_batches(1)
 
     def __len__(self) -> int:
-        return self.num_batches(1)
+        n = self.num_batches(1)
+        if n is None:
+            raise TypeError("streaming DataLoader has no length")
+        return n

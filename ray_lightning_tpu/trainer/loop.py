@@ -72,12 +72,20 @@ class TrainerSpec:
     callbacks: List[Any] = field(default_factory=list)
 
 
-def _limit(n_batches: int, limit: Any) -> int:
+def _limit(n_batches: Optional[int], limit: Any) -> Optional[int]:
+    """None n_batches = a streaming loader (unknown length): int limits
+    bound it, fractional limits have nothing to take a fraction OF."""
     if limit is None:
         return n_batches
     if isinstance(limit, float):
+        if n_batches is None:
+            raise ValueError(
+                "fractional batch limits need a sized dataset; streaming "
+                "(IterableDataset) loaders have no length — use an int "
+                "limit or max_steps"
+            )
         return max(1, int(n_batches * limit))
-    return min(n_batches, int(limit))
+    return int(limit) if n_batches is None else min(n_batches, int(limit))
 
 
 class TrainingLoop:
@@ -619,10 +627,16 @@ class TrainingLoop:
             if isinstance(vci, float) and vci == 1.0:
                 vci = None  # PTL: 1.0 == once per epoch (the default path)
             elif vci is not None and 0 < float(vci) < 1:
+                if n_batches is None:
+                    raise ValueError(
+                        "float val_check_interval needs a sized dataset; "
+                        "streaming (IterableDataset) loaders have no "
+                        "length — use an int interval"
+                    )
                 vci = max(1, int(n_batches * float(vci)))
             elif vci is not None:
                 vci = int(vci)
-                if vci > n_batches > 0:
+                if n_batches is not None and vci > n_batches > 0:
                     raise ValueError(
                         f"val_check_interval ({vci}) exceeds the number of "
                         f"training batches per epoch ({n_batches}); use a "
@@ -650,7 +664,9 @@ class TrainingLoop:
                             self._update_count += 1
                     if (
                         self.global_step % self.spec.log_every_n_steps == 0
-                        or batch_idx == n_batches - 1
+                        # Streaming epochs (n_batches None) have no known
+                        # final batch; the post-loop drain covers the tail.
+                        or (n_batches is not None and batch_idx == n_batches - 1)
                     ):
                         host_logs = _drain_logs()
                         self.logged_metrics.update(host_logs)
@@ -661,7 +677,11 @@ class TrainingLoop:
                         and val_epoch
                         and (batch_idx + 1) % vci == 0
                     ):
-                        if batch_idx == n_batches - 1 and self._mini_host == 0:
+                        if (
+                            n_batches is not None
+                            and batch_idx == n_batches - 1
+                            and self._mini_host == 0
+                        ):
                             # Final batch, nothing left to flush: any
                             # checkpoint this val writes is epoch-complete.
                             self._epoch_complete = True
@@ -688,7 +708,9 @@ class TrainingLoop:
             # ON the final batch still flushes, while an earlier stop must
             # not advance params past the requested step budget.
             flushed = False
-            if not stop or batch_idx == n_batches - 1:
+            if not stop or (
+                n_batches is not None and batch_idx == n_batches - 1
+            ):
                 flushed = self._mini_host > 0  # flush will change params
                 self._flush_accumulation()
                 self._epoch_complete = True
@@ -792,7 +814,21 @@ class TrainingLoop:
         )
         n_batches = _limit(loader.num_batches(mult), limit)
         if max_batches is not None:
-            n_batches = min(n_batches, max_batches)
+            n_batches = (
+                max_batches if n_batches is None else min(n_batches, max_batches)
+            )
+        if n_batches is None and not getattr(self, "_warned_stream_eval", False):
+            # Train epochs over unbounded streams are boundable with
+            # max_steps; an eval epoch has no such brake.
+            self._warned_stream_eval = True
+            warnings.warn(
+                "evaluating over a streaming (IterableDataset) loader with "
+                "no batch limit: the eval epoch runs until the stream "
+                "ends — set limit_val_batches/limit_test_batches (int) if "
+                "the stream is unbounded",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         # Each step returns (per-key masked sums, real-sample count) — device
         # scalars, fetched once at the end. The weighted combine makes epoch
         # metrics exact on non-divisible datasets (padding rows carry zero
