@@ -360,6 +360,11 @@ def main() -> None:
                 # window — a flagged record must really be a CPU run.
                 os.environ.pop("RLT_REQUIRE_TPU", None)
                 os.environ["RLT_NUM_TPU_CHIPS"] = "0"
+                # Full-size extras (GPT-2 124M / ResNet-18) take hours on
+                # one CPU core; a flagged fallback run must still FINISH,
+                # so shrink them to the tiny configs (the ratio headline
+                # keeps its real sizes — MLP steps are cheap on CPU).
+                os.environ.setdefault("RLT_BENCH_TINY", "1")
                 fabric.init(num_cpus=bench_cpus)
                 break
             print(
@@ -385,6 +390,7 @@ def main() -> None:
     if probe_error is not None:
         env["tpu_probe_failed"] = True
         env["probe_error"] = probe_error[:500]
+        env["tiny_extras"] = _tiny()  # flagged runs shrink GPT/ResNet
 
     t0 = time.time()
     mnist = bench_mnist(
