@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
 
-_SUBCOMMANDS = ("fit", "validate", "test", "predict", "generate")
+_SUBCOMMANDS = ("fit", "validate", "test", "predict", "generate", "convert-hf")
 
 
 def import_class(path: str) -> type:
@@ -128,6 +128,12 @@ def _apply_dotted(
     field_overrides: List[Tuple[str, str, str]] = []
     for key, raw in dotted:
         section, _, field = key.partition(".")
+        if section in ("src", "out"):  # convert-hf scalar options
+            config[section] = raw
+            continue
+        if section == "overrides":  # convert-hf GPTConfig overrides
+            config.setdefault("overrides", {})[field] = yaml.safe_load(raw)
+            continue
         if section not in ("model", "strategy", "trainer", "data", "generate"):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
@@ -298,6 +304,53 @@ def run_generate(config: Dict[str, Any]) -> Any:
     return out
 
 
+def run_convert_hf(config: Dict[str, Any]) -> str:
+    """``convert-hf``: local Hugging Face GPT-2 checkpoint -> a native
+    params checkpoint usable as ``fit/validate/generate`` ckpt_path.
+
+    Options (``--src``/``--out`` or a ``convert_hf:`` YAML section):
+      src (required, HF checkpoint directory), out (required, .ckpt file),
+      plus GPTConfig overrides under ``overrides:`` (e.g.
+      ``--overrides.attn_impl reference``).
+    """
+    section = dict(config.pop("convert_hf", None) or {})
+    src = config.pop("src", None) or section.pop("src", None)
+    out = config.pop("out", None) or section.pop("out", None)
+    overrides = dict(
+        (config.pop("overrides", None) or section.pop("overrides", None) or {})
+    )
+    leftovers = {k: v for k, v in {**config, **section}.items()}
+    if leftovers:
+        raise ValueError(f"unknown convert-hf options: {sorted(leftovers)}")
+    if not src or not out:
+        raise ValueError("convert-hf requires --src <hf_dir> and --out <file.ckpt>")
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu.models import load_hf_gpt2
+    from ray_lightning_tpu.utils import to_state_stream
+    from ray_lightning_tpu.utils.state_stream import state_stream_to_file
+
+    params, cfg = load_hf_gpt2(src, **overrides)
+    state_stream_to_file(
+        to_state_stream(
+            {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+        ),
+        out,
+    )
+    n_params = sum(
+        int(np.prod(np.shape(x)))
+        for x in jax.tree_util.tree_leaves(params)
+    )
+    print(
+        f"wrote {out}: {n_params:,} params, "
+        f"n_layer={cfg.n_layer} d_model={cfg.d_model} vocab={cfg.vocab_size}"
+    )
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> Any:
     subcommand, config = parse_args(argv)
     fabric_cfg = config.pop("fabric", None) or {}
@@ -305,6 +358,8 @@ def main(argv: Optional[List[str]] = None) -> Any:
         from ray_lightning_tpu import fabric
 
         fabric.init(**fabric_cfg)
+    if subcommand == "convert-hf":
+        return run_convert_hf(config)
     if subcommand == "generate":
         return run_generate(config)
     trainer, model, datamodule = build(config)

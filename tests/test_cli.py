@@ -1,6 +1,8 @@
 """CLI construction tests — parity with the reference's LightningCLI
 coverage (strategy instantiated from CLI flags,
 /root/reference/ray_lightning/tests/test_lightning_cli.py:11-27)."""
+import os
+
 import numpy as np
 import pytest
 import yaml
@@ -184,6 +186,48 @@ def test_cli_address_enters_client_mode(fabric_head):
 
 
 @pytest.mark.slow
+def test_cli_convert_hf_then_generate(tmp_path, capsys):
+    """convert-hf writes a native checkpoint from a local HF GPT-2; the
+    generate subcommand decodes from it — the full torch-weights
+    migration through the CLI alone."""
+    pytest.importorskip("transformers")
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(0)
+    hf_dir = tmp_path / "hf"
+    GPT2LMHeadModel(
+        GPT2Config(
+            vocab_size=48, n_positions=32, n_embd=32, n_layer=1, n_head=2,
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+    ).save_pretrained(str(hf_dir))
+    out_path = str(tmp_path / "native.ckpt")
+
+    cli.main([
+        "convert-hf", "--src", str(hf_dir), "--out", out_path,
+        "--overrides.attn_impl", "reference",
+    ])
+    assert os.path.exists(out_path)
+    assert "wrote" in capsys.readouterr().out
+
+    gen = cli.main([
+        "generate",
+        "--model", "ray_lightning_tpu.models.GPTLM",
+        "--model.config",
+        "{vocab_size: 48, n_layer: 1, n_head: 2, d_model: 32, "
+        "max_seq: 32, attn_impl: reference}",
+        "--generate.ckpt_path", out_path,
+        "--generate.prompt", "1,2,3",
+        "--generate.max_new_tokens", "4",
+    ])
+    assert gen.shape == (1, 7)
+    assert (gen >= 0).all() and (gen < 48).all()
+
+    with pytest.raises(ValueError, match="requires --src"):
+        cli.main(["convert-hf", "--out", out_path])
+
+
 def test_cli_generate_from_checkpoint(tmp_path, capsys):
     """generate subcommand: fit a tiny GPT in-process, checkpoint it, then
     decode from the CLI with sampling flags."""
