@@ -208,7 +208,14 @@ class ModelCheckpoint(Callback):
 
 
 class EarlyStopping(Callback):
-    """Stop training when a monitored metric stops improving."""
+    """Stop training when a monitored metric stops improving.
+
+    PTL-parity knobs beyond patience: ``stopping_threshold`` stops as soon
+    as the metric is at least this good (the goal is reached),
+    ``divergence_threshold`` stops as soon as it is at least this BAD (the
+    run is unrecoverable), and ``check_finite`` stops on NaN/inf instead
+    of skipping the reading.
+    """
 
     def __init__(
         self,
@@ -216,12 +223,18 @@ class EarlyStopping(Callback):
         patience: int = 3,
         mode: str = "min",
         min_delta: float = 0.0,
+        stopping_threshold: Optional[float] = None,
+        divergence_threshold: Optional[float] = None,
+        check_finite: bool = False,
     ) -> None:
         assert mode in ("min", "max")
         self.monitor = monitor
         self.patience = patience
         self.mode = mode
         self.min_delta = abs(min_delta)
+        self.stopping_threshold = stopping_threshold
+        self.divergence_threshold = divergence_threshold
+        self.check_finite = check_finite
         self.wait = 0
         self.best: Optional[float] = None
 
@@ -232,11 +245,28 @@ class EarlyStopping(Callback):
             return score < self.best - self.min_delta
         return score > self.best + self.min_delta
 
+    def _beats(self, score: float, threshold: float) -> bool:
+        return score <= threshold if self.mode == "min" else score >= threshold
+
     def on_validation_end(self, trainer: Any, module: Any) -> None:
         if getattr(trainer, "sanity_checking", False):
             return  # discarded sanity metrics must not seed best/wait
         score = _metric_value(trainer, self.monitor)
-        if score is None or math.isnan(score):
+        if score is None:
+            return
+        if not math.isfinite(score):
+            if self.check_finite:
+                trainer.should_stop = True
+            return
+        if self.stopping_threshold is not None and self._beats(
+            score, self.stopping_threshold
+        ):
+            trainer.should_stop = True
+            return
+        if self.divergence_threshold is not None and not self._beats(
+            score, self.divergence_threshold
+        ):
+            trainer.should_stop = True
             return
         if self._improved(score):
             self.best = score

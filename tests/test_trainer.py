@@ -107,6 +107,37 @@ def test_early_stopping():
     assert trainer.current_epoch < 19  # stopped well before max_epochs
 
 
+def test_early_stopping_thresholds():
+    """stopping_threshold stops on goal reached; divergence_threshold stops
+    on unrecoverable runs; check_finite stops on NaN metrics."""
+    # Goal reached: loss drops under the threshold almost immediately.
+    m = BoringModule()
+    es = EarlyStopping(monitor="val_loss", patience=100,
+                       stopping_threshold=1e6)
+    t = get_trainer(max_epochs=20, callbacks=[es])
+    t.fit(m)
+    assert t.current_epoch == 0  # any finite loss beats 1e6
+
+    # Divergence: a threshold any loss exceeds stops on the first val.
+    m2 = BoringModule()
+    es2 = EarlyStopping(monitor="val_loss", patience=100,
+                        divergence_threshold=-1e6)
+    t2 = get_trainer(max_epochs=20, callbacks=[es2])
+    t2.fit(m2)
+    assert t2.current_epoch == 0  # any loss > -1e6 counts as diverged
+
+    # check_finite: a NaN metric stops instead of being skipped.
+    m3 = BoringModule()
+    orig = m3.validation_step
+    m3.validation_step = lambda params, batch: {
+        "val_loss": orig(params, batch)["val_loss"] * float("nan")
+    }
+    es3 = EarlyStopping(monitor="val_loss", patience=100, check_finite=True)
+    t3 = get_trainer(max_epochs=20, callbacks=[es3])
+    t3.fit(m3)
+    assert t3.current_epoch == 0
+
+
 def test_datamodule_path():
     module = XORModule(batch_size=2)
     dm = XORDataModule(batch_size=2)
