@@ -9,6 +9,7 @@ from ray_lightning_tpu.trainer.callbacks import (
     TPUStatsCallback,
 )
 from ray_lightning_tpu.trainer.ema import ema_params, params_ema
+from ray_lightning_tpu.trainer.lr_finder import LRFindResult, lr_find
 from ray_lightning_tpu.trainer.data import (
     ArrayDataset,
     DataLoader,
@@ -32,6 +33,8 @@ __all__ = [
     "ModelCheckpoint",
     "CSVLogger",
     "TensorBoardLogger",
+    "LRFindResult",
+    "lr_find",
     "EarlyStopping",
     "LearningRateMonitor",
     "JaxProfilerCallback",

@@ -1,0 +1,132 @@
+"""Learning-rate range test (PTL's ``Tuner.lr_find`` analog).
+
+Short exponential LR sweep (Smith, "Cyclical Learning Rates", 2015): one
+jitted update per step with the LR ramping from ``min_lr`` to ``max_lr``,
+loss recorded per step, early-stopped on divergence. The suggestion is
+the LR at the steepest descent of the smoothed curve — the classic
+pick-one-below-the-cliff heuristic.
+
+Runs single-process on the default backend (a range test is a probe, not
+a training run); the chosen LR then feeds any strategy's real fit.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LRFindResult:
+    lrs: List[float]
+    losses: List[float]  # smoothed
+    raw_losses: List[float]
+    suggestion: Optional[float]
+
+    def suggestion_or(self, default: float) -> float:
+        return self.suggestion if self.suggestion is not None else default
+
+
+def lr_find(
+    module: Any,
+    min_lr: float = 1e-6,
+    max_lr: float = 1.0,
+    num_steps: int = 100,
+    optimizer: Optional[Callable[[Any], Any]] = None,
+    smooth: float = 0.05,
+    divergence_factor: float = 4.0,
+    seed: int = 0,
+) -> LRFindResult:
+    """Sweep the LR exponentially over ``num_steps`` minibatches.
+
+    Args:
+      module: a TPUModule (uses its ``train_dataloader`` and
+        ``training_step``; params re-initialized from ``seed`` — the
+        probe never touches ``module.params``).
+      optimizer: ``schedule -> optax transform``; default ``optax.adam``.
+        Pass the same family you will train with (the useful range is
+        optimizer-dependent).
+      smooth: EMA coefficient for the loss curve the heuristics read.
+      divergence_factor: stop once the smoothed loss exceeds this multiple
+        of its best value (the cliff).
+
+    Returns an :class:`LRFindResult`; ``suggestion`` is None when the
+    curve never descends (raise ``max_lr`` or fix the model).
+    """
+    import jax
+    import optax
+
+    if not (0 < min_lr < max_lr):
+        raise ValueError(f"need 0 < min_lr < max_lr, got {min_lr}, {max_lr}")
+    if num_steps < 2:
+        raise ValueError("num_steps must be >= 2")
+
+    ratio = max_lr / min_lr
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        frac = jnp.asarray(step, jnp.float32) / float(num_steps - 1)
+        return jnp.asarray(min_lr, jnp.float32) * jnp.power(
+            jnp.asarray(ratio, jnp.float32), frac
+        )
+
+    tx = (optimizer or optax.adam)(schedule)
+    loader = module.train_dataloader()
+    rng = jax.random.PRNGKey(seed)
+    init_rng, step_rng = jax.random.split(rng)
+    batches = loader.iter_batches(1, prefetch=0)
+    first = next(iter(loader.iter_batches(1, prefetch=0)))
+    params = module.init_params(init_rng, first)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step_fn(params, opt_state, batch, rng):
+        def loss_fn(p):
+            loss, _ = module.training_step(p, batch, rng)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    lrs: List[float] = []
+    raw: List[float] = []
+    smoothed: List[float] = []
+    ema = None
+    best = math.inf
+    step = 0
+    while step < num_steps:
+        try:
+            batch = next(batches)
+        except StopIteration:
+            batches = loader.iter_batches(1, prefetch=0)  # cycle epochs
+            continue
+        params, opt_state, loss = step_fn(params, opt_state, batch, step_rng)
+        loss = float(np.asarray(loss))
+        lr_now = float(np.asarray(schedule(step)))
+        if not math.isfinite(loss):
+            break  # past the cliff: NaN/inf ends the sweep
+        ema = loss if ema is None else smooth * loss + (1 - smooth) * ema
+        lrs.append(lr_now)
+        raw.append(loss)
+        smoothed.append(ema)
+        best = min(best, ema)
+        if ema > divergence_factor * best and step > 1:
+            break
+        step += 1
+
+    suggestion = None
+    if len(smoothed) >= 4:
+        grads = np.gradient(np.asarray(smoothed))
+        # Skip the first few warmup points; require an actual descent.
+        lo = min(3, len(grads) - 1)
+        idx = lo + int(np.argmin(grads[lo:]))
+        if grads[idx] < 0:
+            suggestion = lrs[idx]
+    return LRFindResult(
+        lrs=lrs, losses=smoothed, raw_losses=raw, suggestion=suggestion
+    )
+

@@ -107,6 +107,30 @@ def test_early_stopping():
     assert trainer.current_epoch < 19  # stopped well before max_epochs
 
 
+def test_lr_find_range_test():
+    """The LR range test descends on a well-posed problem, suggests an lr
+    inside the swept range, early-stops past the divergence cliff, and
+    validates its inputs."""
+    from ray_lightning_tpu.trainer import lr_find
+
+    m = _DetModule(batch_size=8, n=96)
+    res = lr_find(m, min_lr=1e-5, max_lr=10.0, num_steps=60)
+    assert res.suggestion is not None
+    assert 1e-5 <= res.suggestion <= 10.0
+    assert len(res.lrs) == len(res.losses) == len(res.raw_losses)
+    # The sweep should have found the cliff before max_lr (sgd on a linear
+    # regression diverges well before lr=10) OR run out of steps.
+    assert len(res.lrs) <= 60
+    assert res.suggestion_or(1e-3) == res.suggestion
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="min_lr"):
+        lr_find(m, min_lr=1.0, max_lr=0.1)
+    with _pytest.raises(ValueError, match="num_steps"):
+        lr_find(m, num_steps=1)
+
+
 def test_early_stopping_thresholds():
     """stopping_threshold stops on goal reached; divergence_threshold stops
     on unrecoverable runs; check_finite stops on NaN metrics."""
