@@ -197,3 +197,76 @@ class AsyncOrbaxCheckpointIO(OrbaxCheckpointIO):
             ckptr.close()
         if is_rank_zero:
             state_stream_to_file(meta_stream, os.path.join(path, _META_FILE))
+
+
+def average_checkpoints(paths, out_path=None, keys=("params",)):
+    """Average parameter trees across state-stream checkpoints.
+
+    The "model soup" / checkpoint-SWA utility: element-wise mean of the
+    listed checkpoints' ``params`` (and any other ``keys`` whose trees
+    match), with the FIRST checkpoint's remaining state (progress
+    counters, callbacks) carried over. Floating leaves are averaged in
+    float64 and cast back; non-float leaves must be identical across
+    inputs (they are carried, not averaged).
+
+    Args:
+      paths: two or more state-stream checkpoint files.
+      out_path: when given, the averaged state is written there.
+    Returns the averaged state dict.
+    """
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu.utils.state_stream import (
+        load_state_stream,
+        state_stream_to_file,
+        to_state_stream,
+    )
+
+    paths = list(paths)
+    if len(paths) < 2:
+        raise ValueError("average_checkpoints needs at least two inputs")
+    states = []
+    for p in paths:
+        if is_sharded_checkpoint(p):
+            raise ValueError(
+                f"{p} is a sharded (orbax) directory; restore it to a "
+                "state-stream file first (validate/save_checkpoint)"
+            )
+        with open(p, "rb") as f:
+            states.append(load_state_stream(f.read()))
+    out = dict(states[0])
+    for key in keys:
+        trees = [s[key] for s in states if key in s]
+        if not trees:
+            continue
+        if len(trees) != len(states):
+            raise ValueError(
+                f"checkpoint key {key!r} present in only {len(trees)} of "
+                f"{len(states)} inputs"
+            )
+        structs = {jax.tree_util.tree_structure(t) for t in trees}
+        if len(structs) > 1:
+            raise ValueError(
+                f"checkpoint trees under {key!r} have different structures"
+            )
+
+        def _avg(*leaves):
+            first = np.asarray(leaves[0])
+            if not np.issubdtype(first.dtype, np.floating):
+                for other in leaves[1:]:
+                    if not np.array_equal(first, np.asarray(other)):
+                        raise ValueError(
+                            "non-float leaves differ across checkpoints; "
+                            "only float parameters can be averaged"
+                        )
+                return first
+            acc = np.mean(
+                [np.asarray(x, np.float64) for x in leaves], axis=0
+            )
+            return acc.astype(first.dtype)
+
+        out[key] = jax.tree_util.tree_map(_avg, *trees)
+    if out_path is not None:
+        state_stream_to_file(to_state_stream(out), out_path)
+    return out

@@ -107,6 +107,46 @@ def test_early_stopping():
     assert trainer.current_epoch < 19  # stopped well before max_epochs
 
 
+def test_average_checkpoints_soup(tmp_path):
+    """Model-soup averaging: the written soup holds the element-wise mean
+    of the input params, loads through the normal eval path, and rejects
+    mismatched inputs."""
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.trainer.checkpoint_io import average_checkpoints
+
+    paths = []
+    mods = []
+    for seed in (0, 1):
+        m = _DetModule(batch_size=4, n=96)
+        t = Trainer(
+            max_epochs=1, enable_checkpointing=False, seed=seed,
+            num_sanity_val_steps=0,
+        )
+        t.fit(m)
+        p = str(tmp_path / f"m{seed}.ckpt")
+        t.save_checkpoint(p)
+        paths.append(p)
+        mods.append(np.asarray(m.params["w"]))
+
+    soup_path = str(tmp_path / "soup.ckpt")
+    soup = average_checkpoints(paths, out_path=soup_path)
+    np.testing.assert_allclose(
+        np.asarray(soup["params"]["w"]), (mods[0] + mods[1]) / 2, rtol=1e-7
+    )
+    fresh = _DetModule(batch_size=4, n=96)
+    res = Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0,
+    ).validate(fresh, ckpt_path=soup_path)
+    assert np.isfinite(res[0]["val_loss"])
+    np.testing.assert_allclose(
+        np.asarray(fresh.params["w"]), (mods[0] + mods[1]) / 2, rtol=1e-7
+    )
+
+    with pytest.raises(ValueError, match="two inputs"):
+        average_checkpoints(paths[:1])
+
+
 def test_lr_find_range_test():
     """The LR range test descends on a well-posed problem, suggests an lr
     inside the swept range, early-stops past the divergence cliff, and
