@@ -310,6 +310,7 @@ class TPUStatsCallback(Callback):
         self.epoch_times: list[float] = []
         self.peak_memory: list[float] = []
         self.mfu: list[float] = []
+        self.steps_per_sec: list[float] = []
         self._t0 = 0.0
         self._step0 = 0
 
@@ -340,6 +341,13 @@ class TPUStatsCallback(Callback):
         self._fence(trainer)
         dt = time.perf_counter() - self._t0
         self.epoch_times.append(dt)
+        steps_done = trainer.global_step - self._step0
+        if dt > 0 and steps_done > 0:
+            # Per-host step rate; a user-facing throughput number without
+            # extra syncs (the fence above already paid the only one).
+            sps = steps_done / dt
+            self.steps_per_sec.append(sps)
+            trainer.callback_metrics["steps_per_sec"] = sps
         peak = 0.0
         for dev in jax.local_devices():
             try:
@@ -380,12 +388,14 @@ class TPUStatsCallback(Callback):
             "epoch_times": self.epoch_times,
             "peak_memory": self.peak_memory,
             "mfu": self.mfu,
+            "steps_per_sec": self.steps_per_sec,
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.epoch_times = list(state.get("epoch_times", []))
         self.peak_memory = list(state.get("peak_memory", []))
         self.mfu = list(state.get("mfu", []))
+        self.steps_per_sec = list(state.get("steps_per_sec", []))
 
 
 class JaxProfilerCallback(Callback):
