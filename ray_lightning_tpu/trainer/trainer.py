@@ -224,6 +224,8 @@ class Trainer:
         module.trainer = self
         if ckpt_path == "last":
             ckpt_path = self._resolve_last_ckpt()
+        elif ckpt_path == "best":
+            ckpt_path = self._resolve_best_ckpt()
         if ckpt_stream is None:
             ckpt_stream = self._read_ckpt(ckpt_path)
         prev_opt_state = getattr(module, "opt_state", None)
@@ -354,6 +356,18 @@ class Trainer:
             except OSError:
                 continue
         return out
+
+    def _resolve_best_ckpt(self) -> str:
+        """Resolve ``ckpt_path="best"`` (PTL convention): the checkpoint
+        callback's best_model_path from the monitored metric."""
+        cb = self.checkpoint_callback
+        best = getattr(cb, "best_model_path", "") if cb is not None else ""
+        if best and os.path.exists(best):
+            return best
+        raise FileNotFoundError(
+            'ckpt_path="best" needs a ModelCheckpoint with a recorded '
+            "best_model_path (fit with a monitored metric first)"
+        )
 
     def _resolve_last_ckpt(self) -> str:
         """Resolve ``ckpt_path="last"`` (PTL convention): the checkpoint

@@ -1286,6 +1286,16 @@ def test_ckpt_path_last_and_stage_limits(tmp_path):
         np.asarray(m2.params["w"]).shape, np.asarray(m.params["w"]).shape
     )
 
+    # ckpt_path="best": the monitored best from the fit's callback.
+    m_best = BoringModule()
+    res = t.validate(m_best, ckpt_path="best")
+    assert np.isfinite(res[0]["val_loss"])
+    with pytest.raises(FileNotFoundError, match="best"):
+        Trainer(
+            max_epochs=1, enable_checkpointing=False, seed=0,
+            num_sanity_val_steps=0,
+        ).validate(BoringModule(), ckpt_path="best")
+
     with pytest.raises(FileNotFoundError, match="last"):
         Trainer(
             max_epochs=1, enable_checkpointing=False, seed=0,
