@@ -15,31 +15,42 @@
 #include <thread>
 #include <vector>
 
+namespace {
+
+// Run work(lo, hi) over [0, n) on up to n_threads threads. Small inputs
+// stay single-threaded (thread spawn costs more than the copy). The one
+// chunking/spawn/join implementation every kernel shares.
+template <typename Fn>
+void parallel_rows(int64_t n, int32_t n_threads, Fn&& work) {
+  if (n_threads <= 1 || n < 4 * n_threads) {
+    work(static_cast<int64_t>(0), n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
 extern "C" {
 
 // Gather rows of a contiguous 2D-view array: out[i, :] = src[idx[i], :].
 // row_bytes covers all trailing dims. Multi-threaded for large batches.
 void rlt_gather_rows(const uint8_t* src, uint8_t* out, const int64_t* idx,
                      int64_t n_idx, int64_t row_bytes, int32_t n_threads) {
-  auto work = [&](int64_t lo, int64_t hi) {
+  parallel_rows(n_idx, n_threads, [=](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) {
       std::memcpy(out + i * row_bytes, src + idx[i] * row_bytes,
                   static_cast<size_t>(row_bytes));
     }
-  };
-  if (n_threads <= 1 || n_idx < 4 * n_threads) {
-    work(0, n_idx);
-    return;
-  }
-  std::vector<std::thread> ts;
-  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
-  for (int32_t t = 0; t < n_threads; ++t) {
-    int64_t lo = t * chunk;
-    int64_t hi = lo + chunk < n_idx ? lo + chunk : n_idx;
-    if (lo >= hi) break;
-    ts.emplace_back(work, lo, hi);
-  }
-  for (auto& t : ts) t.join();
+  });
 }
 
 // Fused gather + uint8 -> float32 normalize: out[i, j] =
@@ -48,7 +59,7 @@ void rlt_gather_rows(const uint8_t* src, uint8_t* out, const int64_t* idx,
 void rlt_gather_u8_to_f32(const uint8_t* src, float* out, const int64_t* idx,
                           int64_t n_idx, int64_t row_elems, float scale,
                           float shift, int32_t n_threads) {
-  auto work = [&](int64_t lo, int64_t hi) {
+  parallel_rows(n_idx, n_threads, [=](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) {
       const uint8_t* s = src + idx[i] * row_elems;
       float* o = out + i * row_elems;
@@ -56,22 +67,43 @@ void rlt_gather_u8_to_f32(const uint8_t* src, float* out, const int64_t* idx,
         o[j] = static_cast<float>(s[j]) * scale + shift;
       }
     }
-  };
-  if (n_threads <= 1 || n_idx < 4 * n_threads) {
-    work(0, n_idx);
-    return;
-  }
-  std::vector<std::thread> ts;
-  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
-  for (int32_t t = 0; t < n_threads; ++t) {
-    int64_t lo = t * chunk;
-    int64_t hi = lo + chunk < n_idx ? lo + chunk : n_idx;
-    if (lo >= hi) break;
-    ts.emplace_back(work, lo, hi);
-  }
-  for (auto& t : ts) t.join();
+  });
 }
 
-int32_t rlt_abi_version() { return 1; }
+// Window gather for memmapped token corpora: out[i, :] is the
+// window_bytes-long slice of src starting at byte_starts[i]. Unlike
+// rlt_gather_rows the copy length is decoupled from the offset stride
+// (windows overlap when stride < seq_len). Page faults on a cold memmap
+// happen in these threads with the GIL already released, so corpus IO
+// overlaps device compute.
+void rlt_gather_windows_bytes(const uint8_t* src, uint8_t* out,
+                              const int64_t* byte_starts, int64_t n,
+                              int64_t window_bytes, int32_t n_threads) {
+  parallel_rows(n, n_threads, [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(out + i * window_bytes, src + byte_starts[i],
+                  static_cast<size_t>(window_bytes));
+    }
+  });
+}
+
+// Fused window gather + uint16 -> int32 widen: the GPT-pretraining hot
+// path (uint16 token shards, int32 model inputs) in one pass, no
+// intermediate uint16 batch + astype.
+void rlt_gather_windows_u16_i32(const uint16_t* src, int32_t* out,
+                                const int64_t* elem_starts, int64_t n,
+                                int64_t window_elems, int32_t n_threads) {
+  parallel_rows(n, n_threads, [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint16_t* s = src + elem_starts[i];
+      int32_t* o = out + i * window_elems;
+      for (int64_t j = 0; j < window_elems; ++j) {
+        o[j] = static_cast<int32_t>(s[j]);
+      }
+    }
+  });
+}
+
+int32_t rlt_abi_version() { return 2; }
 
 }  // extern "C"

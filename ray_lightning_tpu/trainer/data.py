@@ -136,6 +136,33 @@ class TokenBinDataset(Dataset):
             self._map(fi)[start : start + self.seq_len + 1], dtype=np.int32
         )
 
+    def gather_batch(self, sel: Any) -> np.ndarray:
+        """Assemble ``[len(sel), seq_len + 1]`` int32 windows in one pass.
+
+        The DataLoader's whole-batch fast path: indices are grouped by
+        shard and each group runs through the native window gather
+        (utils/native.py) — the memmap page faults and the uint16->int32
+        widen happen off the GIL, so corpus IO overlaps device compute
+        instead of serializing behind the per-item ``__getitem__`` loop.
+        """
+        from ray_lightning_tpu.utils.native import gather_windows
+
+        sel = np.ascontiguousarray(sel, dtype=np.int64)
+        out = np.empty((len(sel), self.seq_len + 1), dtype=np.int32)
+        if not len(sel):
+            return out
+        if sel.min() < 0 or sel.max() >= self._len:
+            bad = sel[(sel < 0) | (sel >= self._len)][0]
+            raise IndexError(bad)
+        fis = np.searchsorted(self._cum, sel, side="right") - 1
+        for fi in np.unique(fis):
+            mask = fis == fi
+            starts = (sel[mask] - int(self._cum[fi])) * self.stride
+            out[mask] = gather_windows(
+                self._map(int(fi)), starts, self.seq_len + 1, np.int32
+            )
+        return out
+
     def __getstate__(self):
         # mmap handles are process-local; re-open lazily on the worker.
         state = dict(self.__dict__)
@@ -306,6 +333,10 @@ class DataLoader:
 
             outs = tuple(gather_rows(a, sel) for a in self.dataset.arrays)
             return outs if len(outs) > 1 else outs[0]
+        if self.collate_fn is None and type(self.dataset) is TokenBinDataset:
+            # Same exact-type gate: whole-batch shard-grouped window
+            # gather with the GIL released (memmap IO + dtype widen).
+            return self.dataset.gather_batch(sel)
         return self._collate([self.dataset[int(i)] for i in sel])
 
     def _iter_selections(

@@ -15,7 +15,7 @@ import os
 import subprocess
 import threading
 from pathlib import Path
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -59,7 +59,7 @@ def _build() -> Optional[ctypes.CDLL]:
         os.replace(tmp, out)  # atomic vs concurrent workers building too
     lib = ctypes.CDLL(str(out))
     lib.rlt_abi_version.restype = ctypes.c_int32
-    if lib.rlt_abi_version() != 1:
+    if lib.rlt_abi_version() != 2:
         raise RuntimeError("rltnative ABI mismatch")
     lib.rlt_gather_rows.argtypes = [
         ctypes.c_void_p,
@@ -77,6 +77,22 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,
         ctypes.c_float,
         ctypes.c_float,
+        ctypes.c_int32,
+    ]
+    lib.rlt_gather_windows_bytes.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    lib.rlt_gather_windows_u16_i32.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
         ctypes.c_int32,
     ]
     return lib
@@ -163,3 +179,62 @@ def gather_rows_u8_to_f32(
     )
     return out
 
+
+
+def gather_windows(
+    src: np.ndarray, starts: np.ndarray, window: int, out_dtype: Any = None
+) -> np.ndarray:
+    """out[i] = src[starts[i] : starts[i] + window] for 1-D ``src``.
+
+    The memmap token-corpus batch path: windows may overlap (stride <
+    seq_len), and ``src`` is typically a cold np.memmap whose page faults
+    should happen off the GIL — the native copy threads do exactly that.
+    uint16 -> int32 (the GPT shard-to-model-input case) runs fused in one
+    pass; other dtype conversions copy then astype.
+    """
+    if src.ndim != 1:
+        raise ValueError(f"gather_windows needs 1-D src, got ndim={src.ndim}")
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    out_dtype = np.dtype(out_dtype) if out_dtype is not None else src.dtype
+    if len(starts) and (
+        starts.min() < 0 or starts.max() + window > src.shape[0]
+    ):
+        bad = starts[(starts < 0) | (starts + window > src.shape[0])][0]
+        raise IndexError(
+            f"window [{int(bad)}, {int(bad) + window}) out of bounds for "
+            f"size {src.shape[0]}"
+        )
+    lib = get_lib()
+    if lib is None or not src.flags.c_contiguous:
+        return np.stack(
+            [src[s : s + window] for s in starts]
+        ).astype(out_dtype, copy=False) if len(starts) else np.empty(
+            (0, window), out_dtype
+        )
+    if not len(starts):
+        return np.empty((0, window), dtype=out_dtype)
+    if src.dtype == np.uint16 and out_dtype == np.int32:
+        out = np.empty((len(starts), window), dtype=out_dtype)
+        lib.rlt_gather_windows_u16_i32(
+            src.ctypes.data,
+            out.ctypes.data,
+            starts.ctypes.data,
+            len(starts),
+            window,
+            _n_threads(len(starts)),
+        )
+        return out
+    item = src.dtype.itemsize
+    raw = np.empty((len(starts), window), dtype=src.dtype)
+    # Bound to a name: a bare `(starts * item).ctypes.data` hands C a
+    # pointer into a temporary the GC may reclaim mid-call.
+    byte_starts = starts * item
+    lib.rlt_gather_windows_bytes(
+        src.ctypes.data,
+        raw.ctypes.data,
+        byte_starts.ctypes.data,
+        len(starts),
+        window * item,
+        _n_threads(len(starts)),
+    )
+    return raw.astype(out_dtype, copy=False)

@@ -124,3 +124,69 @@ def test_gather_errors_propagate_through_prefetch():
     loader = DataLoader(Bad(), batch_size=2)
     with pytest.raises(RuntimeError, match="boom"):
         list(loader.iter_batches(1, prefetch=2))
+
+
+def test_gather_windows_fused_and_bytes_paths():
+    """Window gather matches per-slice numpy for the fused uint16->int32
+    path, the same-dtype byte path, and a cross-dtype astype path —
+    including overlapping windows (stride < window)."""
+    g = np.random.default_rng(2)
+    src = g.integers(0, 50000, size=997).astype(np.uint16)
+    starts = np.array([0, 1, 5, 997 - 17, 400, 400])  # dup + overlap ok
+    w = 17
+    expect = np.stack([src[s : s + w] for s in starts])
+
+    fused = native.gather_windows(src, starts, w, np.int32)
+    assert fused.dtype == np.int32
+    np.testing.assert_array_equal(fused, expect.astype(np.int32))
+
+    same = native.gather_windows(src, starts, w)
+    assert same.dtype == np.uint16
+    np.testing.assert_array_equal(same, expect)
+
+    f32 = native.gather_windows(src.astype(np.float32), starts, w, np.int64)
+    assert f32.dtype == np.int64
+    np.testing.assert_array_equal(f32, expect.astype(np.int64))
+
+    # Empty selection and bounds checks.
+    assert native.gather_windows(src, np.empty(0, np.int64), w).shape == (0, w)
+    with pytest.raises(IndexError):
+        native.gather_windows(src, np.array([997 - 16]), w)
+    with pytest.raises(IndexError):
+        native.gather_windows(src, np.array([-1]), w)
+    with pytest.raises(ValueError, match="1-D"):
+        native.gather_windows(src.reshape(-1, 1), starts, w)
+
+
+def test_token_bin_gather_batch_matches_items(tmp_path):
+    """TokenBinDataset.gather_batch == stacked __getitem__ across shard
+    boundaries, and the DataLoader's whole-batch fast path uses it."""
+    from ray_lightning_tpu.trainer.data import (
+        DataLoader,
+        TokenBinDataset,
+        write_token_bin,
+    )
+
+    g = np.random.default_rng(3)
+    d = tmp_path / "corpus"
+    d.mkdir()
+    # Two unequal shards so global->(shard, local) mapping is non-trivial.
+    write_token_bin(str(d / "a.bin"), g.integers(0, 60000, size=311))
+    write_token_bin(str(d / "b.bin"), g.integers(0, 60000, size=173))
+    ds = TokenBinDataset(str(d), seq_len=16)
+
+    sel = np.array([0, len(ds) - 1, 3, 7, 3])  # spans shards, dup ok
+    got = ds.gather_batch(sel)
+    assert got.dtype == np.int32 and got.shape == (5, 17)
+    np.testing.assert_array_equal(
+        got, np.stack([ds[int(i)] for i in sel])
+    )
+    with pytest.raises(IndexError):
+        ds.gather_batch(np.array([len(ds)]))
+
+    # Loader path: full-batch iteration equals the per-item collate.
+    loader = DataLoader(ds, batch_size=4, drop_last=True)
+    batches = list(loader.iter_batches(1, prefetch=0))
+    flat = np.concatenate(batches)
+    expect = np.stack([ds[i] for i in range(len(flat))])
+    np.testing.assert_array_equal(flat, expect)
