@@ -5,8 +5,14 @@ from ray_lightning_tpu.trainer.callbacks import (
     LearningRateMonitor,
     ModelCheckpoint,
     JaxProfilerCallback,
+    PredictionWriter,
+    StochasticWeightAveraging,
     TensorBoardLogger,
     TPUStatsCallback,
+)
+from ray_lightning_tpu.trainer.batch_finder import (
+    ScaleBatchSizeResult,
+    scale_batch_size,
 )
 from ray_lightning_tpu.trainer.ema import ema_params, params_ema
 from ray_lightning_tpu.trainer.lr_finder import LRFindResult, lr_find
@@ -35,7 +41,11 @@ __all__ = [
     "TensorBoardLogger",
     "LRFindResult",
     "lr_find",
+    "ScaleBatchSizeResult",
+    "scale_batch_size",
     "EarlyStopping",
+    "PredictionWriter",
+    "StochasticWeightAveraging",
     "LearningRateMonitor",
     "JaxProfilerCallback",
     "TPUStatsCallback",

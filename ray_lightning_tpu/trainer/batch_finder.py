@@ -1,0 +1,196 @@
+"""Batch-size finder (PTL's ``Tuner.scale_batch_size`` analog).
+
+Probes how large a per-step batch the device can take by compiling and
+running the module's real jitted update at a ramp of candidate sizes,
+catching XLA's RESOURCE_EXHAUSTED at compile or execute time. Two things
+are TPU-specific here:
+
+- OOM is a *compile-or-first-run* event (static shapes: if one step fits,
+  every step fits), so ``steps_per_trial`` can stay tiny and the probe is
+  cheap — there is no fragmentation drift to chase across an epoch.
+- On TPU the largest-fitting batch is often NOT the fastest point: past
+  MXU saturation steps/s stops improving while the batch keeps growing.
+  Each trial therefore also measures samples/s, and the result carries a
+  ``throughput_optimal`` size next to the Lightning-style ``largest``.
+
+Probe batches are synthesized by row-tiling the loader's first batch, so
+the sweep never depends on the dataset being big enough to fill the
+candidate size. Like :mod:`.lr_finder`, this runs single-process on the
+default backend — it is a probe, not a training run; the chosen size then
+feeds any strategy's real fit.
+"""
+from __future__ import annotations
+
+import dataclasses
+import gc
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OOM",
+    "Allocation failure",
+)
+
+
+def _is_oom(exc: BaseException) -> bool:
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def _tile_rows(arr: Any, n: int) -> np.ndarray:
+    """Row-tile ``arr`` along axis 0 to exactly ``n`` rows (wrapping)."""
+    a = np.asarray(arr)
+    if a.ndim == 0:
+        raise ValueError("batch leaves must have a leading batch axis")
+    return a[np.arange(n) % a.shape[0]]
+
+
+@dataclasses.dataclass
+class ScaleBatchSizeResult:
+    sizes: List[int]  # every size probed, in order
+    samples_per_sec: Dict[int, float]  # successful sizes only
+    largest: Optional[int]  # biggest size that fit (Lightning's answer)
+    throughput_optimal: Optional[int]  # fastest samples/s among fits
+    failed_at: Optional[int]  # first size that OOMed (None: never)
+
+    @property
+    def suggestion(self) -> Optional[int]:
+        return self.largest
+
+    def suggestion_or(self, default: int) -> int:
+        return self.largest if self.largest is not None else default
+
+
+def scale_batch_size(
+    module: Any,
+    mode: str = "power",
+    init_val: int = 2,
+    max_trials: int = 25,
+    steps_per_trial: int = 3,
+    max_val: Optional[int] = None,
+    optimizer: Optional[Callable[..., Any]] = None,
+    seed: int = 0,
+) -> ScaleBatchSizeResult:
+    """Find the largest (and fastest) batch the device can step.
+
+    Args:
+      module: a TPUModule; its ``train_dataloader`` supplies one template
+        batch and ``training_step`` defines the probed computation.
+        ``module.params`` is never touched.
+      mode: ``"power"`` doubles from ``init_val`` until failure;
+        ``"binsearch"`` additionally bisects between the last fit and the
+        first failure for a tighter answer.
+      max_trials: cap on total probe steps (each trial is one compile).
+      max_val: optional hard ceiling (e.g. the real dataset size, or a
+        global-batch constraint from the mesh's data axis).
+      optimizer: ``optax`` transform factory probed against (default
+        ``optax.adam(1e-3)``) — optimizer state is part of the memory
+        footprint, so probe with the family you will train with.
+
+    Returns a :class:`ScaleBatchSizeResult`. ``largest`` is None when even
+    ``init_val`` does not fit.
+    """
+    import jax
+    import optax
+
+    if mode not in ("power", "binsearch"):
+        raise ValueError(f"mode must be 'power' or 'binsearch', got {mode!r}")
+    if init_val < 1:
+        raise ValueError("init_val must be >= 1")
+
+    tx = optimizer(1e-3) if optimizer is not None else optax.adam(1e-3)
+    loader = module.train_dataloader()
+    template = next(iter(loader.iter_batches(1, prefetch=0)))
+    rng = jax.random.PRNGKey(seed)
+    init_rng, step_rng = jax.random.split(rng)
+
+    def probe(bs: int) -> Optional[float]:
+        """samples/s at ``bs``, or None on OOM. Non-OOM errors propagate."""
+        batch = jax.tree_util.tree_map(lambda x: _tile_rows(x, bs), template)
+
+        @jax.jit
+        def step_fn(params, opt_state, b, r):
+            def loss_fn(p):
+                loss, _ = module.training_step(p, b, r)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        try:
+            params = module.init_params(init_rng, batch)
+            opt_state = tx.init(params)
+            # Warmup = compile + first execute; OOM surfaces here.
+            params, opt_state, loss = step_fn(params, opt_state, batch, step_rng)
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps_per_trial):
+                params, opt_state, loss = step_fn(params, opt_state, batch, step_rng)
+            jax.block_until_ready(loss)
+            dt = max(time.perf_counter() - t0, 1e-9)
+            return bs * steps_per_trial / dt
+        except Exception as exc:  # noqa: BLE001 - OOM classification below
+            if _is_oom(exc):
+                return None
+            raise
+        finally:
+            # Drop the probe's device buffers before the next (bigger) try.
+            del batch
+            gc.collect()
+
+    sizes: List[int] = []
+    rates: Dict[int, float] = {}
+    failed_at: Optional[int] = None
+    largest: Optional[int] = None
+
+    bs = init_val if max_val is None else min(init_val, max_val)
+    trials = 0
+    while trials < max_trials:
+        sizes.append(bs)
+        trials += 1
+        rate = probe(bs)
+        if rate is None:
+            failed_at = bs
+            break
+        rates[bs] = rate
+        largest = bs
+        if max_val is not None and bs >= max_val:
+            break
+        # Clamp the ramp so the ceiling ITSELF gets probed (a plain
+        # doubling would skip e.g. max_val=48 after 32 and return a
+        # smaller batch than the cap the caller asked about).
+        bs = bs * 2 if max_val is None else min(bs * 2, max_val)
+
+    if mode == "binsearch" and failed_at is not None and largest is not None:
+        lo, hi = largest, failed_at
+        while trials < max_trials and hi - lo > max(1, lo // 8):
+            mid = (lo + hi) // 2
+            sizes.append(mid)
+            trials += 1
+            rate = probe(mid)
+            if rate is None:
+                hi = mid
+                failed_at = mid
+            else:
+                rates[mid] = rate
+                lo = mid
+                largest = max(largest, mid)
+
+    throughput_optimal = (
+        max(rates, key=lambda k: rates[k]) if rates else None
+    )
+    return ScaleBatchSizeResult(
+        sizes=sizes,
+        samples_per_sec={k: round(v, 3) for k, v in rates.items()},
+        largest=largest,
+        throughput_optimal=throughput_optimal,
+        failed_at=failed_at,
+    )

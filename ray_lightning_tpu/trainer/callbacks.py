@@ -31,6 +31,14 @@ class Callback:
 
     def on_fit_end(self, trainer: Any, module: Any) -> None: ...
 
+    def on_predict_batch_end(
+        self, trainer: Any, module: Any, prediction: Any, batch_idx: int
+    ) -> None: ...
+
+    def on_predict_end(
+        self, trainer: Any, module: Any, predictions: Any
+    ) -> None: ...
+
     def state_dict(self) -> Dict[str, Any]:
         return {}
 
@@ -658,3 +666,160 @@ class CSVLogger(Callback):
             # Rewrite locally: in client mode the worker's file lives on the
             # remote head's filesystem; the driver needs its own copy.
             self._write()
+
+
+class StochasticWeightAveraging(Callback):
+    """Equal-weight average of params along the training trajectory
+    (Izmailov et al. 2018), PTL's ``StochasticWeightAveraging`` analog.
+
+    From ``swa_epoch_start`` on, the end-of-epoch params are folded into a
+    host-side running average (``avg += (params - avg) / n``); at fit end
+    the averaged weights replace the live ones (``swap_params=False`` keeps
+    them aside as ``.swa_params`` instead). Three averaging flavors now
+    exist, picked by cadence: in-step decayed EMA (``Trainer(ema_decay=)``),
+    epoch-cadence equal SWA (this), and post-hoc checkpoint soups
+    (``average_checkpoints``).
+
+    TPU notes: the average lives on HOST memory (no HBM cost); collection
+    runs at epoch cadence so the gather never blocks the step stream. Every
+    rank computes the same average — ``gather_state`` is a collective under
+    sharded strategies, mirroring ModelCheckpoint's every-rank discipline.
+    """
+
+    def __init__(
+        self, swa_epoch_start: Any = 0.8, swap_params: bool = True
+    ) -> None:
+        if isinstance(swa_epoch_start, float) and not 0 <= swa_epoch_start <= 1:
+            raise ValueError(
+                f"float swa_epoch_start must be in [0, 1], got {swa_epoch_start}"
+            )
+        if isinstance(swa_epoch_start, int) and swa_epoch_start < 0:
+            raise ValueError(
+                f"int swa_epoch_start must be >= 0, got {swa_epoch_start}"
+            )
+        self.swa_epoch_start = swa_epoch_start
+        self.swap_params = swap_params
+        self.n_models = 0
+        self.swa_params: Any = None
+
+    def _start_epoch(self, trainer: Any) -> int:
+        if isinstance(self.swa_epoch_start, float):
+            max_epochs = getattr(
+                getattr(trainer, "spec", trainer), "max_epochs", 1
+            )
+            return int(self.swa_epoch_start * max_epochs)
+        return int(self.swa_epoch_start)
+
+    def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
+        if trainer.current_epoch < self._start_epoch(trainer):
+            return
+        import jax
+
+        params = trainer.strategy.gather_state(trainer.params)
+        self.n_models += 1
+        n = self.n_models
+        if self.swa_params is None:
+            self.swa_params = params
+        else:
+            self.swa_params = jax.tree_util.tree_map(
+                lambda avg, p: avg + (np.asarray(p, avg.dtype) - avg) / n,
+                self.swa_params,
+                params,
+            )
+
+    def on_fit_end(self, trainer: Any, module: Any) -> None:
+        if self.swa_params is None or not self.swap_params:
+            return
+        # The fit is over (no steps follow), so host arrays are fine here;
+        # the rank-0 result collection device_gets them unchanged.
+        trainer.params = self.swa_params
+        module.params = self.swa_params
+
+    def state_dict(self) -> Dict[str, Any]:
+        # The running average rides checkpoints so fault-tolerant restarts
+        # (Trainer(max_restarts=)) keep collecting instead of starting over.
+        return {"n_models": self.n_models, "swa_params": self.swa_params}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.n_models = int(state.get("n_models", 0))
+        self.swa_params = state.get("swa_params")
+
+
+class PredictionWriter(Callback):
+    """Per-rank streaming prediction writer (PTL's BasePredictionWriter).
+
+    Large-scale inference on a pod can't funnel every prediction through
+    the rank-0 result channel; each rank instead writes ITS shard of
+    predictions (the loop hands callbacks disjoint per-process row sets
+    that partition each batch exactly once) to ``output_dir`` as
+    state-stream files readable with :meth:`read`. ``write_interval="batch"`` streams one file per batch —
+    pair it with ``predict(return_predictions=False)`` and per-rank memory
+    stays O(1 batch), with nothing shipped through the result channel;
+    ``"epoch"`` writes a single file per rank at the end (this rank's
+    accumulated shard — O(dataset/world) memory, independent of
+    return_predictions).
+    """
+
+    def __init__(self, output_dir: str, write_interval: str = "batch") -> None:
+        if write_interval not in ("batch", "epoch"):
+            raise ValueError(
+                f"write_interval must be 'batch' or 'epoch', got "
+                f"{write_interval!r}"
+            )
+        self.output_dir = output_dir
+        self.write_interval = write_interval
+        self.written_paths: list = []
+
+    def _write(self, tree: Any, path: str) -> None:
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        os.makedirs(self.output_dir, exist_ok=True)
+        state_stream_to_file(to_state_stream(tree), path)
+        self.written_paths.append(path)
+
+    def on_predict_batch_end(
+        self, trainer: Any, module: Any, prediction: Any, batch_idx: int
+    ) -> None:
+        if self.write_interval != "batch":
+            return
+        self._write(
+            prediction,
+            os.path.join(
+                self.output_dir,
+                f"predictions_rank{trainer.global_rank}"
+                f"_batch{batch_idx:05d}.npz",
+            ),
+        )
+
+    def on_predict_end(self, trainer: Any, module: Any, predictions: Any) -> None:
+        if self.write_interval != "epoch":
+            return
+        if predictions is None:
+            return
+        self._write(
+            predictions,
+            os.path.join(
+                self.output_dir,
+                f"predictions_rank{trainer.global_rank}.npz",
+            ),
+        )
+
+    @staticmethod
+    def read(path: str) -> Any:
+        """Load one written prediction file back as its host pytree."""
+        from ray_lightning_tpu.utils.state_stream import load_state_stream
+
+        with open(path, "rb") as f:
+            return load_state_stream(f.read())
+
+    def state_dict(self) -> Dict[str, Any]:
+        # Paths ride the callback sync so the driver can locate per-rank
+        # shards after a distributed predict (shared-FS assumption, same
+        # as best_model_path propagation).
+        return {"written_paths": self.written_paths}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.written_paths = list(state.get("written_paths", []))

@@ -39,6 +39,20 @@ class TrainerSpec:
 
     max_epochs: int = 1
     max_steps: Optional[int] = None
+    # Debug: train on a fixed unshuffled slice and validate on the SAME
+    # slice (PTL's overfit_batches); int = batches, float = epoch fraction.
+    overfit_batches: Optional[Any] = None
+    # Debug: enable jax_debug_nans in the worker — any NaN/inf produced by
+    # a compiled step re-runs de-optimized and raises at the culprit op
+    # (PTL's detect_anomaly analog; costs a per-step sync, debug only).
+    detect_anomaly: bool = False
+    # Wall-clock budget in seconds (Trainer parses str/timedelta forms).
+    # Single-process: checked at every step boundary. Multi-process: checked
+    # at collective boundaries (mid-epoch val, epoch end) with a cross-rank
+    # consensus so every rank takes the same stop decision — a local
+    # per-step clock check could diverge across ranks and deadlock the
+    # next collective.
+    max_time: Optional[float] = None
     limit_train_batches: Optional[Any] = None  # int or float fraction
     limit_val_batches: Optional[Any] = None
     limit_test_batches: Optional[Any] = None
@@ -69,6 +83,13 @@ class TrainerSpec:
     # ~2x-params gather/transfer for Adam when worker-side ModelCheckpoint
     # is the only checkpoint path.
     ship_optimizer_state: bool = True
+    # Print a parameter summary table at fit start (rank 0), PTL's
+    # enable_model_summary.
+    enable_model_summary: bool = True
+    # predict(): accumulate + ship outputs through the rank-0 channel.
+    # False = streaming inference (PredictionWriter writes per-rank shards;
+    # per-rank memory stays O(1 batch)).
+    return_predictions: bool = True
     callbacks: List[Any] = field(default_factory=list)
 
 
@@ -188,6 +209,20 @@ class TrainingLoop:
                 num_replicas=skw["num_replicas"], rank=skw["rank"], seed=seed
             )
         self._val_loader = val
+        if self.spec.overfit_batches:
+            # Overfit debugging: same fixed slice for train AND val, no
+            # shuffling (order defines the slice). Batch limits were set
+            # by the Trainer; only the loader wiring happens here. Val is
+            # only redirected when the module HAS a val loop to run.
+            if self._train_loader is not None and getattr(
+                self._train_loader, "shuffle", False
+            ):
+                self._train_loader.shuffle = False
+                sampler = getattr(self._train_loader, "sampler", None)
+                if sampler is not None and hasattr(sampler, "shuffle"):
+                    sampler.shuffle = False
+            if val is not None:
+                self._val_loader = self._train_loader
 
     def _init_state(self, ckpt_stream: Optional[Any]) -> None:
         import jax
@@ -522,10 +557,80 @@ class TrainingLoop:
         }
 
     # ------------------------------------------------------------------
-    def run_fit(self, ckpt_stream: Optional[bytes] = None) -> Optional[WorkerOutput]:
+    def _out_of_time(self, synced: bool) -> bool:
+        """Has the fit's wall-clock budget expired?
+
+        ``synced=True`` reaches a cross-rank consensus (any rank out of
+        time stops everyone) and may only be called at points every rank
+        reaches together — it is a collective. ``synced=False`` is a pure
+        local clock read, safe anywhere but only used to stop when this
+        process is the whole world.
+        """
+        if getattr(self, "_fit_deadline", None) is None:
+            return False
+        import time as _time
+
+        local = _time.monotonic() >= self._fit_deadline
+        if not synced:
+            return local
         import jax
 
+        if jax.process_count() == 1:
+            return local
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(np.asarray(local))
+        return bool(np.any(flags))
+
+    # ------------------------------------------------------------------
+    def _anomaly_guard(self):
+        """Own jax_debug_nans for the duration of one run (detect_anomaly).
+
+        Worker-side: the compiled steps run here. With detect_anomaly,
+        NaN/inf in any jitted output re-runs the computation de-optimized
+        and raises at the producing op. try/finally restoration covers the
+        raise itself — the feature's primary outcome is an exception, and
+        leaking the de-optimizing flag into the caller's process (or
+        clobbering a user-set one) would outlive the run.
+        """
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            import jax
+
+            prev = bool(jax.config.jax_debug_nans)
+            jax.config.update(
+                "jax_debug_nans", bool(self.spec.detect_anomaly)
+            )
+            try:
+                yield
+            finally:
+                jax.config.update("jax_debug_nans", prev)
+
+        return guard()
+
+    def run_fit(self, ckpt_stream: Optional[bytes] = None) -> Optional[WorkerOutput]:
+        with self._anomaly_guard():
+            return self._run_fit_impl(ckpt_stream)
+
+    def _run_fit_impl(
+        self, ckpt_stream: Optional[bytes] = None
+    ) -> Optional[WorkerOutput]:
+        import jax
+        import time as _time
+
         self.state = {"status": "running", "stage": "fit"}
+        self._fit_deadline = (
+            _time.monotonic() + self.spec.max_time
+            if self.spec.max_time is not None
+            else None
+        )
+        # Per-step clock reads may STOP the loop only when this process is
+        # the whole world; multi-process stops ride consensus boundaries.
+        self._time_check_per_step = (
+            self._fit_deadline is not None and jax.process_count() == 1
+        )
         self._setup_common()
         if self._train_loader is None:
             raise RuntimeError("fit requires train_dataloader()")
@@ -539,6 +644,14 @@ class TrainingLoop:
             else None
         )
 
+        if self.spec.enable_model_summary and self.global_rank == 0:
+            import sys
+
+            from ray_lightning_tpu.utils.summary import summarize_params
+
+            # stderr: stdout is a data channel for CLI generate / bench
+            # JSON pipelines; diagnostics must not interleave into it.
+            print(summarize_params(self.params), file=sys.stderr, flush=True)
         self.module.on_fit_start()
         self._call_callbacks("on_fit_start")
         mult = self.strategy.batch_multiplier
@@ -688,10 +801,18 @@ class TrainingLoop:
                         self._run_eval_epoch(val_step, self._val_loader, "val")
                         self._call_callbacks("on_validation_end")
                         last_val_step = self.global_step
+                        # Every rank just finished the same val epoch: a
+                        # safe point for the max_time consensus check.
+                        if self._out_of_time(synced=True):
+                            self.should_stop = True
                     if (
-                        self.spec.max_steps is not None
-                        and self.global_step >= self.spec.max_steps
-                    ) or self.should_stop:
+                        (
+                            self.spec.max_steps is not None
+                            and self.global_step >= self.spec.max_steps
+                        )
+                        or self.should_stop
+                        or (self._time_check_per_step and self._out_of_time(False))
+                    ):
                         # should_stop: a mid-epoch val's EarlyStopping must
                         # end training NOW, not at the epoch boundary —
                         # stopping inside very long epochs is the point of
@@ -747,6 +868,10 @@ class TrainingLoop:
 
             self.module.on_train_epoch_end(epoch, dict(self.callback_metrics))
             self._call_callbacks("on_train_epoch_end")
+            # Epoch end is the multi-process max_time boundary (and catches
+            # budget expiry during the val epoch in any topology).
+            if self._out_of_time(synced=True):
+                self.should_stop = True
 
         self.state = {"status": "finished", "stage": "fit"}
         self.module.params = self.params
@@ -870,6 +995,12 @@ class TrainingLoop:
     def run_evaluate(
         self, stage: str, ckpt_stream: Optional[bytes] = None
     ) -> Optional[WorkerOutput]:
+        with self._anomaly_guard():
+            return self._run_evaluate_impl(stage, ckpt_stream)
+
+    def _run_evaluate_impl(
+        self, stage: str, ckpt_stream: Optional[bytes] = None
+    ) -> Optional[WorkerOutput]:
         self.state = {"status": "running", "stage": stage}
         self._setup_common()
         source = self.datamodule if self.datamodule is not None else self.module
@@ -895,6 +1026,12 @@ class TrainingLoop:
     def run_predict(
         self, ckpt_stream: Optional[bytes] = None
     ) -> Optional[WorkerOutput]:
+        with self._anomaly_guard():
+            return self._run_predict_impl(ckpt_stream)
+
+    def _run_predict_impl(
+        self, ckpt_stream: Optional[bytes] = None
+    ) -> Optional[WorkerOutput]:
         self.state = {"status": "running", "stage": "predict"}
         self._setup_common()
         source = self.datamodule if self.datamodule is not None else self.module
@@ -916,10 +1053,26 @@ class TrainingLoop:
         n_batches = _limit(
             loader.num_batches(mult), self.spec.limit_predict_batches
         )
+        keep = self.spec.return_predictions
+        # on_predict_end receives THIS RANK's predictions (PTL's
+        # write_on_epoch_end contract): accumulate the local shards only
+        # when some callback actually overrides the hook, independent of
+        # whether the full set rides the rank-0 return channel.
+        from ray_lightning_tpu.trainer.callbacks import Callback as _CB
+
+        wants_end = any(
+            type(cb).on_predict_end is not _CB.on_predict_end
+            for cb in self.callbacks
+            if isinstance(cb, _CB)
+        )
         preds = []
+        local_preds = []
+        own_rows = None
         eval_params = self._eval_params()
-        for host_batch, host_mask in itertools.islice(
-            loader.iter_batches(mult, with_mask=True), n_batches
+        for bi, (host_batch, host_mask) in enumerate(
+            itertools.islice(
+                loader.iter_batches(mult, with_mask=True), n_batches
+            )
         ):
             batch = self.strategy.make_global_batch(host_batch)
             gmask = self.strategy.make_global_batch(host_mask)
@@ -927,12 +1080,59 @@ class TrainingLoop:
             # Trim wrap-around padding rows so predictions line up 1:1 with
             # the dataset (mask comes back replicated alongside preds).
             mask = np.asarray(mask).astype(bool)
-            preds.append(
-                jax.tree_util.tree_map(lambda p: np.asarray(p)[mask], out)
+            if own_rows is None or len(own_rows) != len(mask):
+                own_rows = self._owner_rows(gmask)
+            # Callbacks receive THIS process's disjoint share of the rows
+            # (PredictionWriter shards then partition the dataset exactly
+            # once across ranks); the rank-0 result channel still carries
+            # the full set when predictions are kept.
+            local = jax.tree_util.tree_map(
+                lambda p: np.asarray(p)[own_rows & mask], out
             )
+            self._call_callbacks("on_predict_batch_end", local, bi)
+            if wants_end:
+                local_preds.append(local)
+            # return_predictions=False: the full prediction dies here —
+            # per-rank memory stays O(1 batch) (or O(local shard) with an
+            # epoch-end consumer) and nothing crosses the rank-0 result
+            # channel (the callbacks above already consumed it, e.g. a
+            # PredictionWriter streaming shards to disk).
+            if keep:
+                preds.append(
+                    local
+                    if bool(own_rows.all())
+                    else jax.tree_util.tree_map(
+                        lambda p: np.asarray(p)[mask], out
+                    )
+                )
+        self._call_callbacks(
+            "on_predict_end", local_preds if wants_end else None
+        )
         self.state = {"status": "finished", "stage": "predict"}
         self.strategy.teardown_worker()
-        return self._collect_rank_zero_results(results=preds)
+        return self._collect_rank_zero_results(results=preds if keep else None)
+
+    @staticmethod
+    def _owner_rows(gmask: Any) -> "np.ndarray":
+        """Boolean mask of global batch rows THIS process canonically owns.
+
+        Derived from the assembled mask array's own sharding
+        (``devices_indices_map``), so it makes no assumption about mesh
+        device ordering; rows replicated across processes (model axes
+        spanning hosts) go to the lowest-index owner. The per-process masks
+        partition [0, G) exactly — PredictionWriter shards are disjoint and
+        complete by construction.
+        """
+        import jax
+
+        g = gmask.shape[0]
+        if jax.process_count() == 1:
+            return np.ones(g, dtype=bool)
+        owner = np.full(g, np.iinfo(np.int32).max, dtype=np.int32)
+        for d, idx in gmask.sharding.devices_indices_map(gmask.shape).items():
+            sl = idx[0]
+            owner[sl] = np.minimum(owner[sl], d.process_index)
+        return owner == jax.process_index()
 
     def _restore_or_adopt(self, ckpt_stream: Optional[Any]) -> None:
         """Load params from a checkpoint (stream bytes or sharded orbax

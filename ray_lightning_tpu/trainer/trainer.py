@@ -23,11 +23,48 @@ from ray_lightning_tpu.trainer.loop import TrainerSpec, TrainingLoop
 from ray_lightning_tpu.utils.seed import seed_everything
 
 
+def _parse_max_time(value: Any) -> Optional[float]:
+    """Normalize a max_time spec to seconds (None passes through)."""
+    import datetime
+
+    if value is None:
+        return None
+    if isinstance(value, datetime.timedelta):
+        seconds = value.total_seconds()
+    elif isinstance(value, dict):
+        seconds = datetime.timedelta(**value).total_seconds()
+    elif isinstance(value, str):
+        parts = value.split(":")
+        if len(parts) not in (3, 4) or not all(
+            p.strip().isdigit() for p in parts
+        ):
+            raise ValueError(
+                "max_time string must be 'DD:HH:MM:SS' or 'HH:MM:SS', "
+                f"got {value!r}"
+            )
+        nums = [int(p) for p in parts]
+        if len(nums) == 3:
+            nums = [0] + nums
+        d, h, m, s = nums
+        seconds = float(((d * 24 + h) * 60 + m) * 60 + s)
+    elif isinstance(value, (int, float)) and not isinstance(value, bool):
+        seconds = float(value)
+    else:
+        raise ValueError(
+            "max_time must be seconds, a timedelta, a timedelta kwargs "
+            f"dict, or a 'DD:HH:MM:SS' string, got {type(value).__name__}"
+        )
+    if seconds <= 0:
+        raise ValueError(f"max_time must be positive, got {seconds}s")
+    return seconds
+
+
 class Trainer:
     def __init__(
         self,
         max_epochs: int = 1,
         max_steps: Optional[int] = None,
+        max_time: Optional[Any] = None,
         strategy: Optional[Strategy] = None,
         callbacks: Optional[List[Any]] = None,
         limit_train_batches: Optional[Any] = None,
@@ -36,11 +73,14 @@ class Trainer:
         limit_predict_batches: Optional[Any] = None,
         num_sanity_val_steps: int = 2,
         check_val_every_n_epoch: int = 1,
+        overfit_batches: Optional[Any] = None,
+        detect_anomaly: bool = False,
         val_check_interval: Optional[Any] = None,
         accumulate_grad_batches: int = 1,
         gradient_clip_val: Optional[float] = None,
         log_every_n_steps: int = 50,
         enable_checkpointing: bool = True,
+        enable_model_summary: bool = True,
         default_root_dir: Optional[str] = None,
         seed: Optional[int] = None,
         precision: str = "fp32",
@@ -53,6 +93,11 @@ class Trainer:
     ) -> None:
         self.max_epochs = max_epochs
         self.max_steps = max_steps
+        # Wall-clock fit budget (PTL's Trainer(max_time=...)): seconds,
+        # datetime.timedelta, a {"days"/"hours"/...} dict, or a
+        # "DD:HH:MM:SS" / "HH:MM:SS" string. With max_restarts > 0 the
+        # budget applies per attempt (each restart re-enters the loop).
+        self.max_time = _parse_max_time(max_time)
         self.strategy = strategy
         self.callbacks = list(callbacks or [])
         self.limit_train_batches = limit_train_batches
@@ -61,6 +106,25 @@ class Trainer:
         self.limit_predict_batches = limit_predict_batches
         self.num_sanity_val_steps = num_sanity_val_steps
         self.check_val_every_n_epoch = check_val_every_n_epoch
+        # PTL's overfit_batches: train AND validate on the same fixed
+        # unshuffled slice (int batches / float fraction). It subsumes the
+        # train/val batch limits, so mixing them is a config error.
+        if overfit_batches is not None:
+            v = float(overfit_batches)
+            if v <= 0 or (isinstance(overfit_batches, float) and v > 1):
+                raise ValueError(
+                    "overfit_batches must be a positive int (batches) or a "
+                    f"float in (0, 1] (fraction), got {overfit_batches!r}"
+                )
+            if limit_train_batches is not None or limit_val_batches is not None:
+                raise ValueError(
+                    "overfit_batches replaces limit_train_batches/"
+                    "limit_val_batches; pass one or the other"
+                )
+            self.limit_train_batches = overfit_batches
+            self.limit_val_batches = overfit_batches
+        self.overfit_batches = overfit_batches
+        self.detect_anomaly = bool(detect_anomaly)
         if val_check_interval is not None:
             import math
 
@@ -82,6 +146,7 @@ class Trainer:
         self.gradient_clip_val = gradient_clip_val
         self.log_every_n_steps = log_every_n_steps
         self.enable_checkpointing = enable_checkpointing
+        self.enable_model_summary = bool(enable_model_summary)
         self.default_root_dir = default_root_dir or os.path.join(
             tempfile.gettempdir(), "rlt_runs"
         )
@@ -132,17 +197,21 @@ class Trainer:
         return TrainerSpec(
             max_epochs=self.max_epochs,
             max_steps=self.max_steps,
+            max_time=self.max_time,
             limit_train_batches=self.limit_train_batches,
             limit_val_batches=self.limit_val_batches,
             limit_test_batches=self.limit_test_batches,
             limit_predict_batches=self.limit_predict_batches,
             num_sanity_val_steps=self.num_sanity_val_steps,
             check_val_every_n_epoch=self.check_val_every_n_epoch,
+            overfit_batches=self.overfit_batches,
+            detect_anomaly=self.detect_anomaly,
             val_check_interval=self.val_check_interval,
             accumulate_grad_batches=self.accumulate_grad_batches,
             gradient_clip_val=self.gradient_clip_val,
             log_every_n_steps=self.log_every_n_steps,
             enable_checkpointing=self.enable_checkpointing,
+            enable_model_summary=self.enable_model_summary,
             default_root_dir=self.default_root_dir,
             seed=self.seed,
             precision=self.precision,
@@ -151,6 +220,7 @@ class Trainer:
             async_checkpointing=self.async_checkpointing,
             log_grad_norm=self.log_grad_norm,
             ship_optimizer_state=self.ship_optimizer_state,
+            return_predictions=getattr(self, "_return_predictions", True),
             callbacks=self.callbacks,
         )
 
@@ -460,9 +530,21 @@ class Trainer:
         return self._run("test", module, datamodule, ckpt_path)
 
     def predict(
-        self, module: Any, datamodule: Any = None, ckpt_path: Optional[str] = None
-    ) -> List[Any]:
-        return self._run("predict", module, datamodule, ckpt_path)
+        self,
+        module: Any,
+        datamodule: Any = None,
+        ckpt_path: Optional[str] = None,
+        return_predictions: bool = True,
+    ) -> Optional[List[Any]]:
+        """Run inference. ``return_predictions=False`` (PTL semantics)
+        skips accumulating/shipping outputs entirely — pair it with a
+        ``PredictionWriter`` so each rank streams its shard to disk and
+        per-rank memory stays bounded at pod scale."""
+        self._return_predictions = return_predictions
+        try:
+            return self._run("predict", module, datamodule, ckpt_path)
+        finally:
+            self._return_predictions = True
 
     # ------------------------------------------------------------------
     def _recover_results_in_main_process(self, output: Any, module: Any) -> Any:

@@ -140,6 +140,32 @@ def test_train_two_hosts_metric_fidelity(start_fabric):
 
 
 @pytest.mark.slow
+def test_max_time_consensus_stop_two_hosts(start_fabric):
+    """max_time over real 2-process collectives: the stop decision rides
+    the cross-rank consensus (process_allgather) at epoch boundaries, so
+    both ranks agree and no rank hangs at a collective."""
+    import time
+
+    start_fabric(num_cpus=2)
+    module = XORModule(batch_size=1)
+    trainer = get_trainer(
+        strategy=RayTPUStrategy(num_workers=4, num_hosts=2, use_tpu=False),
+        max_epochs=100000,
+        max_time=8.0,
+        seed=0,
+    )
+    t0 = time.monotonic()
+    trainer.fit(module)
+    elapsed = time.monotonic() - t0
+    # The fit must COMPLETE (no deadlock) and stop far short of 100k
+    # epochs; worker spawn + compile dominate the small budget.
+    assert trainer.state["status"] == "finished"
+    assert trainer.global_step >= 1
+    assert trainer.current_epoch < 99999  # nowhere near max_epochs
+    assert elapsed < 180
+
+
+@pytest.mark.slow
 def test_checkpoint_and_resume_different_worker_count(start_fabric, tmp_path):
     """Checkpoint from a 2-chip run resumes on 1 chip (reference
     test_ddp_sharded.py:118-137 'resume with fewer workers')."""

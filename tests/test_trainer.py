@@ -34,6 +34,62 @@ def test_validation_and_test_and_predict():
     assert len(preds) > 0 and preds[0].shape[-1] == 2
 
 
+def test_prediction_writer_streams_per_rank_files(tmp_path):
+    """PredictionWriter streams each rank's prediction shard to disk:
+    per-batch files whose concatenation round-trips to the returned
+    predictions, or one per-rank file in epoch mode."""
+    from ray_lightning_tpu.trainer import PredictionWriter
+
+    module = BoringModule()
+    get_trainer(max_epochs=1).fit(module)  # params to predict with
+    out_b = str(tmp_path / "batchwise")
+    pw = PredictionWriter(out_b, write_interval="batch")
+    trainer = get_trainer(max_epochs=1, callbacks=[pw])
+    preds = trainer.predict(module)
+    assert pw.written_paths and all(os.path.exists(p) for p in pw.written_paths)
+    assert len(pw.written_paths) == len(preds)
+    loaded = np.concatenate(
+        [PredictionWriter.read(p) for p in sorted(pw.written_paths)]
+    )
+    np.testing.assert_allclose(loaded, np.concatenate(preds), rtol=1e-6)
+
+    out_e = str(tmp_path / "epochwise")
+    pw_e = PredictionWriter(out_e, write_interval="epoch")
+    trainer2 = get_trainer(max_epochs=1, callbacks=[pw_e])
+    preds2 = trainer2.predict(module)
+    assert len(pw_e.written_paths) == 1
+    loaded2 = PredictionWriter.read(pw_e.written_paths[0])
+    np.testing.assert_allclose(
+        np.concatenate(loaded2), np.concatenate(preds2), rtol=1e-6
+    )
+
+    with pytest.raises(ValueError, match="write_interval"):
+        PredictionWriter(out_b, write_interval="step")
+
+    # Streaming mode: return_predictions=False keeps nothing in memory and
+    # returns None, but the batch files still carry everything.
+    out_s = str(tmp_path / "streaming")
+    pw_s = PredictionWriter(out_s, write_interval="batch")
+    trainer3 = get_trainer(max_epochs=1, callbacks=[pw_s])
+    res = trainer3.predict(module, return_predictions=False)
+    assert res is None
+    loaded3 = np.concatenate(
+        [PredictionWriter.read(p) for p in sorted(pw_s.written_paths)]
+    )
+    np.testing.assert_allclose(loaded3, loaded, rtol=1e-6)
+    # Epoch mode works independently of return_predictions: the writer
+    # receives this rank's accumulated shard even when nothing is returned.
+    pw_n = PredictionWriter(str(tmp_path / "none"), write_interval="epoch")
+    res_n = get_trainer(max_epochs=1, callbacks=[pw_n]).predict(
+        module, return_predictions=False
+    )
+    assert res_n is None and len(pw_n.written_paths) == 1
+    loaded_n = PredictionWriter.read(pw_n.written_paths[0])
+    np.testing.assert_allclose(
+        np.concatenate(loaded_n), loaded, rtol=1e-6
+    )
+
+
 def test_mnist_accuracy_bound():
     predict_test(
         get_trainer(max_epochs=2, seed=1),
@@ -169,6 +225,347 @@ def test_lr_find_range_test():
         lr_find(m, min_lr=1.0, max_lr=0.1)
     with _pytest.raises(ValueError, match="num_steps"):
         lr_find(m, num_steps=1)
+
+
+def test_model_summary_printed_and_suppressible(capsys):
+    """enable_model_summary prints a rank-0 parameter table at fit start
+    (PTL behavior); False silences it; the util itself reports exact
+    counts, bytes, and dtypes per group."""
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.utils.summary import summarize_params
+
+    import jax.numpy as jnp
+
+    table = summarize_params(
+        {"enc": {"w": jnp.zeros((4, 8)), "b": jnp.zeros((8,))},
+         "head": jnp.zeros((8, 2), jnp.bfloat16)}
+    )
+    assert "enc" in table and "head" in table and "total" in table
+    assert "40" in table  # enc: 4*8 + 8 params
+    assert "bfloat16" in table
+
+    m = _DetModule(batch_size=4, n=96)
+    Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        num_sanity_val_steps=0, check_val_every_n_epoch=10**9,
+    ).fit(m)
+    err = capsys.readouterr().err
+    assert "total" in err and "params" in err
+
+    m2 = _DetModule(batch_size=4, n=96)
+    Trainer(
+        max_epochs=1, enable_checkpointing=False, seed=0,
+        enable_model_summary=False,
+        num_sanity_val_steps=0, check_val_every_n_epoch=10**9,
+    ).fit(m2)
+    assert "total" not in capsys.readouterr().err
+
+
+def test_overfit_batches_trains_and_validates_same_slice():
+    """overfit_batches fixes one unshuffled train slice and points the val
+    loop at it: a val set with shifted targets no longer influences
+    val_loss (it is computed on TRAIN data), and mixing with batch limits
+    is rejected."""
+    import jax.numpy as jnp
+    import optax
+
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader
+    from ray_lightning_tpu.trainer.module import TPUModule
+
+    class M(TPUModule):
+        def __init__(self):
+            super().__init__()
+            g = np.random.default_rng(0)
+            self.x = g.standard_normal((96, 3)).astype(np.float32)
+            self.y = self.x @ np.array([1.0, -2.0, 0.5], np.float32)
+
+        def init_params(self, rng, batch):
+            return {"w": jnp.zeros((3,))}
+
+        def training_step(self, params, batch, rng):
+            bx, by = batch
+            loss = ((bx @ params["w"] - by) ** 2).mean()
+            return loss, {"loss": loss}
+
+        def validation_step(self, params, batch):
+            bx, by = batch
+            return {"val_loss": ((bx @ params["w"] - by) ** 2).mean()}
+
+        def configure_optimizers(self):
+            return optax.adam(5e-2)
+
+        def train_dataloader(self):
+            return DataLoader(
+                ArrayDataset(self.x, self.y), batch_size=4, shuffle=True
+            )
+
+        def val_dataloader(self):
+            # Poisoned val targets: any val_loss computed on THIS data is
+            # >= ~100^2; overfit mode must never see it.
+            return DataLoader(
+                ArrayDataset(self.x, self.y + 100.0), batch_size=4
+            )
+
+    m = M()
+    t = Trainer(
+        max_epochs=60,
+        overfit_batches=2,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+    )
+    t.fit(m)
+    # Val ran on the train slice: loss is the (near-converged) train loss,
+    # not the ~10^4 the poisoned val set would produce.
+    assert float(t.callback_metrics["val_loss"]) < 1.0
+    # And only 2 batches per epoch were consumed.
+    assert t.global_step == 60 * 2
+
+    with pytest.raises(ValueError, match="overfit_batches"):
+        Trainer(overfit_batches=2, limit_train_batches=4)
+    with pytest.raises(ValueError, match="overfit_batches"):
+        Trainer(overfit_batches=-1)
+    with pytest.raises(ValueError, match="overfit_batches"):
+        Trainer(overfit_batches=1.5)
+
+
+def test_detect_anomaly_raises_at_nan():
+    """detect_anomaly surfaces a NaN produced inside the compiled step as
+    an immediate FloatingPointError instead of silently training on."""
+    import jax
+    import jax.numpy as jnp
+
+    m = _DetModule(batch_size=4, n=96)
+    orig = m.training_step
+
+    def nan_step(params, batch, rng):
+        loss, logs = orig(params, batch, rng)
+        # Param-dependent log(negative) -> NaN that reaches the compiled
+        # step's OUTPUTS (a constant NaN with zero gradient and finite
+        # logs would — correctly — never trip debug_nans).
+        bad = jnp.log(-jnp.abs(params["w"]).sum() - 1.0)
+        return loss + bad, {"loss": loss + bad}
+
+    m.training_step = nan_step
+    from ray_lightning_tpu.trainer import Trainer
+
+    t = Trainer(
+        max_epochs=1,
+        detect_anomaly=True,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        check_val_every_n_epoch=10**9,
+    )
+    with pytest.raises(FloatingPointError):
+        t.fit(m)
+    # The anomaly guard restores the process-global even on the raise
+    # path — the raise IS the feature's normal outcome.
+    assert not jax.config.jax_debug_nans
+
+    # Without the flag the same NaN step runs to completion (and the next
+    # run's _setup_common owns the global back to False).
+    m2 = _DetModule(batch_size=4, n=96)
+    m2.training_step = nan_step
+    t2 = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        check_val_every_n_epoch=10**9,
+    )
+    t2.fit(m2)  # no raise
+    assert not jax.config.jax_debug_nans
+
+
+def test_swa_averages_trajectory_and_swaps():
+    """SWA folds end-of-epoch params (from swa_epoch_start on) into an
+    equal-weight average and swaps it in at fit end; the running state
+    rides state_dict for restart resume."""
+    from ray_lightning_tpu.trainer import StochasticWeightAveraging, Trainer
+    from ray_lightning_tpu.trainer.callbacks import Callback
+
+    class Recorder(Callback):
+        def __init__(self):
+            self.per_epoch = []
+
+        def on_train_epoch_end(self, trainer, module):
+            w = trainer.strategy.gather_state(trainer.params)["w"]
+            self.per_epoch.append(np.asarray(w).copy())
+
+    rec = Recorder()
+    swa = StochasticWeightAveraging(swa_epoch_start=2)
+    m = _DetModule(batch_size=4, n=96)
+    t = Trainer(
+        max_epochs=4,
+        callbacks=[rec, swa],
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        check_val_every_n_epoch=10**9,
+    )
+    t.fit(m)
+    assert swa.n_models == 2  # epochs 2 and 3
+    expected = (rec.per_epoch[2] + rec.per_epoch[3]) / 2
+    np.testing.assert_allclose(np.asarray(m.params["w"]), expected, rtol=1e-6)
+    # The average differs from the raw final params (the trajectory moved).
+    assert not np.allclose(rec.per_epoch[3], expected)
+
+    state = swa.state_dict()
+    fresh = StochasticWeightAveraging(swa_epoch_start=2)
+    fresh.load_state_dict(state)
+    assert fresh.n_models == 2
+    np.testing.assert_allclose(fresh.swa_params["w"], swa.swa_params["w"])
+
+    # Float start: fraction of max_epochs; swap_params=False keeps live
+    # weights and leaves the average on .swa_params.
+    swa2 = StochasticWeightAveraging(swa_epoch_start=0.5, swap_params=False)
+    m2 = _DetModule(batch_size=4, n=96)
+    t2 = Trainer(
+        max_epochs=2,
+        callbacks=[swa2],
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        check_val_every_n_epoch=10**9,
+    )
+    t2.fit(m2)
+    assert swa2.n_models == 1  # epoch 1 only (start = int(0.5*2))
+    # One collected model and no swap: the average IS the final epoch's
+    # params, and the live weights were left alone.
+    np.testing.assert_allclose(
+        np.asarray(swa2.swa_params["w"]), np.asarray(m2.params["w"]), rtol=1e-6
+    )
+
+    with pytest.raises(ValueError, match="swa_epoch_start"):
+        StochasticWeightAveraging(swa_epoch_start=1.5)
+    with pytest.raises(ValueError, match="swa_epoch_start"):
+        StochasticWeightAveraging(swa_epoch_start=-1)
+
+
+def test_max_time_parsing():
+    """max_time accepts seconds / timedelta / kwargs dict / clock strings
+    and rejects malformed or non-positive specs."""
+    import datetime
+
+    from ray_lightning_tpu.trainer.trainer import _parse_max_time
+
+    assert _parse_max_time(None) is None
+    assert _parse_max_time(90) == 90.0
+    assert _parse_max_time(datetime.timedelta(minutes=2)) == 120.0
+    assert _parse_max_time({"hours": 1, "minutes": 30}) == 5400.0
+    assert _parse_max_time("00:01:30") == 90.0
+    assert _parse_max_time("01:00:00:05") == 86405.0
+    for bad in ("90", "1:2", "a:b:c", 0, -5, True, object()):
+        with pytest.raises(ValueError):
+            _parse_max_time(bad)
+
+
+def test_max_time_stops_fit_early():
+    """A wall-clock budget ends the fit long before max_epochs: the loop
+    checks the deadline at step boundaries (single process) and flags
+    should_stop, like PTL's Trainer(max_time=...)."""
+    import time
+
+    from ray_lightning_tpu.trainer import Trainer
+
+    m = _DetModule(batch_size=4, n=96)
+    t = Trainer(
+        max_epochs=100000,
+        max_time=2.0,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        check_val_every_n_epoch=10**9,
+    )
+    t0 = time.monotonic()
+    t.fit(m)
+    elapsed = time.monotonic() - t0
+    # Compile eats part of the budget; the stop must land within a
+    # generous multiple of it, far before 100k epochs' worth of steps.
+    assert elapsed < 60
+    assert 1 <= t.global_step < 100000 * 3
+
+
+def test_scale_batch_size_power_and_throughput():
+    """The power ramp doubles to max_val, records samples/s per fitting
+    size, and suggests the largest fit (Lightning semantics) alongside a
+    throughput-optimal size."""
+    from ray_lightning_tpu.trainer import scale_batch_size
+
+    m = _DetModule(batch_size=4, n=96)
+    res = scale_batch_size(m, init_val=2, max_val=32, steps_per_trial=2)
+    assert res.sizes == [2, 4, 8, 16, 32]
+    assert res.largest == 32
+    assert res.failed_at is None
+    assert res.suggestion == 32
+    assert set(res.samples_per_sec) == {2, 4, 8, 16, 32}
+    assert all(v > 0 for v in res.samples_per_sec.values())
+    assert res.throughput_optimal in res.samples_per_sec
+    assert res.suggestion_or(7) == 32
+
+    # A non-power-of-two ceiling is probed itself, not skipped past.
+    res48 = scale_batch_size(m, init_val=2, max_val=48, steps_per_trial=1)
+    assert res48.sizes == [2, 4, 8, 16, 32, 48]
+    assert res48.largest == 48
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="mode"):
+        scale_batch_size(m, mode="bogus")
+    with _pytest.raises(ValueError, match="init_val"):
+        scale_batch_size(m, init_val=0)
+
+
+def test_scale_batch_size_binsearch_on_oom():
+    """A trace-time RESOURCE_EXHAUSTED is classified as OOM (not re-raised);
+    binsearch tightens between the last fit and first failure. Non-OOM
+    errors propagate unchanged."""
+    from ray_lightning_tpu.trainer import scale_batch_size
+
+    def oom_module(threshold):
+        m = _DetModule(batch_size=4, n=96)
+        orig = m.training_step
+
+        def step(params, batch, rng):
+            if batch[0].shape[0] > threshold:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory allocating probe"
+                )
+            return orig(params, batch, rng)
+
+        m.training_step = step
+        return m
+
+    res = scale_batch_size(
+        oom_module(20), mode="binsearch", init_val=2, steps_per_trial=1
+    )
+    assert res.failed_at is not None and res.failed_at <= 32
+    assert res.largest == 20  # binsearch closes the [16, 32) gap
+    assert 20 in res.samples_per_sec and 32 not in res.samples_per_sec
+
+    # Power mode stops at the first failure without refinement.
+    res_p = scale_batch_size(oom_module(20), init_val=2, steps_per_trial=1)
+    assert res_p.largest == 16 and res_p.failed_at == 32
+
+    # Even init_val failing -> largest is None, suggestion_or falls back.
+    res_0 = scale_batch_size(oom_module(1), init_val=2, steps_per_trial=1)
+    assert res_0.largest is None and res_0.suggestion_or(4) == 4
+
+    class Boom(RuntimeError):
+        pass
+
+    m = _DetModule(batch_size=4, n=96)
+
+    def bad_step(params, batch, rng):
+        raise Boom("shape bug, not memory")
+
+    m.training_step = bad_step
+    import pytest as _pytest
+
+    with _pytest.raises(Boom):
+        scale_batch_size(m, init_val=2, steps_per_trial=1)
 
 
 def test_early_stopping_thresholds():
