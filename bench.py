@@ -168,12 +168,22 @@ def bench_mnist(
         else:
             ref = base_meds[i]
         pair_ratios.append(f_m / ref)
+    # Drift control at zero extra chip cost: consecutive BASELINE fits
+    # compared to each other. Identical code on both sides, so any spread
+    # here is pure environment (tunnel phase) — the noise floor any
+    # framework-vs-baseline ratio sits on. A vs_baseline outside
+    # [1/drift, drift] of 1.0 is signal; inside it is weather.
+    base_self = [
+        round(base_meds[i + 1] / base_meds[i], 4)
+        for i in range(len(base_meds) - 1)
+    ]
     return {
         "baseline_sps_chip": round(statistics.median(base_rates), 3),
         "framework_sps_chip": round(statistics.median(fw_rates), 3),
         # Median of per-round (drift-cancelled) ratios.
         "vs_baseline": round(statistics.median(pair_ratios), 4),
         "pair_ratios": [round(r, 4) for r in pair_ratios],
+        "baseline_self_ratios": base_self,
     }
 
 

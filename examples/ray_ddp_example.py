@@ -87,6 +87,15 @@ def main() -> None:
         "--smoke-test", action="store_true", help="tiny fast run for CI"
     )
     parser.add_argument(
+        "--auto-lr", action="store_true",
+        help="pick the learning rate with an LR range test before the fit",
+    )
+    parser.add_argument(
+        "--auto-batch", action="store_true",
+        help="pick the batch size with the OOM-aware finder (throughput-"
+        "optimal point) before the fit",
+    )
+    parser.add_argument(
         "--address", type=str, default=None,
         help="fabric head address (host:port) for client mode — start one "
         "with `python -m ray_lightning_tpu.fabric.server`",
@@ -103,11 +112,44 @@ def main() -> None:
     fabric.init(address=args.address, num_cpus=num_cpus)
     num_epochs = 1 if args.smoke_test else args.num_epochs
     num_samples = 1 if args.smoke_test else args.num_samples
+    if args.tune and (args.auto_lr or args.auto_batch):
+        parser.error(
+            "--auto-lr/--auto-batch feed the plain fit's config; a --tune "
+            "sweep searches lr/batch itself — combine one or the other"
+        )
+    config = {}
+    if args.auto_lr or args.auto_batch:
+        # Probes run in-process ON CPU: the driver must never initialize
+        # the TPU backend (libtpu is single-owner per process — a driver
+        # that binds the chips starves the fit's worker actors). The lr
+        # suggestion is model-shaped, not hardware-shaped; the batch probe
+        # is illustrative on CPU (run it inside a worker for chip-accurate
+        # OOM bounds).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        probe = MNISTClassifier(batch_size=32, n_train=512 if args.smoke_test else 4096)
+        if args.auto_batch:
+            from ray_lightning_tpu.trainer import scale_batch_size
+
+            res = scale_batch_size(
+                probe,
+                max_val=64 if args.smoke_test else 512,
+                steps_per_trial=2,
+            )
+            config["batch_size"] = res.throughput_optimal or 32
+            print(f"auto-batch: {res.samples_per_sec} -> {config['batch_size']}")
+        if args.auto_lr:
+            from ray_lightning_tpu.trainer import lr_find
+
+            res = lr_find(probe, num_steps=40 if args.smoke_test else 100)
+            config["lr"] = res.suggestion_or(1e-3)
+            print(f"auto-lr: suggestion {config['lr']:.2e}")
     if args.tune:
         tune_mnist(args.num_workers, num_epochs, num_samples, args.use_tpu)
     else:
         trainer = train_mnist(
-            {}, num_workers=args.num_workers, num_epochs=num_epochs, use_tpu=args.use_tpu
+            config, num_workers=args.num_workers, num_epochs=num_epochs, use_tpu=args.use_tpu
         )
         print("Final metrics:", trainer.callback_metrics)
     fabric.shutdown()

@@ -60,6 +60,9 @@ def test_bench_smoke_cpu():
     assert "device_kind" in out["env"]
     assert "tpu_probe_failed" not in out["env"]  # deliberate CPU run: no flag
     assert "pair_ratios" in out["extra"]
+    # Drift control: baseline-vs-itself ratios quantify the noise floor
+    # (rounds=1 -> empty list, but the key must exist).
+    assert "baseline_self_ratios" in out["extra"]
     # Tiny mode must exercise ALL extra configs: an API drift in the
     # ResNet/GPT/Tune benches would otherwise be swallowed into *_error
     # fields on the real TPU run with no test catching it.

@@ -34,6 +34,7 @@ def _run_example(name: str, *args: str) -> subprocess.CompletedProcess:
     "name,args",
     [
         ("ray_ddp_example.py", ()),
+        ("ray_ddp_example.py", ("--auto-lr", "--auto-batch")),
         ("ray_ddp_example.py", ("--tune",)),
         ("ray_ddp_tune.py", ()),
         ("ray_horovod_example.py", ()),
@@ -41,7 +42,10 @@ def _run_example(name: str, *args: str) -> subprocess.CompletedProcess:
         ("gpt_sharded_example.py", ()),
         ("gpt_sharded_example.py", ("--modern",)),
     ],
-    ids=["ddp", "ddp-tune", "tune", "ring", "sharded", "gpt", "gpt-modern"],
+    ids=[
+        "ddp", "ddp-auto", "ddp-tune", "tune", "ring", "sharded", "gpt",
+        "gpt-modern",
+    ],
 )
 def test_example_smoke(name, args):
     proc = _run_example(name, *args)
