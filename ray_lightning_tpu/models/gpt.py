@@ -82,6 +82,14 @@ class GPTConfig:
     # (layers shard over pp; microbatched GPipe schedule,
     # parallel/pipeline.py). 0 -> one microbatch per pipeline stage.
     num_microbatches: int = 0
+    # S-chunk size for the fused LM head + cross-entropy (0 = dense path).
+    # The dense loss materializes fp32 logits (B, S, V) twice (forward
+    # residual + backward cotangent) — ~1.6 GB each at the GPT-2-small
+    # bench shape; the chunked path caps live logits at (B, chunk, V) and
+    # recomputes them in the backward. Ignored under sequence parallelism
+    # (hidden states are seq-sharded; the per-rank dense logits are
+    # already small).
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -339,13 +347,16 @@ def gpt_forward(
     mesh: Optional[jax.sharding.Mesh] = None,
     seq_axis: Optional[str] = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ) -> Any:
     """tokens (B, S) int32 -> logits (B, S, V).
 
     ``mesh``+``seq_axis`` switch attention to the sequence-parallel ring
     (set by GSPMDStrategy when the mesh's seq axis is >1). With
     ``return_aux`` also returns the mean MoE load-balancing loss (zero for
-    dense configs).
+    dense configs). ``return_hidden`` skips the LM head and returns the
+    post-final-LN hidden states (B, S, D) instead of logits — the input
+    the fused :func:`chunked_lm_loss` consumes.
     """
     from ray_lightning_tpu.ops import (
         attention_reference,
@@ -548,6 +559,10 @@ def gpt_forward(
         # logit tests) never see the internal layout; keep seq-sharded so
         # the (B, S, V) logits stay sharded too.
         x = _seq_sharded(x[:, zz_inv])
+    if return_hidden:
+        if return_aux:
+            return x, aux_total / max(1, cfg.n_layer)
+        return x
     # Tied output head (GPT-2 weight tying); logits reduce in fp32.
     logits = jnp.einsum(
         "bsd,vd->bsv", x.astype(jnp.float32), params["wte"].astype(jnp.float32)
@@ -564,6 +579,53 @@ def lm_loss(
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     acc = jnp.mean((jnp.argmax(logits, -1) == targets).astype(jnp.float32))
     return ce.mean(), acc
+
+
+def chunked_lm_loss(
+    x: jax.Array, wte: jax.Array, targets: jax.Array, chunk: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused LM head + mean CE + accuracy without (B, S, V) logits.
+
+    ``x``: post-final-LN hidden states (B, S, D); ``wte``: tied embedding
+    (V, D); ``targets``: (B, S) int32 (negative = ignore). Scans the head
+    matmul + cross-entropy over S-chunks; ``jax.checkpoint`` on the chunk
+    body makes the backward *recompute* each chunk's logits instead of
+    saving them, so peak logits memory is B*chunk*V fp32 on both passes
+    (vs B*S*V twice for the dense path — ~1.6 GB each at the GPT-2-small
+    bench shape). Same fp32 math as :func:`lm_loss`; equality of value and
+    grads is asserted in tests/test_gpt.py.
+    """
+    B, S, D = x.shape
+    nc = -(-S // chunk)
+    pad = nc * chunk - S
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)), constant_values=-1)
+    xc = x.reshape(B, nc, chunk, D).swapaxes(0, 1)  # (nc, B, C, D)
+    tc = targets.reshape(B, nc, chunk).swapaxes(0, 1)  # (nc, B, C)
+    wte32 = wte.astype(jnp.float32)
+
+    def body(carry, xs):
+        ce_sum, n_correct = carry
+        x_c, t_c = xs
+        logits = jnp.einsum("bcd,vd->bcv", x_c.astype(jnp.float32), wte32)
+        valid = t_c >= 0
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, jnp.clip(t_c, 0)[..., None], axis=-1
+        )[..., 0]
+        ce_sum = ce_sum + jnp.sum(jnp.where(valid, lse - tgt, 0.0))
+        hit = (jnp.argmax(logits, -1) == t_c) & valid
+        n_correct = n_correct + jnp.sum(hit.astype(jnp.float32))
+        return (ce_sum, n_correct), None
+
+    (ce_sum, n_correct), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xc, tc),
+    )
+    n = jnp.asarray(B * S, jnp.float32)
+    return ce_sum / n, n_correct / n
 
 
 def make_fake_text(
@@ -853,10 +915,22 @@ class GPTLM(TPUModule):
             params, tokens, self.config, mesh=self._mesh, seq_axis=self._seq_axis
         )
 
+    def _use_chunked_loss(self) -> bool:
+        # Sequence parallelism shards the hidden states over S; the
+        # per-rank dense logits are already 1/sp-sized, and the chunk
+        # scan's dynamic slices over a sharded axis would force gathers.
+        seq_sharded = (
+            self._mesh is not None
+            and self._seq_axis is not None
+            and self._mesh.shape.get(self._seq_axis, 1) > 1
+        )
+        return self.config.loss_chunk > 0 and not seq_sharded
+
     def _loss(
         self, params: Any, batch: Any, return_aux: bool = False
     ) -> Any:
         toks = batch[0] if isinstance(batch, (tuple, list)) else batch
+        chunked = self._use_chunked_loss()
         out = gpt_forward(
             params,
             toks[:, :-1],
@@ -864,12 +938,21 @@ class GPTLM(TPUModule):
             mesh=self._mesh,
             seq_axis=self._seq_axis,
             return_aux=return_aux,
+            return_hidden=chunked,
         )
+        if chunked:
+            def head(o):
+                return chunked_lm_loss(
+                    o, params["wte"], toks[:, 1:], self.config.loss_chunk
+                )
+        else:
+            def head(o):
+                return lm_loss(o, toks[:, 1:])
         if return_aux:
-            logits, aux = out
-            loss, acc = lm_loss(logits, toks[:, 1:])
+            hidden_or_logits, aux = out
+            loss, acc = head(hidden_or_logits)
             return loss, acc, aux
-        loss, acc = lm_loss(out, toks[:, 1:])
+        loss, acc = head(out)
         return loss, acc
 
     # -- steps -----------------------------------------------------------
