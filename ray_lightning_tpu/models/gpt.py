@@ -624,7 +624,10 @@ def chunked_lm_loss(
         (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
         (xc, tc),
     )
-    n = jnp.asarray(B * S, jnp.float32)
+    # Mean over VALID positions only — negative targets really are ignored
+    # (for the in-repo callers every real target is >= 0, so this equals
+    # the dense path's mean over B*S).
+    n = jnp.maximum(jnp.sum((targets >= 0).astype(jnp.float32)), 1.0)
     return ce_sum / n, n_correct / n
 
 
