@@ -262,6 +262,25 @@ def _moe_layer_params(lp: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     }
 
 
+def _lm_head(h: jax.Array, wte: jax.Array) -> jax.Array:
+    """Tied LM head: ``(..., D) x (V, D) -> (..., V)`` logits.
+
+    Operands stay in the hidden states' compute dtype — TPU matmul units
+    consume bf16 anyway, and fp32 operands only double the HBM read
+    traffic on the V-by-D table (which also bounds per-token decode) —
+    while ``preferred_element_type`` keeps accumulation/logits in fp32.
+    The single definition keeps the dense, chunked, and decode heads on
+    one precision scheme (their grad/value equality is asserted in
+    tests/test_gpt.py).
+    """
+    return jnp.einsum(
+        "...d,vd->...v",
+        h,
+        wte.astype(h.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _layernorm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
     x32 = x.astype(jnp.float32)
     mu = x32.mean(-1, keepdims=True)
@@ -563,10 +582,9 @@ def gpt_forward(
         if return_aux:
             return x, aux_total / max(1, cfg.n_layer)
         return x
-    # Tied output head (GPT-2 weight tying); logits reduce in fp32.
-    logits = jnp.einsum(
-        "bsd,vd->bsv", x.astype(jnp.float32), params["wte"].astype(jnp.float32)
-    )
+    # Tied output head (GPT-2 weight tying); see _lm_head for the
+    # precision scheme.
+    logits = _lm_head(x, params["wte"])
     if return_aux:
         return logits, aux_total / max(1, cfg.n_layer)
     return logits
@@ -603,12 +621,15 @@ def chunked_lm_loss(
         targets = jnp.pad(targets, ((0, 0), (0, pad)), constant_values=-1)
     xc = x.reshape(B, nc, chunk, D).swapaxes(0, 1)  # (nc, B, C, D)
     tc = targets.reshape(B, nc, chunk).swapaxes(0, 1)  # (nc, B, C)
-    wte32 = wte.astype(jnp.float32)
+    # Hoist the (V, D) dtype cast out of the scan so the checkpointed
+    # body doesn't re-convert the table on every backward recompute
+    # (_lm_head's astype is then a no-op).
+    wte_c = wte.astype(x.dtype)
 
     def body(carry, xs):
         ce_sum, n_correct = carry
         x_c, t_c = xs
-        logits = jnp.einsum("bcd,vd->bcv", x_c.astype(jnp.float32), wte32)
+        logits = _lm_head(x_c, wte_c)
         valid = t_c >= 0
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(
@@ -843,9 +864,7 @@ def gpt_generate(
         k_cache = jnp.stack(new_k)
         v_cache = jnp.stack(new_v)
         h = _layernorm(h[:, None], params["lnf_g"], params["lnf_b"])[:, 0]
-        logits = jnp.einsum(
-            "bd,vd->bv", h.astype(jnp.float32), params["wte"].astype(jnp.float32)
-        )
+        logits = _lm_head(h, params["wte"])
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(
             sub, logits, temperature=temperature, top_k=top_k, top_p=top_p
