@@ -51,6 +51,22 @@ class IterableDataset:
         raise NotImplementedError
 
 
+def _is_torch_iterable(dataset: Any) -> bool:
+    """True for ``torch.utils.data.IterableDataset`` WITHOUT importing
+    torch: migration interop only applies when the user already has torch
+    loaded (a framework-side import would add seconds of cold start and a
+    hard dependency the TPU path doesn't need)."""
+    import sys
+
+    torch = sys.modules.get("torch")
+    if torch is None:
+        return False
+    try:
+        return isinstance(dataset, torch.utils.data.IterableDataset)
+    except AttributeError:  # torch without torch.utils.data loaded
+        return False
+
+
 class ArrayDataset(Dataset):
     """Dataset over parallel numpy arrays (features, labels, ...)."""
 
@@ -270,7 +286,13 @@ class DataLoader:
         self.drop_last = drop_last
         self.seed = seed
         self.collate_fn = collate_fn
-        self._iterable = isinstance(dataset, IterableDataset)
+        # torch interop (docs/migration.md): a torch map-style Dataset
+        # already satisfies the __len__/__getitem__ protocol (CPU tensors
+        # collate via np.asarray); torch IterableDatasets must be routed
+        # onto the streaming path or len() below would raise.
+        self._iterable = isinstance(dataset, IterableDataset) or _is_torch_iterable(
+            dataset
+        )
         if self._iterable and shuffle:
             raise ValueError(
                 "shuffle=True is undefined for IterableDataset: there are "

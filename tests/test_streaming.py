@@ -182,3 +182,66 @@ def test_stream_guard_rails():
     assert len(batches) == 3
     tail, mask = batches[-1]
     assert mask.tolist() == [True, True, False, False]
+
+# ---------------------------------------------------------------------------
+# torch interop (docs/migration.md): reference users arrive with
+# torch.utils.data datasets; both torch flavors must work unwrapped.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_torch_map_style_dataset_trains(start_fabric):
+    """A torch TensorDataset drops into DataLoader unchanged: the
+    __len__/__getitem__ protocol matches and CPU tensors collate via
+    np.asarray."""
+    torch = pytest.importorskip("torch")
+
+    from ray_lightning_tpu.strategies import RayTPUStrategy
+
+    start_fabric(num_cpus=2)
+    m = _DetModule(batch_size=4, n=32)
+    ds = torch.utils.data.TensorDataset(
+        torch.from_numpy(m.x), torch.from_numpy(m.y)
+    )
+    m.train_dataloader = lambda: DataLoader(ds, batch_size=4)
+    m.val_dataloader = lambda: DataLoader(ds, batch_size=4)
+    trainer = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+    )
+    trainer.fit(m)
+    assert np.isfinite(float(trainer.callback_metrics["loss"]))
+
+
+@pytest.mark.slow
+def test_torch_iterable_dataset_streams(start_fabric):
+    """A torch IterableDataset routes onto the streaming path (stride
+    sharding), not the map-style path (len() would raise)."""
+    torch = pytest.importorskip("torch")
+
+    from ray_lightning_tpu.strategies import RayTPUStrategy
+
+    start_fabric(num_cpus=2)
+    m = _DetModule(batch_size=4, n=32)
+    x, y = m.x, m.y
+
+    class _TorchStream(torch.utils.data.IterableDataset):
+        def __iter__(self):
+            yield from zip(x, y)
+
+    loader = DataLoader(_TorchStream(), batch_size=4)
+    assert loader._iterable
+    m.train_dataloader = lambda: DataLoader(_TorchStream(), batch_size=4)
+    m.val_dataloader = lambda: DataLoader(_TorchStream(), batch_size=4)
+    trainer = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+    )
+    trainer.fit(m)
+    assert np.isfinite(float(trainer.callback_metrics["loss"]))
