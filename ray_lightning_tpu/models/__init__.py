@@ -6,6 +6,14 @@ linear, exercises every hook), XORModule (exact-metric assertions),
 MNISTClassifier (accuracy-bound assertions). Benchmark models (ResNet-18,
 GPT-2) land with the models milestone.
 """
+from ray_lightning_tpu.models.bert import (
+    BERTConfig,
+    BERTEncoder,
+    apply_mlm_masking,
+    bert_forward,
+    init_bert_params,
+    masked_lm_loss,
+)
 from ray_lightning_tpu.models.boring import BoringModule, RandomDataset
 from ray_lightning_tpu.models.gpt import (
     GPTConfig,
@@ -37,4 +45,10 @@ __all__ = [
     "init_gpt_params",
     "make_fake_text",
     "load_hf_gpt2",
+    "BERTConfig",
+    "BERTEncoder",
+    "bert_forward",
+    "init_bert_params",
+    "apply_mlm_masking",
+    "masked_lm_loss",
 ]
