@@ -309,6 +309,23 @@ class BERTEncoder(TPUModule):
             "lr_schedule": sched,
         }
 
+    def fill_mask(self, tokens: Any) -> jax.Array:
+        """Argmax prediction at every ``[MASK]`` position; all other
+        positions pass through unchanged. tokens (B, S) int with
+        ``mask_id`` at the positions to fill."""
+        if self.params is None:
+            raise RuntimeError("no parameters: fit first or set module.params")
+        toks = jnp.asarray(tokens, jnp.int32)
+        # Fitted params arrive as host numpy (gather_state); device-ify
+        # once (the gpt_generate pattern, models/gpt.py).
+        params = jax.tree_util.tree_map(jnp.asarray, self.params)
+        logits = bert_forward(params, toks, self.config)
+        # Never "fill" with [MASK] itself: its wte row has a logit too,
+        # and an undertrained model may rank it first.
+        logits = logits.at[..., self.config.mask_id].set(-jnp.inf)
+        pred = jnp.argmax(logits, -1).astype(toks.dtype)
+        return jnp.where(toks == self.config.mask_id, pred, toks)
+
     def _data(self) -> Dataset:
         if self._dataset is None:
             # Reserve the [MASK] row: corpus tokens stay below mask_id.

@@ -41,10 +41,11 @@ def _run_example(name: str, *args: str) -> subprocess.CompletedProcess:
         ("ray_ddp_sharded_example.py", ()),
         ("gpt_sharded_example.py", ()),
         ("gpt_sharded_example.py", ("--modern",)),
+        ("bert_mlm_example.py", ()),
     ],
     ids=[
         "ddp", "ddp-auto", "ddp-tune", "tune", "ring", "sharded", "gpt",
-        "gpt-modern",
+        "gpt-modern", "bert",
     ],
 )
 def test_example_smoke(name, args):
