@@ -371,7 +371,11 @@ class Strategy:
 
     # -- compiled steps -------------------------------------------------
     def compile_train_step(
-        self, module: Any, tx: Any, log_grad_norm: bool = False
+        self,
+        module: Any,
+        tx: Any,
+        log_grad_norm: bool = False,
+        fold_steps: int = 1,
     ) -> Callable:
         """Build the jitted train step.
 
@@ -383,6 +387,16 @@ class Strategy:
         ``log_grad_norm`` adds the pre-clip global gradient norm to the
         step's logs — computed in-graph (one reduction XLA fuses into the
         backward), not a host-side hook.
+
+        ``fold_steps=K > 1`` returns a FOLDED step (the trainer's
+        ``steps_per_execution``): one executable that ``lax.scan``s K
+        optimizer steps, taking a tuple of K staged batches (stacked
+        in-graph) and returning per-step logs stacked on a leading K
+        axis. One device dispatch then covers K steps — on a
+        high-latency link to the chip (remote PJRT), dispatch/transfer
+        round trips stop bounding steps/sec. Per-step math is identical
+        to the unfolded step (same per-step rng fold; asserted in
+        tests/test_trainer.py).
         """
         import jax
         import optax
@@ -419,7 +433,39 @@ class Strategy:
             logs.setdefault("loss", loss)
             return params2, opt_state2, logs
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        if fold_steps <= 1:
+            return jax.jit(step, donate_argnums=(0, 1))
+        return self._fold_train_step(step, fold_steps)
+
+    @staticmethod
+    def _fold_train_step(step: Callable, fold_steps: int) -> Callable:
+        """Jit a ``(params, opt, batch, rng, step_idx)`` step body into the
+        K-folded executable (``compile_train_step``'s ``fold_steps``
+        contract): takes a K-tuple of batches, scans the step, returns
+        per-step logs stacked on a leading K axis."""
+        import jax
+        import jax.numpy as jnp
+
+        K = int(fold_steps)
+
+        def kstep(params, opt_state, batches, rng, step_idx):
+            # Stack the K staged batches INSIDE the compiled program: the
+            # host dispatches one executable per K steps and no separate
+            # concat kernel.
+            xs = jax.tree_util.tree_map(lambda *bs: jnp.stack(bs), *batches)
+
+            def body(carry, x):
+                p, o = carry
+                i, b = x
+                p, o, logs = step(p, o, b, rng, step_idx + i)
+                return (p, o), logs
+
+            (params2, opt_state2), logs = jax.lax.scan(
+                body, (params, opt_state), (jnp.arange(K), xs)
+            )
+            return params2, opt_state2, logs
+
+        return jax.jit(kstep, donate_argnums=(0, 1))
 
     def compile_eval_step(self, module: Any, stage: str) -> Callable:
         """Compile the eval program.

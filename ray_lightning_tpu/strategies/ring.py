@@ -23,7 +23,11 @@ class RingTPUStrategy(RayTPUStrategy):
     strategy_name = "horovod_ray"
 
     def compile_train_step(
-        self, module: Any, tx: Any, log_grad_norm: bool = False
+        self,
+        module: Any,
+        tx: Any,
+        log_grad_norm: bool = False,
+        fold_steps: int = 1,
     ) -> Callable:
         import jax
         import jax.numpy as jnp
@@ -66,6 +70,8 @@ class RingTPUStrategy(RayTPUStrategy):
             rng = jax.random.fold_in(rng, step_idx)
             return sharded(params, opt_state, batch, rng)
 
+        if fold_steps > 1:
+            return self._fold_train_step(step, fold_steps)
         return jax.jit(step, donate_argnums=(0, 1))
 
     def compile_eval_step(self, module: Any, stage: str) -> Callable:

@@ -16,7 +16,7 @@ from __future__ import annotations
 import os
 import warnings
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -64,6 +64,15 @@ class TrainerSpec:
     val_check_interval: Optional[Any] = None
     accumulate_grad_batches: int = 1
     gradient_clip_val: Optional[float] = None
+    # Fold K optimizer steps into ONE compiled dispatch (lax.scan inside
+    # the executable; Keras-on-TPU's steps_per_execution). Per-step math
+    # is unchanged; host-visible cadences (logging, val_check_interval,
+    # callbacks, stop checks) quantize to K-step chunk boundaries, and
+    # epoch/max_steps tails shorter than K run through the single-step
+    # executable so budgets are exact. The win is dispatch amortization:
+    # on a high-latency link to the chip, launch round trips stop
+    # bounding steps/sec.
+    steps_per_execution: int = 1
     log_every_n_steps: int = 50
     enable_checkpointing: bool = True
     default_root_dir: str = "."
@@ -635,8 +644,22 @@ class TrainingLoop:
         if self._train_loader is None:
             raise RuntimeError("fit requires train_dataloader()")
         self._init_state(ckpt_stream)
+        fold = max(1, int(self.spec.steps_per_execution))
         train_step = self.strategy.compile_train_step(
-            self.module, self._tx, log_grad_norm=self.spec.log_grad_norm
+            self.module,
+            self._tx,
+            log_grad_norm=self.spec.log_grad_norm,
+            fold_steps=fold,
+        )
+        # Tail chunks (epoch remainder, max_steps cap) shorter than the
+        # fold run through the plain executable; jit compiles lazily, so
+        # an epoch divisible by the fold never pays this compile.
+        single_step = (
+            train_step
+            if fold == 1
+            else self.strategy.compile_train_step(
+                self.module, self._tx, log_grad_norm=self.spec.log_grad_norm
+            )
         )
         val_step = (
             self.strategy.compile_eval_step(self.module, "val")
@@ -709,25 +732,31 @@ class TrainingLoop:
             # lists — live device buffers stay O(log interval), not
             # O(steps), so 100k-step epochs don't pin 100k live scalars
             # for one giant end-of-epoch fetch.
-            pending_logs: List[Dict[str, Any]] = []
+            pending_logs: List[Tuple[Dict[str, Any], int]] = []
             epoch_host_vals: Dict[str, List[float]] = {}
 
             def _drain_logs() -> Dict[str, float]:
                 """Fetch buffered device scalars (one device_get), append
                 to the epoch's host accumulators, return the LATEST step's
-                host values (what on_train_batch_end logs)."""
+                host values (what on_train_batch_end logs). Entries are
+                ``(logs, n)``: a folded dispatch contributes one entry of
+                n stacked per-step scalars."""
                 if not pending_logs:
                     return {}
                 fetched = jax.device_get(pending_logs)
                 pending_logs.clear()
-                for d in fetched:
+                last: Dict[str, float] = {}
+                for d, n in fetched:
                     for k, v in d.items():
-                        epoch_host_vals.setdefault(k, []).append(
-                            float(np.asarray(v))
+                        vals = np.asarray(v).reshape(n)
+                        epoch_host_vals.setdefault(k, []).extend(
+                            float(x) for x in vals
                         )
-                return {
-                    k: float(np.asarray(v)) for k, v in fetched[-1].items()
-                }
+                    last = {
+                        k: float(np.asarray(v).reshape(n)[-1])
+                        for k, v in d.items()
+                    }
+                return last
             # Device staging pipeline: host batch assembly (loader prefetch
             # thread) -> H2D transfer (stager pool) -> step dispatch, all
             # overlapped with device compute.
@@ -737,9 +766,11 @@ class TrainingLoop:
             # int = every N batches; float fraction = that share of the
             # epoch's batches.
             vci = self.spec.val_check_interval
+            vci_from_float = False
             if isinstance(vci, float) and vci == 1.0:
                 vci = None  # PTL: 1.0 == once per epoch (the default path)
             elif vci is not None and 0 < float(vci) < 1:
+                vci_from_float = True
                 if n_batches is None:
                     raise ValueError(
                         "float val_check_interval needs a sized dataset; "
@@ -755,28 +786,94 @@ class TrainingLoop:
                         f"training batches per epoch ({n_batches}); use a "
                         "smaller interval or a float epoch fraction"
                     )
+            if vci is not None and fold > 1 and int(vci) % fold:
+                if vci_from_float:
+                    # A fraction promises a cadence, not an exact count:
+                    # quantize to the nearest chunk boundary (docs/api.md
+                    # 'cadences quantize to chunk boundaries').
+                    vci = max(fold, round(int(vci) / fold) * fold)
+                else:
+                    raise ValueError(
+                        f"val_check_interval ({vci}) must be a multiple of "
+                        f"steps_per_execution ({fold}): the host only sees "
+                        "chunk boundaries, so an unaligned int interval "
+                        "would silently validate late (float fractions "
+                        "quantize instead)"
+                    )
+            if (
+                fold > 1
+                and n_batches is not None
+                and fold > n_batches > 0
+            ):
+                from ray_lightning_tpu.utils.rank_zero import rank_zero_warn
+
+                rank_zero_warn(
+                    f"steps_per_execution ({fold}) exceeds the batches per "
+                    f"epoch ({n_batches}); every chunk is an epoch tail, so "
+                    "no dispatch is ever folded — lower it to at most the "
+                    "epoch length to get the amortization"
+                )
             # Mid-epoch vals obey the same epoch cadence as epoch-end ones.
             val_epoch = (epoch + 1) % self.spec.check_val_every_n_epoch == 0
             last_val_step = -1
 
             staged = self.strategy.stage_batches(
-                itertools.islice(self._train_loader.iter_batches(mult), n_batches)
+                itertools.islice(self._train_loader.iter_batches(mult), n_batches),
+                # A folded dispatch consumes `fold` staged batches at once;
+                # keep at least a chunk + 1 in flight so the next chunk's
+                # H2D overlaps this chunk's execution.
+                depth=max(3, fold + 1),
             )
             batch_idx = -1
+            staged_it = iter(staged)
             try:
-                for batch_idx, batch in enumerate(staged):
-                    self.params, self.opt_state, logs = train_step(
-                        self.params, self.opt_state, batch, self._rng, self.global_step
-                    )
-                    pending_logs.append(logs)  # device scalars; no sync here
-                    self.global_step += 1
+                while True:
+                    # Chunk size: the fold, capped by the step budget so a
+                    # folded dispatch never overshoots max_steps (budget
+                    # tails run through the single-step executable).
+                    take = fold
+                    if self.spec.max_steps is not None:
+                        take = min(take, self.spec.max_steps - self.global_step)
+                        if take <= 0:
+                            stop = True
+                            break
+                    chunk = list(itertools.islice(staged_it, take))
+                    if not chunk:
+                        break
+                    n_chunk = len(chunk)
+                    start_step = self.global_step
+                    if n_chunk == fold and fold > 1:
+                        self.params, self.opt_state, logs = train_step(
+                            self.params,
+                            self.opt_state,
+                            tuple(chunk),
+                            self._rng,
+                            start_step,
+                        )
+                        pending_logs.append((logs, fold))  # no sync here
+                    else:
+                        for j, batch in enumerate(chunk):
+                            self.params, self.opt_state, logs = single_step(
+                                self.params,
+                                self.opt_state,
+                                batch,
+                                self._rng,
+                                start_step + j,
+                            )
+                            pending_logs.append((logs, 1))
+                    batch_idx += n_chunk
+                    self.global_step += n_chunk
                     if self._update_count is not None:
-                        self._mini_host += 1
-                        if self._mini_host == self.spec.accumulate_grad_batches:
-                            self._mini_host = 0
-                            self._update_count += 1
+                        self._mini_host += n_chunk
+                        self._update_count += (
+                            self._mini_host // self.spec.accumulate_grad_batches
+                        )
+                        self._mini_host %= self.spec.accumulate_grad_batches
                     if (
-                        self.global_step % self.spec.log_every_n_steps == 0
+                        # Crossed a log boundary within this chunk (for
+                        # fold=1 this is exactly `global_step % N == 0`).
+                        self.global_step // self.spec.log_every_n_steps
+                        != start_step // self.spec.log_every_n_steps
                         # Streaming epochs (n_batches None) have no known
                         # final batch; the post-loop drain covers the tail.
                         or (n_batches is not None and batch_idx == n_batches - 1)

@@ -78,6 +78,7 @@ class Trainer:
         val_check_interval: Optional[Any] = None,
         accumulate_grad_batches: int = 1,
         gradient_clip_val: Optional[float] = None,
+        steps_per_execution: int = 1,
         log_every_n_steps: int = 50,
         enable_checkpointing: bool = True,
         enable_model_summary: bool = True,
@@ -144,6 +145,11 @@ class Trainer:
         self.val_check_interval = val_check_interval
         self.accumulate_grad_batches = accumulate_grad_batches
         self.gradient_clip_val = gradient_clip_val
+        if int(steps_per_execution) < 1:
+            raise ValueError(
+                f"steps_per_execution must be >= 1, got {steps_per_execution}"
+            )
+        self.steps_per_execution = int(steps_per_execution)
         self.log_every_n_steps = log_every_n_steps
         self.enable_checkpointing = enable_checkpointing
         self.enable_model_summary = bool(enable_model_summary)
@@ -209,6 +215,7 @@ class Trainer:
             val_check_interval=self.val_check_interval,
             accumulate_grad_batches=self.accumulate_grad_batches,
             gradient_clip_val=self.gradient_clip_val,
+            steps_per_execution=self.steps_per_execution,
             log_every_n_steps=self.log_every_n_steps,
             enable_checkpointing=self.enable_checkpointing,
             enable_model_summary=self.enable_model_summary,

@@ -1921,3 +1921,107 @@ def test_token_bin_sharded_dir_and_stats_mfu(tmp_path):
     t.fit(m)
     assert stats.epoch_times and stats.mfu == []
     assert "mfu" not in t.callback_metrics
+
+
+# ---------------------------------------------------------------------------
+# steps_per_execution (folded dispatch): per-step math must be identical
+# to the single-step loop — only host dispatch cadence changes.
+# ---------------------------------------------------------------------------
+
+
+def _fit_det(start_fabric, *, n=32, batch_size=4, **trainer_kw):
+    import numpy as np
+
+    from ray_lightning_tpu.strategies import RayTPUStrategy
+    from ray_lightning_tpu.trainer import Trainer
+
+    start_fabric(num_cpus=2)
+    m = _DetModule(batch_size=batch_size, n=n)
+    trainer = Trainer(
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+        **trainer_kw,
+    )
+    trainer.fit(m)
+    return trainer, np.asarray(m.params["w"])
+
+
+def test_steps_per_execution_matches_single(start_fabric):
+    """K=4 folding: final params, step count, and epoch-mean loss equal
+    the single-step loop (8 batches/epoch divide evenly)."""
+    import numpy as np
+
+    t1, w1 = _fit_det(start_fabric, max_epochs=2)
+    t4, w4 = _fit_det(start_fabric, max_epochs=2, steps_per_execution=4)
+    np.testing.assert_allclose(w4, w1, rtol=1e-6, atol=1e-7)
+    # 32 rows shard to 16 per worker -> 4 batches/epoch x 2 epochs.
+    assert t4.global_step == t1.global_step == 8
+    np.testing.assert_allclose(
+        float(t4.callback_metrics["loss"]),
+        float(t1.callback_metrics["loss"]),
+        rtol=1e-6,
+    )
+
+
+def test_steps_per_execution_tail_remainder(start_fabric):
+    """5 batches/epoch (40 rows -> 20/worker) with K=4: one folded chunk
+    + a 1-step tail via the single-step executable; equivalence holds."""
+    import numpy as np
+
+    t1, w1 = _fit_det(start_fabric, n=40, max_epochs=1)
+    tk, wk = _fit_det(start_fabric, n=40, max_epochs=1, steps_per_execution=4)
+    np.testing.assert_allclose(wk, w1, rtol=1e-6, atol=1e-7)
+    assert tk.global_step == t1.global_step == 5
+
+
+def test_steps_per_execution_max_steps_exact(start_fabric):
+    """max_steps=6 with K=4: the second chunk is capped to 2 single
+    steps — the budget is exact, never overshot by folding."""
+    import numpy as np
+
+    t1, w1 = _fit_det(start_fabric, max_epochs=5, max_steps=6)
+    tk, wk = _fit_det(
+        start_fabric, max_epochs=5, max_steps=6, steps_per_execution=4
+    )
+    assert tk.global_step == t1.global_step == 6
+    np.testing.assert_allclose(wk, w1, rtol=1e-6, atol=1e-7)
+
+
+def test_steps_per_execution_composes_with_accumulation(start_fabric):
+    """K=4 folding x accumulate_grad_batches=2: the on-device MultiSteps
+    window rides inside the scan; params match the single-step loop."""
+    import numpy as np
+
+    t1, w1 = _fit_det(start_fabric, max_epochs=2, accumulate_grad_batches=2)
+    tk, wk = _fit_det(
+        start_fabric,
+        max_epochs=2,
+        accumulate_grad_batches=2,
+        steps_per_execution=4,
+    )
+    np.testing.assert_allclose(wk, w1, rtol=1e-6, atol=1e-7)
+    assert tk.global_step == t1.global_step
+
+
+def test_steps_per_execution_vci_alignment(start_fabric):
+    """An unaligned val_check_interval fails fast."""
+    import pytest
+
+    with pytest.raises(ValueError, match="multiple of steps_per_execution"):
+        _fit_det(
+            start_fabric,
+            max_epochs=1,
+            steps_per_execution=4,
+            val_check_interval=3,
+        )
+
+
+def test_steps_per_execution_validation():
+    import pytest
+
+    from ray_lightning_tpu.trainer import Trainer
+
+    with pytest.raises(ValueError, match="steps_per_execution"):
+        Trainer(steps_per_execution=0)
