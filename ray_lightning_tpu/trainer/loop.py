@@ -790,8 +790,12 @@ class TrainingLoop:
                 if vci_from_float:
                     # A fraction promises a cadence, not an exact count:
                     # quantize to the nearest chunk boundary (docs/api.md
-                    # 'cadences quantize to chunk boundaries').
+                    # 'cadences quantize to chunk boundaries'), clamped to
+                    # the epoch so rounding UP can't push the cadence past
+                    # the last batch and silently disable mid-epoch val.
                     vci = max(fold, round(int(vci) / fold) * fold)
+                    if n_batches is not None and vci > n_batches >= fold:
+                        vci = (n_batches // fold) * fold
                 else:
                     raise ValueError(
                         f"val_check_interval ({vci}) must be a multiple of "
@@ -804,9 +808,11 @@ class TrainingLoop:
                 fold > 1
                 and n_batches is not None
                 and fold > n_batches > 0
+                and not getattr(self, "_fold_warned", False)
             ):
                 from ray_lightning_tpu.utils.rank_zero import rank_zero_warn
 
+                self._fold_warned = True  # epoch-invariant; warn once
                 rank_zero_warn(
                     f"steps_per_execution ({fold}) exceeds the batches per "
                     f"epoch ({n_batches}); every chunk is an epoch tail, so "
