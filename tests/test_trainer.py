@@ -2025,3 +2025,35 @@ def test_steps_per_execution_validation():
 
     with pytest.raises(ValueError, match="steps_per_execution"):
         Trainer(steps_per_execution=0)
+
+
+def test_steps_per_execution_ring_and_sharded(start_fabric):
+    """Folding through the OTHER compiled-step builders: ring's explicit
+    shard_map/pmean override and ZeRO's sharded optimizer both produce
+    params identical to their single-step runs."""
+    import numpy as np
+
+    from ray_lightning_tpu.strategies import RayShardedStrategy, RingTPUStrategy
+    from ray_lightning_tpu.trainer import Trainer
+
+    start_fabric(num_cpus=2)
+    for make in (
+        lambda: RingTPUStrategy(num_workers=2, use_tpu=False),
+        lambda: RayShardedStrategy(num_workers=2, use_tpu=False, zero_stage=3),
+    ):
+        ws = []
+        for k in (1, 4):
+            m = _DetModule(batch_size=4, n=32)
+            t = Trainer(
+                max_epochs=2,
+                enable_checkpointing=False,
+                seed=0,
+                num_sanity_val_steps=0,
+                steps_per_execution=k,
+                strategy=make(),
+            )
+            t.fit(m)
+            ws.append((t.global_step, np.asarray(m.params["w"])))
+        (s1, w1), (s4, w4) = ws
+        assert s1 == s4
+        np.testing.assert_allclose(w4, w1, rtol=1e-6, atol=1e-7)
