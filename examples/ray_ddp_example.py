@@ -22,6 +22,7 @@ def train_mnist(
     num_epochs: int = 2,
     use_tpu: bool = False,
     callbacks: list = None,
+    steps_per_execution: int = 1,
 ) -> Trainer:
     module = MNISTClassifier(
         lr=config.get("lr", 1e-3),
@@ -30,6 +31,9 @@ def train_mnist(
     )
     trainer = Trainer(
         max_epochs=num_epochs,
+        # TPU tip: >1 folds K optimizer steps into one compiled dispatch
+        # (amortizes launch latency; math unchanged).
+        steps_per_execution=steps_per_execution,
         callbacks=list(callbacks or []),
         strategy=RayTPUStrategy(num_workers=num_workers, use_tpu=use_tpu),
         enable_checkpointing=False,
@@ -80,6 +84,11 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--num-workers", type=int, default=2)
     parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument(
+        "--steps-per-execution", type=int, default=1,
+        help="fold K optimizer steps into one compiled dispatch "
+        "(recommended 8+ on TPU)",
+    )
     parser.add_argument("--num-samples", type=int, default=2)
     parser.add_argument("--use-tpu", action="store_true", default=False)
     parser.add_argument("--tune", action="store_true", help="run a tune sweep")
@@ -149,7 +158,11 @@ def main() -> None:
         tune_mnist(args.num_workers, num_epochs, num_samples, args.use_tpu)
     else:
         trainer = train_mnist(
-            config, num_workers=args.num_workers, num_epochs=num_epochs, use_tpu=args.use_tpu
+            config,
+            num_workers=args.num_workers,
+            num_epochs=num_epochs,
+            use_tpu=args.use_tpu,
+            steps_per_execution=args.steps_per_execution,
         )
         print("Final metrics:", trainer.callback_metrics)
     fabric.shutdown()
