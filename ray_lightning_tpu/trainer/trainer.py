@@ -65,6 +65,7 @@ class Trainer:
         max_epochs: int = 1,
         max_steps: Optional[int] = None,
         max_time: Optional[Any] = None,
+        fast_dev_run: Any = False,
         strategy: Optional[Strategy] = None,
         callbacks: Optional[List[Any]] = None,
         limit_train_batches: Optional[Any] = None,
@@ -101,6 +102,59 @@ class Trainer:
         self.max_time = _parse_max_time(max_time)
         self.strategy = strategy
         self.callbacks = list(callbacks or [])
+        # PTL's fast_dev_run: touch every code path in one tiny run —
+        # N batches (True = 1) of train/val/test/predict, a single
+        # epoch, no sanity val, no checkpointing. The wiring smoke test
+        # the reference leans on (fast_dev_run=True throughout its
+        # sharded suite, /root/reference/ray_lightning/tests/
+        # test_ddp_sharded.py:37-71).
+        self.fast_dev_run = fast_dev_run
+        if fast_dev_run:
+            if not isinstance(fast_dev_run, (bool, int)):
+                raise ValueError(
+                    f"fast_dev_run must be True or a positive int, got "
+                    f"{fast_dev_run!r}"
+                )
+            n = 1 if fast_dev_run is True else int(fast_dev_run)
+            if n < 1:
+                raise ValueError(
+                    f"fast_dev_run must be True or a positive int, got "
+                    f"{fast_dev_run!r}"
+                )
+            if max_steps is not None or limit_train_batches is not None:
+                raise ValueError(
+                    "fast_dev_run replaces max_steps/limit_*_batches; "
+                    "pass one or the other"
+                )
+            if overfit_batches is not None:
+                raise ValueError(
+                    "fast_dev_run and overfit_batches are mutually "
+                    "exclusive debug modes; pass one or the other"
+                )
+            # self.max_epochs/max_steps were assigned above; override
+            # both the attributes and the locals consumed below.
+            self.max_epochs = max_epochs = 1
+            self.max_steps = max_steps = n
+            limit_train_batches = n
+            limit_val_batches = n
+            limit_test_batches = n
+            limit_predict_batches = n
+            num_sanity_val_steps = 0
+            enable_checkpointing = False
+            # The one-epoch run must still touch the val path (the whole
+            # point), whatever cadence the config carried (PTL resets
+            # both under fast_dev_run).
+            check_val_every_n_epoch = 1
+            val_check_interval = None
+            self.max_time = None
+            # PTL disables checkpoint callbacks outright under
+            # fast_dev_run — including user-supplied ones.
+            from ray_lightning_tpu.trainer.callbacks import ModelCheckpoint
+
+            self.callbacks = [
+                cb for cb in self.callbacks
+                if not isinstance(cb, ModelCheckpoint)
+            ]
         self.limit_train_batches = limit_train_batches
         self.limit_val_batches = limit_val_batches
         self.limit_test_batches = limit_test_batches

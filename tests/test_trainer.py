@@ -2057,3 +2057,58 @@ def test_steps_per_execution_ring_and_sharded(start_fabric):
         (s1, w1), (s4, w4) = ws
         assert s1 == s4
         np.testing.assert_allclose(w4, w1, rtol=1e-6, atol=1e-7)
+
+
+def test_fast_dev_run(start_fabric):
+    """fast_dev_run=True: one train batch + one val batch, one epoch, no
+    sanity val, no checkpoints — and metrics still come back."""
+    import numpy as np
+    import pytest
+
+    from ray_lightning_tpu.strategies import RayTPUStrategy
+    from ray_lightning_tpu.trainer import Trainer
+
+    start_fabric(num_cpus=2)
+    m = _DetModule(batch_size=4, n=32)
+    trainer = Trainer(
+        fast_dev_run=True,
+        max_epochs=50,  # overridden to 1
+        seed=0,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+    )
+    trainer.fit(m)
+    assert trainer.global_step == 1
+    assert trainer.current_epoch == 0
+    assert np.isfinite(float(trainer.callback_metrics["loss"]))
+    assert np.isfinite(float(trainer.callback_metrics["val_loss"]))
+
+    m3 = _DetModule(batch_size=4, n=32)
+    t3 = Trainer(
+        fast_dev_run=3,
+        seed=0,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+    )
+    t3.fit(m3)
+    assert t3.global_step == 3
+
+    with pytest.raises(ValueError, match="fast_dev_run"):
+        Trainer(fast_dev_run=True, max_steps=5)
+    with pytest.raises(ValueError, match="fast_dev_run"):
+        Trainer(fast_dev_run=-1)
+    with pytest.raises(ValueError, match="fast_dev_run"):
+        Trainer(fast_dev_run=2.7)
+    with pytest.raises(ValueError, match="mutually"):
+        Trainer(fast_dev_run=True, overfit_batches=2)
+    # Cadences reset so the one-epoch run still validates; checkpoint
+    # callbacks (incl. user-supplied) are dropped.
+    from ray_lightning_tpu.trainer import ModelCheckpoint
+
+    t = Trainer(
+        fast_dev_run=True,
+        check_val_every_n_epoch=5,
+        val_check_interval=10,
+        callbacks=[ModelCheckpoint(dirpath="/tmp/nope")],
+    )
+    assert t.check_val_every_n_epoch == 1
+    assert t.val_check_interval is None
+    assert not t.callbacks
