@@ -20,6 +20,7 @@ _LAZY = {
     "GSPMDStrategy": "ray_lightning_tpu.strategies",
     "Trainer": "ray_lightning_tpu.trainer",
     "TPUModule": "ray_lightning_tpu.trainer",
+    "ByteBPETokenizer": "ray_lightning_tpu.tokenizer",
 }
 
 

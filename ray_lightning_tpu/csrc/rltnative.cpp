@@ -13,6 +13,7 @@
 #include <cstdint>
 #include <cstring>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -104,6 +105,99 @@ void rlt_gather_windows_u16_i32(const uint16_t* src, int32_t* out,
   });
 }
 
-int32_t rlt_abi_version() { return 2; }
+// ---------------------------------------------------------------------
+// Byte-level BPE (tokenizer.py): the native data-layer component the
+// reference ecosystem gets from HF's Rust tokenizers. Token ids: bytes
+// 0..255, then 256+r for merge rank r. Determinism contract shared with
+// the Python fallback: each round merges the most frequent adjacent
+// pair, ties broken by the smallest (left, right) pair.
+
+// Train: learn up to n_merges merges over a uint8 corpus (one stream,
+// documents joined by the `sep` byte; sep < 0 = no separator). Pairs
+// touching the separator are never counted, so no merge can span a
+// document boundary. Writes (left, right) pairs rank-major into
+// merges_out[2 * n_merges]; returns the number of merges actually
+// learned (early stop when no pair repeats). O(V * N) rescan trainer —
+// linear passes, no incremental pair bookkeeping; train once, ship the
+// vocab.
+int64_t rlt_bpe_train(const uint8_t* corpus, int64_t n_bytes,
+                      int32_t n_merges, int32_t sep, int32_t* merges_out) {
+  std::vector<int32_t> ids(corpus, corpus + n_bytes);
+  int64_t learned = 0;
+  for (int32_t r = 0; r < n_merges; ++r) {
+    std::unordered_map<int64_t, int64_t> counts;
+    counts.reserve(1 << 16);
+    for (size_t i = 0; i + 1 < ids.size(); ++i) {
+      if (ids[i] == sep || ids[i + 1] == sep) continue;
+      counts[(static_cast<int64_t>(ids[i]) << 32) | ids[i + 1]] += 1;
+    }
+    int64_t best_key = -1, best_count = 1;  // require count >= 2
+    for (const auto& kv : counts) {
+      if (kv.second > best_count ||
+          (kv.second == best_count && best_key != -1 && kv.first < best_key)) {
+        best_key = kv.first;
+        best_count = kv.second;
+      }
+    }
+    if (best_key < 0) break;
+    int32_t left = static_cast<int32_t>(best_key >> 32);
+    int32_t right = static_cast<int32_t>(best_key & 0xffffffff);
+    merges_out[2 * r] = left;
+    merges_out[2 * r + 1] = right;
+    int32_t new_id = 256 + r;
+    size_t w = 0;
+    for (size_t i = 0; i < ids.size();) {
+      if (i + 1 < ids.size() && ids[i] == left && ids[i + 1] == right) {
+        ids[w++] = new_id;
+        i += 2;
+      } else {
+        ids[w++] = ids[i++];
+      }
+    }
+    ids.resize(w);
+    ++learned;
+  }
+  return learned;
+}
+
+// Encode: apply merges in rank order (GPT-2 greedy: repeatedly merge the
+// lowest-ranked pair present). out must hold n_bytes int32s; returns the
+// encoded length.
+int64_t rlt_bpe_encode(const uint8_t* text, int64_t n_bytes,
+                       const int32_t* merges, int32_t n_merges,
+                       int32_t* out) {
+  std::unordered_map<int64_t, int32_t> rank;
+  rank.reserve(static_cast<size_t>(n_merges) * 2);
+  for (int32_t r = 0; r < n_merges; ++r) {
+    rank[(static_cast<int64_t>(merges[2 * r]) << 32) | merges[2 * r + 1]] = r;
+  }
+  std::vector<int32_t> ids(text, text + n_bytes);
+  while (ids.size() >= 2) {
+    int32_t best_rank = n_merges;
+    for (size_t i = 0; i + 1 < ids.size(); ++i) {
+      auto it =
+          rank.find((static_cast<int64_t>(ids[i]) << 32) | ids[i + 1]);
+      if (it != rank.end() && it->second < best_rank) best_rank = it->second;
+    }
+    if (best_rank == n_merges) break;
+    int32_t left = merges[2 * best_rank];
+    int32_t right = merges[2 * best_rank + 1];
+    int32_t new_id = 256 + best_rank;
+    size_t w = 0;
+    for (size_t i = 0; i < ids.size();) {
+      if (i + 1 < ids.size() && ids[i] == left && ids[i + 1] == right) {
+        ids[w++] = new_id;
+        i += 2;
+      } else {
+        ids[w++] = ids[i++];
+      }
+    }
+    ids.resize(w);
+  }
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return static_cast<int64_t>(ids.size());
+}
+
+int32_t rlt_abi_version() { return 3; }
 
 }  // extern "C"

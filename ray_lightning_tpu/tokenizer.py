@@ -1,0 +1,213 @@
+"""Byte-level BPE tokenizer — the native data-layer front end.
+
+The reference ecosystem gets tokenization from Hugging Face's Rust
+tokenizers; this build carries its own byte-level BPE with the hot loops
+in C++ (csrc/rltnative.cpp, bound GIL-free via ctypes — the same native
+data path that does batch assembly) and a pure-Python fallback that is
+bit-identical by a shared determinism contract: each training round
+merges the most frequent adjacent pair, ties broken by the smallest
+(left, right) pair; encoding applies merges greedily in rank order
+(GPT-2 style).
+
+Byte-level means no out-of-vocabulary inputs, ever: ids 0..255 are raw
+bytes, 256+r is merge rank r. Pairs with ``TokenBinDataset`` /
+``write_token_bin`` for the corpus -> shard -> GPT/BERT pretraining
+pipeline (uint16 shards hold vocabs up to 65,536).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Sequence, Tuple, Union
+
+import numpy as np
+
+Text = Union[str, bytes]
+
+
+def _to_bytes(text: Text) -> bytes:
+    return text.encode("utf-8") if isinstance(text, str) else bytes(text)
+
+
+def _train_python(
+    corpus: np.ndarray, n_merges: int, sep: int = -1
+) -> np.ndarray:
+    """Reference trainer (fallback + the contract the C++ must match)."""
+    ids = corpus.astype(np.int32).tolist()
+    merges: List[Tuple[int, int]] = []
+    for r in range(n_merges):
+        counts: Dict[Tuple[int, int], int] = {}
+        for pair in zip(ids, ids[1:]):
+            if pair[0] == sep or pair[1] == sep:
+                continue
+            counts[pair] = counts.get(pair, 0) + 1
+        best = None
+        for pair, c in counts.items():
+            if c < 2:
+                continue
+            if best is None or c > best[1] or (c == best[1] and pair < best[0]):
+                best = (pair, c)
+        if best is None:
+            break
+        (left, right), _ = best
+        merges.append((left, right))
+        new_id = 256 + r
+        out: List[int] = []
+        i = 0
+        while i < len(ids):
+            if i + 1 < len(ids) and ids[i] == left and ids[i + 1] == right:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(ids[i])
+                i += 1
+        ids = out
+    return np.asarray(merges, dtype=np.int32).reshape(-1, 2)
+
+
+def _encode_python(text: np.ndarray, merges: np.ndarray) -> np.ndarray:
+    rank = {(int(l), int(r)): i for i, (l, r) in enumerate(merges)}
+    ids = text.astype(np.int32).tolist()
+    n_merges = len(merges)
+    while len(ids) >= 2:
+        best = n_merges
+        for pair in zip(ids, ids[1:]):
+            got = rank.get((int(pair[0]), int(pair[1])), n_merges)
+            if got < best:
+                best = got
+        if best == n_merges:
+            break
+        left, right = (int(x) for x in merges[best])
+        new_id = 256 + best
+        out: List[int] = []
+        i = 0
+        while i < len(ids):
+            if i + 1 < len(ids) and ids[i] == left and ids[i + 1] == right:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(ids[i])
+                i += 1
+        ids = out
+    return np.asarray(ids, dtype=np.int32)
+
+
+class ByteBPETokenizer:
+    """Trained byte-level BPE: ``encode``/``decode`` + JSON persistence.
+
+    ``vocab_size`` = 256 + number of merges. ``train`` learns merges from
+    raw text (native C++ trainer when available); both directions have
+    no unknown-token failure mode — any byte sequence round-trips.
+    """
+
+    def __init__(self, merges: Any = ()) -> None:
+        self.merges = np.asarray(merges, dtype=np.int32).reshape(-1, 2)
+        # Expand each token id to its byte sequence once (decode table).
+        table: List[bytes] = [bytes([b]) for b in range(256)]
+        for left, right in self.merges:
+            table.append(table[int(left)] + table[int(right)])
+        self._bytes_table = table
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def train(
+        cls, texts: Union[Text, Iterable[Text]], vocab_size: int = 512
+    ) -> "ByteBPETokenizer":
+        """Learn ``vocab_size - 256`` merges from text(s).
+
+        Documents are joined with a 0x00 separator, and the trainer
+        excludes every pair touching it — merges can never span a
+        document boundary (binary corpora embedding real NULs simply
+        learn no merges across them).
+        """
+        if vocab_size < 256:
+            raise ValueError(f"vocab_size must be >= 256, got {vocab_size}")
+        if isinstance(texts, (str, bytes)):
+            texts = [texts]
+        corpus = np.frombuffer(
+            b"\x00".join(_to_bytes(t) for t in texts), dtype=np.uint8
+        )
+        n_merges = vocab_size - 256
+        merges = _dispatch_train(corpus, n_merges, sep=0)
+        return cls(merges)
+
+    @classmethod
+    def load(cls, path: str) -> "ByteBPETokenizer":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("type") != "byte_bpe":
+            raise ValueError(f"{path} is not a byte_bpe tokenizer file")
+        return cls(data["merges"])
+
+    def save(self, path: str) -> str:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {"type": "byte_bpe", "merges": self.merges.tolist()}, f
+            )
+        os.replace(tmp, path)
+        return path
+
+    # -- use ------------------------------------------------------------
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges)
+
+    def encode(self, text: Text) -> np.ndarray:
+        data = np.frombuffer(_to_bytes(text), dtype=np.uint8)
+        if not len(data):
+            return np.empty(0, dtype=np.int32)
+        return _dispatch_encode(data, self.merges)
+
+    def encode_corpus(self, texts: Iterable[Text]) -> np.ndarray:
+        """Concatenated ids over documents — the ``write_token_bin``
+        input for pretraining shards.
+
+        One encode call over the 0x00-joined corpus instead of one per
+        document: the trainer never learns a merge touching the
+        separator, so no merge can match across a boundary and stripping
+        the separator ids reproduces the per-document encoding exactly —
+        while the merge-rank table is built once, not per document.
+        (Documents that themselves contain NUL bytes fall back to the
+        per-document path, where their NULs encode as ordinary id-0
+        tokens.)
+        """
+        docs = [_to_bytes(t) for t in texts]
+        if not docs:
+            return np.empty(0, dtype=np.int32)
+        if any(b"\x00" in d for d in docs):
+            return np.concatenate([self.encode(d) for d in docs])
+        joined = np.frombuffer(b"\x00".join(docs), dtype=np.uint8)
+        ids = _dispatch_encode(joined, self.merges)
+        return ids[ids != 0]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
+
+    def decode_bytes(self, ids: Sequence[int]) -> bytes:
+        table = self._bytes_table
+        n = len(table)
+        out = []
+        for i in np.asarray(ids, dtype=np.int64).ravel():
+            if not 0 <= i < n:
+                raise ValueError(f"token id {int(i)} out of range [0, {n})")
+            out.append(table[int(i)])
+        return b"".join(out)
+
+
+def _dispatch_train(
+    corpus: np.ndarray, n_merges: int, sep: int = -1
+) -> np.ndarray:
+    from ray_lightning_tpu.utils import native
+
+    if native.native_available():
+        return native.bpe_train(corpus, n_merges, sep=sep)
+    return _train_python(corpus, n_merges, sep=sep)
+
+
+def _dispatch_encode(data: np.ndarray, merges: np.ndarray) -> np.ndarray:
+    from ray_lightning_tpu.utils import native
+
+    if native.native_available():
+        return native.bpe_encode(data, merges)
+    return _encode_python(data, merges)

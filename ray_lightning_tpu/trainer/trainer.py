@@ -121,16 +121,15 @@ class Trainer:
                     f"fast_dev_run must be True or a positive int, got "
                     f"{fast_dev_run!r}"
                 )
-            if max_steps is not None or limit_train_batches is not None:
-                raise ValueError(
-                    "fast_dev_run replaces max_steps/limit_*_batches; "
-                    "pass one or the other"
-                )
             if overfit_batches is not None:
                 raise ValueError(
                     "fast_dev_run and overfit_batches are mutually "
                     "exclusive debug modes; pass one or the other"
                 )
+            # PTL semantics: every other budget/cadence is silently
+            # overridden by the smoke run (max_steps, limit_*, val
+            # cadences, max_time) — the flag's promise is 'run N batches
+            # of everything right now', not config arbitration.
             # self.max_epochs/max_steps were assigned above; override
             # both the attributes and the locals consumed below.
             self.max_epochs = max_epochs = 1
@@ -147,13 +146,27 @@ class Trainer:
             check_val_every_n_epoch = 1
             val_check_interval = None
             self.max_time = None
-            # PTL disables checkpoint callbacks outright under
-            # fast_dev_run — including user-supplied ones.
-            from ray_lightning_tpu.trainer.callbacks import ModelCheckpoint
+            # PTL disables checkpointing, early stopping, and loggers
+            # outright under fast_dev_run — including user-supplied ones
+            # (a 1-batch run must not early-stop on a missing monitor or
+            # leave logger artifacts on disk).
+            from ray_lightning_tpu.trainer.callbacks import (
+                CSVLogger,
+                EarlyStopping,
+                ModelCheckpoint,
+            )
 
+            drop = (ModelCheckpoint, EarlyStopping, CSVLogger)
+            try:
+                from ray_lightning_tpu.trainer.callbacks import (
+                    TensorBoardLogger,
+                )
+
+                drop = drop + (TensorBoardLogger,)
+            except ImportError:  # pragma: no cover
+                pass
             self.callbacks = [
-                cb for cb in self.callbacks
-                if not isinstance(cb, ModelCheckpoint)
+                cb for cb in self.callbacks if not isinstance(cb, drop)
             ]
         self.limit_train_batches = limit_train_batches
         self.limit_val_batches = limit_val_batches

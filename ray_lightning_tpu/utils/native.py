@@ -59,7 +59,7 @@ def _build() -> Optional[ctypes.CDLL]:
         os.replace(tmp, out)  # atomic vs concurrent workers building too
     lib = ctypes.CDLL(str(out))
     lib.rlt_abi_version.restype = ctypes.c_int32
-    if lib.rlt_abi_version() != 2:
+    if lib.rlt_abi_version() != 3:
         raise RuntimeError("rltnative ABI mismatch")
     lib.rlt_gather_rows.argtypes = [
         ctypes.c_void_p,
@@ -94,6 +94,22 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,
         ctypes.c_int64,
         ctypes.c_int32,
+    ]
+    lib.rlt_bpe_train.restype = ctypes.c_int64
+    lib.rlt_bpe_train.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_void_p,
+    ]
+    lib.rlt_bpe_encode.restype = ctypes.c_int64
+    lib.rlt_bpe_encode.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_void_p,
     ]
     return lib
 
@@ -238,3 +254,35 @@ def gather_windows(
         _n_threads(len(starts)),
     )
     return raw.astype(out_dtype, copy=False)
+
+
+def bpe_train(corpus: np.ndarray, n_merges: int, sep: int = -1) -> np.ndarray:
+    """Learn up to ``n_merges`` BPE merges over a uint8 corpus; returns an
+    (n_learned, 2) int32 array of (left, right) pairs in rank order.
+    Pairs touching ``sep`` (a document separator byte; -1 = none) are
+    never merged. GIL-free when native; tokenizer.py carries the Python
+    fallback."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    corpus = np.ascontiguousarray(corpus, dtype=np.uint8)
+    merges = np.empty((max(1, n_merges), 2), dtype=np.int32)
+    n = lib.rlt_bpe_train(
+        corpus.ctypes.data, len(corpus), n_merges, sep, merges.ctypes.data
+    )
+    return merges[: int(n)].copy()
+
+
+def bpe_encode(text: np.ndarray, merges: np.ndarray) -> np.ndarray:
+    """Encode uint8 bytes -> int32 token ids with rank-ordered merges."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    text = np.ascontiguousarray(text, dtype=np.uint8)
+    merges = np.ascontiguousarray(merges, dtype=np.int32)
+    out = np.empty(max(1, len(text)), dtype=np.int32)
+    n = lib.rlt_bpe_encode(
+        text.ctypes.data, len(text), merges.ctypes.data, len(merges),
+        out.ctypes.data,
+    )
+    return out[: int(n)].copy()

@@ -1,0 +1,131 @@
+"""Byte-level BPE tokenizer: native==fallback bit-equality, roundtrip,
+persistence, document-boundary contract, and the corpus -> shard ->
+pretraining integration."""
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.tokenizer import (
+    ByteBPETokenizer,
+    _encode_python,
+    _train_python,
+)
+
+CORPUS = (
+    ["the cat sat on the mat and the dog ran off"] * 40
+    + ["a stitch in time saves nine"] * 25
+    + ["pack my box with five dozen jugs"] * 15
+)
+
+
+def test_native_matches_python_fallback():
+    """The C++ trainer/encoder and the Python reference implementation
+    follow one determinism contract — identical merges, identical ids."""
+    from ray_lightning_tpu.utils import native
+
+    if not native.native_available():
+        pytest.skip("no native library in this environment")
+    corpus = np.frombuffer(
+        b"\x00".join(t.encode() for t in CORPUS), dtype=np.uint8
+    )
+    m_native = native.bpe_train(corpus, 60, sep=0)
+    m_python = _train_python(corpus, 60, sep=0)
+    np.testing.assert_array_equal(m_native, m_python)
+    text = np.frombuffer(b"the cat sat in a box of time", dtype=np.uint8)
+    np.testing.assert_array_equal(
+        native.bpe_encode(text, m_native), _encode_python(text, m_python)
+    )
+
+
+def test_roundtrip_and_compression():
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=400)
+    assert tok.vocab_size <= 400
+    for text in ["the cat sat on the mat", "unseen words still work!",
+                 "ünïcödé 🙂 bytes"]:
+        ids = tok.encode(text)
+        assert ids.dtype == np.int32
+        assert tok.decode(ids) == text
+    # Trained text compresses; byte-level ids never exceed byte length.
+    ids = tok.encode(CORPUS[0])
+    assert len(ids) < len(CORPUS[0].encode())
+
+
+def test_save_load(tmp_path):
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=320)
+    path = tok.save(str(tmp_path / "tok.json"))
+    tok2 = ByteBPETokenizer.load(path)
+    np.testing.assert_array_equal(tok2.merges, tok.merges)
+    np.testing.assert_array_equal(
+        tok2.encode("the dog sat"), tok.encode("the dog sat")
+    )
+    with pytest.raises(ValueError, match="byte_bpe"):
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"type": "other"}')
+        ByteBPETokenizer.load(str(bad))
+
+
+def test_document_boundary_never_merged():
+    """No learned token's byte expansion may contain the 0x00 separator —
+    merges cannot span documents."""
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=400)
+    for tid in range(256, tok.vocab_size):
+        assert b"\x00" not in tok._bytes_table[tid]
+
+
+def test_edge_inputs():
+    tok = ByteBPETokenizer.train("ababab", vocab_size=260)
+    assert tok.encode("").shape == (0,)
+    assert tok.decode([]) == ""
+    assert tok.decode(tok.encode("x")) == "x"
+    with pytest.raises(ValueError, match="out of range"):
+        tok.decode([tok.vocab_size])
+    with pytest.raises(ValueError, match="vocab_size"):
+        ByteBPETokenizer.train("abc", vocab_size=100)
+    # Degenerate corpus: nothing repeats, no merges learned.
+    assert ByteBPETokenizer.train("abcdefg", vocab_size=300).vocab_size == 256
+
+
+@pytest.mark.slow
+def test_tokenizer_to_pretraining_pipeline(start_fabric, tmp_path):
+    """corpus -> ByteBPETokenizer -> write_token_bin -> TokenBinDataset ->
+    GPTLM fit: the full native data pipeline, end to end."""
+    from ray_lightning_tpu.models import GPTConfig
+    from ray_lightning_tpu.models.gpt import GPTLM
+    from ray_lightning_tpu.strategies import RayTPUStrategy
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.trainer.data import TokenBinDataset, write_token_bin
+
+    start_fabric(num_cpus=2)
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=320)
+    ids = tok.encode_corpus(CORPUS)
+    shard = write_token_bin(str(tmp_path / "corpus.bin"), ids)
+    ds = TokenBinDataset(shard, seq_len=32)
+    cfg = GPTConfig(
+        vocab_size=tok.vocab_size, n_layer=2, n_head=2, d_model=32,
+        max_seq=32, attn_impl="reference", loss_chunk=8,
+    )
+    module = GPTLM(config=cfg, batch_size=8, dataset=ds)
+    trainer = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        limit_train_batches=8,
+        strategy=RayTPUStrategy(num_workers=2, use_tpu=False),
+    )
+    trainer.fit(module)
+    assert np.isfinite(float(trainer.callback_metrics["loss"]))
+
+
+def test_encode_corpus_equals_per_document():
+    """The joined-with-separator batch encode must reproduce per-document
+    encoding exactly (merges never cross the 0x00 boundary)."""
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=380)
+    docs = CORPUS[:7] + ["solo unseen doc", ""]
+    batch = tok.encode_corpus(docs)
+    per_doc = np.concatenate([tok.encode(d) for d in docs])
+    np.testing.assert_array_equal(batch, per_doc)
+    # NUL-containing docs route through the per-document fallback.
+    nul_docs = ["plain", b"nul\x00inside"]
+    batch2 = tok.encode_corpus(nul_docs)
+    per2 = np.concatenate([tok.encode(d) for d in nul_docs])
+    np.testing.assert_array_equal(batch2, per2)

@@ -2091,23 +2091,33 @@ def test_fast_dev_run(start_fabric):
     t3.fit(m3)
     assert t3.global_step == 3
 
-    with pytest.raises(ValueError, match="fast_dev_run"):
-        Trainer(fast_dev_run=True, max_steps=5)
+    # PTL semantics: budgets/cadences silently overridden...
+    t5 = Trainer(fast_dev_run=True, max_steps=50, limit_val_batches=0)
+    assert t5.max_steps == 1 and t5.limit_val_batches == 1
+    # ...but conflicting DEBUG modes and invalid values fail fast.
     with pytest.raises(ValueError, match="fast_dev_run"):
         Trainer(fast_dev_run=-1)
     with pytest.raises(ValueError, match="fast_dev_run"):
         Trainer(fast_dev_run=2.7)
     with pytest.raises(ValueError, match="mutually"):
         Trainer(fast_dev_run=True, overfit_batches=2)
-    # Cadences reset so the one-epoch run still validates; checkpoint
-    # callbacks (incl. user-supplied) are dropped.
-    from ray_lightning_tpu.trainer import ModelCheckpoint
+    # Cadences reset so the one-epoch run still validates; checkpoint,
+    # early-stopping, and logger callbacks (incl. user-supplied) drop.
+    from ray_lightning_tpu.trainer import (
+        CSVLogger,
+        EarlyStopping,
+        ModelCheckpoint,
+    )
 
     t = Trainer(
         fast_dev_run=True,
         check_val_every_n_epoch=5,
         val_check_interval=10,
-        callbacks=[ModelCheckpoint(dirpath="/tmp/nope")],
+        callbacks=[
+            ModelCheckpoint(dirpath="/tmp/nope"),
+            EarlyStopping(monitor="nope"),
+            CSVLogger("/tmp/nope"),
+        ],
     )
     assert t.check_val_every_n_epoch == 1
     assert t.val_check_interval is None
