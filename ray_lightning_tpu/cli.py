@@ -33,7 +33,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
 
-_SUBCOMMANDS = ("fit", "validate", "test", "predict", "generate", "convert-hf")
+_SUBCOMMANDS = (
+    "fit", "validate", "test", "predict", "generate", "convert-hf",
+    "tokenize",
+)
 
 
 def import_class(path: str) -> type:
@@ -134,7 +137,9 @@ def _apply_dotted(
         if section == "overrides":  # convert-hf GPTConfig overrides
             config.setdefault("overrides", {})[field] = yaml.safe_load(raw)
             continue
-        if section not in ("model", "strategy", "trainer", "data", "generate"):
+        if section not in (
+            "model", "strategy", "trainer", "data", "generate", "tokenize",
+        ):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
         if isinstance(node, str):  # YAML bare class-path form
@@ -148,7 +153,7 @@ def _apply_dotted(
     # Pass 2: typed field values.
     for section, field, raw in field_overrides:
         node = config[section]
-        if section in ("trainer", "generate"):  # plain-dict sections
+        if section in ("trainer", "generate", "tokenize"):  # plain dicts
             node[field] = yaml.safe_load(raw)
             continue
         init_args = node.setdefault("init_args", {})
@@ -351,6 +356,66 @@ def run_convert_hf(config: Dict[str, Any]) -> str:
     return out
 
 
+def run_tokenize(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``tokenize``: train (or load) a ByteBPETokenizer and optionally
+    encode the corpus into a pretraining shard.
+
+    Config section (YAML ``tokenize:`` or ``--tokenize.*`` flags):
+      input: text file path or list of paths (each non-empty LINE is one
+        document — merges never span documents)
+      vocab_size: target vocab (default 512)
+      out: tokenizer JSON path (default tokenizer.json)
+      tokenizer: existing tokenizer JSON to reuse instead of training
+      encode_to: token-bin shard path; when set, the corpus is encoded
+        and written for TokenBinDataset
+    Prints one JSON summary line on stdout.
+    """
+    import json as _json
+
+    from ray_lightning_tpu.tokenizer import ByteBPETokenizer
+
+    cfg = dict(config.get("tokenize") or {})
+    inputs = cfg.get("input")
+    if isinstance(inputs, str):
+        inputs = [inputs]
+    if not inputs:
+        raise ValueError("tokenize needs tokenize.input (text file path[s])")
+    docs: List[str] = []
+    for path in inputs:
+        with open(path, "r", encoding="utf-8") as f:
+            docs.extend(line for line in (ln.strip("\n") for ln in f) if line)
+    if not docs:
+        raise ValueError(f"no non-empty lines in {inputs}")
+
+    existing = cfg.get("tokenizer")
+    if existing:
+        tok = ByteBPETokenizer.load(str(existing))
+    else:
+        tok = ByteBPETokenizer.train(docs, vocab_size=int(cfg.get("vocab_size", 512)))
+    out_path = str(cfg.get("out", "tokenizer.json"))
+    if not existing:
+        tok.save(out_path)
+
+    summary: Dict[str, Any] = {
+        "vocab_size": tok.vocab_size,
+        "documents": len(docs),
+        "tokenizer": str(existing) if existing else out_path,
+    }
+    encode_to = cfg.get("encode_to")
+    if encode_to:
+        from ray_lightning_tpu.trainer.data import write_token_bin
+
+        ids = tok.encode_corpus(docs)
+        shard = write_token_bin(str(encode_to), ids)
+        summary["shard"] = shard
+        summary["n_tokens"] = int(ids.size)
+        summary["bytes_per_token"] = round(
+            sum(len(d.encode()) for d in docs) / max(1, ids.size), 3
+        )
+    print(_json.dumps(summary))
+    return summary
+
+
 def main(argv: Optional[List[str]] = None) -> Any:
     subcommand, config = parse_args(argv)
     fabric_cfg = config.pop("fabric", None) or {}
@@ -358,6 +423,8 @@ def main(argv: Optional[List[str]] = None) -> Any:
         from ray_lightning_tpu import fabric
 
         fabric.init(**fabric_cfg)
+    if subcommand == "tokenize":
+        return run_tokenize(config)
     if subcommand == "convert-hf":
         return run_convert_hf(config)
     if subcommand == "generate":

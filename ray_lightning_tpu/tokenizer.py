@@ -102,9 +102,25 @@ class ByteBPETokenizer:
 
     def __init__(self, merges: Any = ()) -> None:
         self.merges = np.asarray(merges, dtype=np.int32).reshape(-1, 2)
-        # Expand each token id to its byte sequence once (decode table).
+        # Expand each token id to its byte sequence once (decode table),
+        # validating ranges as we go: rank r may only reference earlier
+        # ids (negative ids would silently mis-index the table, and a
+        # merge touching byte 0 would break encode_corpus's
+        # separator-strip invariant — the trainer never emits either,
+        # but hand-edited/corrupt JSON must not load quietly).
         table: List[bytes] = [bytes([b]) for b in range(256)]
-        for left, right in self.merges:
+        for r, (left, right) in enumerate(self.merges):
+            for tid in (int(left), int(right)):
+                if not 0 <= tid < 256 + r:
+                    raise ValueError(
+                        f"merge {r} references id {tid}, outside "
+                        f"[0, {256 + r})"
+                    )
+                if tid == 0:
+                    raise ValueError(
+                        f"merge {r} touches byte 0 (the document "
+                        "separator); not a trainer-produced vocab"
+                    )
             table.append(table[int(left)] + table[int(right)])
         self._bytes_table = table
 

@@ -154,17 +154,11 @@ class Trainer:
                 CSVLogger,
                 EarlyStopping,
                 ModelCheckpoint,
+                TensorBoardLogger,
             )
 
-            drop = (ModelCheckpoint, EarlyStopping, CSVLogger)
-            try:
-                from ray_lightning_tpu.trainer.callbacks import (
-                    TensorBoardLogger,
-                )
-
-                drop = drop + (TensorBoardLogger,)
-            except ImportError:  # pragma: no cover
-                pass
+            drop = (ModelCheckpoint, EarlyStopping, CSVLogger,
+                    TensorBoardLogger)
             self.callbacks = [
                 cb for cb in self.callbacks if not isinstance(cb, drop)
             ]

@@ -292,3 +292,48 @@ def test_cli_generate_errors(tmp_path):
             "model": "ray_lightning_tpu.models.BoringModule",
             "generate": {"ckpt_path": "x", "prompt": "1"},
         })
+
+
+def test_cli_tokenize(tmp_path, capsys):
+    """tokenize: train from a text file, save JSON, encode a shard that
+    TokenBinDataset can serve."""
+    import json
+
+    import numpy as np
+
+    from ray_lightning_tpu.cli import main
+    from ray_lightning_tpu.tokenizer import ByteBPETokenizer
+    from ray_lightning_tpu.trainer.data import TokenBinDataset
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(
+        "\n".join(["the cat sat on the mat"] * 60 + ["a dog ran"] * 40)
+    )
+    tok_path = tmp_path / "tok.json"
+    shard_path = tmp_path / "corpus.bin"
+    out = main([
+        "tokenize",
+        "--tokenize.input", str(corpus),
+        "--tokenize.vocab_size", "300",
+        "--tokenize.out", str(tok_path),
+        "--tokenize.encode_to", str(shard_path),
+    ])
+    printed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert printed == {k: out[k] for k in printed}
+    assert out["vocab_size"] <= 300 and out["documents"] == 100
+    tok = ByteBPETokenizer.load(str(tok_path))
+    assert tok.decode(tok.encode("the cat")) == "the cat"
+    ds = TokenBinDataset(out["shard"], seq_len=16)
+    assert len(ds) > 0
+    row = np.asarray(ds[0])
+    assert row.shape == (17,) and row.max() < out["vocab_size"]
+
+    # Reuse an existing tokenizer: no retraining, same encoding.
+    out2 = main([
+        "tokenize",
+        "--tokenize.input", str(corpus),
+        "--tokenize.tokenizer", str(tok_path),
+        "--tokenize.encode_to", str(tmp_path / "c2.bin"),
+    ])
+    assert out2["vocab_size"] == out["vocab_size"]
+    assert out2["n_tokens"] == out["n_tokens"]

@@ -129,3 +129,14 @@ def test_encode_corpus_equals_per_document():
     batch2 = tok.encode_corpus(nul_docs)
     per2 = np.concatenate([tok.encode(d) for d in nul_docs])
     np.testing.assert_array_equal(batch2, per2)
+
+
+def test_corrupt_merges_rejected():
+    """Hand-edited/corrupt vocabs must not load quietly: out-of-range ids
+    and separator-touching merges both raise."""
+    with pytest.raises(ValueError, match="outside"):
+        ByteBPETokenizer([[-1, 97]])
+    with pytest.raises(ValueError, match="outside"):
+        ByteBPETokenizer([[257, 97]])  # rank 0 may only reference bytes
+    with pytest.raises(ValueError, match="separator"):
+        ByteBPETokenizer([[0, 97]])
