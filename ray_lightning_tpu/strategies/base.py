@@ -280,11 +280,32 @@ class Strategy:
     def place_opt_state(self, opt_state: Any, params: Any) -> Any:
         return self._place_tree(opt_state, self.opt_sharding(opt_state, params))
 
-    def make_global_batch(self, host_batch: Any) -> Any:
-        """Host-local numpy batch -> globally sharded jax.Array pytree."""
+    @staticmethod
+    def _shift_spec(sharding: Any) -> Any:
+        """THE fold-axis rule, in one place: a (K, batch, ...) stacked
+        chunk replicates the leading fold axis and shifts the per-step
+        spec right by one."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(sharding.mesh, P(None, *tuple(sharding.spec)))
+
+    def stacked_batch_sharding(self) -> Any:
+        """Sharding for a (K, batch, ...) step-folded chunk (see
+        :meth:`_shift_spec`). Strategies whose ``batch_sharding`` returns
+        a per-leaf callable (GSPMDStrategy) override this accordingly."""
+        return self._shift_spec(self.batch_sharding())
+
+    def make_global_batch(self, host_batch: Any, stacked: bool = False) -> Any:
+        """Host-local numpy batch -> globally sharded jax.Array pytree.
+
+        ``stacked=True``: the leaves carry a leading fold axis (K, B, ...)
+        — one transfer covering K steps (see ``stage_batches(stack=K)``).
+        """
         import jax
 
-        sharding = self.batch_sharding()
+        sharding = (
+            self.stacked_batch_sharding() if stacked else self.batch_sharding()
+        )
         if self.dist_env is None or not self.dist_env.is_distributed:
             # Single-process: plain device_put carries the same semantics
             # with less per-call bookkeeping than the multi-host assembler.
@@ -296,7 +317,9 @@ class Strategy:
             host_batch,
         )
 
-    def stage_batches(self, host_batches: Any, depth: int = 3) -> Any:
+    def stage_batches(
+        self, host_batches: Any, depth: int = 3, stack: int = 0
+    ) -> Any:
         """Iterate device-resident global batches, overlapping host->device
         transfer with compute.
 
@@ -305,19 +328,54 @@ class Strategy:
         flight (order-preserving) so the step stream never stalls on H2D.
         This is the TPU analog of the reference relying on torch DataLoader
         ``pin_memory`` + async ``.cuda()`` copies in its hot loop.
+
+        ``stack=K > 1`` (the trainer's steps_per_execution path) stacks K
+        host batches into ONE (K, batch, ...) transfer, so a folded chunk
+        costs a single H2D round trip instead of K; yields ``(n, batch)``
+        pairs where full chunks have ``n == K`` and the epoch tail arrives
+        as ``n == 1`` singles.
         """
         import collections
         from concurrent.futures import ThreadPoolExecutor
 
+        import numpy as np
+
+        def chunks():
+            if stack <= 1:
+                for hb in host_batches:
+                    yield 1, False, hb
+                return
+            buf = []
+            for hb in host_batches:
+                buf.append(hb)
+                if len(buf) == stack:
+                    yield stack, True, buf  # stacked IN the executor task
+                    buf = []
+            for hb in buf:  # tail shorter than the fold: plain singles
+                yield 1, False, hb
+
+        def assemble(payload, stacked):
+            # The K-batch host stack runs here, on a staging thread — the
+            # consuming (step-dispatching) thread never pays the memcpy.
+            if stacked:
+                import jax
+
+                payload = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *payload
+                )
+            return self.make_global_batch(payload, stacked)
+
         ex = ThreadPoolExecutor(max_workers=depth, thread_name_prefix="rlt-stage")
         pending: "collections.deque" = collections.deque()
         try:
-            for hb in host_batches:
-                pending.append(ex.submit(self.make_global_batch, hb))
+            for n, stacked, hb in chunks():
+                pending.append((n, ex.submit(assemble, hb, stacked)))
                 while len(pending) >= depth:
-                    yield pending.popleft().result()
+                    n0, fut = pending.popleft()
+                    yield (n0, fut.result()) if stack > 1 else fut.result()
             while pending:
-                yield pending.popleft().result()
+                n0, fut = pending.popleft()
+                yield (n0, fut.result()) if stack > 1 else fut.result()
         finally:
             ex.shutdown(wait=False, cancel_futures=True)
 
@@ -376,6 +434,7 @@ class Strategy:
         tx: Any,
         log_grad_norm: bool = False,
         fold_steps: int = 1,
+        fold_stacked: bool = False,
     ) -> Callable:
         """Build the jitted train step.
 
@@ -435,24 +494,38 @@ class Strategy:
 
         if fold_steps <= 1:
             return jax.jit(step, donate_argnums=(0, 1))
-        return self._fold_train_step(step, fold_steps)
+        return self._fold_train_step(step, fold_steps, stacked=fold_stacked)
 
     @staticmethod
-    def _fold_train_step(step: Callable, fold_steps: int) -> Callable:
+    def _fold_train_step(
+        step: Callable, fold_steps: int, stacked: bool = False
+    ) -> Callable:
         """Jit a ``(params, opt, batch, rng, step_idx)`` step body into the
         K-folded executable (``compile_train_step``'s ``fold_steps``
-        contract): takes a K-tuple of batches, scans the step, returns
-        per-step logs stacked on a leading K axis."""
+        contract): scans the step over K batches, returns per-step logs
+        stacked on a leading K axis.
+
+        ``stacked=False``: takes a K-tuple of separately staged batches and
+        stacks them in-graph. ``stacked=True``: takes ONE (K, batch, ...)
+        pytree straight off the stacked staging path
+        (``stage_batches(stack=K)``) — the flag exists because a K-tuple
+        of batch tuples and a single stacked batch tuple are structurally
+        ambiguous at the pytree level.
+        """
         import jax
         import jax.numpy as jnp
 
         K = int(fold_steps)
 
         def kstep(params, opt_state, batches, rng, step_idx):
-            # Stack the K staged batches INSIDE the compiled program: the
-            # host dispatches one executable per K steps and no separate
-            # concat kernel.
-            xs = jax.tree_util.tree_map(lambda *bs: jnp.stack(bs), *batches)
+            if stacked:
+                xs = batches  # already (K, batch, ...) leaves
+            else:
+                # Stack the K staged batches INSIDE the compiled program:
+                # one executable dispatch, no separate concat kernel.
+                xs = jax.tree_util.tree_map(
+                    lambda *bs: jnp.stack(bs), *batches
+                )
 
             def body(carry, x):
                 p, o = carry

@@ -157,10 +157,20 @@ class GSPMDStrategy(RayTPUStrategy):
 
         return spec_for
 
-    def make_global_batch(self, host_batch: Any) -> Any:
+    def stacked_batch_sharding(self) -> Any:
+        """Per-leaf callable (this strategy's batch_sharding contract):
+        the per-step spec is computed on the inner shape — where the
+        seq-axis rule looks at dim 1 — then shifted by the shared
+        fold-axis rule (Strategy._shift_spec)."""
+        spec_for = self.batch_sharding()
+        return lambda x: self._shift_spec(spec_for(x[0]))
+
+    def make_global_batch(self, host_batch: Any, stacked: bool = False) -> Any:
         import jax
 
-        spec_for = self.batch_sharding()
+        spec_for = (
+            self.stacked_batch_sharding() if stacked else self.batch_sharding()
+        )
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(spec_for(x), x),
             host_batch,

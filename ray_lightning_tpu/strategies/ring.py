@@ -28,6 +28,7 @@ class RingTPUStrategy(RayTPUStrategy):
         tx: Any,
         log_grad_norm: bool = False,
         fold_steps: int = 1,
+        fold_stacked: bool = False,
     ) -> Callable:
         import jax
         import jax.numpy as jnp
@@ -71,7 +72,7 @@ class RingTPUStrategy(RayTPUStrategy):
             return sharded(params, opt_state, batch, rng)
 
         if fold_steps > 1:
-            return self._fold_train_step(step, fold_steps)
+            return self._fold_train_step(step, fold_steps, stacked=fold_stacked)
         return jax.jit(step, donate_argnums=(0, 1))
 
     def compile_eval_step(self, module: Any, stage: str) -> Callable:

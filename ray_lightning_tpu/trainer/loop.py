@@ -650,6 +650,10 @@ class TrainingLoop:
             self._tx,
             log_grad_norm=self.spec.log_grad_norm,
             fold_steps=fold,
+            # Chunks arrive as ONE stacked (K, batch, ...) transfer from
+            # the staging pipeline (stage_batches(stack=K)) — a folded
+            # chunk costs a single H2D round trip, not K.
+            fold_stacked=True,
         )
         # Tail chunks (epoch remainder, max_steps cap) shorter than the
         # fold run through the plain executable; jit compiles lazily, so
@@ -823,50 +827,51 @@ class TrainingLoop:
             val_epoch = (epoch + 1) % self.spec.check_val_every_n_epoch == 0
             last_val_step = -1
 
+            # Bound the epoch's batch pull by the step budget so the
+            # stacked staging below is budget-exact: a folded chunk can
+            # never overshoot max_steps (the tail arrives as singles).
+            n_iter = n_batches
+            if self.spec.max_steps is not None:
+                remaining = max(0, self.spec.max_steps - self.global_step)
+                n_iter = (
+                    remaining if n_iter is None else min(n_iter, remaining)
+                )
+                if remaining == 0:
+                    stop = True
             staged = self.strategy.stage_batches(
-                itertools.islice(self._train_loader.iter_batches(mult), n_batches),
-                # A folded dispatch consumes `fold` staged batches at once;
-                # keep at least a chunk + 1 in flight so the next chunk's
-                # H2D overlaps this chunk's execution.
-                depth=max(3, fold + 1),
+                itertools.islice(self._train_loader.iter_batches(mult), n_iter),
+                # Depth counts STAGING UNITS (a whole stacked chunk when
+                # folding): 3 keeps one executing + two in flight without
+                # multiplying in-flight buffers by the fold.
+                depth=3,
+                # stack=K: K host batches leave the host as ONE
+                # (K, batch, ...) transfer; epoch tails shorter than K
+                # arrive as singles for the single-step executable.
+                stack=fold if fold > 1 else 0,
             )
             batch_idx = -1
-            staged_it = iter(staged)
             try:
-                while True:
-                    # Chunk size: the fold, capped by the step budget so a
-                    # folded dispatch never overshoots max_steps (budget
-                    # tails run through the single-step executable).
-                    take = fold
-                    if self.spec.max_steps is not None:
-                        take = min(take, self.spec.max_steps - self.global_step)
-                        if take <= 0:
-                            stop = True
-                            break
-                    chunk = list(itertools.islice(staged_it, take))
-                    if not chunk:
-                        break
-                    n_chunk = len(chunk)
+                for item in (() if stop else staged):
+                    n_chunk, payload = item if fold > 1 else (1, item)
                     start_step = self.global_step
-                    if n_chunk == fold and fold > 1:
+                    if n_chunk > 1:
                         self.params, self.opt_state, logs = train_step(
                             self.params,
                             self.opt_state,
-                            tuple(chunk),
+                            payload,
                             self._rng,
                             start_step,
                         )
-                        pending_logs.append((logs, fold))  # no sync here
+                        pending_logs.append((logs, n_chunk))  # no sync here
                     else:
-                        for j, batch in enumerate(chunk):
-                            self.params, self.opt_state, logs = single_step(
-                                self.params,
-                                self.opt_state,
-                                batch,
-                                self._rng,
-                                start_step + j,
-                            )
-                            pending_logs.append((logs, 1))
+                        self.params, self.opt_state, logs = single_step(
+                            self.params,
+                            self.opt_state,
+                            payload,
+                            self._rng,
+                            start_step,
+                        )
+                        pending_logs.append((logs, 1))
                     batch_idx += n_chunk
                     self.global_step += n_chunk
                     if self._update_count is not None:

@@ -742,3 +742,27 @@ def test_gptlm_fit_with_chunked_loss(start_fabric):
     metrics = {k: float(v) for k, v in trainer.callback_metrics.items()}
     assert np.isfinite(metrics["loss"])
     assert metrics["loss"] < np.log(TINY.vocab_size)
+
+
+@pytest.mark.slow
+def test_gptlm_fit_gspmd_with_fold(start_fabric, tmp_path):
+    """GSPMD (dp x tp) fit with steps_per_execution=2: the stacked
+    (K, B, S) batch sharding shifts the per-step spec right by one and
+    the folded executable runs under multi-axis shardings."""
+    start_fabric(num_cpus=2)
+    from tests.utils import get_trainer, train_test
+
+    strategy = GSPMDStrategy(
+        num_workers=4,
+        use_tpu=False,
+        mesh_shape={"data": 2, "model": 2},
+    )
+    module = GPTLM(config=TINY, batch_size=4, n_train=64)
+    trainer = get_trainer(
+        strategy=strategy,
+        max_epochs=1,
+        default_root_dir=str(tmp_path),
+        steps_per_execution=2,
+    )
+    train_test(trainer, module)
+    assert trainer.callback_metrics.get("val_loss") is not None
