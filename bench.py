@@ -213,8 +213,14 @@ def _tiny() -> bool:
     return os.environ.get("RLT_BENCH_TINY") == "1"
 
 
-def bench_resnet(use_tpu: bool, num_workers: int, epochs: int) -> Dict[str, Any]:
-    """BASELINE.md config 3: ResNet-18/CIFAR, ring collective flavor."""
+def bench_resnet(
+    use_tpu: bool, num_workers: int, epochs: int, fold: int = 1
+) -> Dict[str, Any]:
+    """BASELINE.md config 3: ResNet-18/CIFAR, ring collective flavor.
+    ``fold`` follows --steps-per-execution (capped at 4 by main: ResNet
+    steps are big enough that deeper folding buys little) and is
+    RECORDED in the artifact so the number stays comparable across
+    rounds."""
     from ray_lightning_tpu.models.resnet import CIFARResNet
     from ray_lightning_tpu.strategies import RingTPUStrategy
 
@@ -224,41 +230,82 @@ def bench_resnet(use_tpu: bool, num_workers: int, epochs: int) -> Dict[str, Any]
         width=8 if _tiny() else 64,
     )
     rates, _ = _fit_and_rates(
-        RingTPUStrategy(num_workers=num_workers, use_tpu=use_tpu), module, epochs
+        RingTPUStrategy(num_workers=num_workers, use_tpu=use_tpu),
+        module,
+        epochs,
+        fold=fold,
     )
     return {
         "resnet_steps_per_sec_per_chip": round(
             statistics.median(rates) / max(1, num_workers), 3
-        )
+        ),
+        "resnet_config": f"fold={fold}",
     }
 
 
 def bench_gpt(
-    use_tpu: bool, num_workers: int, epochs: int
+    use_tpu: bool,
+    num_workers: int,
+    epochs: int,
+    ladder: Optional[List[Tuple[int, int, int]]] = None,
 ) -> Tuple[Dict[str, Any], float]:
-    """BASELINE.md config 4: GPT-2 124M tokens/s + MFU, sharded optimizer."""
+    """BASELINE.md config 4: GPT-2 124M tokens/s + MFU, sharded optimizer.
+
+    Config ladder, best first: the chunked LM loss removes the fp32
+    (B, S, V) logits ceiling that pinned the r3 config to batch 16, and
+    step folding amortizes dispatch — but the top rung is validated
+    per-run: any failure (e.g. an OOM this chip disagrees about) falls
+    one rung and is recorded in ``gpt_config`` / ``gpt_fallbacks``.
+    """
     from ray_lightning_tpu.models import GPTConfig
     from ray_lightning_tpu.models.gpt import GPTLM
     from ray_lightning_tpu.strategies import RayShardedStrategy
 
     if _tiny():
-        seq, batch = 32, 2
-        cfg = GPTConfig(
+        seq = 32
+        ladder = ladder or [(2, 8, 1)]
+        base_cfg = dict(
             vocab_size=256, n_layer=2, n_head=4, d_model=64, max_seq=seq,
             attn_impl="reference",
         )
+        make_cfg = lambda chunk: GPTConfig(**base_cfg, loss_chunk=chunk)  # noqa: E731
     else:
-        # batch 16 / no remat: the v5e probe showed throughput scaling
-        # ~linearly in batch up to 32 at this model size (PERF.md); remat
-        # only burns recompute FLOPs when activations fit comfortably.
-        seq, batch = 512, 16
-        cfg = GPTConfig.gpt2_small(max_seq=seq, remat=False)
-    module = GPTLM(config=cfg, batch_size=batch, n_train=batch * num_workers * 16)
-    rates, trainer = _fit_and_rates(
-        RayShardedStrategy(num_workers=num_workers, use_tpu=use_tpu),
-        module,
-        epochs,
-    )
+        seq = 512
+        # (batch, loss_chunk, fold): the r3 on-chip probe showed ~linear
+        # batch scaling to 32 (PERF.md) but the dense loss OOM-bounded
+        # the config at 16; chunked CE lifts that. remat off: pure
+        # recompute overhead at this size.
+        ladder = ladder or [(32, 128, 4), (32, 128, 1), (16, 128, 1), (16, 0, 1)]
+        make_cfg = lambda chunk: GPTConfig.gpt2_small(  # noqa: E731
+            max_seq=seq, remat=False, loss_chunk=chunk
+        )
+    fallbacks: List[str] = []
+    rates = None
+    last_exc: Optional[BaseException] = None
+    for batch, chunk, fold in ladder:
+        module = GPTLM(
+            config=make_cfg(chunk),
+            batch_size=batch,
+            n_train=batch * num_workers * 16,
+        )
+        try:
+            rates, trainer = _fit_and_rates(
+                RayShardedStrategy(num_workers=num_workers, use_tpu=use_tpu),
+                module,
+                epochs,
+                fold=fold,
+            )
+            break
+        except Exception as exc:  # noqa: BLE001 - fall one rung, record why
+            last_exc = exc
+            fallbacks.append(
+                f"b{batch}/c{chunk}/f{fold}: {type(exc).__name__}: "
+                f"{str(exc)[:200]}"
+            )
+    if rates is None:
+        # Chain the final rung's traceback: the artifact of an expensive
+        # remote-TPU run must be diagnosable without a rerun.
+        raise RuntimeError("; ".join(fallbacks)) from last_exc
     sps = statistics.median(rates)  # global steps/s
     tokens_per_sec = sps * batch * num_workers * seq
     # Parameter count from the recovered weights; PaLM-style MFU:
@@ -272,11 +319,15 @@ def bench_gpt(
         n_params = sum(
             int(np.prod(np.shape(x))) for x in jax.tree_util.tree_leaves(module.params)
         )
-    flops_per_token = 6.0 * n_params + 12.0 * cfg.n_layer * cfg.d_model * seq
+    mcfg = module.config
+    flops_per_token = 6.0 * n_params + 12.0 * mcfg.n_layer * mcfg.d_model * seq
     out: Dict[str, Any] = {
         "gpt_tokens_per_sec": round(tokens_per_sec, 1),
         "gpt_params": n_params,
+        "gpt_config": f"batch={batch} loss_chunk={chunk} fold={fold}",
     }
+    if fallbacks:
+        out["gpt_fallbacks"] = fallbacks
     return out, flops_per_token
 
 
@@ -463,7 +514,11 @@ def main() -> None:
             extra["vs_baseline_unfolded_error"] = f"{type(exc).__name__}: {exc}"
     if not args.skip_extra:
         try:
-            extra.update(bench_resnet(use_tpu, num_workers, epochs=3))
+            extra.update(
+                bench_resnet(
+                    use_tpu, num_workers, epochs=3, fold=min(4, fold)
+                )
+            )
         except Exception as exc:  # noqa: BLE001 - record, don't kill headline
             extra["resnet_error"] = f"{type(exc).__name__}: {exc}"
         try:

@@ -97,3 +97,35 @@ def test_bench_operator_contracts_hard_fail():
         proc = _run_bench(extra, "--rounds", "1", "--skip-extra", timeout=300)
         assert proc.returncode != 0, extra
         assert "RLT_REQUIRE_TPU" in proc.stderr
+
+
+def test_gpt_ladder_falls_back(start_fabric, monkeypatch):
+    """A failing top rung falls one rung (recorded in gpt_fallbacks);
+    all rungs failing raises with every cause joined."""
+    import bench as bench_mod
+
+    start_fabric(num_cpus=2)
+    monkeypatch.setenv("RLT_BENCH_TINY", "1")
+    real = bench_mod._fit_and_rates
+
+    def flaky(strategy, module, epochs, fold=1):
+        if fold == 7:
+            raise RuntimeError("forced rung failure")
+        return real(strategy, module, epochs, fold)
+
+    monkeypatch.setattr(bench_mod, "_fit_and_rates", flaky)
+    out, flops = bench_mod.bench_gpt(
+        use_tpu=False, num_workers=1, epochs=2,
+        ladder=[(2, 8, 7), (2, 8, 1)],
+    )
+    assert out["gpt_config"] == "batch=2 loss_chunk=8 fold=1"
+    assert len(out["gpt_fallbacks"]) == 1
+    assert "forced rung failure" in out["gpt_fallbacks"][0]
+    assert out["gpt_tokens_per_sec"] > 0 and flops > 0
+
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="forced rung failure"):
+        bench_mod.bench_gpt(
+            use_tpu=False, num_workers=1, epochs=2, ladder=[(2, 8, 7)]
+        )
