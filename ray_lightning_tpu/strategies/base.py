@@ -540,6 +540,32 @@ class Strategy:
 
         return jax.jit(kstep, donate_argnums=(0, 1))
 
+    @staticmethod
+    def compile_folded_eval_step(eval_step: Callable) -> Callable:
+        """Fold a compiled ``(params, batch, mask) -> (sums, count)`` eval
+        step over a stacked (K, ...) chunk: one dispatch scans K eval
+        batches and returns their summed (sums, count) — the executable
+        is shape-polymorphic in K (lax.map over the leading axis), so one
+        compile serves any fold. Masked sums/counts accumulate
+        associatively, so chunking preserves the epoch means up to fp32
+        summation order (the on-device partial sums reassociate the
+        reduction; equal to the unfolded path within float tolerance,
+        asserted in tests). Unlike the train fold there are no host
+        cadences to quantize. Works for any strategy's val/test step (the
+        inner jitted step inlines when traced)."""
+        import jax
+
+        def feval(params, batches, masks):
+            sums, counts = jax.lax.map(
+                lambda x: eval_step(params, x[0], x[1]), (batches, masks)
+            )
+            return (
+                jax.tree_util.tree_map(lambda v: v.sum(0), sums),
+                counts.sum(),
+            )
+
+        return jax.jit(feval)
+
     def compile_eval_step(self, module: Any, stage: str) -> Callable:
         """Compile the eval program.
 

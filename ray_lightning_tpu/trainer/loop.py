@@ -1074,15 +1074,40 @@ class TrainingLoop:
         # batches past the cutoff.
         import itertools
 
+        # Eval folding (steps_per_execution): masked (sums, count) pairs
+        # accumulate associatively, so scanning K eval batches in one
+        # dispatch preserves the epoch means (up to fp32 summation order;
+        # see compile_folded_eval_step) — pure dispatch amortization, no
+        # cadence caveats. Folded executables cache per compiled eval
+        # step (one per loop lifetime; shape-polymorphic in the fold).
+        fold = max(1, int(self.spec.steps_per_execution))
+        folded = None
+        if fold > 1:
+            cache = getattr(self, "_folded_eval_cache", None)
+            if cache is None:
+                cache = self._folded_eval_cache = {}
+            folded = cache.get(eval_step)
+            if folded is None:
+                folded = cache[eval_step] = (
+                    self.strategy.compile_folded_eval_step(eval_step)
+                )
         staged = self.strategy.stage_batches(
             itertools.islice(
                 loader.iter_batches(mult, with_mask=True), n_batches
-            )
+            ),
+            stack=fold if folded is not None else 0,
         )
         eval_params = self._eval_params()
         try:
-            for batch, gmask in staged:
-                all_pairs.append(eval_step(eval_params, batch, gmask))
+            if folded is not None:
+                for n, payload in staged:
+                    step_fn = folded if n > 1 else eval_step
+                    all_pairs.append(
+                        step_fn(eval_params, payload[0], payload[1])
+                    )
+            else:
+                for batch, gmask in staged:
+                    all_pairs.append(eval_step(eval_params, batch, gmask))
         finally:
             staged.close()
         if not all_pairs:

@@ -2122,3 +2122,17 @@ def test_fast_dev_run(start_fabric):
     assert t.check_val_every_n_epoch == 1
     assert t.val_check_interval is None
     assert not t.callbacks
+
+
+def test_steps_per_execution_folds_eval_exactly(start_fabric):
+    """Folded eval epochs match unfolded metrics to float tolerance —
+    masked (sums, count) accumulation is associative (the on-device
+    chunk partials only reassociate fp32 summation order), including a
+    non-divisible tail (fold 4 -> chunks + singles)."""
+    import numpy as np
+
+    t1, _ = _fit_det(start_fabric, n=40, max_epochs=1)
+    tk, _ = _fit_det(start_fabric, n=40, max_epochs=1, steps_per_execution=4)
+    v1 = float(t1.callback_metrics["val_loss"])
+    vk = float(tk.callback_metrics["val_loss"])
+    np.testing.assert_allclose(vk, v1, rtol=1e-6)
