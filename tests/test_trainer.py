@@ -2136,3 +2136,46 @@ def test_steps_per_execution_folds_eval_exactly(start_fabric):
     v1 = float(t1.callback_metrics["val_loss"])
     vk = float(tk.callback_metrics["val_loss"])
     np.testing.assert_allclose(vk, v1, rtol=1e-6)
+
+
+def test_fold_mid_epoch_checkpoint_and_resume(tmp_path):
+    """Folding x checkpointing: a vci-aligned mid-chunk-boundary save
+    under steps_per_execution=2 resumes with the mid-epoch re-run
+    semantics, and resuming a folded run into an UNFOLDED trainer (and
+    vice versa) converges to the same params — the fold is an execution
+    detail, invisible to checkpoints."""
+    import os
+
+    import numpy as np
+
+    from ray_lightning_tpu.trainer import ModelCheckpoint, Trainer
+
+    # In-process (no strategy, 8 virtual devices -> global batch 32):
+    # 6 batches/epoch (n=192).
+    def fit(fold, resume=None, epochs=1, ckpt_dir=None):
+        m = _DetModule(batch_size=4, n=192)
+        cbs = []
+        if ckpt_dir:
+            cbs = [ModelCheckpoint(
+                dirpath=str(ckpt_dir), monitor="val_loss", save_top_k=-1
+            )]
+        t = Trainer(
+            max_epochs=epochs, enable_checkpointing=bool(ckpt_dir),
+            callbacks=cbs, seed=0, num_sanity_val_steps=0,
+            steps_per_execution=fold,
+            val_check_interval=2 if ckpt_dir else None,
+        )
+        t.fit(m, ckpt_path=resume)
+        return t, np.asarray(m.params["w"])
+
+    t, _ = fit(2, ckpt_dir=tmp_path)
+    assert t.global_step == 6
+    mid = [p for p in os.listdir(tmp_path) if p.endswith("step=2.ckpt")]
+    assert mid, os.listdir(tmp_path)
+
+    # Folded-save -> unfolded-resume and folded-resume: identical params.
+    t1, w1 = fit(1, resume=str(tmp_path / mid[0]))
+    t2, w2 = fit(2, resume=str(tmp_path / mid[0]))
+    assert t1.current_epoch == t2.current_epoch == 0  # epoch re-run
+    assert t1.global_step == t2.global_step == 2 + 6
+    np.testing.assert_allclose(w2, w1, rtol=1e-6, atol=1e-7)
