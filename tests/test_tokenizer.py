@@ -140,3 +140,30 @@ def test_corrupt_merges_rejected():
         ByteBPETokenizer([[257, 97]])  # rank 0 may only reference bytes
     with pytest.raises(ValueError, match="separator"):
         ByteBPETokenizer([[0, 97]])
+
+
+def test_native_matches_python_fuzz():
+    """Property check over random byte corpora (seeded): the C++ and
+    Python implementations agree bit-for-bit on merges AND encodings —
+    including high bytes, repeated runs, and sep exclusion."""
+    from ray_lightning_tpu.utils import native
+
+    if not native.native_available():
+        pytest.skip("no native library in this environment")
+    g = np.random.default_rng(1234)
+    for trial in range(6):
+        n = int(g.integers(64, 2048))
+        # Mixed regimes: heavy repetition (small alphabets) vs near-random.
+        alpha = int(g.choice([4, 16, 64, 250]))
+        corpus = g.integers(0, alpha, n).astype(np.uint8)
+        sep = int(g.choice([-1, 0]))
+        n_merges = int(g.integers(1, 40))
+        m_n = native.bpe_train(corpus, n_merges, sep=sep)
+        m_p = _train_python(corpus, n_merges, sep=sep)
+        np.testing.assert_array_equal(m_n, m_p, err_msg=f"trial {trial}")
+        text = g.integers(0, alpha, int(g.integers(1, 256))).astype(np.uint8)
+        np.testing.assert_array_equal(
+            native.bpe_encode(text, m_n),
+            _encode_python(text, m_p),
+            err_msg=f"trial {trial} encode",
+        )
