@@ -1,0 +1,58 @@
+#!/bin/bash
+# Tunnel watcher v2: probe every 120s; on two consecutive healthy probes
+# (and no /tmp/CPU_BUSY), run the HEADLINE bench first (short — the
+# artifact the round is graded on), then the full bench with extras.
+# Artifacts land in /tmp/bench_watch_headline.json and
+# /tmp/bench_watch_full.json the moment each run finishes.
+set -u
+PROBE='import jax; import jax.numpy as jnp; x = jnp.ones((256,256)); print(float((x@x).sum()))'
+ok_streak=0
+have_headline=0
+while true; do
+  if [ -e /tmp/BENCH_DONE ]; then exit 0; fi
+  if timeout 60 python -c "$PROBE" > /dev/null 2>&1; then
+    ok_streak=$((ok_streak+1))
+    echo "$(date -u +%H:%M:%S) probe OK (streak $ok_streak)" >> /tmp/tpu_watch.log
+  else
+    ok_streak=0
+    echo "$(date -u +%H:%M:%S) probe FAIL" >> /tmp/tpu_watch.log
+  fi
+  if [ "$ok_streak" -ge 2 ]; then
+    if [ -e /tmp/CPU_BUSY ]; then
+      echo "$(date -u +%H:%M:%S) healthy but CPU_BUSY; holding" >> /tmp/tpu_watch.log
+    else
+      touch /tmp/BENCH_RUNNING
+      rm -rf /tmp/bench_snap2 && mkdir -p /tmp/bench_snap2
+      git -C /root/repo archive HEAD | tar -x -C /tmp/bench_snap2
+      if [ "$have_headline" -eq 0 ]; then
+        echo "$(date -u +%H:%M:%S) launching HEADLINE bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --skip-extra --rounds 6 --epochs 8 \
+            > /tmp/bench_watch_headline.json 2> /tmp/bench_watch_headline.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/bench_watch_headline.json ]; then
+          have_headline=1
+          echo "$(date -u +%H:%M:%S) HEADLINE bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          echo "$(date -u +%H:%M:%S) headline bench failed rc=$rc" >> /tmp/tpu_watch.log
+        fi
+      else
+        echo "$(date -u +%H:%M:%S) launching FULL bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 3600 python bench.py --rounds 3 --epochs 8 \
+            > /tmp/bench_watch_full.json 2> /tmp/bench_watch_full.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/bench_watch_full.json ]; then
+          echo "$(date -u +%H:%M:%S) FULL bench SUCCEEDED" >> /tmp/tpu_watch.log
+          touch /tmp/BENCH_DONE
+          rm -f /tmp/BENCH_RUNNING
+          exit 0
+        fi
+        echo "$(date -u +%H:%M:%S) full bench failed rc=$rc" >> /tmp/tpu_watch.log
+      fi
+      rm -f /tmp/BENCH_RUNNING
+      ok_streak=0
+    fi
+  fi
+  sleep 120
+done
