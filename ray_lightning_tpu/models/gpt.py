@@ -77,7 +77,7 @@ class GPTConfig:
     n_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
-    moe_top_k: int = 1  # 1 = switch, 2 = GShard-style top-2
+    moe_top_k: int = 1  # 1 = switch; k >= 2 = GShard-style top-k
     # Pipeline parallelism: used when the bound mesh has a "pp" axis > 1
     # (layers shard over pp; microbatched GPipe schedule,
     # parallel/pipeline.py). 0 -> one microbatch per pipeline stage.

@@ -122,6 +122,40 @@ def test_moe_top2_routing():
     assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
 
 
+def test_moe_topk_routing_general():
+    """The sort-based dispatch is K-generic: top_k=4 with ample capacity
+    equals the explicit four-expert mixture per token (no special-cased
+    k=1/k=2 code paths)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.parallel.moe import init_moe_params, moe_ffn
+
+    rng = jax.random.PRNGKey(7)
+    D, F, E, K = 8, 16, 6, 4
+    params = init_moe_params(rng, n_experts=E, d_model=D, d_ff=F)
+    x = jax.random.normal(jax.random.PRNGKey(8), (1, 5, D))
+    out, aux = moe_ffn(params, x, capacity_factor=8.0, top_k=K)
+    assert float(aux["dropped"]) == 0.0
+
+    tokens = np.asarray(x.reshape(-1, D), np.float32)
+    probs = np.asarray(
+        jax.nn.softmax(jnp.asarray(tokens) @ params["router"], axis=-1)
+    )
+    wi, bi = np.asarray(params["wi"]), np.asarray(params["bi"])
+    wo, bo = np.asarray(params["wo"]), np.asarray(params["bo"])
+    ref = np.zeros_like(tokens)
+    for t in range(tokens.shape[0]):
+        topk = np.argsort(-probs[t])[:K]
+        g = probs[t][topk] / probs[t][topk].sum()
+        for gk, e in zip(g, topk):
+            h = np.asarray(jax.nn.gelu(jnp.asarray(tokens[t] @ wi[e] + bi[e])))
+            ref[t] += gk * (h @ wo[e] + bo[e])
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(-1, D), ref, atol=1e-4
+    )
+
+
 @pytest.mark.slow
 def test_gpt_pp_grads_match_dense():
     """Full-model check: GPT loss grads under a pp2 x model2 sharded mesh
