@@ -331,25 +331,31 @@ def bench_gpt(
     return out, flops_per_token
 
 
-def bench_tune(use_tpu: bool, num_workers: int, num_samples: int = 2) -> Dict[str, Any]:
+def bench_tune(use_tpu: bool, num_workers: int, num_samples: int = 8) -> Dict[str, Any]:
     """BASELINE.md config 5: a Tune sweep over MNIST lr (nested distributed
-    fits inside trial actors); records sweep wall time and best accuracy."""
+    fits inside trial actors) with ASHA doing real work: >= 8 trials over an
+    lr grid wide enough (1e-4 .. 3.0) that the top-decade trials diverge,
+    multi-epoch so rung reports exist to prune on. Records sweep wall time,
+    best accuracy, and HOW MANY trials ASHA killed early — a sweep where
+    nothing is pruned proves plumbing, not the tuner (VERDICT r4 weak #4)."""
     from ray_lightning_tpu import tune
     from ray_lightning_tpu.models import MNISTClassifier
     from ray_lightning_tpu.strategies import RayTPUStrategy
     from ray_lightning_tpu.trainer import Trainer
 
-    n_train = 256 if _tiny() else 4096
+    n_train = 256 if _tiny() else 2048
+    epochs = 2 if _tiny() else 4
 
     def train_fn(config: Dict[str, Any]) -> None:
         module = MNISTClassifier(
             lr=config["lr"], batch_size=32, n_train=n_train
         )
         trainer = Trainer(
-            max_epochs=1,
+            max_epochs=epochs,
             enable_checkpointing=False,
             seed=0,
             num_sanity_val_steps=0,
+            check_val_every_n_epoch=1,  # a rung report per epoch
             callbacks=[
                 tune.TuneReportCallback(
                     {"mean_accuracy": "ptl/val_accuracy"}, on="validation_end"
@@ -362,16 +368,29 @@ def bench_tune(use_tpu: bool, num_workers: int, num_samples: int = 2) -> Dict[st
     t0 = time.time()
     results = tune.Tuner(
         train_fn,
-        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        param_space={"lr": tune.loguniform(1e-4, 3.0)},
         num_samples=num_samples,
         resources_per_trial=tune.get_tune_resources(
             num_workers=num_workers, use_tpu=use_tpu
         ),
+        scheduler=tune.ASHAScheduler(
+            "mean_accuracy", mode="max", grace_period=1, reduction_factor=2
+        ),
     ).fit()
     best = results.get_best_result("mean_accuracy", mode="max")
+    # Count only trials ASHA killed with epochs still to run: a stop issued
+    # at the FINAL rung saves no compute (the trial already ran every
+    # epoch), so counting it would let the artifact claim pruning that
+    # never happened.
+    pruned_early = sum(
+        1
+        for r in results
+        if r.status == "stopped" and len(r.history) < epochs
+    )
     return {
         "tune_sweep_wall_s": round(time.time() - t0, 1),
         "tune_trials": num_samples,
+        "tune_pruned": pruned_early,
         "tune_best_accuracy": round(
             float(best.metrics.get("mean_accuracy", 0.0)), 4
         ),
@@ -493,6 +512,19 @@ def main() -> None:
     extra: Dict[str, Any] = {}
     extra.update({k: v for k, v in mnist.items() if k != "vs_baseline"})
     extra["steps_per_execution"] = fold
+    # The headline's definition is versioned IN the artifact (ADVICE r4):
+    # v1 (r1-r3) compared an unfolded framework fit to the bare loop; v2
+    # (r4+) measures the framework's recommended TPU configuration
+    # (steps_per_execution=fold) against the same single-dispatch baseline,
+    # with the v1 apples-to-apples ratio kept on record as
+    # vs_baseline_unfolded. A reader of any artifact can tell which
+    # definition produced the number without consulting git history.
+    extra["vs_baseline_definition"] = (
+        f"v2: framework fold={fold} vs single-dispatch baseline; "
+        "v1 ratio in vs_baseline_unfolded"
+        if fold > 1
+        else "v1: unfolded framework vs single-dispatch baseline"
+    )
     if fold > 1:
         # Transparency pair: one adjacent (baseline, UNFOLDED framework)
         # run so the artifact also carries the pure per-step overhead

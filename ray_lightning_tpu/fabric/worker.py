@@ -24,6 +24,23 @@ import sys
 import traceback
 
 
+#: Flipped once shutdown begins (normal loop exit or a first SIGTERM).
+#: ``kill()`` SIGTERMs shortly after sending the "shutdown" message, so the
+#: signal routinely lands while atexit is already running multiprocessing
+#: manager finalizers — raising SystemExit there prints a traceback into
+#: whatever captures stderr (it half-filled BENCH_r04.json). Once exiting,
+#: further SIGTERMs are no-ops.
+_EXITING = False
+
+
+def _on_sigterm(*_):
+    global _EXITING
+    if _EXITING or sys.is_finalizing():
+        return
+    _EXITING = True
+    sys.exit(0)
+
+
 def _worker_main(conn):
     """Run the actor loop. ``conn`` is an authenticated duplex Connection."""
     import signal
@@ -31,7 +48,7 @@ def _worker_main(conn):
     # SIGTERM (e.g. a tuner killing a trial actor) must run atexit so this
     # process's own fabric session shuts down any nested actors it spawned
     # (a trial's training workers) instead of orphaning them.
-    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    signal.signal(signal.SIGTERM, _on_sigterm)
 
     # Honor an explicit JAX platform choice even when a PJRT plugin loaded
     # at interpreter boot (sitecustomize) already forced its own config.
@@ -83,6 +100,8 @@ def _worker_main(conn):
                 conn.send_bytes(payload)
                 continue
     finally:
+        global _EXITING
+        _EXITING = True  # late SIGTERMs (kill()'s follow-up) are no-ops now
         try:
             conn.close()
         except OSError:

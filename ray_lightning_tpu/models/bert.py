@@ -217,9 +217,14 @@ def apply_mlm_masking(
     r_sel, r_split, r_rand = jax.random.split(rng, 3)
     sel = jax.random.uniform(r_sel, tokens.shape) < cfg.mask_prob
     u = jax.random.uniform(r_split, tokens.shape)
+    # The 10% branch replaces with a REAL vocabulary token: draw from the
+    # vocab minus [MASK] by sampling vocab_size-1 values and shifting the
+    # ones at/above mask_id up by one (uniform over every non-mask id,
+    # wherever mask_token_id sits).
     rand_toks = jax.random.randint(
-        r_rand, tokens.shape, 0, cfg.vocab_size, dtype=tokens.dtype
+        r_rand, tokens.shape, 0, cfg.vocab_size - 1, dtype=tokens.dtype
     )
+    rand_toks = jnp.where(rand_toks >= cfg.mask_id, rand_toks + 1, rand_toks)
     masked = jnp.where(
         u < 0.8,
         jnp.asarray(cfg.mask_id, tokens.dtype),

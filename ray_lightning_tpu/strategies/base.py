@@ -544,9 +544,11 @@ class Strategy:
     def compile_folded_eval_step(eval_step: Callable) -> Callable:
         """Fold a compiled ``(params, batch, mask) -> (sums, count)`` eval
         step over a stacked (K, ...) chunk: one dispatch scans K eval
-        batches and returns their summed (sums, count) — the executable
-        is shape-polymorphic in K (lax.map over the leading axis), so one
-        compile serves any fold. Masked sums/counts accumulate
+        batches and returns their summed (sums, count). ``jax.jit``
+        retraces per distinct leading-dim K, so this costs one compile
+        per fold size actually seen — in practice exactly one, because
+        ``stage_batches`` emits a single stack size and routes tail
+        batches to the unfolded ``eval_step``. Masked sums/counts accumulate
         associatively, so chunking preserves the epoch means up to fp32
         summation order (the on-device partial sums reassociate the
         reduction; equal to the unfolded path within float tolerance,

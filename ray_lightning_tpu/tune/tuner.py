@@ -61,6 +61,10 @@ class Result:
     checkpoint_path: Optional[str]
     history: List[Dict[str, Any]]
     error: Optional[str]
+    #: terminal trial state: "terminated" (ran to completion), "stopped"
+    #: (scheduler-pruned), or "errored" — so callers can count what ASHA
+    #: actually pruned without reaching into Tuner internals.
+    status: str = "terminated"
 
 
 class ResultGrid:
@@ -83,6 +87,11 @@ class ResultGrid:
     @property
     def errors(self) -> List[Result]:
         return [r for r in self._results if r.error]
+
+    @property
+    def num_stopped(self) -> int:
+        """Trials the scheduler pruned before completion."""
+        return sum(1 for r in self._results if r.status == "stopped")
 
 
 class ASHAScheduler:
@@ -320,6 +329,7 @@ class Tuner:
                 checkpoint_path=t.checkpoint_path,
                 history=t.history,
                 error=t.error,
+                status=t.status,
             )
             for t in trials.values()
         ]
@@ -332,6 +342,7 @@ class Tuner:
                         "metrics": r.metrics,
                         "checkpoint_path": r.checkpoint_path,
                         "error": r.error,
+                        "status": r.status,
                     }
                     for r in results
                 ],

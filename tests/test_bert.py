@@ -75,6 +75,46 @@ def test_mlm_masking_contract():
     assert 0.7 < mask_frac < 0.9, mask_frac
 
 
+def test_mlm_random_branch_never_emits_mask_token():
+    """The 10% random-replacement branch must draw REAL vocabulary tokens
+    (BERT's recipe) — never the reserved [MASK] id (ADVICE r4).
+
+    A random-branch draw of [MASK] is indistinguishable per-position from
+    the 80% branch, so the check is distributional, sized to be decisive:
+    vocab_size=2 with mask_token_id=0 makes the only real token 1, so a
+    mask-contaminated random branch lifts the [MASK] fraction among
+    selected positions from 0.80 to 0.85 — ~30 sigma at n~65k. The
+    interior-id case then checks the shift map emits every real token."""
+    import dataclasses
+
+    import jax
+
+    from ray_lightning_tpu.models.bert import apply_mlm_masking
+
+    cfg = dataclasses.replace(
+        TINY, vocab_size=2, mask_token_id=0, mask_prob=1.0
+    )
+    toks = np.ones((16, 4096), np.int32)  # all-real corpus (token 1)
+    inputs, targets = apply_mlm_masking(jax.random.PRNGKey(2), toks, cfg)
+    inputs = np.asarray(inputs)
+    sel = np.asarray(targets) >= 0
+    assert sel.all()  # mask_prob=1 selects everything
+    mask_frac = (inputs[sel] == cfg.mask_id).mean()
+    assert 0.78 < mask_frac < 0.82, mask_frac  # 0.85 pre-fix
+
+    # Interior mask id: random replacements must cover BOTH sides of the
+    # shifted range and never the mask id itself.
+    cfg = dataclasses.replace(TINY, vocab_size=8, mask_token_id=3, mask_prob=1.0)
+    toks = np.full((16, 4096), 5, np.int32)
+    inputs, _ = apply_mlm_masking(jax.random.PRNGKey(3), toks, cfg)
+    inputs = np.asarray(inputs)
+    randomized = inputs[(inputs != cfg.mask_id) & (inputs != 5)]
+    assert randomized.size > 0
+    seen = set(np.unique(randomized).tolist())
+    assert cfg.mask_id not in seen
+    assert seen & {0, 1, 2} and seen & {4, 6, 7}, seen
+
+
 def test_chunked_matches_dense_masked_loss():
     """chunked_lm_loss on ignore-labeled targets == dense masked_lm_loss
     (value and grads) — the first in-repo user of the ignore contract."""

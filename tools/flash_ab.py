@@ -31,7 +31,7 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=64)
-    p.add_argument("--seqs", default="1024,2048,4096,8192")
+    p.add_argument("--seqs", default="512,1024,2048,4096,8192")
     p.add_argument("--window", type=int, default=1024)
     args = p.parse_args()
 
@@ -67,6 +67,15 @@ def main() -> None:
         )
         row[f"flash_w{args.window}_fwd_ms"] = round(
             1e3 * bench_one(win, (q, k, v)), 2
+        )
+        row[f"flash_w{args.window}_bwd_ms"] = round(
+            1e3
+            * grad_wall(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=True, window=args.window
+                )
+            ),
+            2,
         )
         if S <= 4096:  # dense (S, S) scores get expensive fast
             try:
