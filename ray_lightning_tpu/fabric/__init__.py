@@ -35,8 +35,11 @@ from ray_lightning_tpu.fabric.core import (
     is_initialized,
     kill,
     nodes,
+    placement_group,
+    PlacementGroup,
     put,
     remote,
+    remove_placement_group,
     shutdown,
     wait,
 )
@@ -54,6 +57,9 @@ __all__ = [
     "wait",
     "kill",
     "nodes",
+    "placement_group",
+    "remove_placement_group",
+    "PlacementGroup",
     "available_resources",
     "cluster_resources",
     "ObjectRef",

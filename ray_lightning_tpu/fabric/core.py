@@ -340,6 +340,166 @@ def available_resources() -> Dict[str, float]:
 
 
 # --------------------------------------------------------------------------
+# Placement groups (gang scheduling)
+# --------------------------------------------------------------------------
+@dataclass
+class _Bundle:
+    """One reserved resource bundle of a placement group."""
+
+    index: int
+    request: Dict[str, float]
+    node: Node
+    remaining: Dict[str, float]
+
+
+class PlacementGroup:
+    """A gang reservation: N resource bundles acquired atomically.
+
+    The fabric analog of ``ray.util.placement_group`` as the reference's
+    Tune integration consumes it (tune.py:50-55: ``PlacementGroupFactory(
+    [head] + N*[worker], strategy="PACK")``): the bundles are RESERVED on
+    logical nodes at creation; actors then schedule INTO a bundle via
+    ``options(placement_group=pg, placement_group_bundle_index=i)``,
+    drawing from the reservation instead of free node capacity.
+
+    Strategies (Ray semantics):
+      - ``"PACK"``: all bundles on one node when possible, else spill to
+        as few nodes as needed (best effort).
+      - ``"STRICT_PACK"``: all bundles on one node, or placement fails.
+      - ``"SPREAD"``: bundles across distinct nodes where possible.
+    """
+
+    def __init__(self, pg_id: str, bundles: List[_Bundle], strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.removed = False
+
+    @property
+    def bundle_node_ids(self) -> List[str]:
+        return [b.node.node_id for b in self.bundles]
+
+
+def placement_group(
+    bundles: List[Dict[str, float]], strategy: str = "PACK"
+) -> PlacementGroup:
+    """Atomically reserve ``bundles`` on the cluster's logical nodes.
+
+    Raises :class:`InsufficientResourcesError` when the bundles cannot be
+    placed under ``strategy`` with current availability (nothing is leaked:
+    partial acquisitions roll back). Not available in client mode."""
+    if _client_mode() is not None:
+        raise FabricError(
+            "placement groups are not supported in client mode; schedule "
+            "with flat per-actor resources instead"
+        )
+    if strategy not in ("PACK", "STRICT_PACK", "SPREAD"):
+        raise ValueError(f"unknown placement strategy {strategy!r}")
+    reqs = [
+        {k: float(v) for k, v in b.items() if float(v)} for b in bundles
+    ]
+    if not reqs:
+        raise ValueError("placement group needs at least one bundle")
+    sess = _require_session()
+    with sess.lock:
+        total: Dict[str, float] = {}
+        for r in reqs:
+            for k, v in r.items():
+                total[k] = total.get(k, 0.0) + v
+        assigned: List[Node] = []
+        one_node = (
+            next((n for n in sess.nodes if n.fits(total)), None)
+            if strategy in ("PACK", "STRICT_PACK")
+            else None
+        )
+        if one_node is not None:
+            assigned = [one_node] * len(reqs)
+        elif strategy == "STRICT_PACK":
+            raise InsufficientResourcesError(
+                f"STRICT_PACK placement of {reqs} (total {total}) fits no "
+                f"single node; available per node: "
+                f"{[n.available() for n in sess.nodes]}"
+            )
+        else:
+            # Greedy spill (PACK) / distribution (SPREAD). Acquire as we
+            # assign so same-node bundles see each other's reservations;
+            # roll back on failure.
+            placed_count: Dict[str, int] = {}
+            acquired: List[Tuple[Node, Dict[str, float]]] = []
+            try:
+                for r in reqs:
+                    fitting = [n for n in sess.nodes if n.fits(r)]
+                    if not fitting:
+                        raise InsufficientResourcesError(
+                            f"cannot place bundle {r}; available per node: "
+                            f"{[n.available() for n in sess.nodes]}"
+                        )
+                    key = (
+                        min
+                        if strategy == "SPREAD"
+                        else max
+                    )
+                    node = key(
+                        fitting,
+                        key=lambda n: (
+                            placed_count.get(n.node_id, 0),
+                            # tie-break: keep node order deterministic
+                            -sess.nodes.index(n),
+                        ),
+                    )
+                    node.acquire(r)
+                    acquired.append((node, r))
+                    assigned.append(node)
+                    placed_count[node.node_id] = (
+                        placed_count.get(node.node_id, 0) + 1
+                    )
+            except InsufficientResourcesError:
+                for node, r in acquired:
+                    node.release(r)
+                raise
+        if one_node is not None:
+            for r in reqs:
+                one_node.acquire(r)
+        pg = PlacementGroup(
+            f"pg-{uuid.uuid4().hex[:8]}",
+            [
+                _Bundle(i, dict(r), node, dict(r))
+                for i, (r, node) in enumerate(zip(reqs, assigned))
+            ],
+            strategy,
+        )
+        return pg
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    """Release a placement group's reservations. Kill actors scheduled into
+    its bundles first — removal does not terminate them."""
+    sess = _require_session()
+    with sess.lock:
+        # Check-and-set under the lock: concurrent removals (user cleanup
+        # racing Tuner teardown) must not double-release node capacity.
+        if pg.removed:
+            return
+        pg.removed = True
+        for b in pg.bundles:
+            b.node.release(b.request)
+    with sess.cv:
+        sess.cv.notify_all()
+
+
+def _release_actor_resources(handle: "ActorHandle") -> None:
+    """Return an actor's resources to its placement-group bundle (if it was
+    gang-scheduled) or to its node's free pool. Caller holds sess.lock."""
+    bundle = handle._pg_bundle
+    if bundle is not None:
+        for k, v in handle._request.items():
+            if v:
+                bundle.remaining[k] = bundle.remaining.get(k, 0.0) + v
+    else:
+        handle._node.release(handle._request)
+
+
+# --------------------------------------------------------------------------
 # Object store (shared memory)
 # --------------------------------------------------------------------------
 @dataclass(frozen=True)
@@ -537,6 +697,7 @@ class ActorHandle:
         node: Node,
         request: Dict[str, float],
         options: Dict[str, Any],
+        pg_bundle: Optional[_Bundle] = None,
     ) -> None:
         self.actor_id = actor_id
         self._process = process
@@ -544,6 +705,7 @@ class ActorHandle:
         self._node = node
         self._request = request
         self._options = options
+        self._pg_bundle = pg_bundle
         self._send_lock = threading.Lock()
         self._alive = True
         self._reader = threading.Thread(
@@ -604,7 +766,7 @@ class ActorHandle:
                     self.actor_id, f"process exited (exitcode={exitcode})"
                 )
                 if sess.actors.pop(self.actor_id, None) is not None:
-                    self._node.release(self._request)
+                    _release_actor_resources(self)
                 sess.cv.notify_all()
 
     def _send(self, msg: Any) -> None:
@@ -683,23 +845,67 @@ def _spawn_actor(
     for k, v in (opts.get("resources") or {}).items():
         request[k] = float(v)
 
+    pg: Optional[PlacementGroup] = opts.get("placement_group")
+    pg_bundle: Optional[_Bundle] = None
     with sess.lock:
-        node = None
-        for cand in sess.nodes:
-            if cand.fits(request):
-                node = cand
-                break
-        if node is None:
-            raise InsufficientResourcesError(
-                f"cannot place actor requiring {request}; "
-                f"available per node: {[n.available() for n in sess.nodes]}"
-            )
-        node.acquire(request)
+        if pg is not None:
+            # Gang-scheduled: draw from the bundle's reservation, land on
+            # the bundle's node (Ray's placement_group/bundle_index opts).
+            idx = int(opts.get("placement_group_bundle_index", 0))
+            if pg.removed:
+                raise FabricError(f"placement group {pg.id} was removed")
+            if not 0 <= idx < len(pg.bundles):
+                raise ValueError(
+                    f"bundle index {idx} out of range for {len(pg.bundles)}"
+                    " bundles"
+                )
+            pg_bundle = pg.bundles[idx]
+            short = {
+                k: v
+                for k, v in request.items()
+                if v and pg_bundle.remaining.get(k, 0.0) < v - 1e-9
+            }
+            if short:
+                raise InsufficientResourcesError(
+                    f"actor requiring {request} does not fit bundle {idx} "
+                    f"of {pg.id} (remaining {pg_bundle.remaining})"
+                )
+            for k, v in request.items():
+                if v:
+                    pg_bundle.remaining[k] -= v
+            node = pg_bundle.node
+        else:
+            node = None
+            for cand in sess.nodes:
+                if cand.fits(request):
+                    node = cand
+                    break
+            if node is None:
+                raise InsufficientResourcesError(
+                    f"cannot place actor requiring {request}; "
+                    f"available per node: {[n.available() for n in sess.nodes]}"
+                )
+            node.acquire(request)
 
     env = dict(opts.get("env") or {})
     actor_id = f"actor-{uuid.uuid4().hex[:8]}"
-    proc, parent_conn = _boot_worker_process(actor_id, env, node)
-    handle = ActorHandle(actor_id, proc, parent_conn, node, request, opts)
+    try:
+        proc, parent_conn = _boot_worker_process(actor_id, env, node)
+    except BaseException:
+        # Boot never produced a handle; hand the reservation back directly.
+        with sess.lock:
+            if pg_bundle is not None:
+                for k, v in request.items():
+                    if v:
+                        pg_bundle.remaining[k] = (
+                            pg_bundle.remaining.get(k, 0.0) + v
+                        )
+            else:
+                node.release(request)
+        raise
+    handle = ActorHandle(
+        actor_id, proc, parent_conn, node, request, opts, pg_bundle=pg_bundle
+    )
     with sess.lock:
         sess.actors[actor_id] = handle
 
@@ -843,7 +1049,7 @@ def kill(handle: ActorHandle, no_restart: bool = True) -> None:  # noqa: ARG001
     handle._shutdown(force=True)
     with sess.lock:
         if handle.actor_id in sess.actors:
-            handle._node.release(handle._request)
+            _release_actor_resources(handle)
             del sess.actors[handle.actor_id]
         sess.dead_actors.setdefault(handle.actor_id, "killed")
     with sess.cv:

@@ -547,26 +547,46 @@ def gpt_forward(
 
     pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
     if pp_size > 1:
-        if cfg.n_experts > 0:
-            raise NotImplementedError(
-                "MoE + pipeline parallelism is not supported yet "
-                "(expert all-to-all inside the pp shard_map)"
-            )
         from ray_lightning_tpu.parallel.pipeline import pipeline_apply
 
-        def stage(lp: Dict[str, jax.Array], h: jax.Array) -> jax.Array:
-            (h2, _), _ = block((h, jnp.zeros((), jnp.float32)), lp)
-            return h2
+        if cfg.n_experts > 0:
+            # MoE composes with the pipeline: the pp shard_map is manual
+            # over "pp" ONLY, so the expert all-to-all stays a GSPMD
+            # concern — moe_ffn's ep-sharded expert weights route tokens
+            # across the "ep" axis inside each pipeline stage exactly as
+            # in the unpipelined path. The per-layer load-balancing aux
+            # rides pipeline_apply's aux channel (mean over microbatches;
+            # see its docstring for the batch-statistics contract).
+            def stage_aux(
+                lp: Dict[str, jax.Array], h: jax.Array
+            ) -> Tuple[jax.Array, jax.Array]:
+                (h2, a), _ = block((h, jnp.zeros((), jnp.float32)), lp)
+                return h2, a
 
-        stage_body = jax.checkpoint(stage) if cfg.remat else stage
-        x = pipeline_apply(
-            stage_body,
-            params["blocks"],
-            x,
-            mesh,
-            num_microbatches=cfg.num_microbatches or None,
-        )
-        aux_total = jnp.zeros((), jnp.float32)
+            body = jax.checkpoint(stage_aux) if cfg.remat else stage_aux
+            x, aux_total = pipeline_apply(
+                body,
+                params["blocks"],
+                x,
+                mesh,
+                num_microbatches=cfg.num_microbatches or None,
+                with_aux=True,
+            )
+        else:
+
+            def stage(lp: Dict[str, jax.Array], h: jax.Array) -> jax.Array:
+                (h2, _), _ = block((h, jnp.zeros((), jnp.float32)), lp)
+                return h2
+
+            stage_body = jax.checkpoint(stage) if cfg.remat else stage
+            x = pipeline_apply(
+                stage_body,
+                params["blocks"],
+                x,
+                mesh,
+                num_microbatches=cfg.num_microbatches or None,
+            )
+            aux_total = jnp.zeros((), jnp.float32)
     else:
         body = jax.checkpoint(block) if cfg.remat else block
         (x, aux_total), _ = jax.lax.scan(

@@ -26,6 +26,7 @@ from ray_lightning_tpu.tune.session import (
 )
 from ray_lightning_tpu.tune.tuner import (
     ASHAScheduler,
+    PlacementGroupFactory,
     Result,
     ResultGrid,
     Tuner,
@@ -39,6 +40,7 @@ __all__ = [
     "ResultGrid",
     "Result",
     "ASHAScheduler",
+    "PlacementGroupFactory",
     "get_tune_resources",
     "TuneReportCallback",
     "TuneReportCheckpointCallback",

@@ -366,13 +366,62 @@ def test_gpt_pipeline_train_step():
     assert losses[-1] < losses[0], losses
 
 
-def test_moe_plus_pipeline_rejected():
+def test_moe_pipeline_matches_dense_oracle():
+    """MoE x pipeline composition (VERDICT r4 item 4): a pp2 x ep2 x data2
+    mesh reproduces the unsharded dense-mixture logits. Capacity is set
+    drop-free (per-microbatch capacity differs from full-batch capacity, so
+    only the no-drop regime is layout-independent and exactly comparable)."""
     import jax
 
-    strategy = make_inprocess({"pp": 2, "data": 4})
-    module = GPTLM(config=MOE_CFG, batch_size=4)
+    no_drop = dataclasses.replace(MOE_CFG, moe_capacity_factor=8.0)
+    strategy = make_inprocess({"pp": 2, "ep": 2, "data": 2})
+    module = GPTLM(config=no_drop, batch_size=4)
     strategy.bind_module(module)
+    params = init_gpt_params(jax.random.PRNGKey(0), no_drop)
+    from jax.sharding import PartitionSpec as P
+
+    sh = strategy.param_sharding(params)
+    # Layers shard over pp AND experts over ep simultaneously.
+    assert sh["blocks"]["wi"].spec[0] == "pp"
+    assert "ep" in sh["blocks"]["wi"].spec
+
+    toks = np.asarray(
+        jax.random.randint(
+            jax.random.PRNGKey(1), (4, 16), 0, no_drop.vocab_size
+        )
+    )
+    dense = gpt_forward(params, toks, no_drop)
+    placed = strategy.place_params(params)
+    piped = jax.jit(lambda p, t: module._forward(p, t))(placed, toks)
+    np.testing.assert_allclose(
+        np.asarray(piped), np.asarray(dense), atol=2e-4
+    )
+
+
+def test_moe_pipeline_train_step():
+    """MoE x pp training: the step compiles and runs on a pp2 x ep2 mesh,
+    the loss decreases, and the load-balancing aux is finite and logged."""
+    import jax
+
+    from ray_lightning_tpu.models import make_fake_text
+
+    strategy = make_inprocess({"pp": 2, "ep": 2, "data": 2})
+    module = GPTLM(config=MOE_CFG, batch_size=4, lr=1e-2, warmup_steps=2)
+    strategy.bind_module(module)
+    data = make_fake_text(32, seq_len=16, vocab=MOE_CFG.vocab_size)
+    toks = data.arrays[0][:8]
+    rng = jax.random.PRNGKey(0)
     params = init_gpt_params(jax.random.PRNGKey(0), MOE_CFG)
-    toks = np.zeros((4, 16), np.int32)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        module._forward(strategy.place_params(params), toks)
+    tx, _ = unpack_optimizers(module.configure_optimizers())
+    opt_state = tx.init(params)
+    params = strategy.place_params(params)
+    opt_state = strategy.place_opt_state(opt_state, params)
+    batch = strategy.make_global_batch((toks,))
+    step = strategy.compile_train_step(module, tx)
+    losses = []
+    for i in range(15):
+        params, opt_state, logs = step(params, opt_state, batch, rng, i)
+        losses.append(float(np.asarray(logs["loss"])))
+    aux = float(np.asarray(logs["moe_aux"]))
+    assert np.isfinite(aux) and aux > 0.0
+    assert losses[-1] < losses[0], losses

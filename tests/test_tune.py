@@ -25,10 +25,16 @@ def test_generate_configs_grid_and_samples():
 
 
 def test_get_tune_resources_shape():
+    """Head bundle + one bundle per worker, PACK strategy (the reference's
+    PlacementGroupFactory([{CPU:1}] + N x child, "PACK"), tune.py:50-55)."""
     r = tune.get_tune_resources(num_workers=4, num_cpus_per_worker=2)
-    assert r == {"CPU": 9.0}  # 1 driver + 4*2 workers
+    assert isinstance(r, tune.PlacementGroupFactory)
+    assert r.strategy == "PACK"
+    assert r.bundles == [{"CPU": 1.0}] + [{"CPU": 2.0}] * 4
+    assert r.required_resources == {"CPU": 9.0}  # 1 driver + 4*2 workers
     rt = tune.get_tune_resources(num_workers=8, use_tpu=True)
-    assert rt["TPU"] == 8.0
+    assert rt.bundles[1] == {"CPU": 1.0, "TPU": 1.0}
+    assert rt.required_resources["TPU"] == 8.0
 
 
 def test_asha_scheduler_stops_worst():
