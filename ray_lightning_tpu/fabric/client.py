@@ -190,7 +190,13 @@ class ClientActorClass:
 
     def remote(self, *args: Any, **kwargs: Any) -> ClientActorHandle:
         blob = cloudpickle.dumps((self._cls, args, kwargs), protocol=5)
-        actor_id = _client.request(("spawn", blob, self._options))
+        opts = dict(self._options)
+        pg = opts.pop("placement_group", None)
+        if pg is not None:
+            # Ship the id; the server resolves it to its live PlacementGroup
+            # (which holds Node objects and cannot cross the wire).
+            opts["__pg_id__"] = pg.id
+        actor_id = _client.request(("spawn", blob, opts))
         return ClientActorHandle(actor_id)
 
 
@@ -227,6 +233,32 @@ def wait(
 
 def kill(handle: Any, no_restart: bool = True) -> None:  # noqa: ARG001
     _client.request(("kill", handle.actor_id))
+
+
+class ClientPlacementGroup:
+    """Client-side proxy to a placement group living on the fabric head."""
+
+    def __init__(
+        self, pg_id: str, bundle_node_ids: List[str], strategy: str
+    ) -> None:
+        self.id = pg_id
+        self.bundle_node_ids = bundle_node_ids
+        self.strategy = strategy
+        self.removed = False
+
+
+def placement_group(
+    bundles: Sequence[Dict[str, float]], strategy: str = "PACK"
+) -> ClientPlacementGroup:
+    pg_id, node_ids = _client.request(
+        ("pg_create", [dict(b) for b in bundles], strategy)
+    )
+    return ClientPlacementGroup(pg_id, node_ids, strategy)
+
+
+def remove_placement_group(pg: Any) -> None:
+    _client.request(("pg_remove", pg.id))
+    pg.removed = True
 
 
 def nodes() -> List[Dict[str, Any]]:

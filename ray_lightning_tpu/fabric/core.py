@@ -382,17 +382,16 @@ class PlacementGroup:
 
 def placement_group(
     bundles: List[Dict[str, float]], strategy: str = "PACK"
-) -> PlacementGroup:
+) -> Any:
     """Atomically reserve ``bundles`` on the cluster's logical nodes.
 
     Raises :class:`InsufficientResourcesError` when the bundles cannot be
     placed under ``strategy`` with current availability (nothing is leaked:
-    partial acquisitions roll back). Not available in client mode."""
-    if _client_mode() is not None:
-        raise FabricError(
-            "placement groups are not supported in client mode; schedule "
-            "with flat per-actor resources instead"
-        )
+    partial acquisitions roll back). In client mode the reservation lives
+    on the fabric head and a lightweight proxy is returned."""
+    _c = _client_mode()
+    if _c is not None:
+        return _c.placement_group(bundles, strategy=strategy)
     if strategy not in ("PACK", "STRICT_PACK", "SPREAD"):
         raise ValueError(f"unknown placement strategy {strategy!r}")
     reqs = [
@@ -471,9 +470,13 @@ def placement_group(
         return pg
 
 
-def remove_placement_group(pg: PlacementGroup) -> None:
+def remove_placement_group(pg: Any) -> None:
     """Release a placement group's reservations. Kill actors scheduled into
     its bundles first — removal does not terminate them."""
+    _c = _client_mode()
+    if _c is not None:
+        _c.remove_placement_group(pg)
+        return
     sess = _require_session()
     with sess.lock:
         # Check-and-set under the lock: concurrent removals (user cleanup

@@ -87,6 +87,7 @@ class FabricServer:
         self.address = f"{self._listener.address[0]}:{self._listener.address[1]}"
         self._queues: Dict[str, Any] = {}
         self._actors: Dict[str, Any] = {}
+        self._pgs: Dict[str, Any] = {}
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
 
@@ -167,6 +168,16 @@ class FabricServer:
         if kind == "spawn":
             _, blob, opts = msg
             cls, args, kwargs = cloudpickle.loads(blob)
+            opts = dict(opts)
+            # Clients reference placement groups by id (the server-side
+            # PlacementGroup holds live Node objects and never crosses the
+            # wire); resolve to the registered object before scheduling.
+            pg_id = opts.pop("__pg_id__", None)
+            if pg_id is not None:
+                pg = self._pgs.get(pg_id)
+                if pg is None:
+                    raise core.FabricError(f"unknown placement group {pg_id}")
+                opts["placement_group"] = pg
             handle = core.remote(cls).options(**opts).remote(*args, **kwargs)
             self._actors[handle.actor_id] = handle
             return ("ok", handle.actor_id)
@@ -202,6 +213,17 @@ class FabricServer:
             handle = self._actors.pop(actor_id, None)
             if handle is not None:
                 core.kill(handle)
+            return ("ok", None)
+        if kind == "pg_create":
+            _, bundles, strategy = msg
+            pg = core.placement_group(bundles, strategy=strategy)
+            self._pgs[pg.id] = pg
+            return ("ok", (pg.id, pg.bundle_node_ids))
+        if kind == "pg_remove":
+            _, pg_id = msg
+            pg = self._pgs.pop(pg_id, None)
+            if pg is not None:
+                core.remove_placement_group(pg)
             return ("ok", None)
         if kind == "nodes":
             return ("ok", core.nodes())

@@ -17,7 +17,7 @@ import os
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from ray_lightning_tpu import fabric
 from ray_lightning_tpu.tune.search import generate_configs
@@ -209,7 +209,9 @@ class Tuner:
         train_fn: Callable[[Dict[str, Any]], None],
         param_space: Dict[str, Any],
         num_samples: int = 1,
-        resources_per_trial: Optional[Dict[str, float]] = None,
+        resources_per_trial: Optional[
+            Union[Dict[str, float], "PlacementGroupFactory"]
+        ] = None,
         scheduler: Optional[ASHAScheduler] = None,
         max_concurrent: Optional[int] = None,
         experiment_dir: Optional[str] = None,
@@ -233,20 +235,10 @@ class Tuner:
         self.seed = seed
 
     # -- scheduling ----------------------------------------------------
-    def _client_mode(self) -> bool:
-        from ray_lightning_tpu.fabric import client
-
-        return client.is_connected()
-
     def _can_launch(self, running: List[Trial]) -> bool:
         if self.max_concurrent is not None and len(running) >= self.max_concurrent:
             return False
         need = self.resources_per_trial.required_resources
-        if self._client_mode():
-            # Client mode has no placement-group API (the head schedules);
-            # gate on aggregate availability like the legacy flat path.
-            avail = fabric.available_resources()
-            return all(avail.get(k, 0.0) >= v for k, v in need.items())
         # A trial's nested training workers are processes ON the trial
         # driver's host, so the whole gang must fit one node NOW.
         return any(
@@ -259,38 +251,35 @@ class Tuner:
 
         factory = self.resources_per_trial
         head = dict(factory.bundles[0])
-        if self._client_mode():
-            # Legacy flat reservation: one aggregate bundle for the trial.
-            agg = dict(factory.required_resources)
-            num_cpus = agg.pop("CPU", 1.0)
-            options = dict(num_cpus=num_cpus, resources=agg)
-        else:
-            # Gang placement (reference tune.py:50-55): reserve head +
-            # worker bundles together. PACK lands them on one node when it
-            # can; this fabric runs a trial's nested workers as processes
-            # on the trial driver's host, so a gang that STRADDLES nodes
-            # cannot actually co-locate — treat it as unplaceable now and
-            # retry when capacity frees up (fit() pre-checks that packing
-            # is possible at all, so this cannot spin forever).
-            trial.pg = fabric.placement_group(
-                factory.bundles, strategy=factory.strategy
+        # Gang placement (reference tune.py:50-55): reserve head + worker
+        # bundles together (on the fabric head when in client mode). PACK
+        # lands them on one node when it can; this fabric runs a trial's
+        # nested workers as processes on the trial driver's host, so a
+        # gang that STRADDLES nodes cannot actually co-locate — treat it
+        # as unplaceable now and retry when capacity frees up (fit()
+        # pre-checks that packing is possible at all, so this cannot spin
+        # forever).
+        trial.pg = fabric.placement_group(
+            factory.bundles, strategy=factory.strategy
+        )
+        if len(set(trial.pg.bundle_node_ids)) > 1:
+            fabric.remove_placement_group(trial.pg)
+            trial.pg = None
+            raise fabric.InsufficientResourcesError(
+                f"trial {trial.trial_id} gang {factory.bundles} only "
+                "fits straddling nodes; waiting for a single node to "
+                "free up (nested workers run on the trial driver's host)"
             )
-            if len(set(trial.pg.bundle_node_ids)) > 1:
-                fabric.remove_placement_group(trial.pg)
-                trial.pg = None
-                raise fabric.InsufficientResourcesError(
-                    f"trial {trial.trial_id} gang {factory.bundles} only "
-                    "fits straddling nodes; waiting for a single node to "
-                    "free up (nested workers run on the trial driver's "
-                    "host)"
-                )
-            num_cpus = head.pop("CPU", 1.0)
-            options = dict(
-                num_cpus=num_cpus,
-                resources=head,
-                placement_group=trial.pg,
-                placement_group_bundle_index=0,
-            )
+        # Request EXACTLY what bundle 0 reserves: defaulting the driver to
+        # 1 CPU when the head bundle declares none could never fit the
+        # bundle and would retry forever.
+        num_cpus = head.pop("CPU", 0.0)
+        options = dict(
+            num_cpus=num_cpus,
+            resources=head,
+            placement_group=trial.pg,
+            placement_group_bundle_index=0,
+        )
         try:
             trial.actor = (
                 fabric.remote(TrainWorker)
@@ -361,30 +350,18 @@ class Tuner:
         # with the packing math, not discovered as a hang (VERDICT r4
         # missing #1).
         need = self.resources_per_trial.required_resources
-        if self._client_mode():
-            total = fabric.cluster_resources()
-            impossible = {
-                k: v for k, v in need.items() if total.get(k, 0.0) < v
-            }
-            if impossible:
-                raise fabric.InsufficientResourcesError(
-                    f"resources_per_trial {self.resources_per_trial} can "
-                    f"never be satisfied: cluster total is {total} "
-                    f"(short on {impossible})"
-                )
-        else:
-            node_caps = [n["Resources"] for n in fabric.nodes()]
-            if not any(
-                all(cap.get(k, 0.0) >= v for k, v in need.items())
-                for cap in node_caps
-            ):
-                raise fabric.InsufficientResourcesError(
-                    f"resources_per_trial {self.resources_per_trial} "
-                    f"(total {need}) cannot be packed onto any single "
-                    f"node: capacities {node_caps}. A trial's training "
-                    "workers are co-located with its driver, so the gang "
-                    "must fit one node — shrink the trial or add capacity."
-                )
+        node_caps = [n["Resources"] for n in fabric.nodes()]
+        if not any(
+            all(cap.get(k, 0.0) >= v for k, v in need.items())
+            for cap in node_caps
+        ):
+            raise fabric.InsufficientResourcesError(
+                f"resources_per_trial {self.resources_per_trial} "
+                f"(total {need}) cannot be packed onto any single "
+                f"node: capacities {node_caps}. A trial's training "
+                "workers are co-located with its driver, so the gang "
+                "must fit one node — shrink the trial or add capacity."
+            )
         os.makedirs(self.experiment_dir, exist_ok=True)
         configs = generate_configs(self.param_space, self.num_samples, self.seed)
         results_queue = fabric.Queue()

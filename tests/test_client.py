@@ -75,6 +75,36 @@ def test_client_auth_required_and_rejected(fabric_head):
     c.close()
 
 
+def test_client_placement_group(fabric_head):
+    """Placement groups in client mode: the reservation lives on the head,
+    actors schedule into bundles by id, removal frees the capacity."""
+    from ray_lightning_tpu.launchers.utils import TrainWorker
+
+    fabric.init(address=fabric_head)
+    total = fabric.available_resources()["CPU"]
+    pg = fabric.placement_group([{"CPU": 1}, {"CPU": 2}], strategy="PACK")
+    assert len(pg.bundle_node_ids) == 2
+    assert fabric.available_resources()["CPU"] == total - 3
+
+    actor = (
+        fabric.remote(TrainWorker)
+        .options(num_cpus=2, placement_group=pg, placement_group_bundle_index=1)
+        .remote()
+    )
+    # Draws from the reservation, not free capacity.
+    assert fabric.available_resources()["CPU"] == total - 3
+    assert actor.node_id == pg.bundle_node_ids[1]
+    # Exhausted bundle rejects a second actor, with the bundle in the error.
+    with pytest.raises(fabric.InsufficientResourcesError, match="bundle 1"):
+        fabric.remote(TrainWorker).options(
+            num_cpus=1, placement_group=pg, placement_group_bundle_index=1
+        ).remote()
+    fabric.kill(actor)
+    fabric.remove_placement_group(pg)
+    assert fabric.available_resources()["CPU"] == total
+    fabric.shutdown()
+
+
 def test_client_exception_propagates(fabric_head):
     from ray_lightning_tpu.launchers.utils import TrainWorker
 

@@ -378,8 +378,6 @@ def test_moe_pipeline_matches_dense_oracle():
     module = GPTLM(config=no_drop, batch_size=4)
     strategy.bind_module(module)
     params = init_gpt_params(jax.random.PRNGKey(0), no_drop)
-    from jax.sharding import PartitionSpec as P
-
     sh = strategy.param_sharding(params)
     # Layers shard over pp AND experts over ep simultaneously.
     assert sh["blocks"]["wi"].spec[0] == "pp"

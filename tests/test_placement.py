@@ -134,6 +134,28 @@ def test_tuner_gang_packs_trial_onto_fitting_node(two_nodes):
     assert _node_avail() == {"node-0": 2.0, "node-1": 6.0}
 
 
+@pytest.mark.slow
+def test_tuner_cpu_less_trial_bundle_does_not_hang(two_nodes):
+    """A legacy flat request with no CPU key (accelerator-only) must run:
+    the trial driver requests exactly what its bundle reserves — a default
+    1-CPU request against a CPU-less bundle would retry forever."""
+    cluster = two_nodes(4, 4)
+    # Give both nodes a custom accelerator resource.
+    for node in cluster._nodes:
+        node.capacity["accel"] = 2.0
+
+    def train_fn(config):
+        tune.report(x=1.0)
+
+    results = tune.Tuner(
+        train_fn,
+        param_space={"lr": tune.choice([0.1])},
+        num_samples=1,
+        resources_per_trial={"accel": 2.0},
+    ).fit()
+    assert not results.errors, [r.error for r in results]
+
+
 def test_tuner_unpackable_trial_fails_fast(two_nodes):
     """A gang no node's CAPACITY can hold is rejected before any trial
     launches (previously this spun forever in the scheduler loop)."""
