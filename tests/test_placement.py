@@ -156,6 +156,32 @@ def test_tuner_cpu_less_trial_bundle_does_not_hang(two_nodes):
     assert not results.errors, [r.error for r in results]
 
 
+@pytest.mark.slow
+def test_tuner_errored_trial_releases_gang(two_nodes):
+    """A trial whose train_fn raises must surface the error AND free its
+    gang so later trials (and the post-sweep cluster) see full capacity."""
+    two_nodes(2, 6)
+
+    def train_fn(config):
+        if config["lr"] > 1.0:
+            raise RuntimeError("bad trial")
+        tune.report(x=1.0)
+
+    results = tune.Tuner(
+        train_fn,
+        param_space={"lr": tune.grid_search([0.1, 2.0])},
+        num_samples=1,
+        resources_per_trial=tune.PlacementGroupFactory(
+            [{"CPU": 1}, {"CPU": 2}, {"CPU": 2}], strategy="PACK"
+        ),
+    ).fit()
+    assert len(results.errors) == 1
+    assert "bad trial" in results.errors[0].error
+    ok = [r for r in results if not r.error]
+    assert len(ok) == 1 and ok[0].metrics["x"] == 1.0
+    assert _node_avail() == {"node-0": 2.0, "node-1": 6.0}
+
+
 def test_tuner_unpackable_trial_fails_fast(two_nodes):
     """A gang no node's CAPACITY can hold is rejected before any trial
     launches (previously this spun forever in the scheduler loop)."""
