@@ -78,6 +78,14 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
     moe_top_k: int = 1  # 1 = switch; k >= 2 = GShard-style top-k
+    # Expert-parallel dispatch flavor when the mesh's ep axis is >1:
+    # "auto" uses the explicit all-to-all path (parallel/moe.py:moe_ffn_ep
+    # — token shuffles ride ICI; GSPMD's lowering of the sorted dispatch
+    # is all-gather based) whenever it applies (no pp nesting, batch
+    # divisible by ep), falling back to "gspmd" otherwise; "a2a" forces it
+    # (errors when inapplicable); "gspmd" keeps the sharded-weights-only
+    # formulation.
+    moe_dispatch: str = "auto"
     # Pipeline parallelism: used when the bound mesh has a "pp" axis > 1
     # (layers shard over pp; microbatched GPipe schedule,
     # parallel/pipeline.py). 0 -> one microbatch per pipeline stage.
@@ -510,11 +518,42 @@ def gpt_forward(
             sinks=cfg.attn_sinks,
         )
 
+    pp_size_ = mesh.shape.get("pp", 1) if mesh is not None else 1
+    ep_size = mesh.shape.get("ep", 1) if mesh is not None else 1
+    a2a_applicable = (
+        ep_size > 1
+        and pp_size_ == 1  # the pp schedule is itself a shard_map; no nesting
+        and B % ep_size == 0
+        # moe_ffn_ep owns exact expert shards; GSPMD pads uneven ones.
+        and cfg.n_experts % ep_size == 0
+    )
+    if cfg.moe_dispatch not in ("auto", "a2a", "gspmd"):
+        raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
+    if cfg.moe_dispatch == "a2a" and cfg.n_experts > 0 and not a2a_applicable:
+        raise ValueError(
+            "moe_dispatch='a2a' needs an ep>1 mesh axis, no pp axis, and "
+            "batch AND n_experts divisible by ep (got "
+            f"ep={ep_size}, pp={pp_size_}, B={B}, "
+            f"n_experts={cfg.n_experts}); use 'auto' or 'gspmd'"
+        )
+    use_a2a = cfg.moe_dispatch in ("auto", "a2a") and a2a_applicable
+
     def mlp(h: jax.Array, lp: Dict[str, jax.Array]) -> Tuple[jax.Array, jax.Array]:
         m = _layernorm(h, lp["ln2_g"], lp["ln2_b"])
         if cfg.n_experts > 0:
-            from ray_lightning_tpu.parallel.moe import moe_ffn
+            from ray_lightning_tpu.parallel.moe import moe_ffn, moe_ffn_ep
 
+            if use_a2a:
+                out, aux = moe_ffn_ep(
+                    _moe_layer_params(lp),
+                    m,
+                    mesh,
+                    ep_axis="ep",
+                    capacity_factor=cfg.moe_capacity_factor,
+                    compute_dtype=cdt,
+                    top_k=cfg.moe_top_k,
+                )
+                return out, aux["aux_loss"]
             out, aux = moe_ffn(
                 _moe_layer_params(lp),
                 m,
