@@ -81,10 +81,10 @@ class GPTConfig:
     # Expert-parallel dispatch flavor when the mesh's ep axis is >1:
     # "auto" uses the explicit all-to-all path (parallel/moe.py:moe_ffn_ep
     # — token shuffles ride ICI; GSPMD's lowering of the sorted dispatch
-    # is all-gather based) whenever it applies (no pp nesting, batch
-    # divisible by ep), falling back to "gspmd" otherwise; "a2a" forces it
-    # (errors when inapplicable); "gspmd" keeps the sharded-weights-only
-    # formulation.
+    # is all-gather based) whenever it applies (no pp nesting, batch and
+    # n_experts divisible by ep), falling back to "gspmd" otherwise;
+    # "a2a" forces it (errors when inapplicable); "gspmd" keeps the
+    # sharded-weights-only formulation.
     moe_dispatch: str = "auto"
     # Pipeline parallelism: used when the bound mesh has a "pp" axis > 1
     # (layers shard over pp; microbatched GPipe schedule,
@@ -518,11 +518,16 @@ def gpt_forward(
             sinks=cfg.attn_sinks,
         )
 
-    pp_size_ = mesh.shape.get("pp", 1) if mesh is not None else 1
+    pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
     ep_size = mesh.shape.get("ep", 1) if mesh is not None else 1
     a2a_applicable = (
         ep_size > 1
-        and pp_size_ == 1  # the pp schedule is itself a shard_map; no nesting
+        # Nesting moe_ffn_ep's shard_map inside the pp stage shard_map
+        # traces and runs FORWARD, but the backward's residuals currently
+        # trip a Shardy verifier error (mixed ep/pp manual shardings on
+        # sdy.manual_computation operands) — so under pp the dispatch
+        # stays with GSPMD until the partitioner supports it.
+        and pp_size == 1
         and B % ep_size == 0
         # moe_ffn_ep owns exact expert shards; GSPMD pads uneven ones.
         and cfg.n_experts % ep_size == 0
@@ -531,9 +536,10 @@ def gpt_forward(
         raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
     if cfg.moe_dispatch == "a2a" and cfg.n_experts > 0 and not a2a_applicable:
         raise ValueError(
-            "moe_dispatch='a2a' needs an ep>1 mesh axis, no pp axis, and "
-            "batch AND n_experts divisible by ep (got "
-            f"ep={ep_size}, pp={pp_size_}, B={B}, "
+            "moe_dispatch='a2a' needs an ep>1 mesh axis, no pp axis (the "
+            "backward of a shard_map nested in the pp stages is not yet "
+            "partitionable), and batch AND n_experts divisible by ep (got "
+            f"ep={ep_size}, pp={pp_size}, B={B}, "
             f"n_experts={cfg.n_experts}); use 'auto' or 'gspmd'"
         )
     use_a2a = cfg.moe_dispatch in ("auto", "a2a") and a2a_applicable
@@ -584,18 +590,19 @@ def gpt_forward(
         m_out, aux = mlp(h, lp)
         return (h + m_out, aux_acc + aux), None
 
-    pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
     if pp_size > 1:
         from ray_lightning_tpu.parallel.pipeline import pipeline_apply
 
         if cfg.n_experts > 0:
             # MoE composes with the pipeline: the pp shard_map is manual
-            # over "pp" ONLY, so the expert all-to-all stays a GSPMD
-            # concern — moe_ffn's ep-sharded expert weights route tokens
-            # across the "ep" axis inside each pipeline stage exactly as
-            # in the unpipelined path. The per-layer load-balancing aux
-            # rides pipeline_apply's aux channel (mean over microbatches;
-            # see its docstring for the batch-statistics contract).
+            # over "pp" only, so the expert routing stays a GSPMD concern
+            # inside each stage — moe_ffn's ep-sharded weights route
+            # tokens across the "ep" axis exactly as in the unpipelined
+            # path. (The explicit a2a dispatch nests and runs FORWARD
+            # here, but its backward trips the Shardy partitioner; see
+            # a2a_applicable.) The per-layer load-balancing aux rides
+            # pipeline_apply's aux channel (mean over microbatches; see
+            # its docstring for the batch-statistics contract).
             def stage_aux(
                 lp: Dict[str, jax.Array], h: jax.Array
             ) -> Tuple[jax.Array, jax.Array]:

@@ -169,7 +169,7 @@ def moe_ffn(
 def moe_ffn_ep(
     params: Dict[str, jax.Array],
     x: jax.Array,
-    mesh: Any,
+    mesh: Any = None,
     ep_axis: str = "ep",
     capacity_factor: float = 1.25,
     compute_dtype: Any = jnp.float32,
@@ -207,7 +207,21 @@ def moe_ffn_ep(
         single-device statistics (router probs are token-local).
 
     Top-1 and top-k routing follow :func:`moe_ffn` (same gating math).
+
+    ``mesh=None`` resolves the CONTEXT abstract mesh — the way to call
+    this inside another shard_map (e.g. a pipeline stage, where the pp
+    axis is already manual): nested shard_maps must be built on the
+    context mesh, whose already-manual axes differ from the concrete
+    mesh's.
     """
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+    if ep_axis not in mesh.shape:
+        raise ValueError(
+            f"moe_ffn_ep needs a mesh with an {ep_axis!r} axis; got mesh "
+            f"axes {tuple(mesh.shape)} (pass mesh= explicitly or call "
+            "under a mesh context that defines it)"
+        )
     B, S, D = x.shape
     E = params["router"].shape[1]
     ep = mesh.shape[ep_axis]
