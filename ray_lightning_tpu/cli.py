@@ -131,7 +131,7 @@ def _apply_dotted(
     field_overrides: List[Tuple[str, str, str]] = []
     for key, raw in dotted:
         section, _, field = key.partition(".")
-        if section in ("src", "out"):  # convert-hf scalar options
+        if section in ("src", "out", "family"):  # convert-hf scalar options
             config[section] = raw
             continue
         if section == "overrides":  # convert-hf GPTConfig overrides
@@ -310,7 +310,7 @@ def run_generate(config: Dict[str, Any]) -> Any:
 
 
 def run_convert_hf(config: Dict[str, Any]) -> str:
-    """``convert-hf``: local Hugging Face GPT-2 checkpoint -> a native
+    """``convert-hf``: local Hugging Face GPT-2/Llama checkpoint -> a native
     params checkpoint usable as ``fit/validate/generate`` ckpt_path.
 
     Options (``--src``/``--out`` or a ``convert_hf:`` YAML section):
@@ -321,6 +321,9 @@ def run_convert_hf(config: Dict[str, Any]) -> str:
     section = dict(config.pop("convert_hf", None) or {})
     src = config.pop("src", None) or section.pop("src", None)
     out = config.pop("out", None) or section.pop("out", None)
+    family = (
+        config.pop("family", None) or section.pop("family", None) or "gpt2"
+    )
     overrides = dict(
         (config.pop("overrides", None) or section.pop("overrides", None) or {})
     )
@@ -334,11 +337,16 @@ def run_convert_hf(config: Dict[str, Any]) -> str:
     import jax
     import numpy as np
 
-    from ray_lightning_tpu.models import load_hf_gpt2
+    from ray_lightning_tpu.models import load_hf_gpt2, load_hf_llama
     from ray_lightning_tpu.utils import to_state_stream
     from ray_lightning_tpu.utils.state_stream import state_stream_to_file
 
-    params, cfg = load_hf_gpt2(src, **overrides)
+    if family not in ("gpt2", "llama"):
+        raise ValueError(
+            f"unknown convert-hf family {family!r}; use 'gpt2' or 'llama'"
+        )
+    loader = load_hf_llama if family == "llama" else load_hf_gpt2
+    params, cfg = loader(src, **overrides)
     state_stream_to_file(
         to_state_stream(
             {"params": params, "gpt_config": dataclasses.asdict(cfg)}

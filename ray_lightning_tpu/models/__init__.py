@@ -22,7 +22,7 @@ from ray_lightning_tpu.models.gpt import (
     init_gpt_params,
     make_fake_text,
 )
-from ray_lightning_tpu.models.hf_import import load_hf_gpt2
+from ray_lightning_tpu.models.hf_import import load_hf_gpt2, load_hf_llama
 from ray_lightning_tpu.models.mnist import MNISTClassifier, make_fake_mnist
 from ray_lightning_tpu.models.resnet import CIFARResNet, make_fake_cifar
 from ray_lightning_tpu.models.vit import ViTClassifier, ViTConfig, vit_forward
@@ -45,6 +45,7 @@ __all__ = [
     "init_gpt_params",
     "make_fake_text",
     "load_hf_gpt2",
+    "load_hf_llama",
     "BERTConfig",
     "BERTEncoder",
     "bert_forward",

@@ -71,6 +71,14 @@ class GPTConfig:
     # attention costs no per-layer resharding.
     seq_impl: str = "ring"
     init_std: float = 0.02
+    # Llama-family knobs: "gelu" (GPT-2 MLP) or "swiglu" (gate/up SiLU,
+    # bias-free style — ``wi`` packs [gate|up] as (D, 2*ff_dim));
+    # "layernorm" or "rmsnorm" (rmsnorm ignores the bias leaves);
+    # untied heads add an ``lm_head`` (V, D) parameter.
+    mlp_variant: str = "gelu"
+    norm_impl: str = "layernorm"
+    norm_eps: float = 1e-5
+    tie_word_embeddings: bool = True
     # Mixture-of-Experts: n_experts > 0 replaces every block's dense MLP
     # with a switch (top-1) MoE layer (parallel/moe.py); expert weights
     # shard over the "ep" mesh axis under GSPMDStrategy.
@@ -116,6 +124,38 @@ class GPTConfig:
     def ff_dim(self) -> int:
         return self.d_ff or 4 * self.d_model
 
+    def validate_variants(self) -> None:
+        if self.mlp_variant not in ("gelu", "swiglu"):
+            raise ValueError(
+                f"unknown mlp_variant {self.mlp_variant!r}; use 'gelu' or "
+                "'swiglu'"
+            )
+        if self.norm_impl not in ("layernorm", "rmsnorm"):
+            raise ValueError(
+                f"unknown norm_impl {self.norm_impl!r}; use 'layernorm' or "
+                "'rmsnorm'"
+            )
+        if self.mlp_variant == "swiglu" and self.n_experts > 0:
+            raise ValueError(
+                "mlp_variant='swiglu' applies to the dense MLP; MoE expert "
+                "FFNs are gelu (parallel/moe.py) — use n_experts=0 or "
+                "mlp_variant='gelu'"
+            )
+
+    @staticmethod
+    def llama(**overrides: Any) -> "GPTConfig":
+        """Llama-family defaults: RoPE, RMSNorm, SwiGLU, untied head.
+        Sizes (vocab/layers/heads/d_model/d_ff, GQA n_kv_head) come from
+        ``overrides`` or :func:`load_hf_llama`."""
+        cfg = GPTConfig(
+            pos_embed="rope",
+            norm_impl="rmsnorm",
+            norm_eps=1e-5,
+            mlp_variant="swiglu",
+            tie_word_embeddings=False,
+        )
+        return replace(cfg, **overrides) if overrides else cfg
+
     @staticmethod
     def gpt2_small(**overrides: Any) -> "GPTConfig":
         """GPT-2 124M: the flagship/bench configuration."""
@@ -132,6 +172,7 @@ class GPTConfig:
 
 def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
     """Parameter pytree with stacked per-layer leaves (leading dim L)."""
+    cfg.validate_variants()
     L, D, H, hd, F = (
         cfg.n_layer,
         cfg.d_model,
@@ -159,9 +200,12 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
             "bo2": jnp.zeros((L, E, D)),
         }
     else:
+        # swiglu packs [gate|up] into one (D, 2F) leaf so the block tree
+        # keeps the same leaf names (sharding rules unchanged).
+        fin = 2 * F if cfg.mlp_variant == "swiglu" else F
         mlp = {
-            "wi": norm(keys[4], (L, D, F), std),
-            "bi": jnp.zeros((L, F)),
+            "wi": norm(keys[4], (L, D, fin), std),
+            "bi": jnp.zeros((L, fin)),
             "wo2": norm(keys[5], (L, F, D), res_std),
             "bo2": jnp.zeros((L, D)),
         }
@@ -201,6 +245,10 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
     elif cfg.pos_embed != "rope":
         raise ValueError(
             f"unknown pos_embed {cfg.pos_embed!r}; use 'learned' or 'rope'"
+        )
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = norm(
+            jax.random.fold_in(keys[0], 1), (cfg.vocab_size, D), std
         )
     return out
 
@@ -255,6 +303,8 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
     }
     if cfg.pos_embed == "learned":
         out["wpe"] = (None, "embed")
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = ("vocab", "embed")
     return out
 
 
@@ -289,11 +339,51 @@ def _lm_head(h: jax.Array, wte: jax.Array) -> jax.Array:
     )
 
 
-def _layernorm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
+def _layernorm(
+    x: jax.Array, g: jax.Array, b: jax.Array, eps: float = 1e-5
+) -> jax.Array:
     x32 = x.astype(jnp.float32)
     mu = x32.mean(-1, keepdims=True)
     var = x32.var(-1, keepdims=True)
-    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5) * g + b).astype(x.dtype)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+
+def _rmsnorm(x: jax.Array, g: jax.Array, eps: float = 1e-5) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, -1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps) * g).astype(x.dtype)
+
+
+def _make_norm(cfg: GPTConfig):
+    """The block-norm function for the config: ``fn(x, g, b)``. RMSNorm
+    ignores the bias leaf (kept in the tree so the layout is uniform)."""
+    if cfg.norm_impl == "rmsnorm":
+        return lambda x, g, b: _rmsnorm(x, g, cfg.norm_eps)
+    return lambda x, g, b: _layernorm(x, g, b, cfg.norm_eps)
+
+
+def _dense_mlp(
+    m: jax.Array, lp: Dict[str, jax.Array], cfg: GPTConfig, cdt: Any
+) -> jax.Array:
+    """The dense (non-MoE) feed-forward on normed input (..., D): GPT-2
+    gelu or Llama-style SwiGLU ([gate|up] packed in ``wi``). One
+    definition serves the training forward and the KV-cached decode."""
+    z = jnp.einsum("...d,df->...f", m, lp["wi"].astype(cdt)) + lp[
+        "bi"
+    ].astype(cdt)
+    if cfg.mlp_variant == "swiglu":
+        gate, up = jnp.split(z, 2, axis=-1)
+        h = jax.nn.silu(gate) * up
+    else:
+        h = jax.nn.gelu(z)
+    return jnp.einsum("...f,fd->...d", h, lp["wo2"].astype(cdt)) + lp[
+        "bo2"
+    ].astype(cdt)
+
+
+def _head_weight(params: Dict[str, Any], cfg: GPTConfig) -> jax.Array:
+    """The (V, D) output-projection table: tied embedding or ``lm_head``."""
+    return params["wte"] if cfg.tie_word_embeddings else params["lm_head"]
 
 
 def _rope_tables(
@@ -391,7 +481,9 @@ def gpt_forward(
         ring_self_attention,
     )
 
+    cfg.validate_variants()
     cdt = jnp.dtype(cfg.compute_dtype)
+    norm_fn = _make_norm(cfg)
     B, S = tokens.shape
 
     use_ring = (
@@ -545,7 +637,7 @@ def gpt_forward(
     use_a2a = cfg.moe_dispatch in ("auto", "a2a") and a2a_applicable
 
     def mlp(h: jax.Array, lp: Dict[str, jax.Array]) -> Tuple[jax.Array, jax.Array]:
-        m = _layernorm(h, lp["ln2_g"], lp["ln2_b"])
+        m = norm_fn(h, lp["ln2_g"], lp["ln2_b"])
         if cfg.n_experts > 0:
             from ray_lightning_tpu.parallel.moe import moe_ffn, moe_ffn_ep
 
@@ -568,20 +660,13 @@ def gpt_forward(
                 top_k=cfg.moe_top_k,
             )
             return out, aux["aux_loss"]
-        m = jax.nn.gelu(
-            jnp.einsum("bsd,df->bsf", m, lp["wi"].astype(cdt))
-            + lp["bi"].astype(cdt)
-        )
-        out = jnp.einsum("bsf,fd->bsd", m, lp["wo2"].astype(cdt)) + lp[
-            "bo2"
-        ].astype(cdt)
-        return out, jnp.zeros((), jnp.float32)
+        return _dense_mlp(m, lp, cfg, cdt), jnp.zeros((), jnp.float32)
 
     def block(
         carry: Tuple[jax.Array, jax.Array], lp: Dict[str, jax.Array]
     ) -> Tuple[Tuple[jax.Array, jax.Array], None]:
         h, aux_acc = carry
-        a = _layernorm(h, lp["ln1_g"], lp["ln1_b"])
+        a = norm_fn(h, lp["ln1_g"], lp["ln1_b"])
         q, k, v = _project_qkv(a, lp, cfg, cdt, rope_tables)  # (B,S,H,hd)
         o = attend(q, k, v)
         h = h + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cdt)) + lp[
@@ -638,7 +723,7 @@ def gpt_forward(
         (x, aux_total), _ = jax.lax.scan(
             body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
         )
-    x = _layernorm(x, params["lnf_g"], params["lnf_b"])
+    x = norm_fn(x, params["lnf_g"], params["lnf_b"])
     if use_zigzag:
         # Back to natural order before the head so callers (loss, predict,
         # logit tests) never see the internal layout; keep seq-sharded so
@@ -648,9 +733,9 @@ def gpt_forward(
         if return_aux:
             return x, aux_total / max(1, cfg.n_layer)
         return x
-    # Tied output head (GPT-2 weight tying); see _lm_head for the
-    # precision scheme.
-    logits = _lm_head(x, params["wte"])
+    # Output head (tied embedding, or lm_head when untied); see _lm_head
+    # for the precision scheme.
+    logits = _lm_head(x, _head_weight(params, cfg))
     if return_aux:
         return logits, aux_total / max(1, cfg.n_layer)
     return logits
@@ -810,7 +895,9 @@ def gpt_generate(
         raise ValueError(
             f"prompt + max_new_tokens = {total} exceeds max_seq {cfg.max_seq}"
         )
+    cfg.validate_variants()
     cdt = jnp.dtype(cfg.compute_dtype)
+    norm_fn = _make_norm(cfg)
     L, H, hd = cfg.n_layer, cfg.n_head, cfg.head_dim
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -844,7 +931,7 @@ def gpt_generate(
 
         def layer(h, args):
             lp, kc_l, vc_l = args
-            a = _layernorm(h[:, None], lp["ln1_g"], lp["ln1_b"])[:, 0]
+            a = norm_fn(h[:, None], lp["ln1_g"], lp["ln1_b"])[:, 0]
             if Hkv == H:
                 qkv = (
                     jnp.einsum("bd,dthk->bthk", a, lp["wqkv"].astype(cdt))
@@ -894,7 +981,7 @@ def gpt_generate(
             h = h + jnp.einsum("bhk,hkd->bd", o, lp["wo"].astype(cdt)) + lp[
                 "bo"
             ].astype(cdt)
-            m = _layernorm(h[:, None], lp["ln2_g"], lp["ln2_b"])
+            m = norm_fn(h[:, None], lp["ln2_g"], lp["ln2_b"])
             if cfg.n_experts > 0:
                 from ray_lightning_tpu.parallel.moe import moe_ffn
 
@@ -909,13 +996,7 @@ def gpt_generate(
                 )
                 m_out = m_out[:, 0]
             else:
-                mm = jax.nn.gelu(
-                    jnp.einsum("bd,df->bf", m[:, 0], lp["wi"].astype(cdt))
-                    + lp["bi"].astype(cdt)
-                )
-                m_out = jnp.einsum("bf,fd->bd", mm, lp["wo2"].astype(cdt)) + lp[
-                    "bo2"
-                ].astype(cdt)
+                m_out = _dense_mlp(m[:, 0], lp, cfg, cdt)
             return h + m_out, (kc_l, vc_l)
 
         h = x
@@ -929,8 +1010,8 @@ def gpt_generate(
             new_v.append(vc_l)
         k_cache = jnp.stack(new_k)
         v_cache = jnp.stack(new_v)
-        h = _layernorm(h[:, None], params["lnf_g"], params["lnf_b"])[:, 0]
-        logits = _lm_head(h, params["wte"])
+        h = norm_fn(h[:, None], params["lnf_g"], params["lnf_b"])[:, 0]
+        logits = _lm_head(h, _head_weight(params, cfg))
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(
             sub, logits, temperature=temperature, top_k=top_k, top_p=top_p
@@ -1031,7 +1112,10 @@ class GPTLM(TPUModule):
         if chunked:
             def head(o):
                 return chunked_lm_loss(
-                    o, params["wte"], toks[:, 1:], self.config.loss_chunk
+                    o,
+                    _head_weight(params, self.config),
+                    toks[:, 1:],
+                    self.config.loss_chunk,
                 )
         else:
             def head(o):

@@ -89,9 +89,17 @@ def load_hf_gpt2(model_or_path: Any, **cfg_overrides: Any):
         max_seq=hf_cfg.n_positions,
         pos_embed="learned",
     )
-    # Shape fields come from the checkpoint; structure fields (GQA, MoE)
-    # would change the param LAYOUT the converted tree doesn't have.
-    locked = set(arch) | {"n_kv_head", "n_experts"}
+    # Shape fields come from the checkpoint; structure fields (GQA, MoE,
+    # norm/MLP flavor, head tying) would change the param layout or the
+    # numerics the converted tree was trained with.
+    locked = set(arch) | {
+        "n_kv_head",
+        "n_experts",
+        "norm_impl",
+        "norm_eps",
+        "mlp_variant",
+        "tie_word_embeddings",
+    }
     clash = set(cfg_overrides) & locked
     if clash:
         raise ValueError(
@@ -130,15 +138,168 @@ def load_hf_gpt2(model_or_path: Any, **cfg_overrides: Any):
     return params, cfg
 
 
-def _resolve_model(model_or_path: Any):
+def load_hf_llama(model_or_path: Any, **cfg_overrides: Any):
+    """HF Llama -> (params pytree, GPTConfig).
+
+    Maps a ``transformers`` ``LlamaForCausalLM`` (instance or local
+    checkpoint path) onto the native decoder: RoPE (the native half-split
+    rotation is exactly HF Llama's ``rotate_half``), RMSNorm, SwiGLU
+    ([gate|up] packed into ``wi``), GQA when ``num_key_value_heads <
+    num_attention_heads``, untied ``lm_head`` unless the checkpoint ties.
+    Numerical parity is asserted in tests/test_hf_import.py.
+    """
+    from ray_lightning_tpu.models.gpt import GPTConfig
+
+    model = _resolve_model(model_or_path, family="llama")
+    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    prefix = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def t(name: str) -> np.ndarray:
+        return np.asarray(sd[prefix + name], np.float32)
+
+    hf_cfg = model.config
+    # Fail fast on family variants the native forward does not implement —
+    # a silent convert would run with wrong numerics.
+    unsupported = {
+        "hidden_act": (getattr(hf_cfg, "hidden_act", "silu"), ("silu",)),
+        "rope_scaling": (getattr(hf_cfg, "rope_scaling", None), (None,)),
+        "attention_bias": (
+            bool(getattr(hf_cfg, "attention_bias", False)),
+            (False,),
+        ),
+        "mlp_bias": (bool(getattr(hf_cfg, "mlp_bias", False)), (False,)),
+    }
+    bad = {k: got for k, (got, ok) in unsupported.items() if got not in ok}
+    if bad:
+        raise ValueError(
+            f"HF Llama config options {bad} are not supported by the "
+            "native decoder (it implements stock Llama: silu SwiGLU, "
+            "unscaled RoPE, bias-free projections)"
+        )
+    L, D = hf_cfg.num_hidden_layers, hf_cfg.hidden_size
+    H = hf_cfg.num_attention_heads
+    Hkv = getattr(hf_cfg, "num_key_value_heads", H) or H
+    hd = D // H
+    F = hf_cfg.intermediate_size
+    tied = bool(getattr(hf_cfg, "tie_word_embeddings", False))
+
+    arch = dict(
+        vocab_size=hf_cfg.vocab_size,
+        n_layer=L,
+        n_head=H,
+        d_model=D,
+        d_ff=F,
+        max_seq=hf_cfg.max_position_embeddings,
+        pos_embed="rope",
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        norm_impl="rmsnorm",
+        norm_eps=float(getattr(hf_cfg, "rms_norm_eps", 1e-5)),
+        mlp_variant="swiglu",
+        tie_word_embeddings=tied,
+    )
+    if Hkv != H:
+        arch["n_kv_head"] = Hkv
+    locked = set(arch) | {"n_kv_head", "n_experts"}
+    clash = set(cfg_overrides) & locked
+    if clash:
+        raise ValueError(
+            f"architecture fields {sorted(clash)} are defined by the HF "
+            "checkpoint and cannot be overridden"
+        )
+    cfg = GPTConfig(**arch, **cfg_overrides)
+
+    def lin(name: str, i: int) -> np.ndarray:
+        # torch Linear stores (out, in); the native einsums consume (in, out).
+        return np.asarray(
+            sd[f"{prefix}layers.{i}.{name}.weight"], np.float32
+        ).T
+
+    def stack(fn) -> np.ndarray:
+        return np.stack([fn(i) for i in range(L)])
+
+    zeros = np.zeros
+    if Hkv == H:
+        attn = {
+            "wqkv": stack(
+                lambda i: np.stack(
+                    [
+                        lin("self_attn.q_proj", i).reshape(D, H, hd),
+                        lin("self_attn.k_proj", i).reshape(D, H, hd),
+                        lin("self_attn.v_proj", i).reshape(D, H, hd),
+                    ],
+                    axis=1,
+                )
+            ),
+            "bqkv": zeros((L, 3, H, hd), np.float32),
+        }
+    else:
+        attn = {
+            "wq": stack(lambda i: lin("self_attn.q_proj", i).reshape(D, H, hd)),
+            "bq": zeros((L, H, hd), np.float32),
+            "wkv": stack(
+                lambda i: np.stack(
+                    [
+                        lin("self_attn.k_proj", i).reshape(D, Hkv, hd),
+                        lin("self_attn.v_proj", i).reshape(D, Hkv, hd),
+                    ],
+                    axis=1,
+                )
+            ),
+            "bkv": zeros((L, 2, Hkv, hd), np.float32),
+        }
+    params: Dict[str, Any] = {
+        "wte": t("embed_tokens.weight"),
+        "blocks": {
+            "ln1_g": stack(
+                lambda i: t(f"layers.{i}.input_layernorm.weight")
+            ),
+            "ln1_b": zeros((L, D), np.float32),  # rmsnorm: unused
+            **attn,
+            "wo": stack(
+                lambda i: lin("self_attn.o_proj", i).reshape(H, hd, D)
+            ),
+            "bo": zeros((L, D), np.float32),
+            "ln2_g": stack(
+                lambda i: t(f"layers.{i}.post_attention_layernorm.weight")
+            ),
+            "ln2_b": zeros((L, D), np.float32),
+            # SwiGLU packing: wi[:, :F] = gate, wi[:, F:] = up (the order
+            # _dense_mlp's split consumes).
+            "wi": stack(
+                lambda i: np.concatenate(
+                    [lin("mlp.gate_proj", i), lin("mlp.up_proj", i)], axis=1
+                )
+            ),
+            "bi": zeros((L, 2 * F), np.float32),
+            "wo2": stack(lambda i: lin("mlp.down_proj", i)),
+            "bo2": zeros((L, D), np.float32),
+        },
+        "lnf_g": t("norm.weight"),
+        "lnf_b": zeros((D,), np.float32),
+    }
+    if not tied:
+        if "lm_head.weight" not in sd:
+            raise ValueError(
+                "checkpoint has tie_word_embeddings=False but no "
+                "lm_head.weight — pass a LlamaForCausalLM (a bare "
+                "LlamaModel carries no output head)"
+            )
+        params["lm_head"] = np.asarray(sd["lm_head.weight"], np.float32)
+    return params, cfg
+
+
+def _resolve_model(model_or_path: Any, family: str = "gpt2"):
     import os
 
     if isinstance(model_or_path, (str, os.PathLike)):
-        from transformers import GPT2LMHeadModel
+        if family == "llama":
+            from transformers import LlamaForCausalLM as cls
+        else:
+            from transformers import GPT2LMHeadModel as cls
 
         # local_files_only: this is an import bridge, not a downloader —
         # point it at a checkout/export you already have on disk.
-        return GPT2LMHeadModel.from_pretrained(
+        return cls.from_pretrained(
             os.fspath(model_or_path), local_files_only=True
         )
     return model_or_path

@@ -228,6 +228,37 @@ def test_cli_convert_hf_then_generate(tmp_path, capsys):
         cli.main(["convert-hf", "--out", out_path])
 
 
+@pytest.mark.slow
+def test_cli_convert_hf_llama(tmp_path, capsys):
+    """convert-hf --family llama converts a local HF Llama checkpoint."""
+    pytest.importorskip("transformers")
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf_dir = tmp_path / "hf_llama"
+    LlamaForCausalLM(
+        LlamaConfig(
+            vocab_size=48, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=1, max_position_embeddings=32,
+        )
+    ).save_pretrained(str(hf_dir))
+    out_path = str(tmp_path / "llama.ckpt")
+    cli.main([
+        "convert-hf", "--family", "llama", "--src", str(hf_dir),
+        "--out", out_path, "--overrides.attn_impl", "reference",
+    ])
+    assert os.path.exists(out_path)
+    assert "wrote" in capsys.readouterr().out
+
+    with pytest.raises(ValueError, match="unknown convert-hf family"):
+        cli.main([
+            "convert-hf", "--family", "bert", "--src", str(hf_dir),
+            "--out", out_path,
+        ])
+
+
 def test_cli_generate_from_checkpoint(tmp_path, capsys):
     """generate subcommand: fit a tiny GPT in-process, checkpoint it, then
     decode from the CLI with sampling flags."""

@@ -117,6 +117,52 @@ def test_tp_forward_matches_dense():
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), atol=1e-4)
 
 
+def test_llama_variant_forward_and_sharding():
+    """Llama-family knobs (RMSNorm, SwiGLU, RoPE, GQA, untied head): the
+    variant trains under a tp/fsdp mesh and its sharded logits equal the
+    unsharded forward; lm_head shards like the embedding table."""
+    import dataclasses
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    cfg = dataclasses.replace(
+        GPTConfig.llama(
+            vocab_size=64, n_layer=2, n_head=4, n_kv_head=2, d_model=32,
+            d_ff=48, max_seq=32,
+        ),
+        attn_impl="reference",
+    )
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    assert "lm_head" in params
+    assert params["blocks"]["wi"].shape == (2, 32, 96)  # [gate|up] packed
+    toks = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+    )
+    dense = gpt_forward(params, toks, cfg)
+    assert np.isfinite(np.asarray(dense)).all()
+
+    strategy = make_inprocess({"data": 2, "fsdp": 2, "model": 2})
+    module = GPTLM(config=cfg, batch_size=4)
+    strategy.bind_module(module)
+    sh = strategy.param_sharding(params)
+    assert sh["lm_head"].spec == P("model", "fsdp")
+    placed = strategy.place_params(params)
+    sharded = jax.jit(lambda p, t: gpt_forward(p, t, cfg))(placed, toks)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), atol=1e-4)
+
+    # Variant validation fails fast.
+    with pytest.raises(ValueError, match="mlp_variant"):
+        gpt_forward(
+            params, toks, dataclasses.replace(cfg, mlp_variant="relu")
+        )
+    with pytest.raises(ValueError, match="swiglu"):
+        init_gpt_params(
+            jax.random.PRNGKey(0),
+            dataclasses.replace(cfg, n_experts=4),
+        )
+
+
 def test_sequence_parallel_ring_matches_dense():
     """Ring attention over the seq axis reproduces the dense causal logits."""
     import jax

@@ -103,3 +103,131 @@ def test_hf_path_like_accepted(tmp_path):
         atol=2e-4,
         rtol=2e-4,
     )
+
+def _tiny_llama(seed=0, kv_heads=2, tie=False):
+    import torch
+
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(seed)
+    cfg = LlamaConfig(
+        vocab_size=96,
+        hidden_size=48,
+        intermediate_size=80,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=kv_heads,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=tie,
+        attention_dropout=0.0,
+    )
+    return LlamaForCausalLM(cfg)
+
+
+def test_hf_llama_logits_match():
+    """Random-init HF Llama (GQA, RMSNorm, SwiGLU, RoPE, untied head) ->
+    converted pytree: logits match the torch forward to fp32 tolerance."""
+    from ray_lightning_tpu.models.hf_import import load_hf_llama
+
+    model = _tiny_llama()
+    params, cfg = load_hf_llama(model, attn_impl="reference")
+    assert cfg.norm_impl == "rmsnorm" and cfg.mlp_variant == "swiglu"
+    assert cfg.pos_embed == "rope" and cfg.kv_head == 2
+    assert not cfg.tie_word_embeddings and "lm_head" in params
+
+    toks = np.random.default_rng(1).integers(0, 96, (2, 17)).astype(np.int32)
+    ours = np.asarray(gpt_forward(params, toks, cfg))
+    theirs = hf_gpt2_logits(model, toks)  # family-agnostic logits oracle
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-4)
+
+
+def test_hf_llama_mha_and_tied_variant():
+    """num_key_value_heads == num_attention_heads takes the fused-wqkv
+    layout; tie_word_embeddings reuses wte (no lm_head leaf)."""
+    from ray_lightning_tpu.models.hf_import import load_hf_llama
+
+    model = _tiny_llama(kv_heads=4, tie=True)
+    params, cfg = load_hf_llama(model, attn_impl="reference")
+    assert cfg.tie_word_embeddings and "lm_head" not in params
+    assert "wqkv" in params["blocks"]
+    toks = np.random.default_rng(3).integers(0, 96, (1, 11)).astype(np.int32)
+    np.testing.assert_allclose(
+        np.asarray(gpt_forward(params, toks, cfg)),
+        hf_gpt2_logits(model, toks),
+        atol=3e-4,
+        rtol=3e-4,
+    )
+
+
+def test_hf_llama_generate_and_train():
+    """Imported Llama weights drive the KV-cached decode and a training
+    step (the full migration surface, not just the forward)."""
+    import jax
+
+    from ray_lightning_tpu.models import GPTLM
+    from ray_lightning_tpu.models.gpt import gpt_generate
+    from ray_lightning_tpu.models.hf_import import load_hf_llama
+
+    params, cfg = load_hf_llama(_tiny_llama(), attn_impl="reference")
+    prompt = np.asarray([[5, 17, 3]], np.int32)
+    out = gpt_generate(
+        jax.tree_util.tree_map(np.asarray, params),
+        cfg,
+        prompt,
+        max_new_tokens=4,
+        temperature=0.0,
+    )
+    assert out.shape == (1, 7)
+    # Greedy decode must agree with argmax over the parallel forward at the
+    # first generated position.
+    logits = np.asarray(gpt_forward(params, prompt, cfg))
+    assert int(out[0, 3]) == int(logits[0, -1].argmax())
+
+    module = GPTLM(config=cfg, batch_size=2, n_train=16, lr=1e-4)
+    toks = np.random.default_rng(5).integers(0, 96, (2, 17)).astype(np.int32)
+    import jax.numpy as jnp
+
+    loss, logs = module.training_step(
+        jax.tree_util.tree_map(jnp.asarray, params),
+        (jnp.asarray(toks),),
+        jax.random.PRNGKey(0),
+    )
+    assert np.isfinite(float(loss))
+
+
+def test_hf_numerics_fields_locked():
+    """Fields that change the checkpoint's numerics/layout (norm flavor,
+    MLP flavor, head tying) are locked on BOTH loaders."""
+    from ray_lightning_tpu.models.hf_import import load_hf_llama
+
+    for bad in (
+        {"norm_impl": "rmsnorm"},
+        {"mlp_variant": "swiglu"},
+        {"tie_word_embeddings": False},
+    ):
+        with pytest.raises(ValueError, match="cannot be overridden"):
+            load_hf_gpt2(_tiny_hf_model(), **bad)
+    with pytest.raises(ValueError, match="cannot be overridden"):
+        load_hf_llama(_tiny_llama(), norm_impl="layernorm")
+
+
+def test_hf_llama_bare_model_fails_fast():
+    """An untied checkpoint without lm_head (bare LlamaModel) is rejected
+    with guidance instead of a KeyError deep in conversion."""
+    import torch
+    from transformers import LlamaConfig, LlamaModel
+
+    from ray_lightning_tpu.models.hf_import import load_hf_llama
+
+    torch.manual_seed(0)
+    bare = LlamaModel(
+        LlamaConfig(
+            vocab_size=48, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=2, max_position_embeddings=32,
+        )
+    )
+    with pytest.raises(ValueError, match="LlamaForCausalLM"):
+        load_hf_llama(bare)
