@@ -72,7 +72,8 @@ class GPTConfig:
     seq_impl: str = "ring"
     init_std: float = 0.02
     # Llama-family knobs: "gelu" (GPT-2 MLP) or "swiglu" (gate/up SiLU,
-    # bias-free style — ``wi`` packs [gate|up] as (D, 2*ff_dim));
+    # bias-free style — ``wi`` stacks gate/up as (D, 2, ff_dim) so tensor
+    # parallelism on the trailing axis keeps both shards co-located);
     # "layernorm" or "rmsnorm" (rmsnorm ignores the bias leaves);
     # untied heads add an ``lm_head`` (V, D) parameter.
     mlp_variant: str = "gelu"
@@ -199,13 +200,22 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
             "wo2": norm(k_moe[2], (L, E, F, D), res_std),
             "bo2": jnp.zeros((L, E, D)),
         }
-    else:
-        # swiglu packs [gate|up] into one (D, 2F) leaf so the block tree
-        # keeps the same leaf names (sharding rules unchanged).
-        fin = 2 * F if cfg.mlp_variant == "swiglu" else F
+    elif cfg.mlp_variant == "swiglu":
+        # Megatron SwiGLU packing: gate/up stack on their OWN axis (D, 2,
+        # F) with tensor parallelism on the trailing F — each model rank
+        # holds matching gate/up shards, so silu(gate)*up is local (a
+        # (D, 2F) concat sharded on its last axis would put gate and up
+        # on different ranks and reshard activations every layer).
         mlp = {
-            "wi": norm(keys[4], (L, D, fin), std),
-            "bi": jnp.zeros((L, fin)),
+            "wi": norm(keys[4], (L, D, 2, F), std),
+            "bi": jnp.zeros((L, 2, F)),
+            "wo2": norm(keys[5], (L, F, D), res_std),
+            "bo2": jnp.zeros((L, D)),
+        }
+    else:
+        mlp = {
+            "wi": norm(keys[4], (L, D, F), std),
+            "bi": jnp.zeros((L, F)),
             "wo2": norm(keys[5], (L, F, D), res_std),
             "bo2": jnp.zeros((L, D)),
         }
@@ -264,6 +274,13 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
             "bi": ("layers", "expert", "mlp"),
             "wo2": ("layers", "expert", "mlp", "embed"),
             "bo2": ("layers", "expert", None),
+        }
+    elif cfg.mlp_variant == "swiglu":
+        mlp = {
+            "wi": ("layers", "embed", None, "mlp"),
+            "bi": ("layers", None, "mlp"),
+            "wo2": ("layers", "mlp", "embed"),
+            "bo2": ("layers", None),
         }
     else:
         mlp = {
@@ -366,15 +383,18 @@ def _dense_mlp(
     m: jax.Array, lp: Dict[str, jax.Array], cfg: GPTConfig, cdt: Any
 ) -> jax.Array:
     """The dense (non-MoE) feed-forward on normed input (..., D): GPT-2
-    gelu or Llama-style SwiGLU ([gate|up] packed in ``wi``). One
-    definition serves the training forward and the KV-cached decode."""
-    z = jnp.einsum("...d,df->...f", m, lp["wi"].astype(cdt)) + lp[
-        "bi"
-    ].astype(cdt)
+    gelu or Llama-style SwiGLU (gate/up stacked in ``wi`` (D, 2, F) so
+    tensor parallelism on F keeps both shards co-located). One definition
+    serves the training forward and the KV-cached decode."""
     if cfg.mlp_variant == "swiglu":
-        gate, up = jnp.split(z, 2, axis=-1)
-        h = jax.nn.silu(gate) * up
+        z = jnp.einsum("...d,dcf->...cf", m, lp["wi"].astype(cdt)) + lp[
+            "bi"
+        ].astype(cdt)
+        h = jax.nn.silu(z[..., 0, :]) * z[..., 1, :]
     else:
+        z = jnp.einsum("...d,df->...f", m, lp["wi"].astype(cdt)) + lp[
+            "bi"
+        ].astype(cdt)
         h = jax.nn.gelu(z)
     return jnp.einsum("...f,fd->...d", h, lp["wo2"].astype(cdt)) + lp[
         "bo2"

@@ -18,6 +18,27 @@ from typing import Any, Dict, Tuple
 import numpy as np
 
 
+def _check_overrides(arch: Dict[str, Any], overrides: Dict[str, Any]) -> None:
+    """Reject overrides of checkpoint-defined fields. Single source for
+    every family loader: shape fields (whatever ``arch`` pins) plus the
+    structure/numerics fields that would change the param layout or the
+    math the checkpoint was trained with."""
+    locked = set(arch) | {
+        "n_kv_head",
+        "n_experts",
+        "norm_impl",
+        "norm_eps",
+        "mlp_variant",
+        "tie_word_embeddings",
+    }
+    clash = set(overrides) & locked
+    if clash:
+        raise ValueError(
+            f"architecture fields {sorted(clash)} are defined by the HF "
+            "checkpoint and cannot be overridden"
+        )
+
+
 def load_hf_gpt2(model_or_path: Any, **cfg_overrides: Any):
     """HF GPT-2 -> (params pytree, GPTConfig).
 
@@ -89,23 +110,7 @@ def load_hf_gpt2(model_or_path: Any, **cfg_overrides: Any):
         max_seq=hf_cfg.n_positions,
         pos_embed="learned",
     )
-    # Shape fields come from the checkpoint; structure fields (GQA, MoE,
-    # norm/MLP flavor, head tying) would change the param layout or the
-    # numerics the converted tree was trained with.
-    locked = set(arch) | {
-        "n_kv_head",
-        "n_experts",
-        "norm_impl",
-        "norm_eps",
-        "mlp_variant",
-        "tie_word_embeddings",
-    }
-    clash = set(cfg_overrides) & locked
-    if clash:
-        raise ValueError(
-            f"architecture fields {sorted(clash)} are defined by the HF "
-            "checkpoint and cannot be overridden"
-        )
+    _check_overrides(arch, cfg_overrides)
     cfg = GPTConfig(**arch, **cfg_overrides)
 
     def stack(name: str, reshape=None) -> np.ndarray:
@@ -199,13 +204,7 @@ def load_hf_llama(model_or_path: Any, **cfg_overrides: Any):
     )
     if Hkv != H:
         arch["n_kv_head"] = Hkv
-    locked = set(arch) | {"n_kv_head", "n_experts"}
-    clash = set(cfg_overrides) & locked
-    if clash:
-        raise ValueError(
-            f"architecture fields {sorted(clash)} are defined by the HF "
-            "checkpoint and cannot be overridden"
-        )
+    _check_overrides(arch, cfg_overrides)
     cfg = GPTConfig(**arch, **cfg_overrides)
 
     def lin(name: str, i: int) -> np.ndarray:
@@ -263,14 +262,15 @@ def load_hf_llama(model_or_path: Any, **cfg_overrides: Any):
                 lambda i: t(f"layers.{i}.post_attention_layernorm.weight")
             ),
             "ln2_b": zeros((L, D), np.float32),
-            # SwiGLU packing: wi[:, :F] = gate, wi[:, F:] = up (the order
-            # _dense_mlp's split consumes).
+            # SwiGLU packing: gate/up stacked on their own axis (D, 2, F)
+            # — wi[:, 0] = gate, wi[:, 1] = up, matching _dense_mlp and
+            # keeping tensor-parallel shards of both co-located.
             "wi": stack(
-                lambda i: np.concatenate(
+                lambda i: np.stack(
                     [lin("mlp.gate_proj", i), lin("mlp.up_proj", i)], axis=1
                 )
             ),
-            "bi": zeros((L, 2 * F), np.float32),
+            "bi": zeros((L, 2, F), np.float32),
             "wo2": stack(lambda i: lin("mlp.down_proj", i)),
             "bo2": zeros((L, D), np.float32),
         },

@@ -78,9 +78,14 @@ def test_bench_smoke_cpu():
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into
     # the artifact (VERDICT r4 weak #3): a captured bench run's stderr
-    # carries no tracebacks.
+    # carries no tracebacks. On failure, show the text AROUND the first
+    # marker (not the stderr tail, which is usually unrelated stats noise).
     for marker in ("Traceback", "Exception ignored", "SystemExit"):
-        assert marker not in proc.stderr, proc.stderr[-3000:]
+        idx = proc.stderr.find(marker)
+        assert idx < 0, (
+            f"{marker!r} in bench stderr:\n"
+            f"{proc.stderr[max(0, idx - 500):idx + 1500]}"
+        )
 
 
 @pytest.mark.slow

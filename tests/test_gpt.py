@@ -135,7 +135,8 @@ def test_llama_variant_forward_and_sharding():
     )
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     assert "lm_head" in params
-    assert params["blocks"]["wi"].shape == (2, 32, 96)  # [gate|up] packed
+    # gate/up stacked (D, 2, F): tp shards of both halves co-locate.
+    assert params["blocks"]["wi"].shape == (2, 32, 2, 48)
     toks = np.asarray(
         jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
     )
