@@ -443,13 +443,17 @@ def _project_qkv(
     cfg: GPTConfig,
     cdt: Any,
     rope_tables: Optional[Tuple[jax.Array, jax.Array]] = None,
+    repeat_kv: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """(B, S, D) -> q, k, v each (B, S, H, hd).
+    """(B, S, D) -> q (B, S, H, hd) and k/v (B, S, H or Hkv, hd).
 
     Fused MHA projection, or separate q / grouped-kv projections (GQA) with
     kv heads repeated up to H — compute matches MHA, while params and the
     decode cache stay Hkv-sized. RoPE (when configured) rotates q/k here,
     BEFORE the kv repeat, so the rotation runs at Hkv width.
+    ``repeat_kv=False`` returns k/v at their native Hkv width (what the
+    decode cache stores — the prefill path repeats locally for attention
+    but caches the grouped heads).
     """
     if cfg.kv_head == cfg.n_head:
         qkv = (
@@ -470,7 +474,7 @@ def _project_qkv(
     if rope_tables is not None:
         q = _rope(q, rope_tables)
         k = _rope(k, rope_tables)
-    if cfg.kv_head != cfg.n_head:
+    if repeat_kv and cfg.kv_head != cfg.n_head:
         rep = cfg.n_head // cfg.kv_head
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
@@ -894,10 +898,11 @@ def gpt_generate(
 ) -> jax.Array:
     """Autoregressive decode with a KV cache — TPU-native shapes.
 
-    prompt (B, P) int32 -> (B, P + max_new_tokens). Everything is static:
-    the cache is a fixed (L, B, S, H, hd) buffer, the position loop is one
-    ``lax.scan`` (prompt teacher-forcing and generation share it), and each
-    step's attention masks the cache by ``position <= t``. Greedy when
+    prompt (B, P) int32 -> (B, P + max_new_tokens). Two phases, both with
+    static shapes: a PREFILL (one parallel forward over the prompt fills
+    the fixed (L, B, S, Hkv, hd) cache and samples the first new token),
+    then a ``lax.scan`` over only the generated positions, each step's
+    attention masking the cache by ``position <= t``. Greedy when
     ``temperature == 0``; otherwise softmax sampling with optional top-k /
     nucleus (top-p) filtering (:func:`sample_logits`).
 
@@ -921,6 +926,8 @@ def gpt_generate(
     L, H, hd = cfg.n_layer, cfg.n_head, cfg.head_dim
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if int(max_new_tokens) == 0:
+        return jnp.asarray(prompt)
     # Fitted params arrive as host numpy (gather_state); device-ify so
     # traced indexing works.
     params = jax.tree_util.tree_map(jnp.asarray, params)
@@ -931,9 +938,79 @@ def gpt_generate(
     # (HBM traffic per token shrinks by H/Hkv).
     k_cache = jnp.zeros((L, B, total, Hkv, hd), cdt)
     v_cache = jnp.zeros((L, B, total, Hkv, hd), cdt)
-    # Ring buffer of emitted tokens; prompt positions stay teacher-forced.
+    # Emitted tokens; positions past the prompt fill as they are sampled.
     toks = jnp.concatenate(
         [prompt, jnp.zeros((B, int(max_new_tokens)), prompt.dtype)], axis=1
+    )
+
+    # ---- Prefill: ONE parallel forward over the prompt fills the KV
+    # cache for positions [0, P) and yields the logits that choose the
+    # first generated token — the MXU-friendly split (the per-position
+    # scan below would instead run P sequential single-token matmuls,
+    # leaving the matrix units near-idle and paying P dispatches).
+    from ray_lightning_tpu.ops import attention_reference, flash_attention
+
+    attn_fn = (
+        flash_attention if cfg.attn_impl == "flash" else attention_reference
+    )
+    pf_tables = (
+        _rope_tables(jnp.arange(P), cfg.rope_theta, hd)
+        if cfg.pos_embed == "rope"
+        else None
+    )
+    x0 = params["wte"][prompt]
+    if cfg.pos_embed == "learned":
+        x0 = x0 + params["wpe"][:P]
+    x0 = x0.astype(cdt)
+
+    def prefill_block(h, lp):
+        a = norm_fn(h, lp["ln1_g"], lp["ln1_b"])
+        q, k_kv, v_kv = _project_qkv(
+            a, lp, cfg, cdt, pf_tables, repeat_kv=False
+        )
+        if Hkv != H:
+            k_att = jnp.repeat(k_kv, rep, axis=2)
+            v_att = jnp.repeat(v_kv, rep, axis=2)
+        else:
+            k_att, v_att = k_kv, v_kv
+        o = attn_fn(
+            q, k_att, v_att, causal=True, window=cfg.attn_window,
+            sinks=cfg.attn_sinks,
+        )
+        h = h + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cdt)) + lp[
+            "bo"
+        ].astype(cdt)
+        m = norm_fn(h, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts > 0:
+            from ray_lightning_tpu.parallel.moe import moe_ffn
+
+            m_out, _ = moe_ffn(
+                _moe_layer_params(lp),
+                m,
+                capacity_factor=float(cfg.n_experts),  # never drop (see above)
+                compute_dtype=cdt,
+                top_k=cfg.moe_top_k,
+            )
+        else:
+            m_out = _dense_mlp(m, lp, cfg, cdt)
+        return h + m_out, (k_kv.astype(cdt), v_kv.astype(cdt))
+
+    h_pf, (pf_k, pf_v) = jax.lax.scan(prefill_block, x0, params["blocks"])
+    k_cache = k_cache.at[:, :, :P].set(pf_k)
+    v_cache = v_cache.at[:, :, :P].set(pf_v)
+    h_last = norm_fn(
+        h_pf[:, P - 1 : P], params["lnf_g"], params["lnf_b"]
+    )[:, 0]
+    rng, sub = jax.random.split(rng)
+    first_new = sample_logits(
+        sub,
+        _lm_head(h_last, _head_weight(params, cfg)),
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p,
+    ).astype(toks.dtype)
+    toks = jax.lax.dynamic_update_slice_in_dim(
+        toks, first_new[:, None], P, axis=1
     )
 
     def one_position(carry, t):
@@ -1036,21 +1113,21 @@ def gpt_generate(
         nxt = sample_logits(
             sub, logits, temperature=temperature, top_k=top_k, top_p=top_p
         ).astype(toks.dtype)
-        # Only write past the prompt: prompt positions stay teacher-forced.
-        write_pos = jnp.minimum(t + 1, total - 1)
-        keep_prompt = (t + 1) < P
-        cur_next = jax.lax.dynamic_slice_in_dim(toks, write_pos, 1, axis=1)[:, 0]
-        chosen = jnp.where(keep_prompt, cur_next, nxt)
+        # The scan runs t = P .. total-2 (prefill handled the prompt), so
+        # t+1 is always a generated position.
         toks = jax.lax.dynamic_update_slice_in_dim(
-            toks, chosen[:, None], write_pos, axis=1
+            toks, nxt[:, None], t + 1, axis=1
         )
         return (toks, k_cache, v_cache, rng), None
 
+    # Decode scan covers only the GENERATED region: position t computes
+    # its k/v (the prompt's live in the cache from prefill) and samples
+    # t+1. The first generated token came from the prefill logits.
     (toks, _, _, _), _ = jax.lax.scan(
         one_position,
         (toks, k_cache, v_cache, rng),
-        jnp.arange(total - 1),
-        length=total - 1,
+        P + jnp.arange(total - 1 - P),
+        length=total - 1 - P,
     )
     return toks
 

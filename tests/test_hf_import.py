@@ -231,3 +231,39 @@ def test_hf_llama_bare_model_fails_fast():
     )
     with pytest.raises(ValueError, match="LlamaForCausalLM"):
         load_hf_llama(bare)
+
+
+def test_hf_llama_long_prompt_prefill_parity():
+    """The prefill split (parallel prompt forward + decode-only scan) must
+    be invisible: greedy decode from a LONG prompt matches transformers
+    token-for-token, and max_new_tokens=0 returns the prompt unchanged."""
+    import jax
+    import torch
+
+    from ray_lightning_tpu.models.gpt import gpt_generate
+    from ray_lightning_tpu.models.hf_import import load_hf_llama
+
+    model = _tiny_llama(seed=11)
+    params, cfg = load_hf_llama(model, attn_impl="reference")
+    prompt = np.random.default_rng(9).integers(0, 96, (2, 23)).astype(np.int32)
+    hf_out = (
+        model.generate(
+            torch.from_numpy(prompt.astype(np.int64)),
+            max_new_tokens=6,
+            do_sample=False,
+        )
+        .numpy()
+    )
+    ours = np.asarray(
+        gpt_generate(
+            jax.tree_util.tree_map(np.asarray, params), cfg, prompt,
+            max_new_tokens=6, temperature=0.0,
+        )
+    )
+    np.testing.assert_array_equal(ours, hf_out)
+
+    unchanged = gpt_generate(
+        jax.tree_util.tree_map(np.asarray, params), cfg, prompt,
+        max_new_tokens=0,
+    )
+    np.testing.assert_array_equal(np.asarray(unchanged), prompt)

@@ -156,6 +156,31 @@ def test_moe_topk_routing_general():
     )
 
 
+def test_moe_decode_matches_full_forward():
+    """Greedy KV-cached decode of a MoE config (prefill + per-position
+    dispatch with never-drop capacity) agrees with argmax over the full
+    forward at every generated position — the silent-divergence guard for
+    the decode path's MoE branch."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt_generate
+
+    params = init_gpt_params(jax.random.PRNGKey(0), MOE_CFG)
+    prompt = np.asarray([[3, 1, 4, 1, 5, 9, 2]], np.int32)
+    out = np.asarray(
+        gpt_generate(
+            params, MOE_CFG, jnp.asarray(prompt), max_new_tokens=8
+        )
+    )
+    assert out.shape == (1, 15)
+    for p in range(6, 14):
+        logits = gpt_forward(params, out[:, : p + 1], MOE_CFG)
+        np.testing.assert_array_equal(
+            np.argmax(np.asarray(logits[:, -1]), -1), out[:, p + 1]
+        )
+
+
 def test_moe_a2a_matches_oracle_values_and_grads():
     """moe_ffn_ep (explicit all-to-all over ep) == moe_ffn exactly in the
     drop-free regime: outputs, grads, and aux stats, across 1D/2D/3D
