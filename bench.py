@@ -274,8 +274,16 @@ def bench_gpt(
         # (batch, loss_chunk, fold): the r3 on-chip probe showed ~linear
         # batch scaling to 32 (PERF.md) but the dense loss OOM-bounded
         # the config at 16; chunked CE lifts that. remat off: pure
-        # recompute overhead at this size.
-        ladder = ladder or [(32, 128, 4), (32, 128, 1), (16, 128, 1), (16, 0, 1)]
+        # recompute overhead at this size. The batch-48 top rung is the
+        # next MFU step the chunked loss should afford; an OOM falls one
+        # rung with the reason recorded.
+        ladder = ladder or [
+            (48, 128, 4),
+            (32, 128, 4),
+            (32, 128, 1),
+            (16, 128, 1),
+            (16, 0, 1),
+        ]
         make_cfg = lambda chunk: GPTConfig.gpt2_small(  # noqa: E731
             max_seq=seq, remat=False, loss_chunk=chunk
         )
@@ -492,6 +500,27 @@ def main() -> None:
     env = _env_probe(use_tpu)
     env["use_tpu"] = use_tpu
     env["num_workers"] = num_workers
+    # Provenance: which code produced this artifact. Watcher runs execute
+    # from a bare `git archive` snapshot (no .git), so absence is normal
+    # there — the watcher logs the archived HEAD instead.
+    try:
+        import subprocess
+
+        env["git_rev"] = (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True,
+                text=True,
+                timeout=10,
+                # Resolve from THIS file's repo, not the caller's cwd — a
+                # cwd inside some other checkout must not stamp that
+                # repo's HEAD into the artifact.
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip()
+            or "unknown"
+        )
+    except Exception:  # noqa: BLE001
+        env["git_rev"] = "unknown"
     if probe_error is not None:
         env["tpu_probe_failed"] = True
         env["probe_error"] = probe_error[:500]

@@ -41,6 +41,24 @@ def _on_sigterm(*_):
     sys.exit(0)
 
 
+def _install_unraisable_filter():
+    """Silence the one benign unraisable: our SIGTERM SystemExit landing
+    inside a finalizer/__del__ (e.g. a manager proxy's Finalize _decref
+    mid-connection), where Python can only report-and-swallow it. The
+    process still exits promptly — kill() sends the "shutdown" message
+    before SIGTERM, so the actor loop breaks on its next recv (with
+    SIGKILL escalation as the backstop). Everything else chains to the
+    default hook."""
+    default = sys.unraisablehook
+
+    def hook(args):
+        if args.exc_type is SystemExit and _EXITING:
+            return
+        default(args)
+
+    sys.unraisablehook = hook
+
+
 def _worker_main(conn):
     """Run the actor loop. ``conn`` is an authenticated duplex Connection."""
     import signal
@@ -49,6 +67,7 @@ def _worker_main(conn):
     # process's own fabric session shuts down any nested actors it spawned
     # (a trial's training workers) instead of orphaning them.
     signal.signal(signal.SIGTERM, _on_sigterm)
+    _install_unraisable_filter()
 
     # Honor an explicit JAX platform choice even when a PJRT plugin loaded
     # at interpreter boot (sitecustomize) already forced its own config.

@@ -52,7 +52,7 @@ def main() -> None:
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument(
         "--configs",
-        default="16:0,16:128,32:128,48:128,32:128:4",
+        default="16:0,16:128,32:128,48:128,32:128:4,48:128:4",
         help="comma-separated batch:loss_chunk[:fold] specs",
     )
     args = p.parse_args()

@@ -32,7 +32,11 @@ while true; do
     else
       touch /tmp/BENCH_RUNNING
       rm -rf /tmp/bench_snap2 && mkdir -p /tmp/bench_snap2
-      git -C /root/repo archive HEAD | tar -x -C /tmp/bench_snap2
+      # Resolve the rev ONCE and archive exactly it, so the provenance
+      # line cannot drift from the archived tree if HEAD moves between.
+      snap_rev=$(git -C /root/repo rev-parse --short HEAD)
+      git -C /root/repo archive "$snap_rev" | tar -x -C /tmp/bench_snap2
+      echo "$(date -u +%H:%M:%S) snapshot at $snap_rev" >> /tmp/tpu_watch.log
       if [ "$have_headline" -eq 0 ]; then
         echo "$(date -u +%H:%M:%S) launching HEADLINE bench" >> /tmp/tpu_watch.log
         ( cd /tmp/bench_snap2 && \
