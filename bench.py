@@ -508,7 +508,9 @@ def main() -> None:
 
         env["git_rev"] = (
             subprocess.run(
-                ["git", "rev-parse", "--short", "HEAD"],
+                # --dirty: an artifact from uncommitted code must not
+                # claim a clean commit produced it.
+                ["git", "describe", "--always", "--dirty"],
                 capture_output=True,
                 text=True,
                 timeout=10,

@@ -82,7 +82,8 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     # Mixture-of-Experts: n_experts > 0 replaces every block's dense MLP
     # with a switch (top-1) MoE layer (parallel/moe.py); expert weights
-    # shard over the "ep" mesh axis under GSPMDStrategy.
+    # shard over the "ep" mesh axis under GSPMDStrategy. Experts follow
+    # ``mlp_variant`` — gelu, or SwiGLU for Mixtral-class configs.
     n_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
@@ -136,12 +137,6 @@ class GPTConfig:
                 f"unknown norm_impl {self.norm_impl!r}; use 'layernorm' or "
                 "'rmsnorm'"
             )
-        if self.mlp_variant == "swiglu" and self.n_experts > 0:
-            raise ValueError(
-                "mlp_variant='swiglu' applies to the dense MLP; MoE expert "
-                "FFNs are gelu (parallel/moe.py) — use n_experts=0 or "
-                "mlp_variant='gelu'"
-            )
 
     @staticmethod
     def llama(**overrides: Any) -> "GPTConfig":
@@ -193,10 +188,17 @@ def init_gpt_params(rng: jax.Array, cfg: GPTConfig) -> Dict[str, Any]:
     if cfg.n_experts > 0:
         E = cfg.n_experts
         k_moe = jax.random.split(keys[4], 3)
+        if cfg.mlp_variant == "swiglu":
+            # Mixtral-style experts: gate/up stacked (see _expert_ffn).
+            wi = norm(k_moe[1], (L, E, D, 2, F), std)
+            bi = jnp.zeros((L, E, 2, F))
+        else:
+            wi = norm(k_moe[1], (L, E, D, F), std)
+            bi = jnp.zeros((L, E, F))
         mlp = {
             "router": norm(k_moe[0], (L, D, E), std),
-            "wi": norm(k_moe[1], (L, E, D, F), std),
-            "bi": jnp.zeros((L, E, F)),
+            "wi": wi,
+            "bi": bi,
             "wo2": norm(k_moe[2], (L, E, F, D), res_std),
             "bo2": jnp.zeros((L, E, D)),
         }
@@ -268,10 +270,16 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
     ``parallel.logical`` rules (embed->fsdp, heads/mlp/vocab->model,
     expert->ep)."""
     if cfg.n_experts > 0:
+        if cfg.mlp_variant == "swiglu":
+            wi_axes = ("layers", "expert", "embed", None, "mlp")
+            bi_axes = ("layers", "expert", None, "mlp")
+        else:
+            wi_axes = ("layers", "expert", "embed", "mlp")
+            bi_axes = ("layers", "expert", "mlp")
         mlp = {
             "router": ("layers", "embed", None),
-            "wi": ("layers", "expert", "embed", "mlp"),
-            "bi": ("layers", "expert", "mlp"),
+            "wi": wi_axes,
+            "bi": bi_axes,
             "wo2": ("layers", "expert", "mlp", "embed"),
             "bo2": ("layers", "expert", None),
         }

@@ -37,30 +37,35 @@ def init_moe_params(
     d_ff: int,
     std: float = 0.02,
     res_std: float = 0.02,
+    mlp_variant: str = "gelu",
 ) -> Dict[str, jax.Array]:
+    if mlp_variant not in ("gelu", "swiglu"):
+        raise ValueError(
+            f"unknown mlp_variant {mlp_variant!r}; use 'gelu' or 'swiglu'"
+        )
     k1, k2, k3 = jax.random.split(rng, 3)
+    if mlp_variant == "swiglu":
+        # Mixtral-style experts: gate/up stacked (E, D, 2, F) — same
+        # co-sharded packing as the dense decoder's SwiGLU.
+        wi = (
+            jax.random.normal(k2, (n_experts, d_model, 2, d_ff)) * std
+        ).astype(jnp.float32)
+        bi = jnp.zeros((n_experts, 2, d_ff))
+    else:
+        wi = (
+            jax.random.normal(k2, (n_experts, d_model, d_ff)) * std
+        ).astype(jnp.float32)
+        bi = jnp.zeros((n_experts, d_ff))
     return {
         "router": (jax.random.normal(k1, (d_model, n_experts)) * std).astype(
             jnp.float32
         ),
-        "wi": (
-            jax.random.normal(k2, (n_experts, d_model, d_ff)) * std
-        ).astype(jnp.float32),
-        "bi": jnp.zeros((n_experts, d_ff)),
+        "wi": wi,
+        "bi": bi,
         "wo": (
             jax.random.normal(k3, (n_experts, d_ff, d_model)) * res_std
         ).astype(jnp.float32),
         "bo": jnp.zeros((n_experts, d_model)),
-    }
-
-
-def moe_logical_axes() -> Dict[str, Tuple]:
-    return {
-        "router": ("embed", None),
-        "wi": ("expert", "embed", "mlp"),
-        "bi": ("expert", "mlp"),
-        "wo": ("expert", "mlp", "embed"),
-        "bo": ("expert", None),
     }
 
 
@@ -103,6 +108,30 @@ def _route_and_pack(
     return probs, e_flat, e_s, t_s, g_s, keep, pos_c
 
 
+def _expert_ffn(
+    expert_in: jax.Array, params: Dict[str, jax.Array], cdt: Any
+) -> jax.Array:
+    """(E, C, D) expert batches -> (E, C, D). Gelu MLP, or SwiGLU experts
+    (Mixtral-style) when ``wi`` carries the stacked gate/up axis
+    (E, D, 2, F) — the same (co-sharded) packing the dense decoder uses.
+    One definition serves the in-place, expert-parallel, and dense-oracle
+    dispatchers."""
+    wi = params["wi"]
+    if wi.ndim == 4:  # (E, D, 2, F): SwiGLU experts
+        z = jnp.einsum(
+            "ecd,edgf->ecgf", expert_in, wi.astype(cdt)
+        ) + params["bi"][:, None].astype(cdt)
+        h = jax.nn.silu(z[..., 0, :]) * z[..., 1, :]
+    else:
+        h = jax.nn.gelu(
+            jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(cdt))
+            + params["bi"][:, None, :].astype(cdt)
+        )
+    return jnp.einsum(
+        "ecf,efd->ecd", h, params["wo"].astype(cdt)
+    ) + params["bo"][:, None, :].astype(cdt)
+
+
 def moe_ffn(
     params: Dict[str, jax.Array],
     x: jax.Array,
@@ -140,13 +169,7 @@ def moe_ffn(
     expert_in = (
         jnp.zeros((E, capacity, D), jnp.float32).at[e_s, pos_c].add(gathered)
     ).astype(cdt)
-    h = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", expert_in, params["wi"].astype(cdt))
-        + params["bi"][:, None, :].astype(cdt)
-    )
-    expert_out = jnp.einsum(
-        "ecf,efd->ecd", h, params["wo"].astype(cdt)
-    ) + params["bo"][:, None, :].astype(cdt)
+    expert_out = _expert_ffn(expert_in, params, cdt)
     contrib = (
         expert_out.astype(jnp.float32)[e_s, pos_c]
         * (g_s[:, None] * keep_f)
@@ -267,13 +290,9 @@ def moe_ffn_ep(
         expert_in = recv.transpose(1, 0, 2, 3).reshape(
             E_local, ep * c_src, D
         )
-        h = jax.nn.gelu(
-            jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(cdt))
-            + bi[:, None, :].astype(cdt)
+        expert_out = _expert_ffn(
+            expert_in, {"wi": wi, "bi": bi, "wo": wo, "bo": bo}, cdt
         )
-        expert_out = jnp.einsum(
-            "ecf,efd->ecd", h, wo.astype(cdt)
-        ) + bo[:, None, :].astype(cdt)
         # Ship contributions back to their source ranks (reverse a2a), still
         # cdt-wide — the fp32 upcast happens at the local combine:
         # (E_local, src*c, D) -> (src, E_local, c, D), send chunk src back
@@ -373,13 +392,7 @@ def moe_ffn_dense(
     expert_in = jnp.einsum(
         "tec,td->ecd", dispatch, tokens.astype(jnp.float32)
     ).astype(cdt)
-    h = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", expert_in, params["wi"].astype(cdt))
-        + params["bi"][:, None, :].astype(cdt)
-    )
-    expert_out = jnp.einsum(
-        "ecf,efd->ecd", h, params["wo"].astype(cdt)
-    ) + params["bo"][:, None, :].astype(cdt)
+    expert_out = _expert_ffn(expert_in, params, cdt)
     combine = dispatch * gate[:, None, None]
     out = jnp.einsum(
         "tec,ecd->td", combine, expert_out.astype(jnp.float32)
