@@ -35,6 +35,7 @@ import optax
 
 from ray_lightning_tpu.trainer.data import ArrayDataset, DataLoader, Dataset
 from ray_lightning_tpu.trainer.module import TPUModule
+from ray_lightning_tpu.utils.quantize import dequant, embed_rows
 
 
 @dataclass(frozen=True)
@@ -359,7 +360,7 @@ def _lm_head(h: jax.Array, wte: jax.Array) -> jax.Array:
     return jnp.einsum(
         "...d,vd->...v",
         h,
-        wte.astype(h.dtype),
+        dequant(wte, h.dtype),
         preferred_element_type=jnp.float32,
     )
 
@@ -395,16 +396,16 @@ def _dense_mlp(
     tensor parallelism on F keeps both shards co-located). One definition
     serves the training forward and the KV-cached decode."""
     if cfg.mlp_variant == "swiglu":
-        z = jnp.einsum("...d,dcf->...cf", m, lp["wi"].astype(cdt)) + lp[
+        z = jnp.einsum("...d,dcf->...cf", m, dequant(lp["wi"], cdt)) + lp[
             "bi"
         ].astype(cdt)
         h = jax.nn.silu(z[..., 0, :]) * z[..., 1, :]
     else:
-        z = jnp.einsum("...d,df->...f", m, lp["wi"].astype(cdt)) + lp[
+        z = jnp.einsum("...d,df->...f", m, dequant(lp["wi"], cdt)) + lp[
             "bi"
         ].astype(cdt)
         h = jax.nn.gelu(z)
-    return jnp.einsum("...f,fd->...d", h, lp["wo2"].astype(cdt)) + lp[
+    return jnp.einsum("...f,fd->...d", h, dequant(lp["wo2"], cdt)) + lp[
         "bo2"
     ].astype(cdt)
 
@@ -465,17 +466,17 @@ def _project_qkv(
     """
     if cfg.kv_head == cfg.n_head:
         qkv = (
-            jnp.einsum("bsd,dthk->bsthk", a, lp["wqkv"].astype(cdt))
+            jnp.einsum("bsd,dthk->bsthk", a, dequant(lp["wqkv"], cdt))
             + lp["bqkv"].astype(cdt)
         )
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     else:
         q = (
-            jnp.einsum("bsd,dhk->bshk", a, lp["wq"].astype(cdt))
+            jnp.einsum("bsd,dhk->bshk", a, dequant(lp["wq"], cdt))
             + lp["bq"].astype(cdt)
         )
         kv = (
-            jnp.einsum("bsd,dthk->bsthk", a, lp["wkv"].astype(cdt))
+            jnp.einsum("bsd,dthk->bsthk", a, dequant(lp["wkv"], cdt))
             + lp["bkv"].astype(cdt)
         )
         k, v = kv[:, :, 0], kv[:, :, 1]
@@ -593,16 +594,29 @@ def gpt_forward(
         # "involuntary full rematerialization"); from a replicated table
         # it's a clean shard-local gather. The all-gather happens either
         # way — this just routes it through the cheap path.
-        wte_rep = jax.lax.with_sharding_constraint(
-            params["wte"], NamedSharding(mesh, P(None, None))
-        )
-        x = wte_rep[toks_z]
+        # Replicate the table at its STORED width (int8 when quantized —
+        # dequantizing first would 4x the gather/replication bytes), then
+        # dequantize only the gathered rows.
+        from ray_lightning_tpu.utils.quantize import is_quantized
+
+        wte_node = params["wte"]
+        if is_quantized(wte_node):
+            rep = NamedSharding(mesh, P(None, None))
+            wte_rep = {
+                "q": jax.lax.with_sharding_constraint(wte_node["q"], rep),
+                "s": jax.lax.with_sharding_constraint(wte_node["s"], rep),
+            }
+        else:
+            wte_rep = jax.lax.with_sharding_constraint(
+                wte_node, NamedSharding(mesh, P(None, None))
+            )
+        x = embed_rows(wte_rep, toks_z)
         if cfg.pos_embed == "learned":
             x = x + params["wpe"][zz_perm]
         x = _seq_sharded(x)
         positions = zz_perm  # true token positions in the permuted layout
     else:
-        x = params["wte"][tokens]
+        x = embed_rows(params["wte"], tokens)
         if cfg.pos_embed == "learned":
             x = x + params["wpe"][:S]
         positions = jnp.arange(S)
@@ -701,7 +715,7 @@ def gpt_forward(
         a = norm_fn(h, lp["ln1_g"], lp["ln1_b"])
         q, k, v = _project_qkv(a, lp, cfg, cdt, rope_tables)  # (B,S,H,hd)
         o = attend(q, k, v)
-        h = h + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cdt)) + lp[
+        h = h + jnp.einsum("bshk,hkd->bsd", o, dequant(lp["wo"], cdt)) + lp[
             "bo"
         ].astype(cdt)
         m_out, aux = mlp(h, lp)
@@ -804,10 +818,10 @@ def chunked_lm_loss(
         targets = jnp.pad(targets, ((0, 0), (0, pad)), constant_values=-1)
     xc = x.reshape(B, nc, chunk, D).swapaxes(0, 1)  # (nc, B, C, D)
     tc = targets.reshape(B, nc, chunk).swapaxes(0, 1)  # (nc, B, C)
-    # Hoist the (V, D) dtype cast out of the scan so the checkpointed
+    # Hoist the (V, D) cast/dequant out of the scan so the checkpointed
     # body doesn't re-convert the table on every backward recompute
-    # (_lm_head's astype is then a no-op).
-    wte_c = wte.astype(x.dtype)
+    # (_lm_head's dequant is then a no-op; also accepts a quantized head).
+    wte_c = dequant(wte, x.dtype)
 
     def body(carry, xs):
         ce_sum, n_correct = carry
@@ -966,7 +980,7 @@ def gpt_generate(
         if cfg.pos_embed == "rope"
         else None
     )
-    x0 = params["wte"][prompt]
+    x0 = embed_rows(params["wte"], prompt)
     if cfg.pos_embed == "learned":
         x0 = x0 + params["wpe"][:P]
     x0 = x0.astype(cdt)
@@ -985,7 +999,7 @@ def gpt_generate(
             q, k_att, v_att, causal=True, window=cfg.attn_window,
             sinks=cfg.attn_sinks,
         )
-        h = h + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(cdt)) + lp[
+        h = h + jnp.einsum("bshk,hkd->bsd", o, dequant(lp["wo"], cdt)) + lp[
             "bo"
         ].astype(cdt)
         m = norm_fn(h, lp["ln2_g"], lp["ln2_b"])
@@ -1024,7 +1038,7 @@ def gpt_generate(
     def one_position(carry, t):
         toks, k_cache, v_cache, rng = carry
         cur = jax.lax.dynamic_slice_in_dim(toks, t, 1, axis=1)[:, 0]  # (B,)
-        x = params["wte"][cur]
+        x = embed_rows(params["wte"], cur)
         if cfg.pos_embed == "learned":
             x = x + params["wpe"][t]
         x = x.astype(cdt)  # (B, D)
@@ -1039,17 +1053,17 @@ def gpt_generate(
             a = norm_fn(h[:, None], lp["ln1_g"], lp["ln1_b"])[:, 0]
             if Hkv == H:
                 qkv = (
-                    jnp.einsum("bd,dthk->bthk", a, lp["wqkv"].astype(cdt))
+                    jnp.einsum("bd,dthk->bthk", a, dequant(lp["wqkv"], cdt))
                     + lp["bqkv"].astype(cdt)
                 )
                 q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B,H,hd)
             else:
                 q = (
-                    jnp.einsum("bd,dhk->bhk", a, lp["wq"].astype(cdt))
+                    jnp.einsum("bd,dhk->bhk", a, dequant(lp["wq"], cdt))
                     + lp["bq"].astype(cdt)
                 )
                 kv = (
-                    jnp.einsum("bd,dthk->bthk", a, lp["wkv"].astype(cdt))
+                    jnp.einsum("bd,dthk->bthk", a, dequant(lp["wkv"], cdt))
                     + lp["bkv"].astype(cdt)
                 )
                 k_new, v_new = kv[:, 0], kv[:, 1]  # (B, Hkv, hd)
@@ -1083,7 +1097,7 @@ def gpt_generate(
             o = jnp.einsum(
                 "bgrs,bsgk->bgrk", p, vc_l.astype(jnp.float32)
             ).reshape(B, H, hd).astype(cdt)
-            h = h + jnp.einsum("bhk,hkd->bd", o, lp["wo"].astype(cdt)) + lp[
+            h = h + jnp.einsum("bhk,hkd->bd", o, dequant(lp["wo"], cdt)) + lp[
                 "bo"
             ].astype(cdt)
             m = norm_fn(h[:, None], lp["ln2_g"], lp["ln2_b"])

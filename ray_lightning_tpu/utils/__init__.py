@@ -12,9 +12,15 @@ from ray_lightning_tpu.utils.state_stream import (
     to_state_stream,
 )
 from ray_lightning_tpu.utils.rank_zero import rank_zero_info, rank_zero_only, rank_zero_warn
+from ray_lightning_tpu.utils.quantize import (
+    dequantize_params,
+    quantize_params_int8,
+)
 from ray_lightning_tpu.utils.unavailable import Unavailable
 
 __all__ = [
+    "quantize_params_int8",
+    "dequantize_params",
     "find_free_port",
     "reset_seed",
     "seed_everything",

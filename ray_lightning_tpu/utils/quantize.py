@@ -1,0 +1,105 @@
+"""Weight-only int8 quantization for inference.
+
+Decode on TPU is HBM-bandwidth-bound: every generated token re-reads the
+model's matmul weights (and the (V, D) head), so halving the bytes per
+weight roughly halves the per-token floor that bf16 sets. Weight-only
+int8 (symmetric, per-output-channel scales over the contraction axes)
+keeps activations and accumulation in the compute dtype — XLA fuses the
+``int8 -> f32 * scale`` dequant into the consuming matmul's operand read.
+
+``quantize_params_int8`` maps a GPT parameter pytree (models/gpt.py
+layout) to the same tree with the large matmul leaves replaced by
+``{"q": int8, "s": f32 broadcast-ready scales}`` nodes; norms, biases,
+positional tables, and MoE/router leaves stay fp32 (tiny, or
+accuracy-sensitive). The forward/decode paths consume either form via
+:func:`dequant` / :func:`embed_rows`, so one code path serves both —
+equality of the quantized path against dequantize-then-compute is
+asserted in tests/test_quantize.py.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and set(w) == {"q", "s"}
+
+
+def quantize_tensor(
+    w: jax.Array, reduce_axes: Tuple[int, ...]
+) -> Dict[str, jax.Array]:
+    """Symmetric int8 with fp32 scales shared over ``reduce_axes`` (the
+    contraction dims of the consuming matmul, i.e. per-output-channel)."""
+    w = jnp.asarray(w, jnp.float32)
+    s = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)  # all-zero channels: avoid 0/0
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def dequant(w: Any, dt: Any) -> jax.Array:
+    """Quantized node -> dense weights in ``dt``; plain arrays pass
+    through (the training path pays nothing). The multiply sits directly
+    before the consuming matmul so XLA folds it into the operand read."""
+    if is_quantized(w):
+        return (w["q"].astype(jnp.float32) * w["s"]).astype(dt)
+    return w.astype(dt)
+
+
+def embed_rows(table: Any, idx: jax.Array) -> jax.Array:
+    """Row gather from a (possibly quantized) (V, D) table: gather the
+    int8 rows and their scales, dequantize only what was read."""
+    if is_quantized(table):
+        return table["q"][idx].astype(jnp.float32) * table["s"][idx]
+    return table[idx]
+
+
+#: contraction (reduce) axes per QUANTIZED leaf of the stacked GPT tree;
+#: leaves absent from a model (GQA vs fused, tied vs untied) are skipped.
+_GPT_BLOCK_AXES: Dict[str, Tuple[int, ...]] = {
+    "wqkv": (1,),  # (L, D, 3, H, hd): contract D
+    "wq": (1,),  # (L, D, H, hd)
+    "wkv": (1,),  # (L, D, 2, Hkv, hd)
+    "wo": (1, 2),  # (L, H, hd, D): contract H, hd
+    "wi": (1,),  # (L, D, F) or (L, D, 2, F): contract D
+    "wo2": (1,),  # (L, F, D): contract F
+}
+
+
+def quantize_params_int8(params: Dict[str, Any]) -> Dict[str, Any]:
+    """GPT parameter tree -> same tree with the large matmul weights as
+    int8 nodes. MoE expert leaves (rank-4/5 ``wi``/``wo2`` with a leading
+    expert dim) are left fp32 — expert weights are read sparsely and the
+    router is accuracy-critical; quantize them separately if profiling
+    says otherwise."""
+    out: Dict[str, Any] = dict(params)
+    blocks = dict(params["blocks"])
+    moe = "router" in blocks  # MoE trees keep expert leaves fp32
+    for name, axes in _GPT_BLOCK_AXES.items():
+        if name not in blocks:
+            continue
+        if moe and name in ("wi", "wo2"):
+            continue
+        blocks[name] = quantize_tensor(blocks[name], axes)
+    out["blocks"] = blocks
+    out["wte"] = quantize_tensor(params["wte"], (1,))  # (V, D): contract D
+    if "lm_head" in params:
+        out["lm_head"] = quantize_tensor(params["lm_head"], (1,))
+    return out
+
+
+def dequantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse mapping to a plain fp32 tree (the reference-semantics
+    oracle: quantized-path outputs must equal running THIS tree)."""
+
+    def walk(node: Any) -> Any:
+        if is_quantized(node):
+            return node["q"].astype(jnp.float32) * node["s"]
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)
