@@ -35,7 +35,7 @@ import yaml
 
 _SUBCOMMANDS = (
     "fit", "validate", "test", "predict", "generate", "convert-hf",
-    "tokenize",
+    "tokenize", "serve",
 )
 
 
@@ -139,6 +139,7 @@ def _apply_dotted(
             continue
         if section not in (
             "model", "strategy", "trainer", "data", "generate", "tokenize",
+            "serve",
         ):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
@@ -153,7 +154,7 @@ def _apply_dotted(
     # Pass 2: typed field values.
     for section, field, raw in field_overrides:
         node = config[section]
-        if section in ("trainer", "generate", "tokenize"):  # plain dicts
+        if section in ("trainer", "generate", "tokenize", "serve"):  # plain dicts
             node[field] = yaml.safe_load(raw)
             continue
         init_args = node.setdefault("init_args", {})
@@ -364,6 +365,109 @@ def run_convert_hf(config: Dict[str, Any]) -> str:
     return out
 
 
+def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``serve``: spawn replica actors on the fabric and serve prompts.
+
+    Config section (``--serve.<key>`` or ``serve:`` in YAML):
+      ckpt_path (required): state-stream checkpoint (convert-hf native
+        form with an embedded gpt_config, or a trainer checkpoint) or a
+        sharded orbax dir (then ``config`` is required).
+      config: GPTConfig field dict (overrides/completes the stored one).
+      int8: quantize weights at load (weight-only int8 decode).
+      replicas, num_slots, max_seq, max_prefills_per_step: topology knobs.
+      prompts: path to a prompts file ("-" = stdin), one request per
+        line as comma/space-separated token ids.
+      max_new_tokens, temperature, top_k, top_p, seed, eos_token:
+        sampling defaults applied to every request.
+
+    All prompts are submitted up front (they overlap inside the engine —
+    that is the point), streamed to completion, and printed as
+    ``<request_id><TAB><prompt+generated ids csv>`` lines. One final JSON
+    line carries the per-replica stats-endpoint snapshots.
+    """
+    import json as _json
+
+    from ray_lightning_tpu import fabric
+    from ray_lightning_tpu.serve import start_replicas
+
+    serve_cfg = dict(config.pop("serve", None) or {})
+    ckpt_path = serve_cfg.pop("ckpt_path", None)
+    if ckpt_path is None:
+        raise ValueError("serve requires --serve.ckpt_path")
+    prompts_src = serve_cfg.pop("prompts", None)
+    if prompts_src is None:
+        raise ValueError(
+            "serve requires --serve.prompts (file of token-id lines, or -)"
+        )
+    sampling = {
+        "max_new_tokens": int(serve_cfg.pop("max_new_tokens", 32)),
+        "temperature": float(serve_cfg.pop("temperature", 0.0)),
+        "top_k": serve_cfg.pop("top_k", None),
+        "top_p": serve_cfg.pop("top_p", None),
+        "eos_token": serve_cfg.pop("eos_token", None),
+    }
+    seed = int(serve_cfg.pop("seed", 0))
+    replicas = int(serve_cfg.pop("replicas", 1))
+    replica_kwargs = {
+        "ckpt_path": ckpt_path,
+        "model_config": serve_cfg.pop("config", None),
+        "int8": bool(serve_cfg.pop("int8", False)),
+        "num_slots": int(serve_cfg.pop("num_slots", 4)),
+        "max_seq": serve_cfg.pop("max_seq", None),
+        "max_prefills_per_step": int(
+            serve_cfg.pop("max_prefills_per_step", 1)
+        ),
+    }
+    pb = serve_cfg.pop("prefill_buckets", None)
+    if pb is not None:
+        replica_kwargs["prefill_buckets"] = [int(b) for b in pb]
+    if serve_cfg:
+        raise ValueError(f"unknown serve options: {sorted(serve_cfg)}")
+
+    if prompts_src == "-":
+        lines = [ln.strip() for ln in sys.stdin]
+    else:
+        with open(prompts_src) as f:
+            lines = [ln.strip() for ln in f]
+    prompts = [
+        [int(t) for t in ln.replace(",", " ").split()] for ln in lines if ln
+    ]
+    if not prompts:
+        raise ValueError(f"no prompts in {prompts_src!r}")
+
+    if not fabric.is_initialized():
+        fabric.init()
+    # Replicas on a chipless fabric decode on CPU; pin the platform so the
+    # actor does not stall probing for devices it will not get.
+    env = (
+        {"JAX_PLATFORMS": "cpu"}
+        if fabric.cluster_resources().get("TPU", 0) < 1
+        else {}
+    )
+    client = start_replicas(replicas, env=env, **replica_kwargs)
+    try:
+        handles = [
+            client.submit(p, seed=seed + i, **sampling)
+            for i, p in enumerate(prompts)
+        ]
+        outputs = []
+        for p, h in zip(prompts, handles):
+            toks = list(client.stream_handle(h))
+            outputs.append(
+                {"request_id": h.request_id, "tokens": p + toks}
+            )
+            print(
+                h.request_id
+                + "\t"
+                + ",".join(str(t) for t in p + toks)
+            )
+        stats = client.stats()
+        print(_json.dumps({"serve_stats": stats}))
+        return {"outputs": outputs, "stats": stats}
+    finally:
+        client.shutdown()
+
+
 def run_tokenize(config: Dict[str, Any]) -> Dict[str, Any]:
     """``tokenize``: train (or load) a ByteBPETokenizer and optionally
     encode the corpus into a pretraining shard.
@@ -437,6 +541,8 @@ def main(argv: Optional[List[str]] = None) -> Any:
         return run_convert_hf(config)
     if subcommand == "generate":
         return run_generate(config)
+    if subcommand == "serve":
+        return run_serve(config)
     trainer, model, datamodule = build(config)
     fn = getattr(trainer, subcommand)
     if datamodule is not None:

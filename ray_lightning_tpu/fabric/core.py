@@ -471,8 +471,17 @@ def placement_group(
 
 
 def remove_placement_group(pg: Any) -> None:
-    """Release a placement group's reservations. Kill actors scheduled into
-    its bundles first — removal does not terminate them."""
+    """Release a placement group's reservations, killing any actors still
+    scheduled into its bundles first (Ray semantics: removing a group
+    terminates its occupants).
+
+    Ordering matters: releasing node capacity while occupants still hold
+    bundle reservations would let a new actor double-book the node — the
+    freed CPUs/chips would be promised twice until the occupant died. So
+    the group is tombstoned first (new spawns into it fail fast), the
+    occupants are killed (their resources return to the bundle, not the
+    node), and only then do the bundle reservations go back to the nodes.
+    """
     _c = _client_mode()
     if _c is not None:
         _c.remove_placement_group(pg)
@@ -484,6 +493,18 @@ def remove_placement_group(pg: Any) -> None:
         if pg.removed:
             return
         pg.removed = True
+        bundle_ids = {id(b) for b in pg.bundles}
+        occupants = [
+            h
+            for h in sess.actors.values()
+            if h._pg_bundle is not None and id(h._pg_bundle) in bundle_ids
+        ]
+    for handle in occupants:
+        try:
+            kill(handle)
+        except Exception:  # noqa: BLE001 - the actor may already be dead
+            pass
+    with sess.lock:
         for b in pg.bundles:
             b.node.release(b.request)
     with sess.cv:

@@ -22,6 +22,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ray_lightning_tpu.utils.compat import shard_map
+
 _NEG_INF = float("-inf")
 
 
@@ -122,7 +124,9 @@ def ring_attention(
         # accumulators genuinely differ per rank on each of them).
         if hasattr(jax.lax, "pcast"):
             return jax.lax.pcast(x, axes, to="varying")
-        return jax.lax.pvary(x, axes)
+        if hasattr(jax.lax, "pvary"):
+            return jax.lax.pvary(x, axes)
+        return x  # pre-vma JAX (0.4.x): no varying types, nothing to mark
 
     init = (
         k,
@@ -207,6 +211,6 @@ def ring_self_attention(
         window=window,
         sinks=sinks,
     )
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

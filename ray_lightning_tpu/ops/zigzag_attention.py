@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_lightning_tpu.utils.compat import shard_map
+
 _NEG_INF = float("-inf")
 
 
@@ -214,7 +216,7 @@ def zigzag_self_attention_zlayout(
     fn = functools.partial(
         zigzag_ring_attention, axis_name=axis_name, sm_scale=sm_scale
     )
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 

@@ -45,8 +45,13 @@ def build_mesh(
             f"mesh shape {tuple(axis_shape)} needs {total} devices, "
             f"have {len(devices)}"
         )
-    axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
-    return jax.make_mesh(tuple(axis_shape), axis_names, axis_types=axis_types)
+    if hasattr(jax.sharding, "AxisType"):
+        axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
+        return jax.make_mesh(
+            tuple(axis_shape), axis_names, axis_types=axis_types
+        )
+    # Older JAX (< 0.5): no sharding-in-types; every axis is already Auto.
+    return jax.make_mesh(tuple(axis_shape), axis_names)
 
 
 def setup_distributed(env) -> None:

@@ -29,6 +29,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from ray_lightning_tpu.utils.compat import shard_map
+
 
 def init_moe_params(
     rng: jax.Array,
@@ -327,7 +329,7 @@ def moe_ffn_ep(
 
     from jax.sharding import PartitionSpec as P
 
-    out, aux_loss, dropped = jax.shard_map(
+    out, aux_loss, dropped = shard_map(
         per_rank,
         mesh=mesh,
         in_specs=(

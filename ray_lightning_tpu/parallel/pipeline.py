@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_lightning_tpu.utils.compat import shard_map
+
 
 def bubble_fraction(pp: int, num_microbatches: Optional[int] = None) -> float:
     """Textbook GPipe bubble: the share of the M+P-1 schedule ticks a rank
@@ -86,7 +88,9 @@ def pipeline_apply(
             # shard_map's varying-mesh-axes type system.
             if hasattr(jax.lax, "pcast"):
                 return jax.lax.pcast(v, (axis_name,), to="varying")
-            return jax.lax.pvary(v, (axis_name,))
+            if hasattr(jax.lax, "pvary"):
+                return jax.lax.pvary(v, (axis_name,))
+            return v  # pre-vma JAX (0.4.x): nothing to mark
 
         def apply_local(h: jax.Array) -> Tuple[jax.Array, jax.Array]:
             def body(carry, lp):
@@ -148,7 +152,7 @@ def pipeline_apply(
         aux_total = jax.lax.psum(aux_local, axis_name) / M
         return outs, aux_total
 
-    return jax.shard_map(
+    return shard_map(
         per_rank,
         mesh=mesh,
         in_specs=(param_specs, P()),

@@ -1,0 +1,61 @@
+"""ray_lightning_tpu.serve — continuous-batching inference serving.
+
+The L5 layer over the decode path (models/gpt.py: prefill + GQA KV cache
++ int8 trees) and the fabric (actors, queues, placement groups):
+
+- :class:`DecodeEngine` — slot-based decode over one compiled step
+  (engine.py): iteration-level admission, bucketed prefill, per-slot
+  sampling, zero per-request recompilation.
+- :class:`Scheduler` / :class:`SamplingParams` — continuous batching
+  policy: FIFO/priority queue, prefill/decode interleave, deadlines,
+  cancellation (scheduler.py).
+- :class:`ServeReplica` / :func:`start_replicas` / :class:`ServeClient`
+  — replica actors on the fabric with a blocking + streaming client
+  (server.py, client.py); ``rlt serve`` is the CLI front end.
+- :class:`ServeMetrics` — queue depth, TTFT, occupancy, tokens/s
+  (metrics.py), exposed through the replicas' ``stats()`` endpoint.
+
+Heavy deps load lazily: the engine (jax) and the replica/client layer
+(fabric) import on first attribute access, not at package import.
+(Replica actors are exec'd fresh interpreters, so their platform env is
+applied before anything heavy loads regardless.)
+"""
+from ray_lightning_tpu.serve.metrics import ServeMetrics
+from ray_lightning_tpu.serve.scheduler import (
+    Request,
+    SamplingParams,
+    Scheduler,
+    TokenEvent,
+)
+
+__all__ = [
+    "DecodeEngine",
+    "ServeMetrics",
+    "SamplingParams",
+    "Request",
+    "Scheduler",
+    "TokenEvent",
+    "ServeReplica",
+    "ServeClient",
+    "start_replicas",
+    "load_serve_params",
+]
+
+_LAZY = {
+    # jax-importing (engine) or fabric-importing (server/client) names.
+    "DecodeEngine": "ray_lightning_tpu.serve.engine",
+    "ServeReplica": "ray_lightning_tpu.serve.server",
+    "load_serve_params": "ray_lightning_tpu.serve.server",
+    "ServeClient": "ray_lightning_tpu.serve.client",
+    "start_replicas": "ray_lightning_tpu.serve.client",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(
+        f"module 'ray_lightning_tpu.serve' has no attribute {name!r}"
+    )

@@ -1,0 +1,203 @@
+"""Serving client: blocking + streaming request API over replica actors.
+
+``start_replicas`` spawns a gang of ServeReplica actors on the fabric
+(placement-group reserved for multi-replica gangs, mirroring how the
+Tuner gang-schedules trials) and hands back a ServeClient. The client
+round-robins submissions across replicas and streams tokens by polling
+each replica's ``result`` endpoint (the poll blocks briefly replica-side,
+so streaming costs ~one RPC per emitted token burst, not per token).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from ray_lightning_tpu import fabric
+from ray_lightning_tpu.serve.server import ServeReplica
+
+
+@dataclass(frozen=True)
+class RequestHandle:
+    replica: int
+    request_id: str
+
+
+class ServeClient:
+    """Driver-side handle to one or more serving replicas."""
+
+    def __init__(self, replicas: List[Any], pg: Any = None) -> None:
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self._replicas = list(replicas)
+        self._pg = pg
+        self._rr = itertools.cycle(range(len(self._replicas)))
+
+    # -- request API -----------------------------------------------------
+    def submit(
+        self,
+        prompt: Sequence[int],
+        *,
+        replica: Optional[int] = None,
+        **sampling: Any,
+    ) -> RequestHandle:
+        """Queue a request (round-robin across replicas unless pinned);
+        sampling kwargs mirror ServeReplica.submit."""
+        idx = next(self._rr) if replica is None else int(replica)
+        rid = fabric.get(
+            self._replicas[idx].submit.remote(
+                [int(t) for t in prompt], **sampling
+            )
+        )
+        return RequestHandle(replica=idx, request_id=rid)
+
+    def stream(
+        self,
+        prompt: Sequence[int],
+        *,
+        poll_s: float = 0.05,
+        timeout_s: float = 300.0,
+        **sampling: Any,
+    ) -> Iterator[int]:
+        """Submit and yield generated tokens as they arrive."""
+        handle = self.submit(prompt, **sampling)
+        yield from self.stream_handle(
+            handle, poll_s=poll_s, timeout_s=timeout_s
+        )
+
+    def stream_handle(
+        self,
+        handle: RequestHandle,
+        *,
+        poll_s: float = 0.05,
+        timeout_s: float = 300.0,
+    ) -> Iterator[int]:
+        actor = self._replicas[handle.replica]
+        cursor = 0
+        deadline = time.monotonic() + timeout_s
+        while True:
+            res = fabric.get(
+                actor.result.remote(
+                    handle.request_id, cursor, wait_s=poll_s
+                )
+            )
+            for tok in res["tokens"]:
+                yield int(tok)
+            cursor += len(res["tokens"])
+            if res["done"]:
+                if res["status"] in ("cancelled", "expired"):
+                    raise RuntimeError(
+                        f"request {handle.request_id} {res['status']}"
+                    )
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"request {handle.request_id} streamed no completion "
+                    f"within {timeout_s}s"
+                )
+
+    def generate(
+        self, prompt: Sequence[int], timeout_s: float = 300.0, **sampling: Any
+    ) -> List[int]:
+        """Blocking decode: returns the generated token ids."""
+        return list(self.stream(prompt, timeout_s=timeout_s, **sampling))
+
+    def result(self, handle: RequestHandle, cursor: int = 0) -> Dict[str, Any]:
+        return fabric.get(
+            self._replicas[handle.replica].result.remote(
+                handle.request_id, cursor
+            )
+        )
+
+    def cancel(self, handle: RequestHandle) -> bool:
+        return fabric.get(
+            self._replicas[handle.replica].cancel.remote(handle.request_id)
+        )
+
+    # -- ops --------------------------------------------------------------
+    @property
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    def stats(self) -> List[Dict[str, Any]]:
+        """Per-replica stats-endpoint snapshots."""
+        return fabric.get([r.stats.remote() for r in self._replicas])
+
+    def shutdown(self) -> None:
+        for r in self._replicas:
+            try:
+                fabric.get(r.stop.remote(), timeout=10.0)
+            except Exception:  # noqa: BLE001 - best-effort drain
+                pass
+            try:
+                fabric.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        if self._pg is not None:
+            try:
+                fabric.remove_placement_group(self._pg)
+            except Exception:  # noqa: BLE001
+                pass
+            self._pg = None
+
+
+def start_replicas(
+    num_replicas: int = 1,
+    *,
+    num_cpus_per_replica: float = 1,
+    num_tpus_per_replica: float = 0,
+    placement_strategy: str = "PACK",
+    env: Optional[Dict[str, Any]] = None,
+    init_timeout: float = 300.0,
+    **replica_kwargs: Any,
+) -> ServeClient:
+    """Spawn a replica gang on the fabric and return a connected client.
+
+    Multi-replica gangs reserve their bundles atomically through a
+    placement group (so a partially-placeable gang fails fast instead of
+    deadlocking half-started); ``replica_kwargs`` go to ServeReplica
+    (ckpt_path/model_config/int8/num_slots/...).
+    """
+    if num_replicas < 1:
+        raise ValueError("num_replicas must be >= 1")
+    bundle: Dict[str, float] = {"CPU": float(num_cpus_per_replica)}
+    if num_tpus_per_replica:
+        bundle["TPU"] = float(num_tpus_per_replica)
+    pg = None
+    if num_replicas > 1:
+        pg = fabric.placement_group(
+            [dict(bundle) for _ in range(num_replicas)],
+            strategy=placement_strategy,
+        )
+    actor_cls = fabric.remote(ServeReplica)
+    replicas = []
+    try:
+        for i in range(num_replicas):
+            opts: Dict[str, Any] = {
+                "num_cpus": num_cpus_per_replica,
+                "env": dict(env or {}),
+                "init_timeout": init_timeout,
+            }
+            if num_tpus_per_replica:
+                opts["num_tpus"] = num_tpus_per_replica
+            if pg is not None:
+                opts["placement_group"] = pg
+                opts["placement_group_bundle_index"] = i
+            replicas.append(
+                actor_cls.options(**opts).remote(**replica_kwargs)
+            )
+        fabric.get([r.ping.remote() for r in replicas], timeout=init_timeout)
+    except BaseException:
+        for r in replicas:
+            try:
+                fabric.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        if pg is not None:
+            try:
+                fabric.remove_placement_group(pg)
+            except Exception:  # noqa: BLE001
+                pass
+        raise
+    return ServeClient(replicas, pg=pg)

@@ -1,0 +1,397 @@
+"""Slot-based continuous-batching decode engine.
+
+The bridge from ``gpt_generate`` (one static-shape batch, one user) to a
+serving system: ONE compiled decode-step executable runs over a fixed
+``(num_slots, max_seq)`` KV cache; requests are admitted into free slots
+at step boundaries (a bucketed prefill writes the slot's cache range),
+finished slots are evicted and recycled — all without recompilation
+(Orca-style iteration-level scheduling over vLLM-style slot-managed
+caches).
+
+Exactness contract: a request decodes token-identically to a solo
+``gpt_generate`` call (greedy), no matter which batchmates share its
+steps. Two properties deliver it, both asserted in tests/test_serve.py:
+
+- **Slot masks.** The shared step (``models/gpt.py:gpt_decode_step``)
+  attends each slot only to ``position <= pos[slot]`` with exact ``-inf``
+  masking — masked cache rows contribute exactly zero through the
+  softmax, so cache length and stale rows from evicted tenants are
+  invisible to the numerics.
+- **Bucketed prefill.** Prompts are right-padded to a fixed bucket
+  length; attention is causal, so the padded rows never influence the
+  real rows, and only row ``len-1``'s logits are consumed. Compiles are
+  per-bucket (all warmed at construction), never per-request.
+
+Sampling is per-slot and traced (temperature/top-k/top-p/rng arrive as
+arrays), so one executable serves any mix of sampling params, and each
+request's rng chain is independent of its batchmates. Weight-only int8
+parameter trees (utils/quantize.py) are consumed directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_lightning_tpu.models.gpt import GPTConfig
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    """Host-side record of one occupied slot."""
+
+    request_id: str
+    max_new_tokens: int
+    n_generated: int
+    eos_token: int  # -1 = disabled
+
+
+def _sample_rows(keys, logits, temps, top_ks, top_ps):
+    """Per-row sampling with TRACED params — the batched counterpart of
+    models.gpt.sample_logits (whose knobs are static Python values).
+
+    ``keys`` (B, 2) uint32 per-row PRNG keys; ``temps`` (B,) fp32 (<= 0 =
+    greedy); ``top_ks`` (B,) int32 (0 = off); ``top_ps`` (B,) fp32 (>= 1 =
+    off). Filters compose k-then-p like sample_logits. Traced knobs keep
+    the decode step at ONE compile for any mix of per-request sampling
+    configs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.maximum(temps, 1e-8)[:, None]
+    lg = (logits / t).astype(jnp.float32)
+    neg = jnp.asarray(float("-inf"), lg.dtype)
+    # top-k: keep each row's k highest (k=V disables).
+    sorted_desc = jnp.sort(lg, axis=-1)[:, ::-1]
+    k = jnp.where((top_ks > 0) & (top_ks < V), top_ks, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    lg = jnp.where(lg < kth, neg, lg)
+    # top-p (nucleus) on the k-filtered rows: cut tokens whose EXCLUSIVE
+    # prefix mass already reaches p (the crossing token stays).
+    apply_p = ((top_ps > 0.0) & (top_ps < 1.0))[:, None]
+    sd = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sd, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    cutoff = jnp.min(
+        jnp.where(before < top_ps[:, None], sd, -neg), axis=-1, keepdims=True
+    )
+    lg = jnp.where(apply_p & (lg < cutoff), neg, lg)
+    sampled = jax.vmap(jax.random.categorical)(keys, lg)
+    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def default_buckets(max_seq: int, lo: int = 16) -> Tuple[int, ...]:
+    """Power-of-two prefill buckets up to ``max_seq`` (inclusive)."""
+    out: List[int] = []
+    b = lo
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(sorted(set(out)))
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a fixed slot-indexed KV cache.
+
+    Construction compiles everything (prefill per bucket, slot write per
+    bucket, one decode step, one first-token sampler); admissions and
+    steps afterwards only EXECUTE — ``compiled_count`` must not move, and
+    the test suite asserts it doesn't.
+
+    Host/device split: the caches live on device across calls; per-slot
+    scalar state (current token, position, sampling knobs, rng keys) lives
+    in host numpy, shipped with each step call (tiny, fixed shapes).
+    All methods must be driven from one thread (the scheduler loop).
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: GPTConfig | Dict[str, Any],
+        num_slots: int = 4,
+        max_seq: Optional[int] = None,
+        prefill_buckets: Optional[Sequence[int]] = None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(config, dict):
+            config = GPTConfig(**config)
+        config.validate_variants()
+        self.cfg = config
+        self.num_slots = int(num_slots)
+        if self.num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.max_seq = int(max_seq or config.max_seq)
+        if self.max_seq > config.max_seq:
+            raise ValueError(
+                f"engine max_seq {self.max_seq} exceeds model max_seq "
+                f"{config.max_seq}"
+            )
+        buckets = tuple(
+            sorted(set(prefill_buckets or default_buckets(self.max_seq)))
+        )
+        if not buckets or buckets[-1] > self.max_seq:
+            raise ValueError(
+                f"prefill buckets {buckets} must be non-empty and <= "
+                f"max_seq {self.max_seq}"
+            )
+        self.prefill_buckets = buckets
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+
+        cdt = jnp.dtype(config.compute_dtype)
+        L, Hkv, hd = config.n_layer, config.kv_head, config.head_dim
+        B, S = self.num_slots, self.max_seq
+        self._k = jnp.zeros((L, B, S, Hkv, hd), cdt)
+        self._v = jnp.zeros((L, B, S, Hkv, hd), cdt)
+
+        # Per-slot host state (fixed shapes: one step signature forever).
+        self._cur = np.zeros(B, np.int32)
+        self._pos = np.zeros(B, np.int32)
+        self._temps = np.zeros(B, np.float32)
+        self._top_ks = np.zeros(B, np.int32)
+        self._top_ps = np.ones(B, np.float32)
+        self._keys = np.zeros((B, 2), np.uint32)
+        self._slots: List[Optional[SlotInfo]] = [None] * B
+
+        self.compiled_count = 0
+        self._compile()
+
+    # -- compilation (all of it, up front) -------------------------------
+    def _compile(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.models.gpt import (
+            _head_weight,
+            _lm_head,
+            _make_norm,
+            gpt_decode_step,
+            gpt_prefill,
+        )
+
+        cfg = self.cfg
+        norm_fn = _make_norm(cfg)
+        p_spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.params
+        )
+
+        def spec(arr):
+            return jax.ShapeDtypeStruct(np.shape(arr), np.asarray(arr).dtype)
+
+        def prefill_impl(params, prompt, last_idx):
+            h, pf_k, pf_v = gpt_prefill(params, cfg, prompt)
+            h_last = jax.lax.dynamic_slice_in_dim(h, last_idx, 1, axis=1)
+            h_last = norm_fn(h_last, params["lnf_g"], params["lnf_b"])[:, 0]
+            logits = _lm_head(h_last, _head_weight(params, cfg))
+            return pf_k, pf_v, logits
+
+        def write_impl(k_cache, v_cache, pf_k, pf_v, slot):
+            # pf_k/pf_v: (L, 1, Pb, Hkv, hd) -> rows [0, Pb) of one slot.
+            zero = jnp.zeros((), jnp.int32)
+            start = (zero, slot, zero, zero, zero)
+            return (
+                jax.lax.dynamic_update_slice(k_cache, pf_k, start),
+                jax.lax.dynamic_update_slice(v_cache, pf_v, start),
+            )
+
+        def first_token_impl(key, logits, temp, top_k, top_p):
+            key, sub = jax.random.split(key)
+            tok = _sample_rows(
+                sub[None], logits, temp[None], top_k[None], top_p[None]
+            )[0]
+            return key, tok
+
+        def step_impl(
+            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps, keys
+        ):
+            logits, k_cache, v_cache = gpt_decode_step(
+                params, cfg, cur, pos, k_cache, v_cache
+            )
+            split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+            new_keys, subs = split[:, 0], split[:, 1]
+            toks = _sample_rows(subs, logits, temps, top_ks, top_ps)
+            return new_keys, toks, k_cache, v_cache
+
+        cache_spec = spec(self._k)
+        self._prefill_exec: Dict[int, Any] = {}
+        self._write_exec: Dict[int, Any] = {}
+        i32 = jax.ShapeDtypeStruct((), np.int32)
+        for pb in self.prefill_buckets:
+            prompt_spec = jax.ShapeDtypeStruct((1, pb), np.int32)
+            self._prefill_exec[pb] = (
+                jax.jit(prefill_impl)
+                .lower(p_spec, prompt_spec, i32)
+                .compile()
+            )
+            self.compiled_count += 1
+            L, Hkv, hd = self.cfg.n_layer, self.cfg.kv_head, self.cfg.head_dim
+            pf_spec = jax.ShapeDtypeStruct(
+                (L, 1, pb, Hkv, hd), jnp.dtype(self.cfg.compute_dtype)
+            )
+            self._write_exec[pb] = (
+                jax.jit(write_impl, donate_argnums=(0, 1))
+                .lower(cache_spec, cache_spec, pf_spec, pf_spec, i32)
+                .compile()
+            )
+            self.compiled_count += 1
+        key_spec = jax.ShapeDtypeStruct((2,), np.uint32)
+        self._first_token_exec = (
+            jax.jit(first_token_impl)
+            .lower(
+                key_spec,
+                jax.ShapeDtypeStruct((1, cfg.vocab_size), np.float32),
+                jax.ShapeDtypeStruct((), np.float32),
+                i32,
+                jax.ShapeDtypeStruct((), np.float32),
+            )
+            .compile()
+        )
+        self.compiled_count += 1
+        self._step_exec = (
+            jax.jit(step_impl, donate_argnums=(1, 2))
+            .lower(
+                p_spec,
+                cache_spec,
+                cache_spec,
+                spec(self._cur),
+                spec(self._pos),
+                spec(self._temps),
+                spec(self._top_ks),
+                spec(self._top_ps),
+                spec(self._keys),
+            )
+            .compile()
+        )
+        self.compiled_count += 1
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def num_active(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds largest prefill bucket "
+            f"{self.prefill_buckets[-1]}"
+        )
+
+    # -- request lifecycle -----------------------------------------------
+    def admit(
+        self,
+        prompt: Sequence[int],
+        *,
+        request_id: str,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+        eos_token: Optional[int] = None,
+    ) -> Tuple[int, int, bool]:
+        """Prefill ``prompt`` into a free slot; returns (slot, first_token,
+        done). Raises when no slot is free or the request cannot fit."""
+        import jax
+
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slot (check free_slots() first)")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        P = int(prompt.shape[0])
+        n_new = int(max_new_tokens)
+        if P < 1 or n_new < 1:
+            raise ValueError("need a non-empty prompt and max_new_tokens >= 1")
+        if P + n_new > self.max_seq:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({n_new}) exceeds engine "
+                f"max_seq {self.max_seq}"
+            )
+        pb = self.bucket_for(P)
+        slot = free[0]
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :P] = prompt
+        pf_k, pf_v, logits = self._prefill_exec[pb](
+            self.params, padded, np.int32(P - 1)
+        )
+        self._k, self._v = self._write_exec[pb](
+            self._k, self._v, pf_k, pf_v, np.int32(slot)
+        )
+        temp = np.float32(temperature)
+        tk = np.int32(0 if top_k is None else top_k)
+        tp = np.float32(1.0 if top_p is None else top_p)
+        key = np.asarray(
+            jax.random.PRNGKey(int(seed)), np.uint32
+        ).reshape(2)
+        key, tok = self._first_token_exec(key, np.asarray(logits), temp, tk, tp)
+        tok = int(np.asarray(tok))
+        eos = -1 if eos_token is None else int(eos_token)
+        done = n_new == 1 or tok == eos
+        if not done:
+            self._slots[slot] = SlotInfo(
+                request_id=request_id,
+                max_new_tokens=n_new,
+                n_generated=1,
+                eos_token=eos,
+            )
+            self._cur[slot] = tok
+            self._pos[slot] = P
+            self._temps[slot] = temp
+            self._top_ks[slot] = tk
+            self._top_ps[slot] = tp
+            self._keys[slot] = np.asarray(key, np.uint32)
+        return slot, tok, done
+
+    def release(self, slot: int) -> None:
+        """Evict a slot (finished or cancelled); it is immediately
+        reusable — the stale cache rows are invisible behind the slot
+        masks and get overwritten by the next tenant."""
+        self._slots[slot] = None
+
+    def step(self) -> List[Tuple[int, str, int, bool]]:
+        """One decode iteration over every occupied slot; returns
+        ``(slot, request_id, token, done)`` per active slot. Finished
+        slots are evicted and recycled before returning."""
+        if self.num_active == 0:
+            return []
+        new_keys, toks, self._k, self._v = self._step_exec(
+            self.params,
+            self._k,
+            self._v,
+            self._cur,
+            self._pos,
+            self._temps,
+            self._top_ks,
+            self._top_ps,
+            self._keys,
+        )
+        toks = np.asarray(toks)
+        # Copy: np.asarray on a device array yields a read-only view, and
+        # admit() writes per-slot keys in place.
+        self._keys = np.array(new_keys, np.uint32)
+        out: List[Tuple[int, str, int, bool]] = []
+        for slot, info in enumerate(self._slots):
+            if info is None:
+                continue
+            tok = int(toks[slot])
+            info.n_generated += 1
+            self._pos[slot] += 1
+            self._cur[slot] = tok
+            done = (
+                info.n_generated >= info.max_new_tokens
+                or tok == info.eos_token
+            )
+            out.append((slot, info.request_id, tok, done))
+            if done:
+                self.release(slot)
+        return out

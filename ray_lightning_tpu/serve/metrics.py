@@ -1,0 +1,126 @@
+"""Serving metrics: queue depth, TTFT, occupancy, tokens/s.
+
+The serving loop is iteration-level (scheduler.step()), so metrics are
+recorded per step and per request-lifecycle event and aggregated over a
+bounded sliding window — a long-lived replica's stats reflect recent
+traffic, not its whole uptime. ``snapshot()`` is the stats endpoint's
+payload (ServeReplica.stats() ships it to clients verbatim); periodic
+logging rides the existing rank-zero logging utilities.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from ray_lightning_tpu.utils.rank_zero import rank_zero_info
+
+
+class ServeMetrics:
+    """Thread-safe counters + sliding-window rates for one engine/replica.
+
+    ``window`` bounds how many recent engine steps and finished requests
+    feed the rate/occupancy aggregates.
+    """
+
+    def __init__(self, num_slots: int, window: int = 512) -> None:
+        self.num_slots = max(1, int(num_slots))
+        self._lock = threading.Lock()
+        # Lifecycle counters (monotonic).
+        self.submitted = 0
+        self.admitted = 0
+        self.finished = 0
+        self.cancelled = 0
+        self.expired = 0
+        # Sliding windows.
+        self._ttft_s: deque = deque(maxlen=window)
+        #: (wall_s, active_slots, tokens_emitted) per engine step.
+        self._steps: deque = deque(maxlen=window)
+        self._queue_depth = 0
+        self._started = time.monotonic()
+        self._last_log = 0.0
+
+    # -- recording -------------------------------------------------------
+    def record_submit(self, queue_depth: int) -> None:
+        with self._lock:
+            self.submitted += 1
+            self._queue_depth = queue_depth
+
+    def record_admit(self, ttft_s: float, queue_depth: int) -> None:
+        with self._lock:
+            self.admitted += 1
+            self._ttft_s.append(float(ttft_s))
+            self._queue_depth = queue_depth
+
+    def record_finish(self, n: int = 1) -> None:
+        with self._lock:
+            self.finished += n
+
+    def record_cancel(self, n: int = 1) -> None:
+        with self._lock:
+            self.cancelled += n
+
+    def record_expire(self, n: int = 1) -> None:
+        with self._lock:
+            self.expired += n
+
+    def record_step(
+        self, wall_s: float, active_slots: int, tokens_emitted: int,
+        queue_depth: int,
+    ) -> None:
+        with self._lock:
+            self._steps.append(
+                (float(wall_s), int(active_slots), int(tokens_emitted))
+            )
+            self._queue_depth = queue_depth
+
+    # -- aggregates ------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Aggregate view over the sliding window (the stats payload)."""
+        with self._lock:
+            steps = list(self._steps)
+            ttft = sorted(self._ttft_s)
+            wall = sum(s[0] for s in steps)
+            tokens = sum(s[2] for s in steps)
+            occ = (
+                sum(s[1] for s in steps) / (len(steps) * self.num_slots)
+                if steps
+                else 0.0
+            )
+            out = {
+                "num_slots": self.num_slots,
+                "queue_depth": self._queue_depth,
+                "submitted": self.submitted,
+                "admitted": self.admitted,
+                "finished": self.finished,
+                "cancelled": self.cancelled,
+                "expired": self.expired,
+                "steps_recorded": len(steps),
+                # Mean fraction of slots decoding per step, over the window.
+                "occupancy": round(occ, 4),
+                "tokens_emitted_window": tokens,
+                "tokens_per_sec": round(tokens / wall, 3) if wall > 0 else 0.0,
+                "uptime_s": round(time.monotonic() - self._started, 3),
+            }
+            if ttft:
+                out["ttft_p50_s"] = round(ttft[len(ttft) // 2], 4)
+                out["ttft_max_s"] = round(ttft[-1], 4)
+            return out
+
+    def maybe_log(self, every_s: float = 10.0) -> Optional[Dict[str, Any]]:
+        """Rank-zero-log a snapshot at most once per ``every_s``; returns
+        the snapshot when it logged, else None."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_log < every_s:
+                return None
+            self._last_log = now
+        snap = self.snapshot()
+        rank_zero_info(
+            "serve: queue=%d occupancy=%.2f tokens/s=%.1f "
+            "admitted=%d finished=%d",
+            snap["queue_depth"], snap["occupancy"], snap["tokens_per_sec"],
+            snap["admitted"], snap["finished"],
+        )
+        return snap

@@ -1,0 +1,241 @@
+"""Continuous-batching scheduler: iteration-level admission over a
+DecodeEngine.
+
+Orca-style scheduling loop: at every step boundary the scheduler (1)
+drops cancelled/expired work, (2) admits queued requests into free engine
+slots — bounded by ``max_prefills_per_step`` so a burst of prompt
+prefills can't starve in-flight decode latency (the prefill/decode
+interleave policy), (3) runs one decode iteration for everything
+resident. Requests carry per-request sampling params, an optional
+priority (lower value = served first; FIFO within a priority), and an
+optional deadline.
+
+The scheduler owns no threads: ``step()`` is driven by whoever hosts the
+engine (ServeReplica's loop thread, a test, the bench). ``submit`` /
+``cancel`` are thread-safe so a replica's RPC surface can feed the loop.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence
+
+from ray_lightning_tpu.serve.metrics import ServeMetrics
+
+if TYPE_CHECKING:  # engine pulls jax; keep the package import light
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode knobs (the engine consumes them as traced
+    per-slot arrays, so any mix shares one compiled step)."""
+
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
+    eos_token: Optional[int] = None
+
+
+@dataclass
+class Request:
+    prompt: List[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    request_id: str = ""
+    priority: int = 0
+    #: Relative deadline in seconds from submission; queued requests past
+    #: it are expired, in-flight ones are cancelled at the next boundary.
+    deadline_s: Optional[float] = None
+    submitted_at: float = 0.0
+
+    def expired(self, now: float) -> bool:
+        return (
+            self.deadline_s is not None
+            and now - self.submitted_at > self.deadline_s
+        )
+
+
+@dataclass(frozen=True)
+class TokenEvent:
+    """One scheduler-step outcome for one request."""
+
+    request_id: str
+    token: Optional[int]  # None for lifecycle-only events
+    done: bool
+    #: "token" | "finished" | "cancelled" | "expired"
+    reason: str = "token"
+
+
+class Scheduler:
+    def __init__(
+        self,
+        engine: DecodeEngine,
+        metrics: Optional[ServeMetrics] = None,
+        max_prefills_per_step: int = 1,
+    ) -> None:
+        self.engine = engine
+        self.metrics = metrics or ServeMetrics(engine.num_slots)
+        self.max_prefills_per_step = max(1, int(max_prefills_per_step))
+        self._lock = threading.RLock()
+        self._seq = itertools.count()
+        #: (priority, seq, Request) min-heap: FIFO within a priority.
+        self._pending: List[Any] = []
+        self._cancelled: set = set()
+        self._slot_req: Dict[int, Request] = {}
+
+    # -- intake (thread-safe) --------------------------------------------
+    def submit(
+        self,
+        prompt: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        *,
+        request_id: Optional[str] = None,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+    ) -> str:
+        """Queue a request; returns its id. Rejects (ValueError) requests
+        that can never fit the engine, instead of queueing them to fail."""
+        sampling = sampling or SamplingParams()
+        prompt = [int(t) for t in prompt]
+        if not prompt or sampling.max_new_tokens < 1:
+            raise ValueError("need a non-empty prompt and max_new_tokens >= 1")
+        self.engine.bucket_for(len(prompt))  # raises when over every bucket
+        if len(prompt) + sampling.max_new_tokens > self.engine.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({sampling.max_new_tokens}) exceeds engine max_seq "
+                f"{self.engine.max_seq}"
+            )
+        req = Request(
+            prompt=prompt,
+            sampling=sampling,
+            request_id=request_id or uuid.uuid4().hex[:12],
+            priority=int(priority),
+            deadline_s=deadline_s,
+            submitted_at=time.monotonic(),
+        )
+        with self._lock:
+            heapq.heappush(
+                self._pending, (req.priority, next(self._seq), req)
+            )
+            self.metrics.record_submit(len(self._pending))
+        return req.request_id
+
+    def cancel(self, request_id: str) -> bool:
+        """Mark a request cancelled; queued ones are dropped and in-flight
+        ones evicted at the next step boundary. Returns whether the id was
+        known (queued or in flight)."""
+        with self._lock:
+            known = any(
+                r.request_id == request_id for _, _, r in self._pending
+            ) or any(
+                r.request_id == request_id for r in self._slot_req.values()
+            )
+            if known:
+                self._cancelled.add(request_id)
+            return known
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._pending) or self.engine.num_active > 0
+
+    # -- the loop body (single driver thread) -----------------------------
+    def step(self) -> List[TokenEvent]:
+        """One iteration: evict cancelled/expired, admit, decode."""
+        events: List[TokenEvent] = []
+        t0 = time.monotonic()
+        with self._lock:
+            # 1) Boundary eviction of in-flight cancellations/expiries.
+            for slot, req in list(self._slot_req.items()):
+                cancelled = req.request_id in self._cancelled
+                if cancelled or req.expired(t0):
+                    self.engine.release(slot)
+                    del self._slot_req[slot]
+                    self._cancelled.discard(req.request_id)
+                    reason = "cancelled" if cancelled else "expired"
+                    (self.metrics.record_cancel if cancelled
+                     else self.metrics.record_expire)()
+                    events.append(
+                        TokenEvent(req.request_id, None, True, reason)
+                    )
+            # 2) Admission: free slots, bounded prefills per step.
+            admitted = 0
+            while (
+                admitted < self.max_prefills_per_step
+                and self._pending
+                and self.engine.free_slots()
+            ):
+                _, _, req = heapq.heappop(self._pending)
+                if req.request_id in self._cancelled:
+                    self._cancelled.discard(req.request_id)
+                    self.metrics.record_cancel()
+                    events.append(
+                        TokenEvent(req.request_id, None, True, "cancelled")
+                    )
+                    continue
+                if req.expired(t0):
+                    self.metrics.record_expire()
+                    events.append(
+                        TokenEvent(req.request_id, None, True, "expired")
+                    )
+                    continue
+                s = req.sampling
+                slot, first_tok, done = self.engine.admit(
+                    req.prompt,
+                    request_id=req.request_id,
+                    max_new_tokens=s.max_new_tokens,
+                    temperature=s.temperature,
+                    top_k=s.top_k,
+                    top_p=s.top_p,
+                    seed=s.seed,
+                    eos_token=s.eos_token,
+                )
+                admitted += 1
+                self.metrics.record_admit(
+                    time.monotonic() - req.submitted_at, len(self._pending)
+                )
+                events.append(
+                    TokenEvent(
+                        req.request_id, first_tok, done,
+                        "finished" if done else "token",
+                    )
+                )
+                if done:
+                    self.metrics.record_finish()
+                else:
+                    self._slot_req[slot] = req
+            # 3) One decode iteration for everything resident.
+            active = self.engine.num_active
+            emitted = 0
+            for slot, rid, tok, done in self.engine.step():
+                emitted += 1
+                events.append(
+                    TokenEvent(rid, tok, done, "finished" if done else "token")
+                )
+                if done:
+                    self.metrics.record_finish()
+                    self._slot_req.pop(slot, None)
+            self.metrics.record_step(
+                time.monotonic() - t0, active, emitted + admitted,
+                len(self._pending),
+            )
+        return events
+
+    def run_until_idle(self, max_steps: int = 100_000) -> List[TokenEvent]:
+        """Drive step() until queue and slots drain (tests, bench)."""
+        out: List[TokenEvent] = []
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            out.extend(self.step())
+        return out

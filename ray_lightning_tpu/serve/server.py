@@ -1,0 +1,250 @@
+"""Serving replica: a fabric actor hosting one DecodeEngine + Scheduler.
+
+One replica = one actor process owning one compiled engine. The actor's
+RPC surface (``submit`` / ``result`` / ``cancel`` / ``stats``) only
+touches host-side queues; a daemon loop thread drives the scheduler so
+ALL jax work happens on one thread while requests stream in through the
+fabric connection. Multi-replica gangs are spawned through
+``serve.client.start_replicas`` (placement groups on the existing
+fabric); this module stays import-light so the actor process configures
+jax from its env before anything heavy loads.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+
+def load_serve_params(
+    ckpt_path: str, model_config: Optional[Dict[str, Any]] = None
+) -> tuple:
+    """Load (params, GPTConfig) for serving from a checkpoint path.
+
+    Accepts the three checkpoint shapes the repo produces:
+    - ``convert-hf`` / serve-native state streams: ``{"params", "gpt_config"}``
+      (``model_config`` entries override the stored config);
+    - trainer state streams: ``{"params": ...}`` (+ optimizer state,
+      ignored) — needs ``model_config``;
+    - sharded orbax dirs: restored host-side against a fresh param tree —
+      needs ``model_config``.
+    """
+    from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+    from ray_lightning_tpu.trainer.checkpoint_io import is_sharded_checkpoint
+
+    overrides = dict(model_config or {})
+    if is_sharded_checkpoint(ckpt_path):
+        if not overrides:
+            raise ValueError(
+                "serving a sharded (orbax) checkpoint needs the model "
+                "config (serve.config) to build the parameter tree"
+            )
+        import jax
+
+        from ray_lightning_tpu.trainer.checkpoint_io import OrbaxCheckpointIO
+
+        cfg = GPTConfig(**overrides)
+        placed = {"params": init_gpt_params(jax.random.PRNGKey(0), cfg)}
+        restored, _ = OrbaxCheckpointIO().restore(
+            ckpt_path, placed, partial=True
+        )
+        return restored["params"], cfg
+    from ray_lightning_tpu.trainer.trainer import Trainer
+    from ray_lightning_tpu.utils.state_stream import load_state_stream
+
+    tree = load_state_stream(Trainer._read_ckpt(ckpt_path))
+    stored = dict(tree.get("gpt_config") or {})
+    if stored:
+        stored.update(overrides)
+        cfg_fields = stored
+    elif overrides:
+        cfg_fields = overrides
+    else:
+        raise ValueError(
+            f"checkpoint {ckpt_path} carries no gpt_config; pass the model "
+            "config (serve.config)"
+        )
+    params = tree["params"] if "params" in tree else tree
+    return params, GPTConfig(**cfg_fields)
+
+
+class ServeReplica:
+    """One serving replica (designed to run as a fabric actor).
+
+    ``params`` may be passed directly (tests/bench) or loaded from
+    ``ckpt_path``; ``int8=True`` quantizes the tree at load
+    (utils.quantize_params_int8), which the engine consumes directly.
+    """
+
+    def __init__(
+        self,
+        ckpt_path: Optional[str] = None,
+        model_config: Optional[Dict[str, Any]] = None,
+        params: Any = None,
+        int8: bool = False,
+        num_slots: int = 4,
+        max_seq: Optional[int] = None,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        max_prefills_per_step: int = 1,
+        tick_s: float = 0.002,
+    ) -> None:
+        from ray_lightning_tpu.models.gpt import GPTConfig
+        from ray_lightning_tpu.serve.engine import DecodeEngine
+        from ray_lightning_tpu.serve.metrics import ServeMetrics
+        from ray_lightning_tpu.serve.scheduler import Scheduler
+
+        if params is None:
+            if ckpt_path is None:
+                raise ValueError("need ckpt_path or params")
+            params, cfg = load_serve_params(ckpt_path, model_config)
+        else:
+            if model_config is None:
+                raise ValueError("explicit params need model_config")
+            cfg = (
+                model_config
+                if isinstance(model_config, GPTConfig)
+                else GPTConfig(**model_config)
+            )
+        if int8:
+            from ray_lightning_tpu.utils.quantize import quantize_params_int8
+
+            params = quantize_params_int8(params)
+        self.int8 = bool(int8)
+        self.engine = DecodeEngine(
+            params,
+            cfg,
+            num_slots=num_slots,
+            max_seq=max_seq,
+            prefill_buckets=prefill_buckets,
+        )
+        self.metrics = ServeMetrics(self.engine.num_slots)
+        self.scheduler = Scheduler(
+            self.engine,
+            metrics=self.metrics,
+            max_prefills_per_step=max_prefills_per_step,
+        )
+        self._tick = float(tick_s)
+        #: request_id -> {"tokens": [...], "done": bool, "status": str}
+        self._buffers: Dict[str, Dict[str, Any]] = {}
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._work = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-replica-loop", daemon=True
+        )
+        self._thread.start()
+
+    # -- loop thread (owns all jax work) ----------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.scheduler.has_work():
+                self._work.wait(timeout=0.1)
+                self._work.clear()
+                continue
+            events = self.scheduler.step()
+            if events:
+                with self._cond:
+                    for ev in events:
+                        buf = self._buffers.setdefault(
+                            ev.request_id,
+                            {"tokens": [], "done": False, "status": "running"},
+                        )
+                        if ev.token is not None:
+                            buf["tokens"].append(ev.token)
+                        if ev.done:
+                            buf["done"] = True
+                            buf["status"] = (
+                                "finished" if ev.reason in ("token", "finished")
+                                else ev.reason
+                            )
+                    self._cond.notify_all()
+            self.metrics.maybe_log()
+            if self._tick:
+                self._stop.wait(self._tick)
+
+    # -- RPC surface ------------------------------------------------------
+    def ping(self) -> str:
+        return "ok"
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+        eos_token: Optional[int] = None,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+    ) -> str:
+        from ray_lightning_tpu.serve.scheduler import SamplingParams
+
+        rid = self.scheduler.submit(
+            prompt,
+            SamplingParams(
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                seed=seed,
+                eos_token=eos_token,
+            ),
+            priority=priority,
+            deadline_s=deadline_s,
+        )
+        with self._cond:
+            self._buffers[rid] = {
+                "tokens": [], "done": False, "status": "queued",
+            }
+        self._work.set()
+        return rid
+
+    def result(
+        self, request_id: str, cursor: int = 0, wait_s: float = 0.0
+    ) -> Dict[str, Any]:
+        """Tokens past ``cursor`` plus done/status. ``wait_s > 0`` blocks
+        (briefly — the actor handles calls serially) until new tokens or
+        completion, which keeps streaming polls cheap."""
+        import time as _time
+
+        deadline = _time.monotonic() + max(0.0, wait_s)
+        with self._cond:
+            while True:
+                buf = self._buffers.get(request_id)
+                if buf is None:
+                    raise KeyError(f"unknown request {request_id!r}")
+                if buf["done"] or len(buf["tokens"]) > cursor:
+                    break
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            return {
+                "tokens": list(buf["tokens"][cursor:]),
+                "done": buf["done"],
+                "status": buf["status"],
+            }
+
+    def cancel(self, request_id: str) -> bool:
+        ok = self.scheduler.cancel(request_id)
+        self._work.set()
+        return ok
+
+    def stats(self) -> Dict[str, Any]:
+        """The stats endpoint: metrics snapshot + engine anatomy."""
+        snap = self.metrics.snapshot()
+        snap.update(
+            {
+                "active_slots": self.engine.num_active,
+                "compiled_count": self.engine.compiled_count,
+                "max_seq": self.engine.max_seq,
+                "prefill_buckets": list(self.engine.prefill_buckets),
+                "int8": self.int8,
+            }
+        )
+        return snap
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        self._thread.join(timeout=5.0)

@@ -35,6 +35,8 @@ class RingTPUStrategy(RayTPUStrategy):
         import optax
         from jax.sharding import PartitionSpec as P
 
+        from ray_lightning_tpu.utils.compat import shard_map
+
         mesh = self.mesh
         prep = self._prep_compute(module)
 
@@ -60,7 +62,7 @@ class RingTPUStrategy(RayTPUStrategy):
             params2 = optax.apply_updates(params, updates)
             return params2, opt_state2, logs
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             per_rank_step,
             mesh=mesh,
             in_specs=(P(), P(), P("data"), P()),
@@ -83,6 +85,8 @@ class RingTPUStrategy(RayTPUStrategy):
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
+        from ray_lightning_tpu.utils.compat import shard_map
+
         if stage == "predict":
             return super().compile_eval_step(module, stage)
 
@@ -103,7 +107,7 @@ class RingTPUStrategy(RayTPUStrategy):
                 }
                 return sums, count
 
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 per_rank_batched,
                 mesh=self.mesh,
                 in_specs=(P(), P("data"), P("data")),
@@ -127,7 +131,7 @@ class RingTPUStrategy(RayTPUStrategy):
             }
             return sums, count
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             per_rank_eval,
             mesh=self.mesh,
             in_specs=(P(), P("data"), P("data")),

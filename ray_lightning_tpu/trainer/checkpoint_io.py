@@ -102,15 +102,25 @@ class OrbaxCheckpointIO:
         state_dir = os.path.join(path, _STATE_SUBDIR)
         if partial:
             ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-            restore_kwargs = {
-                "args": ocp.args.PyTreeRestore(
+            restore_args = ocp.checkpoint_utils.construct_restore_args(
+                abstract
+            )
+            try:
+                pytree_restore = ocp.args.PyTreeRestore(
                     item=abstract,
-                    restore_args=ocp.checkpoint_utils.construct_restore_args(
-                        abstract
-                    ),
+                    restore_args=restore_args,
                     partial_restore=True,
                 )
-            }
+            except TypeError:
+                # Older orbax: no partial_restore kwarg; an (empty)
+                # transforms dict is its spelling of "restore only the
+                # item's keys, ignore the rest of the on-disk tree".
+                pytree_restore = ocp.args.PyTreeRestore(
+                    item=abstract,
+                    restore_args=restore_args,
+                    transforms={},
+                )
+            restore_kwargs = {"args": pytree_restore}
         else:
             ckptr = ocp.StandardCheckpointer()
             restore_kwargs = {"target": abstract}

@@ -33,26 +33,89 @@ def _resolve_metrics(
     return {new: available[old] for new, old in metrics.items() if old in available}
 
 
-class TuneCallback(Callback):
-    """Base: fires on a configured hook, rank 0 only."""
+#: Reference-contract aliases (tune.py:104 accepts the short PTL-style
+#: hook names) -> this trainer's hook vocabulary.
+_HOOK_ALIASES = {
+    "batch_end": "train_batch_end",
+    "epoch_end": "train_epoch_end",
+    "train_end": "fit_end",
+}
 
-    def __init__(self, on: str = "validation_end") -> None:
-        valid = ("validation_end", "train_epoch_end", "fit_end")
-        if on not in valid:
-            raise ValueError(f"on must be one of {valid}")
-        self._on = on
+_VALID_HOOKS = (
+    "fit_start",
+    "train_epoch_start",
+    "train_batch_end",
+    "train_epoch_end",
+    "validation_end",
+    "fit_end",
+)
+
+
+class TuneCallback(Callback):
+    """Base: fires on the configured hook(s), rank 0 only.
+
+    ``on`` is a trainer event name or a LIST of them (reference contract,
+    tune.py:104): any of ``fit_start``, ``train_epoch_start``,
+    ``train_batch_end`` (alias ``batch_end``), ``train_epoch_end`` (alias
+    ``epoch_end``), ``validation_end``, ``fit_end`` (alias ``train_end``);
+    an ``on_`` prefix is tolerated.
+    """
+
+    def __init__(self, on: Union[str, List[str]] = "validation_end") -> None:
+        hooks = [on] if isinstance(on, str) else list(on)
+        if not hooks:
+            raise ValueError("on must name at least one trainer event")
+        canon = []
+        for h in hooks:
+            name = h[3:] if isinstance(h, str) and h.startswith("on_") else h
+            name = _HOOK_ALIASES.get(name, name)
+            if name not in _VALID_HOOKS:
+                raise ValueError(
+                    f"on={h!r} must be one of {_VALID_HOOKS} (aliases "
+                    f"{tuple(_HOOK_ALIASES)})"
+                )
+            canon.append(name)
+        self._on = tuple(canon)
+
+    def _fire(self, hook: str, trainer: Any, module: Any) -> None:
+        if hook in self._on:
+            self._maybe_handle(trainer, module)
+
+    def on_fit_start(self, trainer: Any, module: Any) -> None:
+        self._fire("fit_start", trainer, module)
+
+    def on_train_epoch_start(self, trainer: Any, module: Any) -> None:
+        self._fire("train_epoch_start", trainer, module)
+
+    #: Live logs of the batch that just ended (host floats), set only for
+    #: the duration of a train_batch_end firing: callback_metrics updates
+    #: at epoch boundaries, so per-batch reports resolve against these.
+    _batch_logs: Optional[Dict[str, float]] = None
+
+    def on_train_batch_end(
+        self, trainer: Any, module: Any, logs: Any = None, *args: Any,
+        **kwargs: Any,
+    ) -> None:
+        self._batch_logs = dict(logs or {})
+        try:
+            self._fire("train_batch_end", trainer, module)
+        finally:
+            self._batch_logs = None
+
+    def _available_metrics(self, trainer: Any) -> Dict[str, float]:
+        out = dict(trainer.callback_metrics)
+        if self._batch_logs:
+            out.update(self._batch_logs)
+        return out
 
     def on_validation_end(self, trainer: Any, module: Any) -> None:
-        if self._on == "validation_end":
-            self._maybe_handle(trainer, module)
+        self._fire("validation_end", trainer, module)
 
     def on_train_epoch_end(self, trainer: Any, module: Any) -> None:
-        if self._on == "train_epoch_end":
-            self._maybe_handle(trainer, module)
+        self._fire("train_epoch_end", trainer, module)
 
     def on_fit_end(self, trainer: Any, module: Any) -> None:
-        if self._on == "fit_end":
-            self._maybe_handle(trainer, module)
+        self._fire("fit_end", trainer, module)
 
     #: Subclasses that snapshot ``trainer.checkpoint_state()`` set this so
     #: the (collective) state gathers run on EVERY rank before the rank
@@ -86,13 +149,13 @@ class TuneReportCallback(TuneCallback):
     def __init__(
         self,
         metrics: Union[None, str, List[str], Dict[str, str]] = None,
-        on: str = "validation_end",
+        on: Union[str, List[str]] = "validation_end",
     ) -> None:
         super().__init__(on=on)
         self._metrics = metrics
 
     def _handle(self, trainer: Any, module: Any) -> None:
-        report = _resolve_metrics(self._metrics, dict(trainer.callback_metrics))
+        report = _resolve_metrics(self._metrics, self._available_metrics(trainer))
         if not report:
             return
         # Closure crosses the worker->driver queue and runs in the trial
@@ -133,7 +196,11 @@ class _TuneCheckpointCallback(TuneCallback):
 
     needs_checkpoint_state = True
 
-    def __init__(self, filename: str = "checkpoint.ckpt", on: str = "validation_end") -> None:
+    def __init__(
+        self,
+        filename: str = "checkpoint.ckpt",
+        on: Union[str, List[str]] = "validation_end",
+    ) -> None:
         super().__init__(on=on)
         self._filename = filename
 
@@ -152,7 +219,7 @@ class TuneReportCheckpointCallback(TuneCallback):
         self,
         metrics: Union[None, str, List[str], Dict[str, str]] = None,
         filename: str = "checkpoint.ckpt",
-        on: str = "validation_end",
+        on: Union[str, List[str]] = "validation_end",
     ) -> None:
         super().__init__(on=on)
         self._metrics = metrics

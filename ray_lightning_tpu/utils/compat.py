@@ -1,0 +1,45 @@
+"""JAX version-compat shims.
+
+The package targets current JAX but must keep working on the 0.4.x line
+(the CI nightly/release matrix): APIs that moved between the two are
+funneled through here so call sites stay on the modern spelling.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Set
+
+import jax
+
+
+def shard_map(
+    f: Any,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: Optional[Set[str]] = None,
+    **kwargs: Any,
+):
+    """``jax.shard_map`` (>= 0.5) or ``jax.experimental.shard_map`` (0.4.x).
+
+    ``axis_names`` selects the manual axes; the 0.4.x API expresses the
+    same thing inversely via ``auto`` (every OTHER mesh axis stays under
+    the partitioner).
+    """
+    if hasattr(jax, "shard_map"):
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        # The 0.4.x replication checker mis-types lax.cond carries under
+        # partial-auto manual axes (the pipeline's fill/drain cond); the
+        # checker is advisory, and jax's own error message recommends
+        # disabling it there.
+        kwargs.setdefault("check_rep", False)
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -69,11 +69,22 @@ def test_bench_smoke_cpu():
     assert "resnet_steps_per_sec_per_chip" in out["extra"], out["extra"]
     assert "gpt_tokens_per_sec" in out["extra"], out["extra"]
     assert "tune_best_accuracy" in out["extra"], out["extra"]
-    # ASHA must be in the loop (VERDICT r4 weak #4): the sweep runs >= 8
-    # trials and records how many were pruned (value is workload-dependent;
-    # the key must exist).
+    # ASHA must be in the loop AND able to act (VERDICT r5 directive #2):
+    # >= 8 trials, a NON-DEGENERATE rung-1 metric spread (the saturation
+    # failure mode was every trial at accuracy 1.0 by rung 1, leaving the
+    # cutoff nothing to distinguish), and at least one genuinely-early kill.
     assert out["extra"]["tune_trials"] >= 8, out["extra"]
-    assert "tune_pruned" in out["extra"], out["extra"]
+    assert out["extra"]["tune_rung1_spread"] > 0.05, out["extra"]
+    assert out["extra"]["tune_pruned"] >= 1, out["extra"]
+    # Decode tokens/s table (VERDICT r5 weak #6: no decode metric at all):
+    # one-shot generate vs the serving engine, batch x weights grid.
+    rows = out["extra"]["decode_tokens_per_sec"]
+    assert {r["batch"] for r in rows} == {1, 4, 8}
+    assert {r["weights"] for r in rows} == {"bf16", "int8"}
+    for r in rows:
+        assert r["oneshot_tokens_per_sec"] > 0, r
+        assert r["engine_tokens_per_sec"] > 0, r
+    assert out["extra"]["decode_cpu_control"] is True  # this run is CPU
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

@@ -774,7 +774,9 @@ def test_gptlm_fit_with_chunked_loss(start_fabric):
     cfg = dataclasses.replace(TINY, loss_chunk=8)
     module = GPTLM(config=cfg, batch_size=8, n_train=64)
     trainer = Trainer(
-        max_epochs=2,
+        # 3 epochs: at 2 the loss lands within noise of the ln(V) bound
+        # on some jax versions' rng/numerics (observed 4.167 vs 4.159).
+        max_epochs=3,
         enable_checkpointing=False,
         seed=0,
         num_sanity_val_steps=0,

@@ -201,3 +201,46 @@ def test_tuner_unpackable_trial_fails_fast(two_nodes):
                 num_workers=4, num_cpus_per_worker=1
             ),
         ).fit()
+
+
+def test_remove_placement_group_kills_occupants_no_double_booking(two_nodes):
+    """Removing a group with a live occupant must kill the occupant FIRST
+    and only then release the reservation: releasing while the actor still
+    holds its bundle let a new actor double-book the node (the freed CPUs
+    were promised twice until the occupant died)."""
+    import time
+
+    two_nodes(4, 8)
+    pg = fabric.placement_group([{"CPU": 8}], strategy="PACK")
+    assert pg.bundle_node_ids == ["node-1"]
+    actor = (
+        fabric.remote(Probe)
+        .options(num_cpus=8, placement_group=pg)
+        .remote()
+    )
+    assert fabric.get(actor.node.remote()) == "node-1"
+
+    fabric.remove_placement_group(pg)
+    # The occupant is dead (not merely orphaned holding phantom capacity).
+    deadline = time.monotonic() + 10
+    while actor.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not actor.is_alive()
+    # Capacity came back exactly once: the full node is free again...
+    assert _node_avail() == {"node-0": 4.0, "node-1": 8.0}
+    # ...and can be booked exactly once (no oversubscription window).
+    a2 = fabric.remote(Probe).options(num_cpus=8).remote()
+    with pytest.raises(fabric.InsufficientResourcesError):
+        fabric.remote(Probe).options(num_cpus=8).remote()
+    fabric.kill(a2)
+
+
+def test_remove_placement_group_without_occupants_still_releases(two_nodes):
+    """The no-occupant path (Tuner teardown after killing trial actors)
+    keeps working, and double-removal stays idempotent."""
+    two_nodes(4, 8)
+    pg = fabric.placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert _node_avail() == {"node-0": 0.0, "node-1": 8.0}  # packed on node-0
+    fabric.remove_placement_group(pg)
+    fabric.remove_placement_group(pg)  # idempotent
+    assert _node_avail() == {"node-0": 4.0, "node-1": 8.0}

@@ -160,3 +160,58 @@ def test_tune_nested_distributed_fit(start_fabric, tmp_path):
     assert res.error is None, res.error
     assert len(res.history) == 2
     assert "loss" in res.metrics
+
+
+def test_tune_callback_on_list_and_batch_end_frequency(tmp_path):
+    """Reference contract (tune.py:104): ``on`` accepts a LIST of trainer
+    events and any hook, including per-batch. Frequency check: a
+    batch_end+epoch_end callback reports once per logged batch plus once
+    per epoch."""
+    from ray_lightning_tpu.models import XORModule
+    from ray_lightning_tpu.trainer import Trainer
+    from ray_lightning_tpu.tune import TuneReportCallback
+    from ray_lightning_tpu.tune import session as tune_session
+
+    class FakeQueue:
+        def __init__(self):
+            self.items = []
+
+        def put(self, item):
+            self.items.append(item)
+
+    q = FakeQueue()
+    tune_session.init_trial_session("t0", str(tmp_path), q)
+    try:
+        trainer = Trainer(
+            max_epochs=2,
+            enable_checkpointing=False,
+            seed=0,
+            num_sanity_val_steps=0,
+            log_every_n_steps=1,  # every batch crosses a log boundary
+            callbacks=[
+                TuneReportCallback(on=["batch_end", "epoch_end"])
+            ],
+        )
+        trainer.fit(XORModule(lr=0.1, batch_size=2))
+        n_batches = trainer.global_step
+        assert n_batches > 0
+        # One report per batch + one per epoch (epoch_end alias).
+        assert len(q.items) == n_batches + 2
+        assert all(item["metrics"] for item in q.items)
+    finally:
+        tune_session.clear_trial_session()
+
+
+def test_tune_callback_on_validation_aliases_and_errors():
+    from ray_lightning_tpu.tune import TuneReportCallback
+    from ray_lightning_tpu.tune.callbacks import TuneCallback
+
+    # Aliases and on_ prefixes canonicalize; lists are preserved.
+    cb = TuneReportCallback(
+        on=["on_validation_end", "train_end", "batch_end"]
+    )
+    assert cb._on == ("validation_end", "fit_end", "train_batch_end")
+    with pytest.raises(ValueError, match="must be one of"):
+        TuneCallback(on="after_lunch")
+    with pytest.raises(ValueError, match="at least one"):
+        TuneCallback(on=[])
