@@ -375,6 +375,10 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
       config: GPTConfig field dict (overrides/completes the stored one).
       int8: quantize weights at load (weight-only int8 decode).
       replicas, num_slots, max_seq, max_prefills_per_step: topology knobs.
+      decode_fold: decode iterations per compiled dispatch (K tokens per
+        slot per engine step; amortizes dispatch/sync, admissions land at
+        fold boundaries). pipeline: double-buffer fold dispatch (default
+        on).
       prompts: path to a prompts file ("-" = stdin), one request per
         line as comma/space-separated token ids.
       max_new_tokens, temperature, top_k, top_p, seed, eos_token:
@@ -417,6 +421,8 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         "max_prefills_per_step": int(
             serve_cfg.pop("max_prefills_per_step", 1)
         ),
+        "decode_fold": int(serve_cfg.pop("decode_fold", 1)),
+        "pipeline": bool(serve_cfg.pop("pipeline", True)),
     }
     pb = serve_cfg.pop("prefill_buckets", None)
     if pb is not None:

@@ -1178,6 +1178,140 @@ def gpt_decode_step(
     return logits, k_cache, v_cache
 
 
+def sample_logits_batched(
+    keys: jax.Array,
+    logits: jax.Array,
+    temps: jax.Array,
+    top_ks: jax.Array,
+    top_ps: jax.Array,
+) -> jax.Array:
+    """Per-row sampling with TRACED params — the batched counterpart of
+    :func:`sample_logits` (whose knobs are static Python values).
+
+    ``keys`` (B, 2) uint32 per-row PRNG keys; ``temps`` (B,) fp32 (<= 0 =
+    greedy); ``top_ks`` (B,) int32 (0 = off); ``top_ps`` (B,) fp32 (>= 1 =
+    off). Filters compose k-then-p like sample_logits. Traced knobs keep
+    the serving decode step at ONE compile for any mix of per-request
+    sampling configs.
+
+    One descending sort serves BOTH filters: the top-k threshold reads the
+    (k-1)th sorted entry, and the nucleus cutoff reuses the same sorted
+    rows with the below-threshold tail masked to ``-inf`` — masking a
+    value-suffix of a descending sort leaves it sorted, so this IS the
+    sorted view of the k-filtered logits the p-filter needs, without a
+    second O(V log V) sort of the (B, V) rows.
+
+    An all-greedy batch (the common serving mix, and the exactness
+    control) short-circuits through ``lax.cond`` to a bare argmax at run
+    time — the sort/softmax/categorical pipeline would otherwise cost a
+    real fraction of each decode step — while staying ONE compile and
+    bit-identical to the full branch (whose greedy rows are the same
+    argmax).
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def full(_):
+        t = jnp.maximum(temps, 1e-8)[:, None]
+        lg = (logits / t).astype(jnp.float32)
+        neg = jnp.asarray(float("-inf"), lg.dtype)
+        sorted_desc = jnp.sort(lg, axis=-1)[:, ::-1]
+        # top-k: keep each row's k highest (k=V disables).
+        k = jnp.where((top_ks > 0) & (top_ks < V), top_ks, V)
+        kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+        lg = jnp.where(lg < kth, neg, lg)
+        # top-p (nucleus) on the k-filtered rows: cut tokens whose
+        # EXCLUSIVE prefix mass already reaches p (the crossing token
+        # stays).
+        apply_p = ((top_ps > 0.0) & (top_ps < 1.0))[:, None]
+        sd = jnp.where(sorted_desc < kth, neg, sorted_desc)
+        probs = jax.nn.softmax(sd, axis=-1)
+        before = jnp.cumsum(probs, axis=-1) - probs
+        cutoff = jnp.min(
+            jnp.where(before < top_ps[:, None], sd, -neg),
+            axis=-1,
+            keepdims=True,
+        )
+        lg = jnp.where(apply_p & (lg < cutoff), neg, lg)
+        sampled = jax.vmap(jax.random.categorical)(keys, lg)
+        return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+    return jax.lax.cond(
+        jnp.all(temps <= 0.0), lambda _: greedy, full, None
+    )
+
+
+def gpt_decode_fold(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    cur: jax.Array,
+    pos: jax.Array,
+    keys: jax.Array,
+    temps: jax.Array,
+    top_ks: jax.Array,
+    top_ps: jax.Array,
+    active: jax.Array,
+    remaining: jax.Array,
+    eos_toks: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    *,
+    fold: int,
+) -> Tuple[jax.Array, ...]:
+    """``fold`` decode+sample iterations in ONE traced program (a
+    ``lax.scan`` over :func:`gpt_decode_step`) with per-slot in-graph
+    termination — the serving engine's folded hot loop.
+
+    Per-slot state: ``cur``/``pos`` (B,) int32, ``keys`` (B, 2) uint32,
+    sampling knobs as in :func:`sample_logits_batched`, ``active`` (B,)
+    bool, ``remaining`` (B,) int32 tokens still to emit, ``eos_toks`` (B,)
+    int32 (-1 = disabled). Each iteration decodes every slot, samples, and
+    then advances ONLY the active slots; a slot whose sampled token equals
+    its eos or whose ``remaining`` hits zero self-freezes — its cur/pos/
+    keys stop moving mid-fold, so no post-EOS token is ever emitted and
+    the rng chain of every kept token matches an unfolded run exactly.
+    (Frozen slots still compute — the lanes are batched — and rewrite
+    stale cache rows past their frozen position; those rows are invisible
+    behind the per-slot position masks and are refreshed by the next
+    tenant's prefill/decode writes before any read.)
+
+    Returns ``(tok_block (fold, B) int32 with -1 at non-emitted lanes,
+    emit_block (fold, B) bool, cur, pos, keys, active, remaining,
+    k_cache, v_cache)``. ``fold=1`` is exactly one unfolded step.
+    """
+
+    def body(carry, _):
+        cur, pos, keys, active, remaining, k_cache, v_cache = carry
+        logits, k_cache, v_cache = gpt_decode_step(
+            params, cfg, cur, pos, k_cache, v_cache
+        )
+        split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+        new_keys, subs = split[:, 0], split[:, 1]
+        toks = sample_logits_batched(subs, logits, temps, top_ks, top_ps)
+        emit = active
+        cur = jnp.where(active, toks, cur)
+        pos = jnp.where(active, pos + 1, pos)
+        keys = jnp.where(active[:, None], new_keys, keys)
+        remaining = jnp.where(active, remaining - 1, remaining)
+        active = active & (remaining > 0) & (toks != eos_toks)
+        return (cur, pos, keys, active, remaining, k_cache, v_cache), (
+            jnp.where(emit, toks, -1),
+            emit,
+        )
+
+    carry, (tok_block, emit_block) = jax.lax.scan(
+        body,
+        (cur, pos, keys, active, remaining, k_cache, v_cache),
+        None,
+        length=int(fold),
+    )
+    cur, pos, keys, active, remaining, k_cache, v_cache = carry
+    return (
+        tok_block, emit_block, cur, pos, keys, active, remaining,
+        k_cache, v_cache,
+    )
+
+
 def gpt_generate(
     params: Dict[str, Any],
     cfg: GPTConfig,

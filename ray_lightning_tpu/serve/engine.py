@@ -1,16 +1,49 @@
-"""Slot-based continuous-batching decode engine.
+"""Slot-based continuous-batching decode engine — folded, device-resident.
 
 The bridge from ``gpt_generate`` (one static-shape batch, one user) to a
-serving system: ONE compiled decode-step executable runs over a fixed
+serving system: ONE compiled decode executable runs over a fixed
 ``(num_slots, max_seq)`` KV cache; requests are admitted into free slots
-at step boundaries (a bucketed prefill writes the slot's cache range),
+at fold boundaries (a bucketed prefill writes the slot's cache range),
 finished slots are evicted and recycled — all without recompilation
 (Orca-style iteration-level scheduling over vLLM-style slot-managed
 caches).
 
+Three compounding optimisations close the gap to the fused one-shot
+``gpt_generate`` scan (which pays one dispatch for the whole decode,
+while a naive engine pays dispatch + H2D state ship + blocking D2H token
+sync per token):
+
+- **Device-resident slot state.** ``cur``/``pos``/``temps``/``top_ks``/
+  ``top_ps``/``keys`` plus the in-graph termination state (``active``
+  mask, ``remaining`` token budget, per-slot ``eos``) live as donated
+  device arrays threaded through the compiled step and updated in-graph
+  — steady-state decode ships ZERO per-step H2D traffic. Admission and
+  eviction update the device state through one small compiled slot-write
+  executable (the same pattern as the per-bucket cache writes), so
+  ``compiled_count`` stays frozen after construction.
+- **Folded decode (``decode_fold=K``).** One compiled ``lax.scan``
+  (``models/gpt.py:gpt_decode_fold``) executes K decode iterations per
+  dispatch and returns a ``(K, num_slots)`` token block plus an emit
+  mask. Length/EOS detection runs IN-GRAPH: a slot self-freezes mid-fold
+  (cur/pos/rng stop advancing), so post-EOS tokens are never emitted and
+  kept tokens' rng chains match an unfolded run bit-for-bit. K=1
+  reproduces the unfolded engine exactly; larger K amortizes the
+  dispatch + sync cost over K tokens at the price of admission latency
+  (new requests join at fold boundaries).
+- **Async double-buffered dispatch (``pipeline=True``).** ``step()``
+  dispatches fold N+1 against the donated device state BEFORE blocking
+  on fold N's token block (JAX async dispatch makes this free once the
+  state is device-resident), so host token fan-out, streaming callbacks,
+  and scheduler bookkeeping overlap device compute. Slots cancelled
+  between dispatch and harvest may still decode one zombie fold; their
+  tokens are dropped at harvest by identity against the dispatch-time
+  snapshot, and the deactivate/admission writes queue AFTER the in-flight
+  fold, so a recycled slot can never inherit a stale token.
+
 Exactness contract: a request decodes token-identically to a solo
 ``gpt_generate`` call (greedy), no matter which batchmates share its
-steps. Two properties deliver it, both asserted in tests/test_serve.py:
+steps and no matter the fold. Two properties deliver it, both asserted
+in tests/test_serve.py:
 
 - **Slot masks.** The shared step (``models/gpt.py:gpt_decode_step``)
   attends each slot only to ``position <= pos[slot]`` with exact ``-inf``
@@ -45,43 +78,19 @@ class SlotInfo:
     max_new_tokens: int
     n_generated: int
     eos_token: int  # -1 = disabled
+    #: Host-side eviction marker: tokens an in-flight fold produced for a
+    #: released tenant are dropped at harvest (the device keeps decoding a
+    #: cancelled slot until its deactivate write lands).
+    released: bool = False
 
 
 def _sample_rows(keys, logits, temps, top_ks, top_ps):
-    """Per-row sampling with TRACED params — the batched counterpart of
-    models.gpt.sample_logits (whose knobs are static Python values).
+    """Alias for :func:`models.gpt.sample_logits_batched` (the sampler
+    moved next to ``sample_logits`` when the folded decode scan landed in
+    models/gpt.py; kept so engine-level callers/tests don't churn)."""
+    from ray_lightning_tpu.models.gpt import sample_logits_batched
 
-    ``keys`` (B, 2) uint32 per-row PRNG keys; ``temps`` (B,) fp32 (<= 0 =
-    greedy); ``top_ks`` (B,) int32 (0 = off); ``top_ps`` (B,) fp32 (>= 1 =
-    off). Filters compose k-then-p like sample_logits. Traced knobs keep
-    the decode step at ONE compile for any mix of per-request sampling
-    configs.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    V = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1)
-    t = jnp.maximum(temps, 1e-8)[:, None]
-    lg = (logits / t).astype(jnp.float32)
-    neg = jnp.asarray(float("-inf"), lg.dtype)
-    # top-k: keep each row's k highest (k=V disables).
-    sorted_desc = jnp.sort(lg, axis=-1)[:, ::-1]
-    k = jnp.where((top_ks > 0) & (top_ks < V), top_ks, V)
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
-    lg = jnp.where(lg < kth, neg, lg)
-    # top-p (nucleus) on the k-filtered rows: cut tokens whose EXCLUSIVE
-    # prefix mass already reaches p (the crossing token stays).
-    apply_p = ((top_ps > 0.0) & (top_ps < 1.0))[:, None]
-    sd = jnp.sort(lg, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sd, axis=-1)
-    before = jnp.cumsum(probs, axis=-1) - probs
-    cutoff = jnp.min(
-        jnp.where(before < top_ps[:, None], sd, -neg), axis=-1, keepdims=True
-    )
-    lg = jnp.where(apply_p & (lg < cutoff), neg, lg)
-    sampled = jax.vmap(jax.random.categorical)(keys, lg)
-    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+    return sample_logits_batched(keys, logits, temps, top_ks, top_ps)
 
 
 def default_buckets(max_seq: int, lo: int = 16) -> Tuple[int, ...]:
@@ -98,15 +107,21 @@ def default_buckets(max_seq: int, lo: int = 16) -> Tuple[int, ...]:
 class DecodeEngine:
     """Continuous-batching decode over a fixed slot-indexed KV cache.
 
-    Construction compiles everything (prefill per bucket, slot write per
-    bucket, one decode step, one first-token sampler); admissions and
-    steps afterwards only EXECUTE — ``compiled_count`` must not move, and
-    the test suite asserts it doesn't.
+    Construction compiles everything (one FUSED admission per bucket —
+    prefill + cache write + first-token sample + slot-state write in a
+    single dispatch — one folded decode step, one slot-state write for
+    eviction); admissions and steps afterwards only EXECUTE —
+    ``compiled_count`` must not move, and the test suite asserts it
+    doesn't.
 
-    Host/device split: the caches live on device across calls; per-slot
-    scalar state (current token, position, sampling knobs, rng keys) lives
-    in host numpy, shipped with each step call (tiny, fixed shapes).
-    All methods must be driven from one thread (the scheduler loop).
+    Host/device split: the caches AND all per-slot scalar state (current
+    token, position, sampling knobs, rng keys, active/remaining/eos) live
+    on device across calls, donated through the compiled executables —
+    steady-state decode ships no per-step H2D traffic and syncs D2H once
+    per fold (the token block). The host keeps only request bookkeeping
+    (``SlotInfo``); :meth:`device_state` is the explicit sync point that
+    materializes host mirrors. All methods must be driven from one
+    thread (the scheduler loop).
     """
 
     def __init__(
@@ -116,6 +131,8 @@ class DecodeEngine:
         num_slots: int = 4,
         max_seq: Optional[int] = None,
         prefill_buckets: Optional[Sequence[int]] = None,
+        decode_fold: int = 1,
+        pipeline: bool = True,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -127,6 +144,10 @@ class DecodeEngine:
         self.num_slots = int(num_slots)
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        self.decode_fold = int(decode_fold)
+        if self.decode_fold < 1:
+            raise ValueError("decode_fold must be >= 1")
+        self.pipeline = bool(pipeline)
         self.max_seq = int(max_seq or config.max_seq)
         if self.max_seq > config.max_seq:
             raise ValueError(
@@ -150,14 +171,20 @@ class DecodeEngine:
         self._k = jnp.zeros((L, B, S, Hkv, hd), cdt)
         self._v = jnp.zeros((L, B, S, Hkv, hd), cdt)
 
-        # Per-slot host state (fixed shapes: one step signature forever).
-        self._cur = np.zeros(B, np.int32)
-        self._pos = np.zeros(B, np.int32)
-        self._temps = np.zeros(B, np.float32)
-        self._top_ks = np.zeros(B, np.int32)
-        self._top_ps = np.ones(B, np.float32)
-        self._keys = np.zeros((B, 2), np.uint32)
+        # Per-slot DEVICE state (fixed shapes: one step signature forever).
+        self._cur = jnp.zeros(B, jnp.int32)
+        self._pos = jnp.zeros(B, jnp.int32)
+        self._temps = jnp.zeros(B, jnp.float32)
+        self._top_ks = jnp.zeros(B, jnp.int32)
+        self._top_ps = jnp.ones(B, jnp.float32)
+        self._keys = jnp.zeros((B, 2), jnp.uint32)
+        self._active = jnp.zeros(B, jnp.bool_)
+        self._remaining = jnp.zeros(B, jnp.int32)
+        self._eos = jnp.full(B, -1, jnp.int32)
         self._slots: List[Optional[SlotInfo]] = [None] * B
+        #: Double buffer: ((tok_block, emit_block), dispatch-time slot
+        #: snapshot) of the fold currently executing on device.
+        self._inflight: Optional[Tuple[Tuple[Any, Any], List[Optional[SlotInfo]]]] = None
 
         self.compiled_count = 0
         self._compile()
@@ -171,8 +198,9 @@ class DecodeEngine:
             _head_weight,
             _lm_head,
             _make_norm,
-            gpt_decode_step,
+            gpt_decode_fold,
             gpt_prefill,
+            sample_logits_batched,
         )
 
         cfg = self.cfg
@@ -184,91 +212,184 @@ class DecodeEngine:
         def spec(arr):
             return jax.ShapeDtypeStruct(np.shape(arr), np.asarray(arr).dtype)
 
-        def prefill_impl(params, prompt, last_idx):
+        def admit_impl(
+            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps,
+            keys, active, remaining, eos_toks, prompt, last_idx, slot,
+            key0, temp, tk, tp, n_new, eos,
+        ):
+            # The WHOLE admission in one dispatch: bucketed prefill, cache
+            # write into the slot's rows [0, Pb), first-token sample, and
+            # the slot's full scalar-state write — one executable chain
+            # per admit instead of four, so a burst of admissions doesn't
+            # pay 4x the dispatch latency per request. The slot
+            # deactivates itself in-graph when the request is already
+            # done at its first token (n_new == 1 or eos).
             h, pf_k, pf_v = gpt_prefill(params, cfg, prompt)
             h_last = jax.lax.dynamic_slice_in_dim(h, last_idx, 1, axis=1)
             h_last = norm_fn(h_last, params["lnf_g"], params["lnf_b"])[:, 0]
             logits = _lm_head(h_last, _head_weight(params, cfg))
-            return pf_k, pf_v, logits
-
-        def write_impl(k_cache, v_cache, pf_k, pf_v, slot):
-            # pf_k/pf_v: (L, 1, Pb, Hkv, hd) -> rows [0, Pb) of one slot.
             zero = jnp.zeros((), jnp.int32)
             start = (zero, slot, zero, zero, zero)
-            return (
-                jax.lax.dynamic_update_slice(k_cache, pf_k, start),
-                jax.lax.dynamic_update_slice(v_cache, pf_v, start),
-            )
-
-        def first_token_impl(key, logits, temp, top_k, top_p):
-            key, sub = jax.random.split(key)
-            tok = _sample_rows(
-                sub[None], logits, temp[None], top_k[None], top_p[None]
+            k_cache = jax.lax.dynamic_update_slice(k_cache, pf_k, start)
+            v_cache = jax.lax.dynamic_update_slice(v_cache, pf_v, start)
+            key, sub = jax.random.split(key0)
+            tok = sample_logits_batched(
+                sub[None], logits, temp[None], tk[None], tp[None]
             )[0]
-            return key, tok
+            live = (n_new > 1) & (tok != eos)
+
+            def upd(arr, v):
+                return jax.lax.dynamic_update_index_in_dim(arr, v, slot, 0)
+
+            return (
+                k_cache,
+                v_cache,
+                upd(cur, tok),
+                upd(pos, last_idx + 1),
+                upd(temps, temp),
+                upd(top_ks, tk),
+                upd(top_ps, tp),
+                upd(keys, key),
+                upd(active, live),
+                upd(remaining, n_new - 1),
+                upd(eos_toks, eos),
+                tok,
+            )
 
         def step_impl(
-            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps, keys
+            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps,
+            keys, active, remaining, eos_toks,
         ):
-            logits, k_cache, v_cache = gpt_decode_step(
-                params, cfg, cur, pos, k_cache, v_cache
+            return gpt_decode_fold(
+                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                active, remaining, eos_toks, k_cache, v_cache,
+                fold=self.decode_fold,
             )
-            split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
-            new_keys, subs = split[:, 0], split[:, 1]
-            toks = _sample_rows(subs, logits, temps, top_ks, top_ps)
-            return new_keys, toks, k_cache, v_cache
+
+        def slot_write_impl(
+            cur, pos, temps, top_ks, top_ps, keys, active, remaining,
+            eos_toks, slot, cur_v, pos_v, temp_v, tk_v, tp_v, key_v,
+            active_v, rem_v, eos_v,
+        ):
+            # One slot's full scalar state in one tiny executable —
+            # admission (active_v=True) and eviction (active_v=False)
+            # share it, so occupancy changes never recompile.
+            def upd(arr, v):
+                return jax.lax.dynamic_update_index_in_dim(arr, v, slot, 0)
+
+            return (
+                upd(cur, cur_v),
+                upd(pos, pos_v),
+                upd(temps, temp_v),
+                upd(top_ks, tk_v),
+                upd(top_ps, tp_v),
+                upd(keys, key_v),
+                upd(active, active_v),
+                upd(remaining, rem_v),
+                upd(eos_toks, eos_v),
+            )
 
         cache_spec = spec(self._k)
-        self._prefill_exec: Dict[int, Any] = {}
-        self._write_exec: Dict[int, Any] = {}
+        state_specs = (
+            spec(self._cur),
+            spec(self._pos),
+            spec(self._temps),
+            spec(self._top_ks),
+            spec(self._top_ps),
+            spec(self._keys),
+            spec(self._active),
+            spec(self._remaining),
+            spec(self._eos),
+        )
         i32 = jax.ShapeDtypeStruct((), np.int32)
+        f32 = jax.ShapeDtypeStruct((), np.float32)
+        b1 = jax.ShapeDtypeStruct((), np.bool_)
+        key_spec = jax.ShapeDtypeStruct((2,), np.uint32)
+        self._admit_exec: Dict[int, Any] = {}
         for pb in self.prefill_buckets:
             prompt_spec = jax.ShapeDtypeStruct((1, pb), np.int32)
-            self._prefill_exec[pb] = (
-                jax.jit(prefill_impl)
-                .lower(p_spec, prompt_spec, i32)
+            self._admit_exec[pb] = (
+                jax.jit(admit_impl, donate_argnums=tuple(range(1, 12)))
+                .lower(
+                    p_spec,
+                    cache_spec,
+                    cache_spec,
+                    *state_specs,
+                    prompt_spec,
+                    i32,
+                    i32,
+                    key_spec,
+                    f32,
+                    i32,
+                    f32,
+                    i32,
+                    i32,
+                )
                 .compile()
             )
             self.compiled_count += 1
-            L, Hkv, hd = self.cfg.n_layer, self.cfg.kv_head, self.cfg.head_dim
-            pf_spec = jax.ShapeDtypeStruct(
-                (L, 1, pb, Hkv, hd), jnp.dtype(self.cfg.compute_dtype)
-            )
-            self._write_exec[pb] = (
-                jax.jit(write_impl, donate_argnums=(0, 1))
-                .lower(cache_spec, cache_spec, pf_spec, pf_spec, i32)
-                .compile()
-            )
-            self.compiled_count += 1
-        key_spec = jax.ShapeDtypeStruct((2,), np.uint32)
-        self._first_token_exec = (
-            jax.jit(first_token_impl)
-            .lower(
-                key_spec,
-                jax.ShapeDtypeStruct((1, cfg.vocab_size), np.float32),
-                jax.ShapeDtypeStruct((), np.float32),
-                i32,
-                jax.ShapeDtypeStruct((), np.float32),
-            )
-            .compile()
-        )
-        self.compiled_count += 1
+        # The folded step: caches + in-graph-updated state donated; the
+        # sampling knobs and eos table are read-only inputs (slot writes
+        # own their updates).
         self._step_exec = (
-            jax.jit(step_impl, donate_argnums=(1, 2))
+            jax.jit(step_impl, donate_argnums=(1, 2, 3, 4, 8, 9, 10))
+            .lower(p_spec, cache_spec, cache_spec, *state_specs)
+            .compile()
+        )
+        self.compiled_count += 1
+        self._slot_write_exec = (
+            jax.jit(
+                slot_write_impl,
+                donate_argnums=tuple(range(9)),
+            )
             .lower(
-                p_spec,
-                cache_spec,
-                cache_spec,
-                spec(self._cur),
-                spec(self._pos),
-                spec(self._temps),
-                spec(self._top_ks),
-                spec(self._top_ps),
-                spec(self._keys),
+                *state_specs,
+                i32,
+                i32,
+                i32,
+                f32,
+                i32,
+                f32,
+                key_spec,
+                b1,
+                i32,
+                i32,
             )
             .compile()
         )
         self.compiled_count += 1
+
+    # -- device state plumbing -------------------------------------------
+    def _slot_write(
+        self, slot, cur_v, pos_v, temp_v, tk_v, tp_v, key_v, active_v,
+        rem_v, eos_v,
+    ) -> None:
+        (
+            self._cur, self._pos, self._temps, self._top_ks, self._top_ps,
+            self._keys, self._active, self._remaining, self._eos,
+        ) = self._slot_write_exec(
+            self._cur, self._pos, self._temps, self._top_ks, self._top_ps,
+            self._keys, self._active, self._remaining, self._eos,
+            np.int32(slot), np.int32(cur_v), np.int32(pos_v),
+            np.float32(temp_v), np.int32(tk_v), np.float32(tp_v),
+            key_v, np.bool_(active_v), np.int32(rem_v), np.int32(eos_v),
+        )
+
+    def device_state(self) -> Dict[str, np.ndarray]:
+        """Host snapshot of the device-resident per-slot state. This is a
+        SYNC POINT: it blocks on any in-flight fold (debug/tests only —
+        the steady-state loop never calls it)."""
+        return {
+            "cur": np.asarray(self._cur),
+            "pos": np.asarray(self._pos),
+            "temps": np.asarray(self._temps),
+            "top_ks": np.asarray(self._top_ks),
+            "top_ps": np.asarray(self._top_ps),
+            "keys": np.asarray(self._keys),
+            "active": np.asarray(self._active),
+            "remaining": np.asarray(self._remaining),
+            "eos": np.asarray(self._eos),
+        }
 
     # -- introspection ---------------------------------------------------
     @property
@@ -301,70 +422,143 @@ class DecodeEngine:
         eos_token: Optional[int] = None,
     ) -> Tuple[int, int, bool]:
         """Prefill ``prompt`` into a free slot; returns (slot, first_token,
-        done). Raises when no slot is free or the request cannot fit."""
+        done). Raises when no slot is free or the request cannot fit.
+
+        With a fold in flight, the prefill/cache/slot writes queue AFTER
+        it (donation order), so the new tenant's first decode lands in
+        the NEXT dispatched fold — admission is a fold-boundary event.
+        """
+        return self.admit_many(
+            [
+                dict(
+                    prompt=prompt,
+                    request_id=request_id,
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                    top_k=top_k,
+                    top_p=top_p,
+                    seed=seed,
+                    eos_token=eos_token,
+                )
+            ]
+        )[0]
+
+    def admit_many(
+        self, requests: Sequence[Dict[str, Any]]
+    ) -> List[Tuple[int, int, bool]]:
+        """Admit a burst of requests at one fold boundary; returns
+        ``(slot, first_token, done)`` per request, in order.
+
+        Each request is one fused dispatch (prefill + cache write +
+        first-token sample + slot-state write), and ALL chains are
+        dispatched before the first D2H token sync — the host round trip
+        of request i overlaps the device work of requests i+1..n instead
+        of fencing it. Requests are validated up front, so a bad spec
+        rejects the whole burst before any device state moves.
+        """
         import jax
 
         free = self.free_slots()
-        if not free:
-            raise RuntimeError("no free slot (check free_slots() first)")
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        P = int(prompt.shape[0])
-        n_new = int(max_new_tokens)
-        if P < 1 or n_new < 1:
-            raise ValueError("need a non-empty prompt and max_new_tokens >= 1")
-        if P + n_new > self.max_seq:
-            raise ValueError(
-                f"prompt ({P}) + max_new_tokens ({n_new}) exceeds engine "
-                f"max_seq {self.max_seq}"
+        if len(requests) > len(free):
+            raise RuntimeError(
+                f"{len(requests)} admissions but only {len(free)} free "
+                "slots (check free_slots() first)"
             )
-        pb = self.bucket_for(P)
-        slot = free[0]
-        padded = np.zeros((1, pb), np.int32)
-        padded[0, :P] = prompt
-        pf_k, pf_v, logits = self._prefill_exec[pb](
-            self.params, padded, np.int32(P - 1)
-        )
-        self._k, self._v = self._write_exec[pb](
-            self._k, self._v, pf_k, pf_v, np.int32(slot)
-        )
-        temp = np.float32(temperature)
-        tk = np.int32(0 if top_k is None else top_k)
-        tp = np.float32(1.0 if top_p is None else top_p)
-        key = np.asarray(
-            jax.random.PRNGKey(int(seed)), np.uint32
-        ).reshape(2)
-        key, tok = self._first_token_exec(key, np.asarray(logits), temp, tk, tp)
-        tok = int(np.asarray(tok))
-        eos = -1 if eos_token is None else int(eos_token)
-        done = n_new == 1 or tok == eos
-        if not done:
-            self._slots[slot] = SlotInfo(
-                request_id=request_id,
-                max_new_tokens=n_new,
-                n_generated=1,
-                eos_token=eos,
+        staged = []
+        for r, slot in zip(requests, free):
+            prompt = np.asarray(r["prompt"], np.int32).reshape(-1)
+            P = int(prompt.shape[0])
+            n_new = int(r["max_new_tokens"])
+            if P < 1 or n_new < 1:
+                raise ValueError(
+                    "need a non-empty prompt and max_new_tokens >= 1"
+                )
+            if P + n_new > self.max_seq:
+                raise ValueError(
+                    f"prompt ({P}) + max_new_tokens ({n_new}) exceeds "
+                    f"engine max_seq {self.max_seq}"
+                )
+            pb = self.bucket_for(P)
+            eos_token = r.get("eos_token")
+            staged.append((slot, r, prompt, P, n_new, pb,
+                           -1 if eos_token is None else int(eos_token)))
+        pending = []
+        for slot, r, prompt, P, n_new, pb, eos in staged:
+            padded = np.zeros((1, pb), np.int32)
+            padded[0, :P] = prompt
+            temp = np.float32(r.get("temperature", 0.0))
+            top_k = r.get("top_k")
+            top_p = r.get("top_p")
+            tk = np.int32(0 if top_k is None else top_k)
+            tp = np.float32(1.0 if top_p is None else top_p)
+            key0 = np.asarray(
+                jax.random.PRNGKey(int(r.get("seed", 0))), np.uint32
+            ).reshape(2)
+            (
+                self._k, self._v, self._cur, self._pos, self._temps,
+                self._top_ks, self._top_ps, self._keys, self._active,
+                self._remaining, self._eos, tok,
+            ) = self._admit_exec[pb](
+                self.params, self._k, self._v, self._cur, self._pos,
+                self._temps, self._top_ks, self._top_ps, self._keys,
+                self._active, self._remaining, self._eos,
+                padded, np.int32(P - 1), np.int32(slot), key0,
+                temp, tk, tp, np.int32(n_new), np.int32(eos),
             )
-            self._cur[slot] = tok
-            self._pos[slot] = P
-            self._temps[slot] = temp
-            self._top_ks[slot] = tk
-            self._top_ps[slot] = tp
-            self._keys[slot] = np.asarray(key, np.uint32)
-        return slot, tok, done
+            pending.append((slot, r, n_new, eos, tok))
+        out: List[Tuple[int, int, bool]] = []
+        for slot, r, n_new, eos, tok in pending:
+            tok = int(np.asarray(tok))
+            # Mirrors the in-graph `live` predicate: a request done at
+            # its first token never occupies the slot (the device wrote
+            # its own active=False).
+            done = n_new == 1 or tok == eos
+            if not done:
+                self._slots[slot] = SlotInfo(
+                    request_id=r["request_id"],
+                    max_new_tokens=n_new,
+                    n_generated=1,
+                    eos_token=eos,
+                )
+            out.append((slot, tok, done))
+        return out
 
     def release(self, slot: int) -> None:
-        """Evict a slot (finished or cancelled); it is immediately
-        reusable — the stale cache rows are invisible behind the slot
-        masks and get overwritten by the next tenant."""
+        """Evict a slot (cancelled, or host-observed finished); it is
+        immediately reusable — the stale cache rows are invisible behind
+        the slot masks and get overwritten by the next tenant. A
+        host-initiated eviction also deactivates the slot ON DEVICE
+        (queued after any in-flight fold, whose tokens for this tenant
+        are dropped at harvest via the ``released`` marker)."""
+        info = self._slots[slot]
+        if info is None:
+            return
+        info.released = True
+        self._slots[slot] = None
+        self._deactivate(slot)
+
+    def _deactivate(self, slot: int) -> None:
+        self._slot_write(
+            slot, 0, 0, 0.0, 0, 1.0,
+            np.zeros(2, np.uint32), False, 0, -1,
+        )
+
+    def _release_synced(self, slot: int, info: SlotInfo) -> None:
+        # Device-detected completion: the fold already froze the slot
+        # in-graph at exactly this token, so no deactivate write is
+        # needed — host bookkeeping only.
+        info.released = True
         self._slots[slot] = None
 
-    def step(self) -> List[Tuple[int, str, int, bool]]:
-        """One decode iteration over every occupied slot; returns
-        ``(slot, request_id, token, done)`` per active slot. Finished
-        slots are evicted and recycled before returning."""
-        if self.num_active == 0:
-            return []
-        new_keys, toks, self._k, self._v = self._step_exec(
+    # -- the hot loop ----------------------------------------------------
+    def _dispatch(self) -> Tuple[Tuple[Any, Any], List[Optional[SlotInfo]]]:
+        """Launch one fold against the current device state (async); the
+        donated state arrays are replaced by the fold's outputs, so
+        subsequent writes (admission, eviction) queue after it."""
+        (
+            tok_block, emit_block, self._cur, self._pos, self._keys,
+            self._active, self._remaining, self._k, self._v,
+        ) = self._step_exec(
             self.params,
             self._k,
             self._v,
@@ -374,24 +568,64 @@ class DecodeEngine:
             self._top_ks,
             self._top_ps,
             self._keys,
+            self._active,
+            self._remaining,
+            self._eos,
         )
-        toks = np.asarray(toks)
-        # Copy: np.asarray on a device array yields a read-only view, and
-        # admit() writes per-slot keys in place.
-        self._keys = np.array(new_keys, np.uint32)
-        out: List[Tuple[int, str, int, bool]] = []
+        return (tok_block, emit_block), list(self._slots)
+
+    def _want_next(self, snapshot: List[Optional[SlotInfo]]) -> bool:
+        """Speculation predicate: dispatch fold N+1 before harvesting fold
+        N iff some occupied slot can outlive fold N by token count. (An
+        EOS inside fold N can still idle the speculative fold — frozen
+        slots emit nothing, so it only costs compute, never correctness.)
+        """
+        K = self.decode_fold
         for slot, info in enumerate(self._slots):
             if info is None:
                 continue
-            tok = int(toks[slot])
-            info.n_generated += 1
-            self._pos[slot] += 1
-            self._cur[slot] = tok
-            done = (
-                info.n_generated >= info.max_new_tokens
-                or tok == info.eos_token
-            )
-            out.append((slot, info.request_id, tok, done))
-            if done:
-                self.release(slot)
+            consumed = K if snapshot[slot] is info else 0
+            if info.max_new_tokens - info.n_generated > consumed:
+                return True
+        return False
+
+    def step(self) -> List[Tuple[int, str, int, bool]]:
+        """One fold boundary: dispatch (double-buffered) and fan out up to
+        ``decode_fold`` tokens per occupied slot, in fold order; returns
+        ``(slot, request_id, token, done)`` per emitted token. Finished
+        slots are evicted and recycled before returning."""
+        if self._inflight is None:
+            if self.num_active == 0:
+                return []
+            self._inflight = self._dispatch()
+        outs, snapshot = self._inflight
+        self._inflight = (
+            self._dispatch()
+            if self.pipeline and self._want_next(snapshot)
+            else None
+        )
+        return self._harvest(outs, snapshot)
+
+    def _harvest(
+        self,
+        outs: Tuple[Any, Any],
+        snapshot: List[Optional[SlotInfo]],
+    ) -> List[Tuple[int, str, int, bool]]:
+        # The ONE D2H sync per fold: the (K, B) token block + emit mask.
+        toks = np.asarray(outs[0])
+        emits = np.asarray(outs[1])
+        out: List[Tuple[int, str, int, bool]] = []
+        for kk in range(toks.shape[0]):
+            for slot, info in enumerate(snapshot):
+                if info is None or info.released or not emits[kk, slot]:
+                    continue
+                tok = int(toks[kk, slot])
+                info.n_generated += 1
+                done = (
+                    info.n_generated >= info.max_new_tokens
+                    or tok == info.eos_token
+                )
+                out.append((slot, info.request_id, tok, done))
+                if done:
+                    self._release_synced(slot, info)
         return out

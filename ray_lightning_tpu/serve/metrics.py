@@ -17,6 +17,12 @@ from typing import Any, Dict, Optional
 from ray_lightning_tpu.utils.rank_zero import rank_zero_info
 
 
+def _pct(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
 class ServeMetrics:
     """Thread-safe counters + sliding-window rates for one engine/replica.
 
@@ -106,6 +112,26 @@ class ServeMetrics:
             if ttft:
                 out["ttft_p50_s"] = round(ttft[len(ttft) // 2], 4)
                 out["ttft_max_s"] = round(ttft[-1], 4)
+            # Decode-path latency: with a folded engine one step emits up
+            # to decode_fold tokens per slot, so step time and per-slot
+            # inter-token latency diverge — report both, plus tokens/s
+            # over the steps that actually decoded, so the fold's
+            # TTFT-vs-throughput tradeoff is observable, not inferred.
+            walls = sorted(s[0] for s in steps if s[1] > 0)
+            if walls:
+                out["step_time_p50_s"] = round(_pct(walls, 0.50), 6)
+                out["step_time_p95_s"] = round(_pct(walls, 0.95), 6)
+            inter = sorted(
+                s[0] * s[1] / s[2] for s in steps if s[1] > 0 and s[2] > 0
+            )
+            if inter:
+                out["inter_token_p50_s"] = round(_pct(inter, 0.50), 6)
+                out["inter_token_p95_s"] = round(_pct(inter, 0.95), 6)
+            d_wall = sum(s[0] for s in steps if s[2] > 0)
+            d_tokens = sum(s[2] for s in steps if s[2] > 0)
+            out["decode_tokens_per_sec"] = (
+                round(d_tokens / d_wall, 3) if d_wall > 0 else 0.0
+            )
             return out
 
     def maybe_log(self, every_s: float = 10.0) -> Optional[Dict[str, Any]]:

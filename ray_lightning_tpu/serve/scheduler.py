@@ -13,6 +13,11 @@ optional deadline.
 The scheduler owns no threads: ``step()`` is driven by whoever hosts the
 engine (ServeReplica's loop thread, a test, the bench). ``submit`` /
 ``cancel`` are thread-safe so a replica's RPC surface can feed the loop.
+The lock guards ONLY the queue/bookkeeping state: ``step()`` snapshots
+its decisions under the lock and runs every engine call (prefill,
+decode dispatch, harvest) outside it, so the RPC surface never stalls
+behind device compute — with a folded engine a single dispatch can cover
+``decode_fold`` tokens of wall time.
 """
 from __future__ import annotations
 
@@ -88,6 +93,11 @@ class Scheduler:
         self._pending: List[Any] = []
         self._cancelled: set = set()
         self._slot_req: Dict[int, Request] = {}
+        #: Requests popped for admission but not yet registered in
+        #: _slot_req (engine.admit runs OUTSIDE the lock); cancel() must
+        #: still find them so a cancel racing an admission is honored at
+        #: the next boundary instead of reported unknown.
+        self._admitting: set = set()
 
     # -- intake (thread-safe) --------------------------------------------
     def submit(
@@ -132,10 +142,15 @@ class Scheduler:
         ones evicted at the next step boundary. Returns whether the id was
         known (queued or in flight)."""
         with self._lock:
-            known = any(
-                r.request_id == request_id for _, _, r in self._pending
-            ) or any(
-                r.request_id == request_id for r in self._slot_req.values()
+            known = (
+                request_id in self._admitting
+                or any(
+                    r.request_id == request_id for _, _, r in self._pending
+                )
+                or any(
+                    r.request_id == request_id
+                    for r in self._slot_req.values()
+                )
             )
             if known:
                 self._cancelled.add(request_id)
@@ -151,30 +166,29 @@ class Scheduler:
 
     # -- the loop body (single driver thread) -----------------------------
     def step(self) -> List[TokenEvent]:
-        """One iteration: evict cancelled/expired, admit, decode."""
+        """One iteration: evict cancelled/expired, admit (bounded), run
+        one engine fold. Queue decisions happen under the lock; every
+        engine call runs OUTSIDE it, so submit()/cancel() never wait on
+        device compute."""
         events: List[TokenEvent] = []
         t0 = time.monotonic()
+        to_evict: List[Any] = []
+        admits: List[Request] = []
         with self._lock:
-            # 1) Boundary eviction of in-flight cancellations/expiries.
+            # 1) Collect boundary evictions of in-flight cancels/expiries.
             for slot, req in list(self._slot_req.items()):
                 cancelled = req.request_id in self._cancelled
                 if cancelled or req.expired(t0):
-                    self.engine.release(slot)
                     del self._slot_req[slot]
                     self._cancelled.discard(req.request_id)
-                    reason = "cancelled" if cancelled else "expired"
-                    (self.metrics.record_cancel if cancelled
-                     else self.metrics.record_expire)()
-                    events.append(
-                        TokenEvent(req.request_id, None, True, reason)
-                    )
-            # 2) Admission: free slots, bounded prefills per step.
-            admitted = 0
-            while (
-                admitted < self.max_prefills_per_step
-                and self._pending
-                and self.engine.free_slots()
-            ):
+                    to_evict.append((slot, req, cancelled))
+            # 2) Pop admission candidates: bounded prefills per step,
+            # sized to the slots that are (or are about to be) free.
+            budget = min(
+                self.max_prefills_per_step,
+                len(self.engine.free_slots()) + len(to_evict),
+            )
+            while len(admits) < budget and self._pending:
                 _, _, req = heapq.heappop(self._pending)
                 if req.request_id in self._cancelled:
                     self._cancelled.discard(req.request_id)
@@ -189,20 +203,42 @@ class Scheduler:
                         TokenEvent(req.request_id, None, True, "expired")
                     )
                     continue
-                s = req.sampling
-                slot, first_tok, done = self.engine.admit(
-                    req.prompt,
-                    request_id=req.request_id,
-                    max_new_tokens=s.max_new_tokens,
-                    temperature=s.temperature,
-                    top_k=s.top_k,
-                    top_p=s.top_p,
-                    seed=s.seed,
-                    eos_token=s.eos_token,
+                admits.append(req)
+                self._admitting.add(req.request_id)
+        # -- engine work, lock NOT held --------------------------------
+        for slot, req, cancelled in to_evict:
+            self.engine.release(slot)
+            (self.metrics.record_cancel if cancelled
+             else self.metrics.record_expire)()
+            events.append(
+                TokenEvent(
+                    req.request_id, None, True,
+                    "cancelled" if cancelled else "expired",
                 )
-                admitted += 1
+            )
+        newly: Dict[int, Request] = {}
+        if admits:
+            # One burst: every admission chain is dispatched before the
+            # first token sync (engine.admit_many), so admission i's host
+            # round trip overlaps admission i+1's prefill.
+            results = self.engine.admit_many(
+                [
+                    dict(
+                        prompt=req.prompt,
+                        request_id=req.request_id,
+                        max_new_tokens=req.sampling.max_new_tokens,
+                        temperature=req.sampling.temperature,
+                        top_k=req.sampling.top_k,
+                        top_p=req.sampling.top_p,
+                        seed=req.sampling.seed,
+                        eos_token=req.sampling.eos_token,
+                    )
+                    for req in admits
+                ]
+            )
+            for req, (slot, first_tok, done) in zip(admits, results):
                 self.metrics.record_admit(
-                    time.monotonic() - req.submitted_at, len(self._pending)
+                    time.monotonic() - req.submitted_at, self.queue_depth()
                 )
                 events.append(
                     TokenEvent(
@@ -213,22 +249,30 @@ class Scheduler:
                 if done:
                     self.metrics.record_finish()
                 else:
-                    self._slot_req[slot] = req
-            # 3) One decode iteration for everything resident.
-            active = self.engine.num_active
-            emitted = 0
-            for slot, rid, tok, done in self.engine.step():
-                emitted += 1
-                events.append(
-                    TokenEvent(rid, tok, done, "finished" if done else "token")
-                )
-                if done:
-                    self.metrics.record_finish()
-                    self._slot_req.pop(slot, None)
-            self.metrics.record_step(
-                time.monotonic() - t0, active, emitted + admitted,
-                len(self._pending),
+                    newly[slot] = req
+        # 3) One engine fold for everything resident (up to decode_fold
+        # tokens per slot fan out of a single dispatch+harvest).
+        active = self.engine.num_active
+        emitted = 0
+        finished_slots: List[int] = []
+        for slot, rid, tok, done in self.engine.step():
+            emitted += 1
+            events.append(
+                TokenEvent(rid, tok, done, "finished" if done else "token")
             )
+            if done:
+                self.metrics.record_finish()
+                finished_slots.append(slot)
+        with self._lock:
+            self._slot_req.update(newly)
+            for req in admits:
+                self._admitting.discard(req.request_id)
+            for slot in finished_slots:
+                self._slot_req.pop(slot, None)
+        self.metrics.record_step(
+            time.monotonic() - t0, active, emitted + len(admits),
+            self.queue_depth(),
+        )
         return events
 
     def run_until_idle(self, max_steps: int = 100_000) -> List[TokenEvent]:

@@ -85,6 +85,8 @@ class ServeReplica:
         max_seq: Optional[int] = None,
         prefill_buckets: Optional[Sequence[int]] = None,
         max_prefills_per_step: int = 1,
+        decode_fold: int = 1,
+        pipeline: bool = True,
         tick_s: float = 0.002,
     ) -> None:
         from ray_lightning_tpu.models.gpt import GPTConfig
@@ -115,6 +117,8 @@ class ServeReplica:
             num_slots=num_slots,
             max_seq=max_seq,
             prefill_buckets=prefill_buckets,
+            decode_fold=decode_fold,
+            pipeline=pipeline,
         )
         self.metrics = ServeMetrics(self.engine.num_slots)
         self.scheduler = Scheduler(
@@ -239,6 +243,8 @@ class ServeReplica:
                 "compiled_count": self.engine.compiled_count,
                 "max_seq": self.engine.max_seq,
                 "prefill_buckets": list(self.engine.prefill_buckets),
+                "decode_fold": self.engine.decode_fold,
+                "pipeline": self.engine.pipeline,
                 "int8": self.int8,
             }
         )

@@ -258,6 +258,150 @@ def test_scheduler_outputs_match_reference_under_load(serve_params):
     assert snap["tokens_per_sec"] > 0
 
 
+@pytest.mark.parametrize("fold", [1, 2, 4])
+def test_engine_folded_matches_sequential_generate(serve_params, fold):
+    """decode_fold=K: K tokens per dispatch, mixed lengths, a mid-flight
+    join at a fold boundary — every output token-identical to solo
+    gpt_generate (K=1 included: the fold generalizes, never forks, the
+    unfolded behavior), with ZERO compiles after construction even
+    across admissions and folded steps."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+
+    eng = DecodeEngine(
+        serve_params, SERVE_CFG, num_slots=3, max_seq=64,
+        prefill_buckets=[8, 16], decode_fold=fold,
+    )
+    compiles = eng.compiled_count
+    rng = np.random.default_rng(0)
+    reqs = [
+        (rng.integers(0, 97, size=5).tolist(), 7),
+        (rng.integers(0, 97, size=8).tolist(), 4),
+        (rng.integers(0, 97, size=11).tolist(), 9),
+    ]
+    outs = {}
+    for i, (p, n) in enumerate(reqs):
+        _, tok, done = eng.admit(p, request_id=f"r{i}", max_new_tokens=n)
+        outs[f"r{i}"] = [tok]
+        assert not done
+    joined = False
+    for _ in range(100):
+        if not eng.num_active:
+            break
+        for _, rid, tok, _ in eng.step():
+            outs[rid].append(tok)
+        if not joined and eng.free_slots():
+            p4 = rng.integers(0, 97, size=6).tolist()
+            _, tok, _ = eng.admit(p4, request_id="r3", max_new_tokens=5)
+            outs["r3"] = [tok]
+            reqs.append((p4, 5))
+            joined = True
+    assert joined and eng.num_active == 0
+    for i, (p, n) in enumerate(reqs):
+        assert p + outs[f"r{i}"] == _reference(serve_params, p, n), f"r{i}"
+    assert eng.compiled_count == compiles
+
+
+def test_engine_fold_eos_truncates_mid_fold(serve_params):
+    """EOS landing strictly INSIDE a fold: the slot self-freezes in-graph
+    — emission stops exactly at the eos token (never past it), the
+    device-side active mask drops, and a batchmate decodes through the
+    same folds unperturbed."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+
+    prompt = list(range(1, 7))
+    solo = _reference(serve_params, prompt, 8)[len(prompt):]
+    # eos = the 6th generated token: the first value in this greedy
+    # sequence with no earlier occurrence (the head is a 6,6,6,... run),
+    # landing on the FIRST iteration of the second fold — the slot must
+    # freeze with three fold iterations still to run under it.
+    eos = solo[5]
+    assert eos not in solo[:5]
+    eng = DecodeEngine(
+        serve_params, SERVE_CFG, num_slots=2, max_seq=64,
+        prefill_buckets=[8, 16], decode_fold=4,
+    )
+    _, tok, done = eng.admit(
+        prompt, request_id="e", max_new_tokens=8, eos_token=eos
+    )
+    toks = [tok]
+    assert not done
+    mate_prompt = list(range(20, 31))
+    _, mtok, _ = eng.admit(mate_prompt, request_id="m", max_new_tokens=9)
+    mtoks = [mtok]
+    while eng.num_active:
+        for _, rid, tok, _ in eng.step():
+            (toks if rid == "e" else mtoks).append(tok)
+    assert toks == solo[: solo.index(eos) + 1]  # stopped AT eos, mid-fold
+    assert mate_prompt + mtoks == _reference(serve_params, mate_prompt, 9)
+    state = eng.device_state()  # sync point: device agrees nothing runs
+    assert not state["active"].any()
+
+
+def test_engine_fold_cancel_at_boundary_and_recycle(serve_params):
+    """Cancellation between folds (with a speculative fold already in
+    flight): the zombie fold's tokens are dropped, the slot recycles,
+    and the NEXT tenant of the same slot decodes exactly — the stale
+    state/cache leak nothing."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+
+    eng = DecodeEngine(
+        serve_params, SERVE_CFG, num_slots=1, max_seq=64,
+        prefill_buckets=[8, 16], decode_fold=4,
+    )
+    compiles = eng.compiled_count
+    slot, tok, _ = eng.admit(
+        list(range(1, 9)), request_id="victim", max_new_tokens=20
+    )
+    n_before = 1 + len(eng.step())  # one fold harvested, next in flight
+    eng.release(slot)  # fold-boundary cancel while fold N+1 executes
+    assert eng.num_active == 0 and eng.free_slots() == [0]
+    prompt = list(range(40, 46))
+    slot2, tok2, _ = eng.admit(prompt, request_id="next", max_new_tokens=7)
+    assert slot2 == slot  # same slot, recycled
+    toks = [tok2]
+    while eng.num_active:
+        for _, rid, tok, _ in eng.step():
+            assert rid == "next"  # no zombie "victim" tokens surface
+            toks.append(tok)
+    assert prompt + toks == _reference(serve_params, prompt, 7)
+    assert n_before < 20  # the victim really was cut short
+    assert eng.compiled_count == compiles
+
+
+def test_scheduler_folded_under_load_and_latency_metrics(serve_params):
+    """8 overlapping requests through a folded (K=4) pipelined engine:
+    outputs exact under queueing + continuous batching, and the stats
+    payload carries the decode-latency observability fields."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    eng = DecodeEngine(
+        serve_params, SERVE_CFG, num_slots=3, max_seq=48,
+        prefill_buckets=[8, 16], decode_fold=4,
+    )
+    sched = Scheduler(eng, max_prefills_per_step=2)
+    rng = np.random.default_rng(2)
+    reqs = {}
+    for i in range(8):
+        p = rng.integers(0, 97, size=int(rng.integers(3, 12))).tolist()
+        n = int(rng.integers(2, 9))
+        rid = sched.submit(p, SamplingParams(max_new_tokens=n))
+        reqs[rid] = (p, n, [])
+    events = sched.run_until_idle()
+    for ev in events:
+        if ev.token is not None:
+            reqs[ev.request_id][2].append(ev.token)
+    assert not sched.has_work()
+    for rid, (p, n, toks) in reqs.items():
+        assert p + toks == _reference(serve_params, p, n)
+    snap = sched.metrics.snapshot()
+    assert snap["admitted"] == 8 and snap["finished"] == 8
+    assert snap["decode_tokens_per_sec"] > 0
+    assert snap["step_time_p50_s"] > 0
+    assert snap["step_time_p95_s"] >= snap["step_time_p50_s"]
+    assert snap["inter_token_p50_s"] > 0
+
+
 def _write_ckpt(tmp_path, params):
     import dataclasses
 
