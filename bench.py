@@ -556,6 +556,193 @@ def bench_decode(use_tpu: bool) -> Dict[str, Any]:
     return _in_worker(run, use_tpu, timeout=2400.0)
 
 
+def bench_serve(use_tpu: bool) -> Dict[str, Any]:
+    """Prefill-heavy serving sweep (the decode sweep's complement, now
+    that decode is folded and the hot path is admission-bound):
+
+    - ``shared_prefix``: requests sharing a long prompt prefix, prefix
+      cache OFF vs ON — per-row TTFT p50/p95 (host-measured submit ->
+      first token), prefix hit rate, and chunk dispatches per admit. The
+      graded headline is the OFF/ON TTFT ratio.
+    - ``mixed_long_prompt``: one resident request decoding while long
+      prompts are admitted, monolithic vs chunked prefill — per-row
+      inter-token p95/max of the RESIDENT stream (its decode-stall while
+      a prefill is in flight).
+
+    ``bench.py --serve-only`` runs just this sweep; on a chipless host
+    the rows are an explicitly-labelled CPU control
+    (``serve_cpu_control``).
+    """
+
+    def run():
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+        from ray_lightning_tpu.serve.engine import DecodeEngine
+        from ray_lightning_tpu.serve.scheduler import (
+            SamplingParams,
+            Scheduler,
+        )
+
+        if _tiny():
+            cfg = GPTConfig(
+                vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                max_seq=128, attn_impl="reference",
+                compute_dtype="bfloat16",
+            )
+            shared, uniq, n_new, chunk, pblock = 96, 16, 8, 16, 32
+        else:
+            cfg = GPTConfig.gpt2_small(max_seq=512)
+            shared, uniq, n_new, chunk, pblock = 384, 64, 16, 64, 128
+        P = shared + uniq
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        g = np.random.default_rng(0)
+        prefix = g.integers(0, cfg.vocab_size, size=shared).tolist()
+        suffixes = [
+            g.integers(0, cfg.vocab_size, size=uniq).tolist()
+            for _ in range(8)
+        ]
+        rows = []
+
+        # ---- shared-prefix TTFT: prefix cache off vs on ----------------
+        def ttft_run(prefix_blocks):
+            eng = DecodeEngine(
+                params, cfg, num_slots=2, max_seq=P + n_new,
+                prefill_buckets=[P], prefill_chunk=chunk,
+                prefix_blocks=prefix_blocks, prefix_block=pblock,
+                decode_fold=4,
+            )
+            sched = Scheduler(
+                eng, max_prefills_per_step=1, max_prefill_chunks_per_step=1
+            )
+            # Warm run: first dispatch of every executable, and (cache
+            # on) the insert that later requests hit.
+            sched.submit(
+                prefix + suffixes[-1], SamplingParams(max_new_tokens=n_new)
+            )
+            sched.run_until_idle()
+            ttfts = []
+            for sfx in suffixes[:-1]:
+                rid = sched.submit(
+                    prefix + sfx, SamplingParams(max_new_tokens=n_new)
+                )
+                t0 = _time.monotonic()
+                got = None
+                while got is None:
+                    for ev in sched.step():
+                        if ev.request_id == rid and ev.token is not None:
+                            got = _time.monotonic() - t0
+                            break
+                ttfts.append(got)
+                sched.run_until_idle()  # drain before the next request
+            ttfts.sort()
+            return ttfts, sched.metrics.snapshot()
+
+        def pct(sorted_vals, q):
+            idx = min(
+                len(sorted_vals) - 1,
+                int(round(q * (len(sorted_vals) - 1))),
+            )
+            return sorted_vals[idx]
+
+        off_ttfts, off_snap = ttft_run(0)
+        on_ttfts, on_snap = ttft_run(16)
+        for mode, ttfts, snap in (
+            ("prefix_cache_off", off_ttfts, off_snap),
+            ("prefix_cache_on", on_ttfts, on_snap),
+        ):
+            rows.append(
+                {
+                    "workload": "shared_prefix",
+                    "mode": mode,
+                    "ttft_p50_s": round(pct(ttfts, 0.50), 6),
+                    "ttft_p95_s": round(pct(ttfts, 0.95), 6),
+                    "prefix_hit_rate": snap.get("prefix_hit_rate", 0.0),
+                    "prefill_chunks_per_admit": snap.get(
+                        "prefill_chunks_per_admit", 0.0
+                    ),
+                }
+            )
+        speedup = round(
+            pct(off_ttfts, 0.50) / max(pct(on_ttfts, 0.50), 1e-9), 2
+        )
+
+        # ---- mixed long-prompt: decode-stall while a prefill runs ------
+        def stall_run(chunk_tokens):
+            eng = DecodeEngine(
+                params, cfg, num_slots=2, max_seq=cfg.max_seq,
+                prefill_buckets=[16, P], prefill_chunk=chunk_tokens,
+                decode_fold=1, pipeline=False,
+            )
+            sched = Scheduler(
+                eng, max_prefills_per_step=1, max_prefill_chunks_per_step=1
+            )
+            resident = g.integers(0, cfg.vocab_size, size=16).tolist()
+            longs = [
+                (
+                    g.integers(0, cfg.vocab_size, size=P).tolist()
+                )
+                for _ in range(4)
+            ]
+            rid0 = sched.submit(
+                resident, SamplingParams(max_new_tokens=40)
+            )
+            gaps = []
+            last = None
+            submitted = 0
+            steps = 0
+            while sched.has_work() and steps < 4000:
+                evs = sched.step()
+                steps += 1
+                now = _time.monotonic()
+                for ev in evs:
+                    if ev.request_id == rid0 and ev.token is not None:
+                        if last is not None:
+                            gaps.append(now - last)
+                        last = now
+                # Admit a long prompt every few folds while the resident
+                # stream decodes — each admission is a prefill in flight.
+                if submitted < len(longs) and last is not None and (
+                    steps % 5 == 0
+                ):
+                    sched.submit(
+                        longs[submitted],
+                        SamplingParams(max_new_tokens=2),
+                    )
+                    submitted += 1
+            gaps.sort()
+            return gaps
+
+        for mode, chunk_tokens in (
+            ("monolithic", 0),
+            (f"chunked{chunk}", chunk),
+        ):
+            gaps = stall_run(chunk_tokens)
+            rows.append(
+                {
+                    "workload": "mixed_long_prompt",
+                    "mode": mode,
+                    "inter_token_p95_s": round(pct(gaps, 0.95), 6),
+                    "inter_token_max_s": round(gaps[-1], 6),
+                    "resident_tokens": len(gaps) + 1,
+                }
+            )
+        return {
+            "serve_rows": rows,
+            "serve_shared_prefix_ttft_speedup": speedup,
+            "serve_config": (
+                f"layers={cfg.n_layer} d_model={cfg.d_model} "
+                f"prompt={P} (shared={shared}) new={n_new} chunk={chunk}"
+            ),
+            "serve_cpu_control": not use_tpu,
+        }
+
+    return _in_worker(run, use_tpu, timeout=2400.0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=3)
@@ -574,6 +761,12 @@ def main() -> None:
         help="run ONLY the serving decode sweep (one-shot vs engine, "
         "batch x weights x decode_fold grid) and emit its JSON — the "
         "fast path for regrading the engine-vs-oneshot gap",
+    )
+    parser.add_argument(
+        "--serve-only", action="store_true",
+        help="run ONLY the prefill-heavy serving sweep (shared-prefix "
+        "TTFT with the prefix cache off/on + decode-stall under long-"
+        "prompt admissions, chunked vs monolithic) and emit its JSON",
     )
     args = parser.parse_args()
 
@@ -686,6 +879,28 @@ def main() -> None:
         env["tiny_extras"] = _tiny()  # flagged runs shrink GPT/ResNet
 
     t0 = time.time()
+    if args.serve_only:
+        extra = {}
+        try:
+            extra.update(bench_serve(use_tpu))
+        except Exception as exc:  # noqa: BLE001 - still emit a record
+            extra["serve_error"] = f"{type(exc).__name__}: {exc}"
+        extra["bench_wall_s"] = round(time.time() - t0, 1)
+        val = extra.get("serve_shared_prefix_ttft_speedup", 0.0)
+        print(
+            json.dumps(
+                {
+                    "metric": "serve_shared_prefix_ttft_speedup",
+                    "value": val,
+                    "unit": "ratio",
+                    "vs_baseline": val,
+                    "env": env,
+                    "extra": extra,
+                }
+            )
+        )
+        fabric.shutdown()
+        return
     if args.decode_only:
         extra = {}
         try:
@@ -790,6 +1005,10 @@ def main() -> None:
             extra.update(bench_decode(use_tpu))
         except Exception as exc:  # noqa: BLE001
             extra["decode_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_serve(use_tpu))
+        except Exception as exc:  # noqa: BLE001
+            extra["serve_error"] = f"{type(exc).__name__}: {exc}"
     extra["bench_wall_s"] = round(time.time() - t0, 1)
 
     print(

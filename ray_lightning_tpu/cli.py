@@ -379,6 +379,14 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         slot per engine step; amortizes dispatch/sync, admissions land at
         fold boundaries). pipeline: double-buffer fold dispatch (default
         on).
+      prefill_chunk: chunked prefill (tokens per chunk, 0 = monolithic):
+        long prompts prefill in chunks interleaved between decode folds.
+        max_prefill_chunks_per_step: chunk-vs-fold interleave budget.
+      prefix_cache: "off" (default), "on" (64 blocks), or a block count
+        — device-resident prefix KV reuse for shared prompt prefixes
+        (implies chunked prefill). prefix_block: tokens per pool block.
+      priority_age_s: queued requests age toward priority 0 at this rate
+        (seconds per priority level); unset = strict priority order.
       prompts: path to a prompts file ("-" = stdin), one request per
         line as comma/space-separated token ids.
       max_new_tokens, temperature, top_k, top_p, seed, eos_token:
@@ -423,7 +431,27 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         ),
         "decode_fold": int(serve_cfg.pop("decode_fold", 1)),
         "pipeline": bool(serve_cfg.pop("pipeline", True)),
+        "prefill_chunk": int(serve_cfg.pop("prefill_chunk", 0)),
+        "prefix_block": int(serve_cfg.pop("prefix_block", 16)),
+        "max_prefill_chunks_per_step": int(
+            serve_cfg.pop("max_prefill_chunks_per_step", 1)
+        ),
     }
+    age = serve_cfg.pop("priority_age_s", None)
+    if age is not None:
+        replica_kwargs["priority_age_s"] = float(age)
+    pc = serve_cfg.pop("prefix_cache", "off")
+    if isinstance(pc, str):
+        pc_norm = pc.strip().lower()
+        if pc_norm in ("off", "false", "0", ""):
+            blocks = 0
+        elif pc_norm in ("on", "true"):
+            blocks = 64
+        else:
+            blocks = int(pc_norm)
+    else:
+        blocks = (64 if pc else 0) if isinstance(pc, bool) else int(pc)
+    replica_kwargs["prefix_blocks"] = blocks
     pb = serve_cfg.pop("prefill_buckets", None)
     if pb is not None:
         replica_kwargs["prefill_buckets"] = [int(b) for b in pb]

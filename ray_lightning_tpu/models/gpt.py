@@ -1031,6 +1031,141 @@ def gpt_prefill(
     return h_pf, pf_k, pf_v
 
 
+def gpt_prefill_chunk(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    chunk: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    start_pos: jax.Array,
+    true_len: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Cache-seeded chunked prefill: extend an existing KV range.
+
+    ``chunk`` (1, C) int32 holds the next C prompt tokens (right-padded —
+    only the first ``true_len`` rows are real); ``k_cache``/``v_cache``
+    (L, 1, S, Hkv, hd) already hold the K/V of positions ``[0,
+    start_pos)`` (from earlier chunks, or a prefix-cache copy). The chunk
+    runs one causal forward at absolute positions ``start_pos + i``,
+    attending each query to the cached prefix plus its own causal
+    in-chunk context, and writes the chunk's K/V into rows ``[start_pos,
+    start_pos + true_len)``. Returns pre-final-norm hidden states
+    (1, C, D) and the updated caches — the prefill half of the serving
+    engine's chunk-admission executable (``serve/engine.py``), letting a
+    long prompt prefill in ``prefill_chunk``-token slices interleaved
+    between decode folds instead of one monolithic dispatch.
+
+    Exactness: a causal transformer's layer-l K/V at position p depend
+    only on positions ``<= p``, so chunking the prompt changes nothing
+    mathematically; numerically the attention here reproduces
+    ``ops.attention.attention_reference``'s op order (fp32 scores scaled
+    after the einsum, ``-inf`` band mask, fp32 softmax) against the
+    S-wide cache, where masked rows contribute exactly zero — the same
+    padding-invariance the decode step's slot masks rely on. Greedy
+    chunked output is asserted bit-identical to the monolithic prefill in
+    tests/test_serve.py under ``attn_impl='reference'`` (the flash
+    kernel's blockwise softmax reassociates, as it already does vs the
+    reference path). Padded rows beyond ``true_len`` compute garbage but
+    are never written to the cache and never attended by real rows.
+    """
+    from ray_lightning_tpu.ops.attention import band_allowed
+
+    cfg.validate_variants()
+    cdt = jnp.dtype(cfg.compute_dtype)
+    norm_fn = _make_norm(cfg)
+    L, H, hd = cfg.n_layer, cfg.n_head, cfg.head_dim
+    Hkv = cfg.kv_head
+    rep = H // Hkv
+    _, C = chunk.shape
+    S = k_cache.shape[2]
+    start = jnp.asarray(start_pos, jnp.int32)
+    tl = jnp.asarray(C if true_len is None else true_len, jnp.int32)
+    positions = start + jnp.arange(C, dtype=jnp.int32)
+
+    x = embed_rows(params["wte"], chunk)
+    if cfg.pos_embed == "learned":
+        # Per-row gather (not a dynamic slice): a slice whose window runs
+        # past the table end would CLAMP its start and hand real rows the
+        # wrong positional embeddings; clipping only the (garbage) padded
+        # rows' indices keeps every real row exact.
+        x = x + params["wpe"][jnp.clip(positions, 0, cfg.max_seq - 1)]
+    x = x.astype(cdt)
+    rope_tables = (
+        _rope_tables(positions, cfg.rope_theta, hd)
+        if cfg.pos_embed == "rope"
+        else None
+    )
+
+    rows = jnp.arange(S, dtype=jnp.int32)
+    idx = rows - start  # position-in-chunk of each cache row
+    valid = (idx >= 0) & (idx < tl)
+    gidx = jnp.clip(idx, 0, C - 1)
+    #: (C, S) band mask on ABSOLUTE positions: cached prefix + causal
+    #: in-chunk context (window/sinks band-limit exactly as everywhere).
+    allowed = band_allowed(
+        positions[:, None], rows[None, :], cfg.attn_window, cfg.attn_sinks
+    )
+    sm_scale = 1.0 / (hd**0.5)
+
+    h = x
+    new_k, new_v = [], []
+    # Python loop over layers (L small, static), like gpt_decode_step.
+    for li in range(L):
+        lp = jax.tree_util.tree_map(lambda a: a[li], params["blocks"])
+        a = norm_fn(h, lp["ln1_g"], lp["ln1_b"])
+        q, k_new, v_new = _project_qkv(
+            a, lp, cfg, cdt, rope_tables, repeat_kv=False
+        )
+        kc, vc = k_cache[li], v_cache[li]  # (1, S, Hkv, hd)
+        # Masked row-gather write: only rows [start, start+true_len) take
+        # chunk values — padded chunk rows are never written (a block
+        # write would also clamp near the cache end and corrupt real
+        # rows).
+        wmask = valid[None, :, None, None]
+        kc = jnp.where(wmask, k_new.astype(cdt)[:, gidx], kc)
+        vc = jnp.where(wmask, v_new.astype(cdt)[:, gidx], vc)
+        if Hkv != H:
+            k_att = jnp.repeat(kc, rep, axis=2)
+            v_att = jnp.repeat(vc, rep, axis=2)
+        else:
+            k_att, v_att = kc, vc
+        # attention_reference's exact op order against the S-wide cache.
+        s = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q,
+                k_att,
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )
+        s = jnp.where(allowed[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v_att.dtype), v_att
+        ).astype(q.dtype)
+        h = h + jnp.einsum("bshk,hkd->bsd", o, dequant(lp["wo"], cdt)) + lp[
+            "bo"
+        ].astype(cdt)
+        m = norm_fn(h, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts > 0:
+            from ray_lightning_tpu.parallel.moe import moe_ffn
+
+            m_out, _ = moe_ffn(
+                _moe_layer_params(lp),
+                m,
+                capacity_factor=float(cfg.n_experts),  # never drop
+                compute_dtype=cdt,
+                top_k=cfg.moe_top_k,
+            )
+        else:
+            m_out = _dense_mlp(m, lp, cfg, cdt)
+        h = h + m_out
+        new_k.append(kc)
+        new_v.append(vc)
+    return h, jnp.stack(new_k), jnp.stack(new_v)
+
+
 def gpt_decode_step(
     params: Dict[str, Any],
     cfg: GPTConfig,

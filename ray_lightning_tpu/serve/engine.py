@@ -59,10 +59,45 @@ Sampling is per-slot and traced (temperature/top-k/top-p/rng arrive as
 arrays), so one executable serves any mix of sampling params, and each
 request's rng chain is independent of its batchmates. Weight-only int8
 parameter trees (utils/quantize.py) are consumed directly.
+
+With decode folded and device-resident, admission is the remaining
+head-of-line hazard: a long prompt's fused prefill is one monolithic
+dispatch that stalls every resident decode slot until it completes, and
+identical prompt prefixes are re-prefilled from scratch. Two mechanisms
+remove both (Sarathi-Serve-style chunked prefill; RadixAttention-style
+prefix reuse, pool-of-blocks form):
+
+- **Chunked prefill (``prefill_chunk=C``).** Admission becomes a per-slot
+  state machine: each :meth:`prefill_step` call extends the slot's KV by
+  one C-token chunk (``models/gpt.py:gpt_prefill_chunk`` — a cache-seeded
+  causal forward, one compiled executable per chunk bucket), so the
+  scheduler interleaves chunks between decode folds instead of freezing
+  them behind a whole-prompt dispatch. Mid-prefill the slot is parked
+  inactive with its device ``pos`` pointing at the next chunk's first row
+  — the only row an interleaved fold's idle-lane write can touch, and the
+  next chunk overwrites it before reading — so interleaving never
+  perturbs the numerics. The final chunk samples the first token and arms
+  the slot in-graph, exactly like the fused admit.
+- **Prefix caching (``prefix_blocks=N``).** A device-resident block pool
+  (L, N, ``prefix_block``, Hkv, hd) keyed by chained block digests of the
+  token prefix. Admission walks the longest cached prefix, seeds the
+  slot's KV rows through ONE compiled bidirectional cache-to-cache copy
+  executable, and chunk-prefills only the suffix; completed prefills
+  insert their new full blocks back (same executable, reversed). Blocks
+  are ref-counted while a matching prefill is in flight and evicted LRU
+  under pool pressure. K/V per position are a pure function of the token
+  prefix, so a seeded slot decodes bit-identically to a cold prefill.
+
+Both paths keep the contracts above: the compile count is frozen at
+construction (chunk executables replace the per-bucket fused admits; one
+copy executable), and greedy outputs stay bit-identical to solo
+``gpt_generate`` across chunking x hit/miss x mid-prefill cancel
+(asserted in tests/test_serve.py).
 """
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -82,6 +117,35 @@ class SlotInfo:
     #: released tenant are dropped at harvest (the device keeps decoding a
     #: cancelled slot until its deactivate write lands).
     released: bool = False
+
+
+@dataclasses.dataclass
+class PrefillTask:
+    """Host-side state machine of one in-progress chunked admission."""
+
+    request_id: str
+    tokens: np.ndarray  # (P,) int32 prompt
+    next: int  # first position not yet prefilled (cache rows [0, next) live)
+    max_new_tokens: int
+    eos_token: int
+    temperature: float
+    top_k: int
+    top_p: float
+    key0: np.ndarray  # (2,) uint32 request PRNG key
+    #: Tokens seeded from the prefix pool (suffix prefill starts there).
+    matched_tokens: int = 0
+    #: Pool block indices pinned (ref-counted) for this prefill's lifetime.
+    block_refs: List[int] = dataclasses.field(default_factory=list)
+    chunks: int = 0  # chunk dispatches so far
+
+
+@dataclasses.dataclass
+class _PoolBlock:
+    """Host metadata of one occupied prefix-pool block."""
+
+    digest: bytes
+    refs: int = 0
+    stamp: int = 0  # LRU clock (higher = more recently used)
 
 
 def _sample_rows(keys, logits, temps, top_ks, top_ps):
@@ -133,6 +197,9 @@ class DecodeEngine:
         prefill_buckets: Optional[Sequence[int]] = None,
         decode_fold: int = 1,
         pipeline: bool = True,
+        prefill_chunk: int = 0,
+        prefix_blocks: int = 0,
+        prefix_block: int = 16,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -163,6 +230,32 @@ class DecodeEngine:
                 f"max_seq {self.max_seq}"
             )
         self.prefill_buckets = buckets
+        # Chunked-prefill mode: prefill_chunk > 0 (or any prefix pool —
+        # suffix-only prefill needs the cache-seeded chunk path). Chunk
+        # lengths are bucketed like prompts, so compiles stay per-bucket.
+        self.prefix_blocks = int(prefix_blocks)
+        self.prefix_block = int(prefix_block)
+        if self.prefix_blocks and not prefill_chunk:
+            prefill_chunk = buckets[-1]
+        self.prefill_chunk = int(prefill_chunk)
+        self.chunked = self.prefill_chunk > 0
+        if self.chunked:
+            if self.prefill_chunk > self.max_seq:
+                raise ValueError(
+                    f"prefill_chunk {self.prefill_chunk} exceeds max_seq "
+                    f"{self.max_seq}"
+                )
+            self.chunk_buckets = default_buckets(
+                self.prefill_chunk, lo=min(16, self.prefill_chunk)
+            )
+        else:
+            self.chunk_buckets = ()
+        if self.prefix_blocks:
+            if not 1 <= self.prefix_block <= self.max_seq:
+                raise ValueError(
+                    f"prefix_block {self.prefix_block} must be in "
+                    f"[1, max_seq={self.max_seq}]"
+                )
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
         cdt = jnp.dtype(config.compute_dtype)
@@ -170,6 +263,23 @@ class DecodeEngine:
         B, S = self.num_slots, self.max_seq
         self._k = jnp.zeros((L, B, S, Hkv, hd), cdt)
         self._v = jnp.zeros((L, B, S, Hkv, hd), cdt)
+        # Prefix pool: device-resident K/V blocks + host digest map/LRU.
+        if self.prefix_blocks:
+            self._pool_k = jnp.zeros(
+                (L, self.prefix_blocks, self.prefix_block, Hkv, hd), cdt
+            )
+            self._pool_v = jnp.zeros(
+                (L, self.prefix_blocks, self.prefix_block, Hkv, hd), cdt
+            )
+        self._pool_map: Dict[bytes, int] = {}
+        self._pool_meta: List[Optional[_PoolBlock]] = [None] * self.prefix_blocks
+        self._pool_free: List[int] = list(range(self.prefix_blocks))
+        self._pool_tick = 0
+        self.prefix_lookups = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_prompt_tokens = 0
+        self.prefix_inserts = 0
+        self.prefix_evictions = 0
 
         # Per-slot DEVICE state (fixed shapes: one step signature forever).
         self._cur = jnp.zeros(B, jnp.int32)
@@ -182,6 +292,8 @@ class DecodeEngine:
         self._remaining = jnp.zeros(B, jnp.int32)
         self._eos = jnp.full(B, -1, jnp.int32)
         self._slots: List[Optional[SlotInfo]] = [None] * B
+        #: slot -> in-progress chunked admission (chunked mode only).
+        self._prefills: Dict[int, PrefillTask] = {}
         #: Double buffer: ((tok_block, emit_block), dispatch-time slot
         #: snapshot) of the fold currently executing on device.
         self._inflight: Optional[Tuple[Tuple[Any, Any], List[Optional[SlotInfo]]]] = None
@@ -200,6 +312,7 @@ class DecodeEngine:
             _make_norm,
             gpt_decode_fold,
             gpt_prefill,
+            gpt_prefill_chunk,
             sample_logits_batched,
         )
 
@@ -305,25 +418,164 @@ class DecodeEngine:
         f32 = jax.ShapeDtypeStruct((), np.float32)
         b1 = jax.ShapeDtypeStruct((), np.bool_)
         key_spec = jax.ShapeDtypeStruct((2,), np.uint32)
+
+        L = cfg.n_layer
+        Hkv, hd = cfg.kv_head, cfg.head_dim
+        S = self.max_seq
+
+        def chunk_impl(
+            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps,
+            keys, active, remaining, eos_toks, chunk, start, true_len,
+            slot, key0, temp, tk, tp, n_new, eos, is_final,
+        ):
+            # One prefill chunk of one slot, fused: cache-seeded causal
+            # forward over the chunk, masked K/V write into the slot's
+            # rows [start, start+true_len), and — on the FINAL chunk —
+            # the first-token sample plus the slot's arming state write
+            # (the chunked analog of admit_impl). Non-final chunks park
+            # the slot inactive with pos = start+true_len: the only row
+            # an interleaved fold's idle-lane write can scribble on, and
+            # the next chunk overwrites it before any read.
+            k_slot = jax.lax.dynamic_slice(
+                k_cache, (0, slot, 0, 0, 0), (L, 1, S, Hkv, hd)
+            )
+            v_slot = jax.lax.dynamic_slice(
+                v_cache, (0, slot, 0, 0, 0), (L, 1, S, Hkv, hd)
+            )
+            h, k_slot, v_slot = gpt_prefill_chunk(
+                params, cfg, chunk, k_slot, v_slot, start, true_len
+            )
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k_slot, (0, slot, 0, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v_slot, (0, slot, 0, 0, 0)
+            )
+            h_last = jax.lax.dynamic_slice_in_dim(h, true_len - 1, 1, axis=1)
+            h_last = norm_fn(h_last, params["lnf_g"], params["lnf_b"])[:, 0]
+            logits = _lm_head(h_last, _head_weight(params, cfg))
+            key, sub = jax.random.split(key0)
+            tok = sample_logits_batched(
+                sub[None], logits, temp[None], tk[None], tp[None]
+            )[0]
+            live = is_final & (n_new > 1) & (tok != eos)
+            end = start + true_len
+
+            def upd(arr, v):
+                return jax.lax.dynamic_update_index_in_dim(arr, v, slot, 0)
+
+            return (
+                k_cache,
+                v_cache,
+                upd(cur, jnp.where(is_final, tok, 0)),
+                upd(pos, end),
+                upd(temps, temp),
+                upd(top_ks, tk),
+                upd(top_ps, tp),
+                upd(keys, jnp.where(is_final, key, key0)),
+                upd(active, live),
+                upd(remaining, jnp.where(is_final, n_new - 1, 0)),
+                upd(eos_toks, eos),
+                tok,
+            )
+
+        bs = self.prefix_block
+
+        def copy_impl(pool_k, pool_v, k_cache, v_cache, block, slot, row,
+                      to_slot):
+            # The ONE bidirectional cache-to-cache copy: pool block ->
+            # slot rows [row, row+bs) when to_slot (prefix-hit seeding),
+            # slot rows -> pool block otherwise (insertion). The
+            # non-target side is written back to itself, so both
+            # directions share one executable and one donation pattern.
+            src_k = jax.lax.dynamic_slice(
+                pool_k, (0, block, 0, 0, 0), (L, 1, bs, Hkv, hd)
+            )
+            src_v = jax.lax.dynamic_slice(
+                pool_v, (0, block, 0, 0, 0), (L, 1, bs, Hkv, hd)
+            )
+            dst_k = jax.lax.dynamic_slice(
+                k_cache, (0, slot, row, 0, 0), (L, 1, bs, Hkv, hd)
+            )
+            dst_v = jax.lax.dynamic_slice(
+                v_cache, (0, slot, row, 0, 0), (L, 1, bs, Hkv, hd)
+            )
+            new_k = jnp.where(to_slot, src_k, dst_k)
+            new_v = jnp.where(to_slot, src_v, dst_v)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, new_k, (0, slot, row, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, new_v, (0, slot, row, 0, 0)
+            )
+            pool_k = jax.lax.dynamic_update_slice(
+                pool_k, new_k, (0, block, 0, 0, 0)
+            )
+            pool_v = jax.lax.dynamic_update_slice(
+                pool_v, new_v, (0, block, 0, 0, 0)
+            )
+            return pool_k, pool_v, k_cache, v_cache
+
         self._admit_exec: Dict[int, Any] = {}
-        for pb in self.prefill_buckets:
-            prompt_spec = jax.ShapeDtypeStruct((1, pb), np.int32)
-            self._admit_exec[pb] = (
-                jax.jit(admit_impl, donate_argnums=tuple(range(1, 12)))
+        self._chunk_exec: Dict[int, Any] = {}
+        if self.chunked:
+            # Chunked mode: admission flows through the chunk state
+            # machine exclusively — one executable per CHUNK bucket
+            # replaces the per-prompt-bucket fused admits.
+            for cb in self.chunk_buckets:
+                chunk_spec = jax.ShapeDtypeStruct((1, cb), np.int32)
+                self._chunk_exec[cb] = (
+                    jax.jit(chunk_impl, donate_argnums=tuple(range(1, 12)))
+                    .lower(
+                        p_spec,
+                        cache_spec,
+                        cache_spec,
+                        *state_specs,
+                        chunk_spec,
+                        i32,
+                        i32,
+                        i32,
+                        key_spec,
+                        f32,
+                        i32,
+                        f32,
+                        i32,
+                        i32,
+                        b1,
+                    )
+                    .compile()
+                )
+                self.compiled_count += 1
+        else:
+            for pb in self.prefill_buckets:
+                prompt_spec = jax.ShapeDtypeStruct((1, pb), np.int32)
+                self._admit_exec[pb] = (
+                    jax.jit(admit_impl, donate_argnums=tuple(range(1, 12)))
+                    .lower(
+                        p_spec,
+                        cache_spec,
+                        cache_spec,
+                        *state_specs,
+                        prompt_spec,
+                        i32,
+                        i32,
+                        key_spec,
+                        f32,
+                        i32,
+                        f32,
+                        i32,
+                        i32,
+                    )
+                    .compile()
+                )
+                self.compiled_count += 1
+        if self.prefix_blocks:
+            pool_spec = spec(self._pool_k)
+            self._copy_exec = (
+                jax.jit(copy_impl, donate_argnums=(0, 1, 2, 3))
                 .lower(
-                    p_spec,
-                    cache_spec,
-                    cache_spec,
-                    *state_specs,
-                    prompt_spec,
-                    i32,
-                    i32,
-                    key_spec,
-                    f32,
-                    i32,
-                    f32,
-                    i32,
-                    i32,
+                    pool_spec, pool_spec, cache_spec, cache_spec,
+                    i32, i32, i32, b1,
                 )
                 .compile()
             )
@@ -394,10 +646,22 @@ class DecodeEngine:
     # -- introspection ---------------------------------------------------
     @property
     def num_active(self) -> int:
-        return sum(1 for s in self._slots if s is not None)
+        """Occupied slots: decoding residents PLUS in-progress chunked
+        prefills (both hold their slot and still need engine work)."""
+        return sum(1 for s in self._slots if s is not None) + len(
+            self._prefills
+        )
+
+    @property
+    def num_prefilling(self) -> int:
+        return len(self._prefills)
 
     def free_slots(self) -> List[int]:
-        return [i for i, s in enumerate(self._slots) if s is None]
+        return [
+            i
+            for i, s in enumerate(self._slots)
+            if s is None and i not in self._prefills
+        ]
 
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.prefill_buckets:
@@ -406,6 +670,28 @@ class DecodeEngine:
         raise ValueError(
             f"prompt length {prompt_len} exceeds largest prefill bucket "
             f"{self.prefill_buckets[-1]}"
+        )
+
+    def check_prompt_len(self, prompt_len: int) -> None:
+        """Raise when a prompt can never be admitted: over every bucket
+        (monolithic) or leaving no room for a generated token (chunked —
+        chunking lifts the bucket cap; prompts go up to max_seq - 1)."""
+        if self.chunked:
+            if prompt_len >= self.max_seq:
+                raise ValueError(
+                    f"prompt length {prompt_len} leaves no room for a "
+                    f"generated token (engine max_seq {self.max_seq})"
+                )
+            return
+        self.bucket_for(prompt_len)
+
+    def _chunk_bucket_for(self, n: int) -> int:
+        for b in self.chunk_buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"chunk length {n} exceeds largest chunk bucket "
+            f"{self.chunk_buckets[-1]}"
         )
 
     # -- request lifecycle -----------------------------------------------
@@ -420,13 +706,18 @@ class DecodeEngine:
         top_p: Optional[float] = None,
         seed: int = 0,
         eos_token: Optional[int] = None,
-    ) -> Tuple[int, int, bool]:
+    ) -> Tuple[int, Optional[int], bool]:
         """Prefill ``prompt`` into a free slot; returns (slot, first_token,
         done). Raises when no slot is free or the request cannot fit.
 
         With a fold in flight, the prefill/cache/slot writes queue AFTER
         it (donation order), so the new tenant's first decode lands in
         the NEXT dispatched fold — admission is a fold-boundary event.
+
+        Chunked mode (``prefill_chunk > 0``): admission only SEEDS the
+        slot (prefix-cache copies + state machine) and returns
+        ``(slot, None, False)``; the first token arrives from a later
+        :meth:`prefill_step` once the final chunk runs.
         """
         return self.admit_many(
             [
@@ -445,16 +736,19 @@ class DecodeEngine:
 
     def admit_many(
         self, requests: Sequence[Dict[str, Any]]
-    ) -> List[Tuple[int, int, bool]]:
+    ) -> List[Tuple[int, Optional[int], bool]]:
         """Admit a burst of requests at one fold boundary; returns
         ``(slot, first_token, done)`` per request, in order.
 
-        Each request is one fused dispatch (prefill + cache write +
-        first-token sample + slot-state write), and ALL chains are
-        dispatched before the first D2H token sync — the host round trip
-        of request i overlaps the device work of requests i+1..n instead
-        of fencing it. Requests are validated up front, so a bad spec
-        rejects the whole burst before any device state moves.
+        Monolithic mode: each request is one fused dispatch (prefill +
+        cache write + first-token sample + slot-state write), and ALL
+        chains are dispatched before the first D2H token sync — the host
+        round trip of request i overlaps the device work of requests
+        i+1..n instead of fencing it. Chunked mode: each request walks
+        the prefix pool, dispatches its seeding copies + parking state
+        write, and returns ``(slot, None, False)``; chunks then advance
+        through :meth:`prefill_step`. Requests are validated up front, so
+        a bad spec rejects the whole burst before any device state moves.
         """
         import jax
 
@@ -478,10 +772,52 @@ class DecodeEngine:
                     f"prompt ({P}) + max_new_tokens ({n_new}) exceeds "
                     f"engine max_seq {self.max_seq}"
                 )
-            pb = self.bucket_for(P)
+            pb = None if self.chunked else self.bucket_for(P)
             eos_token = r.get("eos_token")
             staged.append((slot, r, prompt, P, n_new, pb,
                            -1 if eos_token is None else int(eos_token)))
+        if self.chunked:
+            out: List[Tuple[int, Optional[int], bool]] = []
+            for slot, r, prompt, P, n_new, _, eos in staged:
+                key0 = np.asarray(
+                    jax.random.PRNGKey(int(r.get("seed", 0))), np.uint32
+                ).reshape(2)
+                matched_idxs = self._match_prefix(prompt)
+                matched = len(matched_idxs) * self.prefix_block
+                if self.prefix_blocks:
+                    self.prefix_lookups += 1
+                    self.prefix_hit_tokens += matched
+                    self.prefix_prompt_tokens += P
+                for b in matched_idxs:
+                    self._pool_meta[b].refs += 1  # pinned until done/cancel
+                # Park the slot: inactive, pos at the first unseeded row
+                # (the only row interleaved folds can scribble on; the
+                # first chunk rewrites it before reading).
+                self._slot_write(
+                    slot, 0, matched, 0.0, 0, 1.0,
+                    np.zeros(2, np.uint32), False, 0, -1,
+                )
+                for j, b in enumerate(matched_idxs):
+                    self._copy_block(
+                        b, slot, j * self.prefix_block, to_slot=True
+                    )
+                top_k = r.get("top_k")
+                top_p = r.get("top_p")
+                self._prefills[slot] = PrefillTask(
+                    request_id=r["request_id"],
+                    tokens=prompt,
+                    next=matched,
+                    max_new_tokens=n_new,
+                    eos_token=eos,
+                    temperature=float(r.get("temperature", 0.0)),
+                    top_k=0 if top_k is None else int(top_k),
+                    top_p=1.0 if top_p is None else float(top_p),
+                    key0=key0,
+                    matched_tokens=matched,
+                    block_refs=list(matched_idxs),
+                )
+                out.append((slot, None, False))
+            return out
         pending = []
         for slot, r, prompt, P, n_new, pb, eos in staged:
             padded = np.zeros((1, pb), np.int32)
@@ -523,13 +859,197 @@ class DecodeEngine:
             out.append((slot, tok, done))
         return out
 
+    def prefill_step(
+        self, max_chunks: int = 1
+    ) -> List[Tuple[int, PrefillTask, int, bool]]:
+        """Advance up to ``max_chunks`` prefill chunks, round-robin across
+        prefilling slots; returns ``(slot, task, first_token, done)`` for
+        every prefill that COMPLETED (its final chunk sampled the first
+        token and armed the slot for the next decode fold, or finished the
+        request outright). The scheduler calls this between decode folds —
+        the chunk-vs-fold interleave that keeps a long prompt from
+        freezing resident decodes for its whole prefill."""
+        out: List[Tuple[int, PrefillTask, int, bool]] = []
+        budget = int(max_chunks)
+        while budget > 0 and self._prefills:
+            progressed = False
+            for slot in sorted(self._prefills):
+                if budget <= 0:
+                    break
+                task = self._prefills.get(slot)
+                if task is None:  # completed earlier in this sweep
+                    continue
+                progressed = True
+                budget -= 1
+                P = len(task.tokens)
+                this_len = min(self.prefill_chunk, P - task.next)
+                cb = self._chunk_bucket_for(this_len)
+                padded = np.zeros((1, cb), np.int32)
+                padded[0, :this_len] = task.tokens[
+                    task.next : task.next + this_len
+                ]
+                is_final = task.next + this_len >= P
+                (
+                    self._k, self._v, self._cur, self._pos, self._temps,
+                    self._top_ks, self._top_ps, self._keys, self._active,
+                    self._remaining, self._eos, tok,
+                ) = self._chunk_exec[cb](
+                    self.params, self._k, self._v, self._cur, self._pos,
+                    self._temps, self._top_ks, self._top_ps, self._keys,
+                    self._active, self._remaining, self._eos,
+                    padded, np.int32(task.next), np.int32(this_len),
+                    np.int32(slot), task.key0,
+                    np.float32(task.temperature), np.int32(task.top_k),
+                    np.float32(task.top_p), np.int32(task.max_new_tokens),
+                    np.int32(task.eos_token), np.bool_(is_final),
+                )
+                task.next += this_len
+                task.chunks += 1
+                if not is_final:
+                    continue
+                del self._prefills[slot]
+                self._unref_blocks(task)
+                # Insert the finished prompt's full blocks BEFORE any new
+                # tenant can overwrite the slot's rows (decode only
+                # writes at pos >= P, so the prompt rows stay intact).
+                self._insert_prefix(slot, task.tokens)
+                tok = int(np.asarray(tok))  # the one D2H sync per admit
+                done = task.max_new_tokens == 1 or tok == task.eos_token
+                if not done:
+                    self._slots[slot] = SlotInfo(
+                        request_id=task.request_id,
+                        max_new_tokens=task.max_new_tokens,
+                        n_generated=1,
+                        eos_token=task.eos_token,
+                    )
+                out.append((slot, task, tok, done))
+            if not progressed:
+                break
+        return out
+
+    # -- prefix pool -----------------------------------------------------
+    def _block_digests(self, tokens: np.ndarray) -> List[bytes]:
+        """Chained digests of the prompt's FULL blocks: digest i commits
+        to tokens[0:(i+1)*bs], so block i can only hit behind its exact
+        prefix chain."""
+        bs = self.prefix_block
+        out: List[bytes] = []
+        d = b""
+        for i in range(len(tokens) // bs):
+            d = hashlib.blake2b(
+                d + np.asarray(
+                    tokens[i * bs : (i + 1) * bs], np.int32
+                ).tobytes(),
+                digest_size=16,
+            ).digest()
+            out.append(d)
+        return out
+
+    def _match_prefix(self, tokens: np.ndarray) -> List[int]:
+        """Longest cached prefix walk: pool block indices of the leading
+        blocks present, capped so the final chunk always runs (the
+        first-token logits need the last prompt position's hidden state,
+        which the pool does not store)."""
+        if not self.prefix_blocks:
+            return []
+        matched: List[int] = []
+        for d in self._block_digests(tokens):
+            idx = self._pool_map.get(d)
+            if idx is None:
+                break
+            matched.append(idx)
+        while matched and len(matched) * self.prefix_block >= len(tokens):
+            matched.pop()
+        for idx in matched:
+            self._pool_tick += 1
+            self._pool_meta[idx].stamp = self._pool_tick
+        return matched
+
+    def _pool_alloc(self) -> Optional[int]:
+        """A free pool block, evicting the LRU unreferenced block under
+        pressure; None when every block is pinned."""
+        if self._pool_free:
+            return self._pool_free.pop()
+        victim = None
+        for i, m in enumerate(self._pool_meta):
+            if m is None or m.refs > 0:
+                continue
+            if victim is None or m.stamp < self._pool_meta[victim].stamp:
+                victim = i
+        if victim is None:
+            return None
+        del self._pool_map[self._pool_meta[victim].digest]
+        self._pool_meta[victim] = None
+        self.prefix_evictions += 1
+        return victim
+
+    def _insert_prefix(self, slot: int, tokens: np.ndarray) -> None:
+        """Insert the freshly-prefilled prompt's full blocks (slot rows ->
+        pool, compiled copy). Chain-ordered: stop at the first block that
+        cannot be allocated — a later block without its ancestors can
+        never be matched."""
+        if not self.prefix_blocks:
+            return
+        bs = self.prefix_block
+        for i, d in enumerate(self._block_digests(tokens)):
+            idx = self._pool_map.get(d)
+            if idx is not None:
+                self._pool_tick += 1
+                self._pool_meta[idx].stamp = self._pool_tick
+                continue
+            idx = self._pool_alloc()
+            if idx is None:
+                break
+            self._copy_block(idx, slot, i * bs, to_slot=False)
+            self._pool_tick += 1
+            self._pool_map[d] = idx
+            self._pool_meta[idx] = _PoolBlock(
+                digest=d, refs=0, stamp=self._pool_tick
+            )
+            self.prefix_inserts += 1
+
+    def _copy_block(self, block: int, slot: int, row: int,
+                    to_slot: bool) -> None:
+        (self._pool_k, self._pool_v, self._k, self._v) = self._copy_exec(
+            self._pool_k, self._pool_v, self._k, self._v,
+            np.int32(block), np.int32(slot), np.int32(row),
+            np.bool_(to_slot),
+        )
+
+    def _unref_blocks(self, task: PrefillTask) -> None:
+        for b in task.block_refs:
+            meta = self._pool_meta[b]
+            if meta is not None:
+                meta.refs -= 1
+        task.block_refs = []
+
+    def prefix_stats(self) -> Dict[str, int]:
+        """Pool counters for the stats endpoint / bench."""
+        return {
+            "lookups": self.prefix_lookups,
+            "hit_tokens": self.prefix_hit_tokens,
+            "prompt_tokens": self.prefix_prompt_tokens,
+            "inserts": self.prefix_inserts,
+            "evictions": self.prefix_evictions,
+            "blocks_used": self.prefix_blocks - len(self._pool_free),
+            "blocks_total": self.prefix_blocks,
+        }
+
     def release(self, slot: int) -> None:
         """Evict a slot (cancelled, or host-observed finished); it is
         immediately reusable — the stale cache rows are invisible behind
         the slot masks and get overwritten by the next tenant. A
         host-initiated eviction also deactivates the slot ON DEVICE
         (queued after any in-flight fold, whose tokens for this tenant
-        are dropped at harvest via the ``released`` marker)."""
+        are dropped at harvest via the ``released`` marker). A slot
+        cancelled MID-PREFILL drops its state machine and unpins its
+        prefix blocks; the partially-written rows are invisible behind
+        the next tenant's own prefill."""
+        task = self._prefills.pop(slot, None)
+        if task is not None:
+            self._unref_blocks(task)
+            self._deactivate(slot)
+            return
         info = self._slots[slot]
         if info is None:
             return
@@ -595,7 +1115,9 @@ class DecodeEngine:
         ``(slot, request_id, token, done)`` per emitted token. Finished
         slots are evicted and recycled before returning."""
         if self._inflight is None:
-            if self.num_active == 0:
+            # Only DECODING residents warrant a fold (mid-prefill slots
+            # are parked inactive and emit nothing).
+            if not any(s is not None for s in self._slots):
                 return []
             self._inflight = self._dispatch()
         outs, snapshot = self._inflight

@@ -41,6 +41,15 @@ class ServeMetrics:
         self.expired = 0
         # Sliding windows.
         self._ttft_s: deque = deque(maxlen=window)
+        #: TTFT breakdown: time queued (submit -> slot) vs time prefilling
+        #: (slot -> first token) — with chunked prefill the two diverge,
+        #: and only the second is the prefill path's to improve.
+        self._ttft_queue_s: deque = deque(maxlen=window)
+        self._ttft_prefill_s: deque = deque(maxlen=window)
+        #: Chunk dispatches per admission (1 on the fused monolithic path).
+        self._prefill_chunks: deque = deque(maxlen=window)
+        #: (prefix_hit_tokens, prompt_tokens) per admission.
+        self._prefix_tokens: deque = deque(maxlen=window)
         #: (wall_s, active_slots, tokens_emitted) per engine step.
         self._steps: deque = deque(maxlen=window)
         self._queue_depth = 0
@@ -53,11 +62,31 @@ class ServeMetrics:
             self.submitted += 1
             self._queue_depth = queue_depth
 
-    def record_admit(self, ttft_s: float, queue_depth: int) -> None:
+    def record_admit(self, queue_s: float, queue_depth: int) -> None:
+        """A request entered a slot after ``queue_s`` in the queue (its
+        prefill may still be running — see record_first_token)."""
         with self._lock:
             self.admitted += 1
-            self._ttft_s.append(float(ttft_s))
+            self._ttft_queue_s.append(float(queue_s))
             self._queue_depth = queue_depth
+
+    def record_first_token(
+        self,
+        ttft_s: float,
+        prefill_s: float,
+        chunks: int,
+        prefix_hit_tokens: int,
+        prompt_tokens: int,
+    ) -> None:
+        """A request produced its first token: full TTFT, its prefill
+        component, chunk dispatches spent, and the prefix-cache hit."""
+        with self._lock:
+            self._ttft_s.append(float(ttft_s))
+            self._ttft_prefill_s.append(float(prefill_s))
+            self._prefill_chunks.append(int(chunks))
+            self._prefix_tokens.append(
+                (int(prefix_hit_tokens), int(prompt_tokens))
+            )
 
     def record_finish(self, n: int = 1) -> None:
         with self._lock:
@@ -111,7 +140,31 @@ class ServeMetrics:
             }
             if ttft:
                 out["ttft_p50_s"] = round(ttft[len(ttft) // 2], 4)
+                out["ttft_p95_s"] = round(_pct(ttft, 0.95), 4)
                 out["ttft_max_s"] = round(ttft[-1], 4)
+            # TTFT breakdown: queue wait vs prefill time. A fat
+            # ttft_queue_s wants more slots/replicas; a fat
+            # ttft_prefill_s wants chunking/prefix-cache tuning.
+            queue = sorted(self._ttft_queue_s)
+            if queue:
+                out["ttft_queue_p50_s"] = round(_pct(queue, 0.50), 4)
+                out["ttft_queue_p95_s"] = round(_pct(queue, 0.95), 4)
+            pf = sorted(self._ttft_prefill_s)
+            if pf:
+                out["ttft_prefill_p50_s"] = round(_pct(pf, 0.50), 4)
+                out["ttft_prefill_p95_s"] = round(_pct(pf, 0.95), 4)
+            if self._prefill_chunks:
+                out["prefill_chunks_per_admit"] = round(
+                    sum(self._prefill_chunks) / len(self._prefill_chunks), 3
+                )
+            if self._prefix_tokens:
+                hit = sum(h for h, _ in self._prefix_tokens)
+                tot = sum(p for _, p in self._prefix_tokens)
+                # Fraction of prompt tokens served from the prefix pool
+                # instead of prefill compute (0.0 with the cache off).
+                out["prefix_hit_rate"] = (
+                    round(hit / tot, 4) if tot else 0.0
+                )
             # Decode-path latency: with a folded engine one step emits up
             # to decode_fold tokens per slot, so step time and per-slot
             # inter-token latency diverge — report both, plus tokens/s

@@ -5,10 +5,15 @@ Orca-style scheduling loop: at every step boundary the scheduler (1)
 drops cancelled/expired work, (2) admits queued requests into free engine
 slots — bounded by ``max_prefills_per_step`` so a burst of prompt
 prefills can't starve in-flight decode latency (the prefill/decode
-interleave policy), (3) runs one decode iteration for everything
-resident. Requests carry per-request sampling params, an optional
-priority (lower value = served first; FIFO within a priority), and an
-optional deadline.
+interleave policy), (3) advances up to ``max_prefill_chunks_per_step``
+chunks of in-progress chunked prefills (engines built with
+``prefill_chunk`` — a long prompt's prefill then interleaves with decode
+folds instead of freezing them for its whole admission), (4) runs one
+decode iteration for everything resident. Requests carry per-request
+sampling params, an optional priority (lower value = served first; FIFO
+within a priority, with optional aging toward priority 0 via
+``priority_age_s`` so sustained high-priority traffic can't starve the
+rest forever), and an optional deadline.
 
 The scheduler owns no threads: ``step()`` is driven by whoever hosts the
 engine (ServeReplica's loop thread, a test, the bench). ``submit`` /
@@ -58,6 +63,9 @@ class Request:
     #: it are expired, in-flight ones are cancelled at the next boundary.
     deadline_s: Optional[float] = None
     submitted_at: float = 0.0
+    #: Set when the request enters a slot (chunked prefill may still be
+    #: running); the TTFT queue-vs-prefill breakdown pivots on it.
+    admitted_at: float = 0.0
 
     def expired(self, now: float) -> bool:
         return (
@@ -83,10 +91,24 @@ class Scheduler:
         engine: DecodeEngine,
         metrics: Optional[ServeMetrics] = None,
         max_prefills_per_step: int = 1,
+        max_prefill_chunks_per_step: int = 1,
+        priority_age_s: Optional[float] = None,
     ) -> None:
         self.engine = engine
         self.metrics = metrics or ServeMetrics(engine.num_slots)
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
+        #: Chunk-vs-fold interleave budget: prefill chunks advanced per
+        #: step (chunked engines only; sits next to the admission budget).
+        self.max_prefill_chunks_per_step = max(
+            1, int(max_prefill_chunks_per_step)
+        )
+        #: Aging rate: a queued request's effective priority drops by 1
+        #: toward 0 every ``priority_age_s`` seconds, so priority-1 work
+        #: cannot starve forever under a sustained priority-0 stream.
+        #: None = pure (priority, seq) ordering.
+        self.priority_age_s = (
+            None if priority_age_s is None else float(priority_age_s)
+        )
         self._lock = threading.RLock()
         self._seq = itertools.count()
         #: (priority, seq, Request) min-heap: FIFO within a priority.
@@ -115,7 +137,9 @@ class Scheduler:
         prompt = [int(t) for t in prompt]
         if not prompt or sampling.max_new_tokens < 1:
             raise ValueError("need a non-empty prompt and max_new_tokens >= 1")
-        self.engine.bucket_for(len(prompt))  # raises when over every bucket
+        # Raises when the prompt can never be admitted (over every bucket,
+        # or — chunked — leaving no room for a generated token).
+        self.engine.check_prompt_len(len(prompt))
         if len(prompt) + sampling.max_new_tokens > self.engine.max_seq:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -166,16 +190,37 @@ class Scheduler:
 
     # -- the loop body (single driver thread) -----------------------------
     def step(self) -> List[TokenEvent]:
-        """One iteration: evict cancelled/expired, admit (bounded), run
-        one engine fold. Queue decisions happen under the lock; every
-        engine call runs OUTSIDE it, so submit()/cancel() never wait on
-        device compute."""
+        """One iteration: evict cancelled/expired, admit (bounded),
+        advance prefill chunks (bounded), run one engine fold. Queue
+        decisions happen under the lock; every engine call runs OUTSIDE
+        it, so submit()/cancel() never wait on device compute."""
         events: List[TokenEvent] = []
         t0 = time.monotonic()
         to_evict: List[Any] = []
         admits: List[Request] = []
         with self._lock:
-            # 1) Collect boundary evictions of in-flight cancels/expiries.
+            # 0) Priority aging: re-score the queue so long-waiting
+            # requests drift toward priority 0 (FIFO seq breaks ties, so
+            # an aged request outranks younger same-priority arrivals).
+            if self.priority_age_s is not None and self._pending:
+                self._pending = [
+                    (
+                        max(
+                            0,
+                            r.priority
+                            - int(
+                                (t0 - r.submitted_at) / self.priority_age_s
+                            ),
+                        ),
+                        s,
+                        r,
+                    )
+                    for _, s, r in self._pending
+                ]
+                heapq.heapify(self._pending)
+            # 1) Collect boundary evictions of in-flight cancels/expiries
+            # (mid-prefill requests included — release drops their state
+            # machine and unpins their prefix blocks).
             for slot, req in list(self._slot_req.items()):
                 cancelled = req.request_id in self._cancelled
                 if cancelled or req.expired(t0):
@@ -217,10 +262,14 @@ class Scheduler:
                 )
             )
         newly: Dict[int, Request] = {}
+        finished_rids: List[str] = []
         if admits:
             # One burst: every admission chain is dispatched before the
             # first token sync (engine.admit_many), so admission i's host
-            # round trip overlaps admission i+1's prefill.
+            # round trip overlaps admission i+1's prefill. Chunked
+            # engines return first_tok=None here — the first token
+            # arrives from prefill_step below once the final chunk runs.
+            t_admit = time.monotonic()
             results = self.engine.admit_many(
                 [
                     dict(
@@ -237,8 +286,17 @@ class Scheduler:
                 ]
             )
             for req, (slot, first_tok, done) in zip(admits, results):
+                req.admitted_at = t_admit
                 self.metrics.record_admit(
-                    time.monotonic() - req.submitted_at, self.queue_depth()
+                    t_admit - req.submitted_at, self.queue_depth()
+                )
+                if first_tok is None:
+                    newly[slot] = req  # chunked prefill in progress
+                    continue
+                now = time.monotonic()
+                self.metrics.record_first_token(
+                    now - req.submitted_at, now - t_admit, 1, 0,
+                    len(req.prompt),
                 )
                 events.append(
                     TokenEvent(
@@ -248,9 +306,37 @@ class Scheduler:
                 )
                 if done:
                     self.metrics.record_finish()
+                    finished_rids.append(req.request_id)
                 else:
                     newly[slot] = req
-        # 3) One engine fold for everything resident (up to decode_fold
+        # 3) Advance chunked prefills — the chunk-vs-fold interleave.
+        chunk_events = self.engine.prefill_step(
+            self.max_prefill_chunks_per_step
+        )
+        prefilled = 0
+        for slot, task, tok, done in chunk_events:
+            prefilled += 1
+            now = time.monotonic()
+            req = newly.get(slot) or self._slot_req.get(slot)
+            if req is not None:
+                self.metrics.record_first_token(
+                    now - req.submitted_at,
+                    now - (req.admitted_at or now),
+                    task.chunks,
+                    task.matched_tokens,
+                    len(task.tokens),
+                )
+            events.append(
+                TokenEvent(
+                    task.request_id, tok, done,
+                    "finished" if done else "token",
+                )
+            )
+            if done:
+                self.metrics.record_finish()
+                finished_rids.append(task.request_id)
+                newly.pop(slot, None)
+        # 4) One engine fold for everything resident (up to decode_fold
         # tokens per slot fan out of a single dispatch+harvest).
         active = self.engine.num_active
         emitted = 0
@@ -263,15 +349,22 @@ class Scheduler:
             if done:
                 self.metrics.record_finish()
                 finished_slots.append(slot)
+                finished_rids.append(rid)
         with self._lock:
             self._slot_req.update(newly)
             for req in admits:
                 self._admitting.discard(req.request_id)
             for slot in finished_slots:
                 self._slot_req.pop(slot, None)
+            # Purge cancels that raced a same-fold finish: the id left
+            # _slot_req above, so the next eviction scan would never see
+            # it — without this, a cancel landing while the lock-free
+            # engine section ran would pin the id in _cancelled forever
+            # and spuriously evict a later request reusing it.
+            self._cancelled.difference_update(finished_rids)
         self.metrics.record_step(
-            time.monotonic() - t0, active, emitted + len(admits),
-            self.queue_depth(),
+            time.monotonic() - t0, active,
+            emitted + prefilled + len(admits), self.queue_depth(),
         )
         return events
 

@@ -87,6 +87,11 @@ class ServeReplica:
         max_prefills_per_step: int = 1,
         decode_fold: int = 1,
         pipeline: bool = True,
+        prefill_chunk: int = 0,
+        prefix_blocks: int = 0,
+        prefix_block: int = 16,
+        max_prefill_chunks_per_step: int = 1,
+        priority_age_s: Optional[float] = None,
         tick_s: float = 0.002,
     ) -> None:
         from ray_lightning_tpu.models.gpt import GPTConfig
@@ -119,12 +124,17 @@ class ServeReplica:
             prefill_buckets=prefill_buckets,
             decode_fold=decode_fold,
             pipeline=pipeline,
+            prefill_chunk=prefill_chunk,
+            prefix_blocks=prefix_blocks,
+            prefix_block=prefix_block,
         )
         self.metrics = ServeMetrics(self.engine.num_slots)
         self.scheduler = Scheduler(
             self.engine,
             metrics=self.metrics,
             max_prefills_per_step=max_prefills_per_step,
+            max_prefill_chunks_per_step=max_prefill_chunks_per_step,
+            priority_age_s=priority_age_s,
         )
         self._tick = float(tick_s)
         #: request_id -> {"tokens": [...], "done": bool, "status": str}
@@ -245,9 +255,13 @@ class ServeReplica:
                 "prefill_buckets": list(self.engine.prefill_buckets),
                 "decode_fold": self.engine.decode_fold,
                 "pipeline": self.engine.pipeline,
+                "prefill_chunk": self.engine.prefill_chunk,
+                "prefix_cache": self.engine.prefix_blocks > 0,
                 "int8": self.int8,
             }
         )
+        if self.engine.prefix_blocks:
+            snap["prefix"] = self.engine.prefix_stats()
         return snap
 
     def stop(self) -> None:

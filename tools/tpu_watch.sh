@@ -15,14 +15,17 @@ ok_streak=0
 have_headline=0
 have_full=0
 have_gpt=0
+have_serve=0
 full_fails=0
 gpt_fails=0
+serve_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
 headline_status=pending
 full_status=pending
 gpt_status=pending
+serve_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -36,6 +39,7 @@ write_manifest() {
     echo "stage=headline status=$headline_status attempts=$headline_attempts"
     echo "stage=full status=$full_status fails=$full_fails"
     echo "stage=gpt_ab status=$gpt_status fails=$gpt_fails"
+    echo "stage=serve status=$serve_status fails=$serve_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -117,8 +121,31 @@ while true; do
             echo "$(date -u +%H:%M:%S) gpt a/b SKIPPED after $gpt_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
+      elif [ "$have_serve" -eq 0 ]; then
+        # Stage 4: the prefill-heavy serving sweep (shared-prefix TTFT
+        # with the prefix cache off/on + chunked-vs-monolithic decode
+        # stall) — the on-chip companion to BENCH_r08's CPU control.
+        echo "$(date -u +%H:%M:%S) launching SERVE bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/serve_bench.json 2> /tmp/serve_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/serve_bench.json ]; then
+          have_serve=1
+          serve_status=ok
+          echo "$(date -u +%H:%M:%S) SERVE bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          serve_fails=$((serve_fails+1))
+          serve_status=failed
+          echo "$(date -u +%H:%M:%S) serve bench failed rc=$rc (fail $serve_fails)" >> /tmp/tpu_watch.log
+          if [ "$serve_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_serve=1
+            serve_status=skipped
+            echo "$(date -u +%H:%M:%S) serve bench SKIPPED after $serve_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
       else
-        # Stage 4: flash-vs-dense attention timings (VERDICT r4 item 3).
+        # Stage 5: flash-vs-dense attention timings (VERDICT r4 item 3).
         echo "$(date -u +%H:%M:%S) launching flash A/B" >> /tmp/tpu_watch.log
         flash_attempts=$((flash_attempts+1))
         ( cd /tmp/bench_snap2 && \
