@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 
 _LAZY = {
     "fabric": "ray_lightning_tpu",
+    "obs": "ray_lightning_tpu",
     "RayStrategy": "ray_lightning_tpu.strategies",
     "RayTPUStrategy": "ray_lightning_tpu.strategies",
     "RayShardedStrategy": "ray_lightning_tpu.strategies",
@@ -30,8 +31,8 @@ def __getattr__(name):
     if name in _LAZY:
         import importlib
 
-        if name == "fabric":
-            return importlib.import_module("ray_lightning_tpu.fabric")
+        if name in ("fabric", "obs"):
+            return importlib.import_module(f"ray_lightning_tpu.{name}")
         mod = importlib.import_module(_LAZY[name])
         return getattr(mod, name)
     raise AttributeError(f"module 'ray_lightning_tpu' has no attribute {name!r}")

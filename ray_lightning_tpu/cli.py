@@ -387,6 +387,16 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         (implies chunked prefill). prefix_block: tokens per pool block.
       priority_age_s: queued requests age toward priority 0 at this rate
         (seconds per priority level); unset = strict priority order.
+      metrics_port: serve a Prometheus /metrics endpoint (plus /stats
+        JSON) on this driver-side port for the duration of the run,
+        aggregating every replica's registry (0 picks a free port; the
+        chosen URL prints to stderr).
+      tracing: record request traces on the replicas (default on);
+        trace_out: after serving, write the replicas' recent traces as
+        Chrome trace-event JSON to this path (opens in Perfetto).
+      profile_s: capture an on-demand jax.profiler trace of replica 0
+        for this many seconds while the submitted prompts decode; the
+        artifact directory prints to stderr.
       prompts: path to a prompts file ("-" = stdin), one request per
         line as comma/space-separated token ids.
       max_new_tokens, temperature, top_k, top_p, seed, eos_token:
@@ -440,6 +450,10 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     age = serve_cfg.pop("priority_age_s", None)
     if age is not None:
         replica_kwargs["priority_age_s"] = float(age)
+    replica_kwargs["tracing"] = bool(serve_cfg.pop("tracing", True))
+    metrics_port = serve_cfg.pop("metrics_port", None)
+    trace_out = serve_cfg.pop("trace_out", None)
+    profile_s = serve_cfg.pop("profile_s", None)
     pc = serve_cfg.pop("prefix_cache", "off")
     if isinstance(pc, str):
         pc_norm = pc.strip().lower()
@@ -479,11 +493,47 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         else {}
     )
     client = start_replicas(replicas, env=env, **replica_kwargs)
+    metrics_server = None
     try:
+        if metrics_port is not None:
+            # Driver-side Prometheus endpoint for the run's duration:
+            # each scrape pulls every replica's registry live (plus the
+            # driver's own, which carries fabric heartbeat gauges).
+            from ray_lightning_tpu import obs
+            from ray_lightning_tpu.fabric import core as fabric_core
+
+            driver_reg = obs.get_registry()
+
+            def _collect() -> str:
+                obs.heartbeats_to_registry(
+                    fabric_core.heartbeats(), driver_reg
+                )
+                return client.metrics_text() + driver_reg.render()
+
+            metrics_server = obs.MetricsHTTPServer(
+                collect_text=_collect,
+                collect_json=lambda: {"serve_stats": client.stats()},
+                port=int(metrics_port),
+            ).start()
+            print(
+                f"serve metrics endpoint: {metrics_server.url}",
+                file=sys.stderr,
+                flush=True,
+            )
         handles = [
             client.submit(p, seed=seed + i, **sampling)
             for i, p in enumerate(prompts)
         ]
+        if profile_s is not None:
+            # Capture while the submitted prompts decode on the loop
+            # thread (the RPC itself only sleeps replica-side).
+            prof = client.profile(float(profile_s))
+            print(
+                "serve profile: "
+                + (prof.get("dir", "") if prof.get("ok") else str(prof)),
+                file=sys.stderr,
+                flush=True,
+            )
         outputs = []
         for p, h in zip(prompts, handles):
             toks = list(client.stream_handle(h))
@@ -495,10 +545,18 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
                 + "\t"
                 + ",".join(str(t) for t in p + toks)
             )
+        if trace_out:
+            trace_json = client.export_trace(n=len(prompts))
+            with open(trace_out, "w") as f:
+                _json.dump(trace_json, f)
+            print(f"serve trace written: {trace_out}", file=sys.stderr,
+                  flush=True)
         stats = client.stats()
         print(_json.dumps({"serve_stats": stats}))
         return {"outputs": outputs, "stats": stats}
     finally:
+        if metrics_server is not None:
+            metrics_server.close()
         client.shutdown()
 
 

@@ -31,6 +31,7 @@ from ray_lightning_tpu.fabric.core import (
     cluster_resources,
     free,
     get,
+    heartbeats,
     init,
     is_initialized,
     kill,
@@ -52,6 +53,7 @@ __all__ = [
     "is_initialized",
     "remote",
     "get",
+    "heartbeats",
     "put",
     "free",
     "wait",

@@ -339,6 +339,30 @@ def available_resources() -> Dict[str, float]:
         return total
 
 
+def heartbeats() -> Dict[str, Dict[str, Any]]:
+    """Latest heartbeat per live actor: worker-pushed process stats
+    (rss_bytes, cpu_s, uptime_s, calls_handled, calls_in_flight,
+    last_call_age_s) plus the driver-side ``age_s`` of the push. Workers
+    push every ``RLT_HEARTBEAT_S`` seconds (default 10; <= 0 disables),
+    so an empty dict just means no interval has elapsed yet.
+    ``obs.heartbeats_to_registry`` folds this into Prometheus gauges."""
+    if _session is None:
+        return {}
+    with _session.cv:
+        handles = list(_session.actors.values())
+    now = time.monotonic()
+    out: Dict[str, Dict[str, Any]] = {}
+    for h in handles:
+        hb = h._last_heartbeat
+        if hb is None:
+            continue
+        t_recv, stats = hb
+        entry = dict(stats)
+        entry["age_s"] = round(now - t_recv, 3)
+        out[h.actor_id] = entry
+    return out
+
+
 # --------------------------------------------------------------------------
 # Placement groups (gang scheduling)
 # --------------------------------------------------------------------------
@@ -732,6 +756,10 @@ class ActorHandle:
         self._pg_bundle = pg_bundle
         self._send_lock = threading.Lock()
         self._alive = True
+        #: (monotonic receive time, stats dict) of the worker's newest
+        #: heartbeat push (fabric/worker.py's heartbeat thread); None
+        #: until the first one lands. Read via :func:`heartbeats`.
+        self._last_heartbeat: Optional[Tuple[float, Dict[str, Any]]] = None
         self._reader = threading.Thread(
             target=self._reader_loop, name=f"fabric-reader-{actor_id}", daemon=True
         )
@@ -780,6 +808,11 @@ class ActorHandle:
                             (self.actor_id, -1), (msg[0] == "ready", msg[1])
                         )
                         sess.cv.notify_all()
+            elif msg[0] == "heartbeat":
+                # Worker-initiated health push (rss, cpu, call counters):
+                # stored on the handle, surfaced via heartbeats() and the
+                # obs registry — no call_id, nothing blocks on it.
+                self._last_heartbeat = (time.monotonic(), msg[1])
         # Pipe closed: mark actor dead so blocked getters wake up, and release
         # its node resources so a relaunch after a crash can be placed.
         self._alive = False

@@ -59,9 +59,65 @@ def _install_unraisable_filter():
     sys.unraisablehook = hook
 
 
+def _proc_stats():
+    """Process-level stats for one heartbeat: rss, cpu time, uptime."""
+    rss = 0
+    try:
+        with open("/proc/self/statm") as f:
+            rss = int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            # ru_maxrss is KiB on Linux — peak, not current; better than
+            # nothing on non-procfs platforms.
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # noqa: BLE001
+            pass
+    times = os.times()
+    return rss, times.user + times.system
+
+
+def _heartbeat_loop(send, state, interval_s):
+    """Daemon thread: push ("heartbeat", stats) to the driver every
+    ``interval_s`` until the connection dies. The stats let the driver
+    aggregate worker health (rss, cpu, last-call age) into its metrics
+    registry without an RPC round trip — and without competing with a
+    busy actor loop, which handles calls serially."""
+    import time
+
+    import cloudpickle
+
+    while not _EXITING:
+        time.sleep(interval_s)
+        if _EXITING:
+            return
+        rss, cpu_s = _proc_stats()
+        now = time.monotonic()
+        stats = {
+            "pid": os.getpid(),
+            "rss_bytes": rss,
+            "cpu_s": round(cpu_s, 3),
+            "uptime_s": round(now - state["t0"], 3),
+            "calls_handled": state["calls"],
+            "calls_in_flight": state["busy"],
+            "last_call_age_s": (
+                None
+                if state["last_end"] is None
+                else round(now - state["last_end"], 3)
+            ),
+        }
+        try:
+            send(cloudpickle.dumps(("heartbeat", stats)))
+        except (OSError, ValueError):
+            return  # driver gone; the main loop is exiting too
+
+
 def _worker_main(conn):
     """Run the actor loop. ``conn`` is an authenticated duplex Connection."""
     import signal
+    import threading
+    import time
 
     # SIGTERM (e.g. a tuner killing a trial actor) must run atexit so this
     # process's own fabric session shuts down any nested actors it spawned
@@ -77,6 +133,28 @@ def _worker_main(conn):
 
     import cloudpickle  # after env setup; cheap, no jax dependency
 
+    # Heartbeats share the connection with call results; serialize the
+    # byte stream (interleaved send_bytes from two threads would corrupt
+    # framing). RLT_HEARTBEAT_S <= 0 disables.
+    send_lock = threading.Lock()
+
+    def send(payload):
+        with send_lock:
+            conn.send_bytes(payload)
+
+    hb_state = {"calls": 0, "busy": 0, "last_end": None, "t0": time.monotonic()}
+    try:
+        hb_interval = float(os.environ.get("RLT_HEARTBEAT_S", "10"))
+    except ValueError:
+        hb_interval = 10.0
+    if hb_interval > 0:
+        threading.Thread(
+            target=_heartbeat_loop,
+            args=(send, hb_state, hb_interval),
+            name="fabric-heartbeat",
+            daemon=True,
+        ).start()
+
     actor = None
     try:
         while True:
@@ -91,9 +169,9 @@ def _worker_main(conn):
                 try:
                     cls, args, kwargs = cloudpickle.loads(msg[1])
                     actor = cls(*args, **kwargs)
-                    conn.send_bytes(cloudpickle.dumps(("ready", repr(type(actor)))))
+                    send(cloudpickle.dumps(("ready", repr(type(actor)))))
                 except BaseException as exc:  # noqa: BLE001 - report to driver
-                    conn.send_bytes(
+                    send(
                         cloudpickle.dumps(
                             ("ready_error", _exc_payload(exc))
                         )
@@ -101,6 +179,7 @@ def _worker_main(conn):
                 continue
             if kind == "call":
                 call_id, blob = msg[1], msg[2]
+                hb_state["busy"] = 1
                 try:
                     name, args, kwargs = cloudpickle.loads(blob)
                     if actor is None:
@@ -116,7 +195,11 @@ def _worker_main(conn):
                     payload = cloudpickle.dumps(
                         ("result", call_id, False, _exc_payload(exc))
                     )
-                conn.send_bytes(payload)
+                finally:
+                    hb_state["busy"] = 0
+                    hb_state["calls"] += 1
+                    hb_state["last_end"] = time.monotonic()
+                send(payload)
                 continue
     finally:
         global _EXITING

@@ -47,6 +47,15 @@ class TrainWorker:
         """Run an arbitrary closure — the actor's universal entrypoint."""
         return fn(*args, **kwargs)
 
+    def profile(
+        self, duration_s: float = 1.0, outdir: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """On-demand jax.profiler capture of this worker's device work
+        (obs.profiling); returns the artifact paths, never raises."""
+        from ray_lightning_tpu.obs.profiling import capture_profile
+
+        return capture_profile(duration_s, outdir)
+
 
 _train_worker_cls = TrainWorker
 

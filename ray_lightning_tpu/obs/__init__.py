@@ -1,0 +1,63 @@
+"""ray_lightning_tpu.obs — the cross-layer observability subsystem.
+
+The repo's third subsystem (after the trainer and the serving engine):
+one place where serve, trainer, and fabric report what they are doing,
+and one place operators read it back.
+
+- :mod:`obs.trace` — request tracing: typed lifecycle spans in a bounded
+  per-replica ring buffer (:class:`RequestTracer`), exported as Chrome
+  trace-event JSON (:func:`to_chrome_trace`) that opens in Perfetto.
+- :mod:`obs.registry` — counter/gauge/histogram registry
+  (:class:`MetricsRegistry`, :func:`get_registry` for the process
+  default) rendered in Prometheus text format.
+- :mod:`obs.httpd` — the /metrics + /stats HTTP endpoint
+  (:class:`MetricsHTTPServer`) behind ``rlt serve --serve.metrics_port``.
+- :mod:`obs.telemetry` — trainer step breakdown, tokens/s + MFU, fabric
+  heartbeat aggregation (:class:`TrainTelemetry`).
+- :mod:`obs.jaxmon` — JAX compile-event counters
+  (:func:`install_compile_listener`): the frozen-compile contract as a
+  metric, not just a test.
+- :mod:`obs.profiling` — on-demand ``jax.profiler`` capture
+  (:func:`capture_profile`) behind the ``profile(duration_s)`` RPCs.
+
+Import cost: everything here is stdlib-only at import time; jax loads
+only when profiling/monitoring is actually used, so the fabric can ship
+this module into workers whose platform env is not yet applied.
+"""
+from ray_lightning_tpu.obs.httpd import MetricsHTTPServer
+from ray_lightning_tpu.obs.jaxmon import compile_stats, install_compile_listener
+from ray_lightning_tpu.obs.profiling import capture_profile, profiler_available
+from ray_lightning_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    parse_prometheus_text,
+)
+from ray_lightning_tpu.obs.telemetry import (
+    TrainTelemetry,
+    heartbeats_to_registry,
+)
+from ray_lightning_tpu.obs.trace import (
+    RequestTracer,
+    to_chrome_trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsHTTPServer",
+    "RequestTracer",
+    "TrainTelemetry",
+    "capture_profile",
+    "compile_stats",
+    "get_registry",
+    "heartbeats_to_registry",
+    "install_compile_listener",
+    "parse_prometheus_text",
+    "profiler_available",
+    "to_chrome_trace",
+]

@@ -1,0 +1,88 @@
+"""Tiny metrics HTTP endpoint: /metrics (Prometheus text), /stats (JSON).
+
+Standard-library only (http.server in a daemon thread). The handler
+calls the collector functions PER REQUEST, so a scrape always sees
+current values; collectors must therefore be thread-safe (the fabric's
+driver surface and :class:`obs.registry.MetricsRegistry` both are).
+
+Used by ``rlt serve --serve.metrics_port`` (driver-side, aggregating
+replica scrapes) and usable standalone next to any registry::
+
+    srv = MetricsHTTPServer(collect_text=registry.render, port=9400)
+    srv.start()           # -> srv.port (0 picks a free port)
+    ...
+    srv.close()
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsHTTPServer:
+    def __init__(
+        self,
+        collect_text: Callable[[], str],
+        collect_json: Optional[Callable[[], Dict[str, Any]]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self._collect_text = collect_text
+        self._collect_json = collect_json
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args: Any) -> None:  # noqa: ARG002
+                pass  # scrapes must not spam stderr
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path in ("/metrics", "/"):
+                        body = outer._collect_text().encode()
+                        ctype = CONTENT_TYPE_PROM
+                    elif path == "/stats" and outer._collect_json is not None:
+                        body = json.dumps(outer._collect_json()).encode()
+                        ctype = "application/json"
+                    elif path == "/healthz":
+                        body, ctype = b"ok\n", "text/plain"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as exc:  # noqa: BLE001 - scrape-visible
+                    self.send_error(500, str(exc)[:200])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsHTTPServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"obs-metrics-http-{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,89 @@
+"""On-demand jax.profiler capture.
+
+Wraps ``jax.profiler`` start/stop into (1) a context manager used by the
+tools (tools/gpt_profile.py traces a known span of work) and (2)
+:func:`capture_profile` — the duration-based form behind the
+``profile(duration_s)`` RPC on serve replicas and TrainWorkers: start a
+trace, sleep while the process's OWN worker threads keep the device
+busy, stop, report the artifact files. The captured trace opens in
+Perfetto / TensorBoard's profile plugin.
+
+Everything degrades gracefully: when the profiler is unavailable (or a
+capture is already running — jax allows one at a time per process) the
+result says so instead of raising, because a profile RPC against a busy
+replica must never take the replica down.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+#: One capture at a time per process (jax.profiler's own constraint).
+_ACTIVE = threading.Lock()
+
+
+def profiler_available() -> bool:
+    try:
+        import jax.profiler  # noqa: F401
+
+        return True
+    except Exception:  # noqa: BLE001 - any import-time failure
+        return False
+
+
+def _trace_files(outdir: str) -> List[str]:
+    found: List[str] = []
+    for root, _, files in os.walk(outdir):
+        for f in files:
+            found.append(os.path.join(root, f))
+    return sorted(found)
+
+
+@contextlib.contextmanager
+def trace(outdir: str) -> Iterator[str]:
+    """``with obs.profiling.trace(dir):`` — jax.profiler.trace with the
+    one-capture lock held, so overlapping callers queue instead of
+    crashing each other."""
+    import jax
+
+    with _ACTIVE:
+        with jax.profiler.trace(outdir):
+            yield outdir
+
+
+def capture_profile(
+    duration_s: float = 1.0, outdir: Optional[str] = None
+) -> Dict[str, Any]:
+    """Capture ``duration_s`` of whatever this process's threads are
+    doing; returns ``{ok, dir, files, duration_s}`` (or ``{ok: False,
+    error}``). The caller's thread only sleeps — the work being profiled
+    runs on the process's other threads (serve loop, train loop)."""
+    duration_s = max(0.01, float(duration_s))
+    if not profiler_available():
+        return {"ok": False, "error": "jax.profiler unavailable"}
+    if not _ACTIVE.acquire(blocking=False):
+        return {"ok": False, "error": "a profile capture is already running"}
+    try:
+        import jax
+
+        out = outdir or tempfile.mkdtemp(prefix="rlt_profile_")
+        os.makedirs(out, exist_ok=True)
+        try:
+            jax.profiler.start_trace(out)
+            time.sleep(duration_s)
+        finally:
+            jax.profiler.stop_trace()
+        return {
+            "ok": True,
+            "dir": out,
+            "files": _trace_files(out),
+            "duration_s": duration_s,
+        }
+    except Exception as exc:  # noqa: BLE001 - report, never kill the host
+        return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        _ACTIVE.release()

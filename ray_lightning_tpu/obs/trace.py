@@ -1,0 +1,221 @@
+"""Request tracing: typed lifecycle spans + Chrome trace-event export.
+
+Every serve request leaves a trail of timestamped events — submit →
+queued → admitted → each prefill chunk → prefix-cache seed → each decode
+fold it rode → first token → finish/cancel/expire — appended to a
+bounded per-replica ring buffer (:class:`RequestTracer`). Recording is a
+tuple append under one lock, no I/O and no string formatting, so the
+decode hot loop pays nanoseconds per event (the bench measures the
+observer effect as ``obs_overhead``; the smoke test pins it < 5%).
+
+Reconstruction happens at READ time: ``trace(request_id)`` scans the
+ring, and :func:`to_chrome_trace` converts traces into Chrome
+trace-event JSON — the `{"traceEvents": [...]}` format Perfetto and
+chrome://tracing open directly. Lifecycle phases (queued / prefill /
+decode) are derived as complete ("X") events from the markers; the raw
+markers ride along as instant ("i") events on the same track.
+
+Event names (the ``SPAN_*`` constants) are the trace's type system; the
+well-formedness contract per admitted request is::
+
+    submit <= queued <= admitted <= [prefill_chunk...] <= first_token
+           <= finish | cancel | expire
+
+with ``prefix_seed`` inside the admission block (between queued and the
+first chunk — the engine records it while seeding the slot) on a
+prefix-cache hit, and ``decode_fold`` events between first_token and the
+terminal event. tests/test_obs.py asserts it across chunked-prefill x
+prefix-hit x mid-fold-cancel.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# -- span names (the typed vocabulary) ---------------------------------
+SPAN_SUBMIT = "submit"          #: request arrived at the RPC surface
+SPAN_QUEUED = "queued"          #: entered the scheduler queue
+SPAN_ADMITTED = "admitted"      #: entered an engine slot
+SPAN_PREFIX_SEED = "prefix_seed"  #: slot KV seeded from the prefix pool
+SPAN_PREFILL = "prefill"        #: monolithic (fused) prefill dispatched
+SPAN_PREFILL_CHUNK = "prefill_chunk"  #: one chunk of a chunked prefill
+SPAN_FIRST_TOKEN = "first_token"
+SPAN_DECODE_FOLD = "decode_fold"  #: one engine fold this request rode
+SPAN_FINISH = "finish"
+SPAN_CANCEL = "cancel"
+SPAN_EXPIRE = "expire"
+
+TERMINAL_SPANS = (SPAN_FINISH, SPAN_CANCEL, SPAN_EXPIRE)
+
+
+class RequestTracer:
+    """Bounded ring buffer of (request_id, span, t, attrs) events.
+
+    ``capacity`` bounds memory for a long-lived replica: old requests'
+    events fall off the back as new ones append. ``enabled=False`` turns
+    :meth:`event` into an immediate return (the bench's tracing-off
+    mode); flipping it at runtime is safe.
+    """
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True) -> None:
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=self.capacity)
+
+    # -- hot path ---------------------------------------------------------
+    def event(
+        self,
+        request_id: str,
+        span: str,
+        t: Optional[float] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Append one event. ``t`` defaults to ``time.monotonic()`` now;
+        ``attrs`` is stored by reference (callers must not mutate it)."""
+        if not self.enabled:
+            return
+        if t is None:
+            t = time.monotonic()
+        with self._lock:
+            self._events.append((request_id, span, t, attrs))
+
+    # -- read side --------------------------------------------------------
+    def _scan(self) -> List[Tuple[str, str, float, Optional[Dict[str, Any]]]]:
+        with self._lock:
+            return list(self._events)
+
+    def trace(self, request_id: str) -> List[Dict[str, Any]]:
+        """All of one request's events, oldest first, as dicts."""
+        out = []
+        for rid, span, t, attrs in self._scan():
+            if rid != request_id:
+                continue
+            ev: Dict[str, Any] = {"span": span, "t": t}
+            if attrs:
+                ev.update(attrs)
+            out.append(ev)
+        return out
+
+    def recent_traces(self, n: int = 8) -> Dict[str, List[Dict[str, Any]]]:
+        """The last ``n`` distinct request ids (by latest event) with
+        their full event lists."""
+        events = self._scan()
+        order: List[str] = []
+        for rid, _, _, _ in reversed(events):
+            if rid not in order:
+                order.append(rid)
+            if len(order) >= n:
+                break
+        keep = set(order)
+        traces: Dict[str, List[Dict[str, Any]]] = {rid: [] for rid in order}
+        for rid, span, t, attrs in events:
+            if rid in keep:
+                ev: Dict[str, Any] = {"span": span, "t": t}
+                if attrs:
+                    ev.update(attrs)
+                traces[rid].append(ev)
+        return traces
+
+    def request_ids(self) -> List[str]:
+        seen: List[str] = []
+        for rid, _, _, _ in self._scan():
+            if rid not in seen:
+                seen.append(rid)
+        return seen
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+# -- Chrome trace-event export -----------------------------------------
+_PHASES = (
+    # (name, start marker(s), end marker(s))
+    ("queued", (SPAN_SUBMIT, SPAN_QUEUED), (SPAN_ADMITTED,)),
+    ("prefill", (SPAN_ADMITTED,), (SPAN_FIRST_TOKEN,) + TERMINAL_SPANS),
+    ("decode", (SPAN_FIRST_TOKEN,), TERMINAL_SPANS),
+)
+
+
+def _first_t(evs: List[Dict[str, Any]], spans: Tuple[str, ...]) -> Optional[float]:
+    for ev in evs:
+        if ev["span"] in spans:
+            return ev["t"]
+    return None
+
+
+def to_chrome_trace(
+    traces: Dict[str, List[Dict[str, Any]]],
+    process_name: str = "rlt-serve",
+    pid: int = 0,
+) -> Dict[str, Any]:
+    """Convert ``{request_id: [event, ...]}`` into Chrome trace-event
+    JSON (dict form; ``json.dump`` it to get a file Perfetto opens).
+
+    Each request gets its own thread track (tid). Derived lifecycle
+    phases become complete ("X") events; every raw marker becomes an
+    instant ("i") event carrying its attrs as args. Timestamps are
+    microseconds relative to the earliest event in the export.
+    """
+    all_t = [ev["t"] for evs in traces.values() for ev in evs]
+    t0 = min(all_t) if all_t else 0.0
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 1)
+
+    events: List[Dict[str, Any]] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": process_name},
+        }
+    ]
+    for tid, (rid, evs) in enumerate(sorted(traces.items()), start=1):
+        events.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": f"request {rid}"},
+            }
+        )
+        evs = sorted(evs, key=lambda e: e["t"])
+        for phase, starts, ends in _PHASES:
+            ts = _first_t(evs, starts)
+            te = _first_t(evs, ends)
+            if ts is None or te is None or te < ts:
+                continue
+            events.append(
+                {
+                    "ph": "X",
+                    "name": phase,
+                    "cat": "lifecycle",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": us(ts),
+                    "dur": max(round((te - ts) * 1e6, 1), 0.1),
+                    "args": {"request_id": rid},
+                }
+            )
+        for ev in evs:
+            args = {k: v for k, v in ev.items() if k not in ("span", "t")}
+            args["request_id"] = rid
+            events.append(
+                {
+                    "ph": "i",
+                    "name": ev["span"],
+                    "cat": "marker",
+                    "s": "t",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": us(ev["t"]),
+                    "args": args,
+                }
+            )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

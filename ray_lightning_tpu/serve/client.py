@@ -124,6 +124,53 @@ class ServeClient:
         """Per-replica stats-endpoint snapshots."""
         return fabric.get([r.stats.remote() for r in self._replicas])
 
+    def trace(self, handle: RequestHandle) -> List[Dict[str, Any]]:
+        """A request's recorded spans from its replica's ring buffer."""
+        return fabric.get(
+            self._replicas[handle.replica].trace.remote(handle.request_id)
+        )
+
+    def export_trace(
+        self, handle: Optional[RequestHandle] = None, n: int = 8
+    ) -> Dict[str, Any]:
+        """Chrome trace-event JSON for one request (or replica 0's ``n``
+        most recent when no handle is given)."""
+        if handle is not None:
+            return fabric.get(
+                self._replicas[handle.replica].export_trace.remote(
+                    handle.request_id
+                )
+            )
+        return fabric.get(self._replicas[0].export_trace.remote(None, n))
+
+    def metrics_text(self) -> str:
+        """All replicas' registries as ONE Prometheus exposition: each
+        replica's series gets a ``replica="<i>"`` label so identical
+        metric names across replicas stay distinct for the scraper."""
+        from ray_lightning_tpu.obs.registry import relabel_text
+
+        texts = fabric.get(
+            [r.metrics_text.remote() for r in self._replicas]
+        )
+        if len(texts) == 1:
+            return texts[0]
+        parts = [
+            relabel_text(t, replica=i).rstrip("\n")
+            for i, t in enumerate(texts)
+            if t
+        ]
+        return "\n".join(parts) + "\n"
+
+    def profile(
+        self, duration_s: float = 1.0, replica: int = 0
+    ) -> Dict[str, Any]:
+        """On-demand jax.profiler capture on one replica (the replica's
+        serve loop keeps running; this blocks ~duration_s)."""
+        return fabric.get(
+            self._replicas[int(replica)].profile.remote(duration_s),
+            timeout=duration_s + 120.0,
+        )
+
     def shutdown(self) -> None:
         for r in self._replicas:
             try:

@@ -297,6 +297,11 @@ class DecodeEngine:
         #: Double buffer: ((tok_block, emit_block), dispatch-time slot
         #: snapshot) of the fold currently executing on device.
         self._inflight: Optional[Tuple[Tuple[Any, Any], List[Optional[SlotInfo]]]] = None
+        #: Optional obs.trace.RequestTracer: the engine records the spans
+        #: only it can see (prefill dispatches, chunk advances, prefix
+        #: seeds). Set by the Scheduler/ServeReplica after construction;
+        #: None keeps the hot paths branch-only.
+        self.tracer: Optional[Any] = None
 
         self.compiled_count = 0
         self._compile()
@@ -801,6 +806,17 @@ class DecodeEngine:
                     self._copy_block(
                         b, slot, j * self.prefix_block, to_slot=True
                     )
+                if self.tracer is not None and matched:
+                    from ray_lightning_tpu.obs.trace import SPAN_PREFIX_SEED
+
+                    self.tracer.event(
+                        r["request_id"], SPAN_PREFIX_SEED,
+                        attrs={
+                            "tokens": matched,
+                            "blocks": len(matched_idxs),
+                            "slot": slot,
+                        },
+                    )
                 top_k = r.get("top_k")
                 top_p = r.get("top_p")
                 self._prefills[slot] = PrefillTask(
@@ -842,6 +858,13 @@ class DecodeEngine:
                 temp, tk, tp, np.int32(n_new), np.int32(eos),
             )
             pending.append((slot, r, n_new, eos, tok))
+            if self.tracer is not None:
+                from ray_lightning_tpu.obs.trace import SPAN_PREFILL
+
+                self.tracer.event(
+                    r["request_id"], SPAN_PREFILL,
+                    attrs={"bucket": pb, "tokens": P, "slot": slot},
+                )
         out: List[Tuple[int, int, bool]] = []
         for slot, r, n_new, eos, tok in pending:
             tok = int(np.asarray(tok))
@@ -905,6 +928,19 @@ class DecodeEngine:
                 )
                 task.next += this_len
                 task.chunks += 1
+                if self.tracer is not None:
+                    from ray_lightning_tpu.obs.trace import SPAN_PREFILL_CHUNK
+
+                    self.tracer.event(
+                        task.request_id, SPAN_PREFILL_CHUNK,
+                        attrs={
+                            "index": task.chunks - 1,
+                            "tokens": this_len,
+                            "start": task.next - this_len,
+                            "slot": slot,
+                            "final": is_final,
+                        },
+                    )
                 if not is_final:
                     continue
                 del self._prefills[slot]

@@ -12,9 +12,12 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Optional
+from typing import TYPE_CHECKING, Any, Dict, Optional
 
 from ray_lightning_tpu.utils.rank_zero import rank_zero_info
+
+if TYPE_CHECKING:  # registry import is cheap, but keep the seam explicit
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
 
 
 def _pct(sorted_vals, q: float) -> float:
@@ -30,9 +33,43 @@ class ServeMetrics:
     feed the rate/occupancy aggregates.
     """
 
-    def __init__(self, num_slots: int, window: int = 512) -> None:
+    def __init__(
+        self,
+        num_slots: int,
+        window: int = 512,
+        registry: Optional["MetricsRegistry"] = None,
+    ) -> None:
         self.num_slots = max(1, int(num_slots))
         self._lock = threading.Lock()
+        # Optional Prometheus-side mirror (obs.registry): lifecycle
+        # counters, queue-depth gauge, latency histograms. None (the
+        # default for bare Scheduler construction in tests/bench) keeps
+        # the hot loop free of the extra dict updates; ServeReplica
+        # passes the process registry so /metrics sees the serve path.
+        self._reg = None
+        if registry is not None:
+            self._reg = {
+                "lifecycle": registry.counter(
+                    "rlt_serve_requests_total",
+                    "Serve request lifecycle events by kind",
+                ),
+                "tokens": registry.counter(
+                    "rlt_serve_tokens_emitted_total",
+                    "Tokens emitted by the engine",
+                ),
+                "steps": registry.counter(
+                    "rlt_serve_engine_steps_total", "Scheduler steps run"
+                ),
+                "queue": registry.gauge(
+                    "rlt_serve_queue_depth", "Requests waiting for a slot"
+                ),
+                "ttft": registry.histogram(
+                    "rlt_serve_ttft_seconds", "Submit-to-first-token latency"
+                ),
+                "step_time": registry.histogram(
+                    "rlt_serve_step_seconds", "Scheduler step wall time"
+                ),
+            }
         # Lifecycle counters (monotonic).
         self.submitted = 0
         self.admitted = 0
@@ -57,10 +94,24 @@ class ServeMetrics:
         self._last_log = 0.0
 
     # -- recording -------------------------------------------------------
+    def _set_queue_depth(self, queue_depth: Optional[int]) -> None:
+        """Under self._lock. Every lifecycle event that can change the
+        queue reports the depth it observed — finish/cancel/expire
+        included, so the stat can't go stale between submits (a cancel
+        of the last queued request must drop it to 0 without waiting for
+        the next admission to refresh it)."""
+        if queue_depth is None:
+            return
+        self._queue_depth = int(queue_depth)
+        if self._reg is not None:
+            self._reg["queue"].set(self._queue_depth)
+
     def record_submit(self, queue_depth: int) -> None:
         with self._lock:
             self.submitted += 1
-            self._queue_depth = queue_depth
+            self._set_queue_depth(queue_depth)
+        if self._reg is not None:
+            self._reg["lifecycle"].inc(1, kind="submitted")
 
     def record_admit(self, queue_s: float, queue_depth: int) -> None:
         """A request entered a slot after ``queue_s`` in the queue (its
@@ -68,7 +119,9 @@ class ServeMetrics:
         with self._lock:
             self.admitted += 1
             self._ttft_queue_s.append(float(queue_s))
-            self._queue_depth = queue_depth
+            self._set_queue_depth(queue_depth)
+        if self._reg is not None:
+            self._reg["lifecycle"].inc(1, kind="admitted")
 
     def record_first_token(
         self,
@@ -87,18 +140,35 @@ class ServeMetrics:
             self._prefix_tokens.append(
                 (int(prefix_hit_tokens), int(prompt_tokens))
             )
+        if self._reg is not None:
+            self._reg["ttft"].observe(float(ttft_s))
 
-    def record_finish(self, n: int = 1) -> None:
+    def record_finish(
+        self, n: int = 1, queue_depth: Optional[int] = None
+    ) -> None:
         with self._lock:
             self.finished += n
+            self._set_queue_depth(queue_depth)
+        if self._reg is not None:
+            self._reg["lifecycle"].inc(n, kind="finished")
 
-    def record_cancel(self, n: int = 1) -> None:
+    def record_cancel(
+        self, n: int = 1, queue_depth: Optional[int] = None
+    ) -> None:
         with self._lock:
             self.cancelled += n
+            self._set_queue_depth(queue_depth)
+        if self._reg is not None:
+            self._reg["lifecycle"].inc(n, kind="cancelled")
 
-    def record_expire(self, n: int = 1) -> None:
+    def record_expire(
+        self, n: int = 1, queue_depth: Optional[int] = None
+    ) -> None:
         with self._lock:
             self.expired += n
+            self._set_queue_depth(queue_depth)
+        if self._reg is not None:
+            self._reg["lifecycle"].inc(n, kind="expired")
 
     def record_step(
         self, wall_s: float, active_slots: int, tokens_emitted: int,
@@ -108,7 +178,12 @@ class ServeMetrics:
             self._steps.append(
                 (float(wall_s), int(active_slots), int(tokens_emitted))
             )
-            self._queue_depth = queue_depth
+            self._set_queue_depth(queue_depth)
+        if self._reg is not None:
+            self._reg["steps"].inc(1)
+            if tokens_emitted:
+                self._reg["tokens"].inc(int(tokens_emitted))
+            self._reg["step_time"].observe(float(wall_s))
 
     # -- aggregates ------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
@@ -139,7 +214,7 @@ class ServeMetrics:
                 "uptime_s": round(time.monotonic() - self._started, 3),
             }
             if ttft:
-                out["ttft_p50_s"] = round(ttft[len(ttft) // 2], 4)
+                out["ttft_p50_s"] = round(_pct(ttft, 0.50), 4)
                 out["ttft_p95_s"] = round(_pct(ttft, 0.95), 4)
                 out["ttft_max_s"] = round(ttft[-1], 4)
             # TTFT breakdown: queue wait vs prefill time. A fat

@@ -34,9 +34,11 @@ import uuid
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence
 
+from ray_lightning_tpu.obs import trace as _trace
 from ray_lightning_tpu.serve.metrics import ServeMetrics
 
 if TYPE_CHECKING:  # engine pulls jax; keep the package import light
+    from ray_lightning_tpu.obs.trace import RequestTracer
     from ray_lightning_tpu.serve.engine import DecodeEngine
 
 
@@ -93,9 +95,16 @@ class Scheduler:
         max_prefills_per_step: int = 1,
         max_prefill_chunks_per_step: int = 1,
         priority_age_s: Optional[float] = None,
+        tracer: Optional["RequestTracer"] = None,
     ) -> None:
         self.engine = engine
         self.metrics = metrics or ServeMetrics(engine.num_slots)
+        #: Request tracer (obs.trace): lifecycle events recorded from the
+        #: scheduler's vantage point; the engine shares the same tracer
+        #: for its chunk/seed events. None = tracing off (zero cost).
+        self.tracer = tracer
+        if tracer is not None and getattr(engine, "tracer", None) is None:
+            engine.tracer = tracer
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
         #: Chunk-vs-fold interleave budget: prefill chunks advanced per
         #: step (chunked engines only; sits next to the admission budget).
@@ -120,6 +129,12 @@ class Scheduler:
         #: still find them so a cancel racing an admission is honored at
         #: the next boundary instead of reported unknown.
         self._admitting: set = set()
+
+    def _trace(
+        self, rid: str, span: str, t: Optional[float] = None, **attrs: Any
+    ) -> None:
+        if self.tracer is not None:
+            self.tracer.event(rid, span, t=t, attrs=attrs or None)
 
     # -- intake (thread-safe) --------------------------------------------
     def submit(
@@ -158,7 +173,17 @@ class Scheduler:
             heapq.heappush(
                 self._pending, (req.priority, next(self._seq), req)
             )
-            self.metrics.record_submit(len(self._pending))
+            depth = len(self._pending)
+            self.metrics.record_submit(depth)
+        if self.tracer is not None:
+            self.tracer.event(
+                req.request_id, _trace.SPAN_SUBMIT, t=req.submitted_at,
+                attrs={"prompt_tokens": len(prompt), "priority": req.priority},
+            )
+            self.tracer.event(
+                req.request_id, _trace.SPAN_QUEUED,
+                attrs={"queue_depth": depth},
+            )
         return req.request_id
 
     def cancel(self, request_id: str) -> bool:
@@ -237,13 +262,19 @@ class Scheduler:
                 _, _, req = heapq.heappop(self._pending)
                 if req.request_id in self._cancelled:
                     self._cancelled.discard(req.request_id)
-                    self.metrics.record_cancel()
+                    self.metrics.record_cancel(
+                        queue_depth=len(self._pending)
+                    )
+                    self._trace(req.request_id, _trace.SPAN_CANCEL)
                     events.append(
                         TokenEvent(req.request_id, None, True, "cancelled")
                     )
                     continue
                 if req.expired(t0):
-                    self.metrics.record_expire()
+                    self.metrics.record_expire(
+                        queue_depth=len(self._pending)
+                    )
+                    self._trace(req.request_id, _trace.SPAN_EXPIRE)
                     events.append(
                         TokenEvent(req.request_id, None, True, "expired")
                     )
@@ -254,7 +285,14 @@ class Scheduler:
         for slot, req, cancelled in to_evict:
             self.engine.release(slot)
             (self.metrics.record_cancel if cancelled
-             else self.metrics.record_expire)()
+             else self.metrics.record_expire)(
+                queue_depth=self.queue_depth()
+            )
+            self._trace(
+                req.request_id,
+                _trace.SPAN_CANCEL if cancelled else _trace.SPAN_EXPIRE,
+                slot=slot,
+            )
             events.append(
                 TokenEvent(
                     req.request_id, None, True,
@@ -290,6 +328,16 @@ class Scheduler:
                 self.metrics.record_admit(
                     t_admit - req.submitted_at, self.queue_depth()
                 )
+                # Record-time timestamp (not t_admit): the engine's own
+                # admission-block events (prefix_seed) land between
+                # queued and here, and a trace's timestamps must be
+                # monotonic in record order. queue_s keeps the exact
+                # admission clock.
+                self._trace(
+                    req.request_id, _trace.SPAN_ADMITTED,
+                    slot=slot,
+                    queue_s=round(t_admit - req.submitted_at, 6),
+                )
                 if first_tok is None:
                     newly[slot] = req  # chunked prefill in progress
                     continue
@@ -298,6 +346,10 @@ class Scheduler:
                     now - req.submitted_at, now - t_admit, 1, 0,
                     len(req.prompt),
                 )
+                self._trace(
+                    req.request_id, _trace.SPAN_FIRST_TOKEN, t=now,
+                    ttft_s=round(now - req.submitted_at, 6),
+                )
                 events.append(
                     TokenEvent(
                         req.request_id, first_tok, done,
@@ -305,7 +357,10 @@ class Scheduler:
                     )
                 )
                 if done:
-                    self.metrics.record_finish()
+                    self.metrics.record_finish(
+                        queue_depth=self.queue_depth()
+                    )
+                    self._trace(req.request_id, _trace.SPAN_FINISH)
                     finished_rids.append(req.request_id)
                 else:
                     newly[slot] = req
@@ -326,6 +381,12 @@ class Scheduler:
                     task.matched_tokens,
                     len(task.tokens),
                 )
+                self._trace(
+                    task.request_id, _trace.SPAN_FIRST_TOKEN, t=now,
+                    ttft_s=round(now - req.submitted_at, 6),
+                    chunks=task.chunks,
+                    prefix_hit_tokens=task.matched_tokens,
+                )
             events.append(
                 TokenEvent(
                     task.request_id, tok, done,
@@ -333,7 +394,8 @@ class Scheduler:
                 )
             )
             if done:
-                self.metrics.record_finish()
+                self.metrics.record_finish(queue_depth=self.queue_depth())
+                self._trace(task.request_id, _trace.SPAN_FINISH)
                 finished_rids.append(task.request_id)
                 newly.pop(slot, None)
         # 4) One engine fold for everything resident (up to decode_fold
@@ -341,13 +403,28 @@ class Scheduler:
         active = self.engine.num_active
         emitted = 0
         finished_slots: List[int] = []
-        for slot, rid, tok, done in self.engine.step():
+        fold_results = self.engine.step()
+        if self.tracer is not None and fold_results:
+            # One event per request per fold (not per token): "this fold,
+            # this request rode it for n tokens" — the decode-side trace
+            # granularity the hot loop can afford. Recorded before the
+            # finish events below so a trace's fold events always precede
+            # its terminal span.
+            fold_tokens: Dict[str, int] = {}
+            for _, rid, _, _ in fold_results:
+                fold_tokens[rid] = fold_tokens.get(rid, 0) + 1
+            for rid, n in fold_tokens.items():
+                self.tracer.event(
+                    rid, _trace.SPAN_DECODE_FOLD, attrs={"tokens": n}
+                )
+        for slot, rid, tok, done in fold_results:
             emitted += 1
             events.append(
                 TokenEvent(rid, tok, done, "finished" if done else "token")
             )
             if done:
-                self.metrics.record_finish()
+                self.metrics.record_finish(queue_depth=self.queue_depth())
+                self._trace(rid, _trace.SPAN_FINISH)
                 finished_slots.append(slot)
                 finished_rids.append(rid)
         with self._lock:

@@ -93,11 +93,21 @@ class ServeReplica:
         max_prefill_chunks_per_step: int = 1,
         priority_age_s: Optional[float] = None,
         tick_s: float = 0.002,
+        tracing: bool = True,
+        trace_capacity: int = 8192,
     ) -> None:
         from ray_lightning_tpu.models.gpt import GPTConfig
+        from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+        from ray_lightning_tpu.obs.registry import get_registry
+        from ray_lightning_tpu.obs.trace import RequestTracer
         from ray_lightning_tpu.serve.engine import DecodeEngine
         from ray_lightning_tpu.serve.metrics import ServeMetrics
         from ray_lightning_tpu.serve.scheduler import Scheduler
+
+        # Before anything compiles: the listener turns the engine's
+        # frozen-compile contract into a metric (stats() ships
+        # compiles_since_init, which must stay 0 in steady state).
+        self._compile_stats = install_compile_listener()
 
         if params is None:
             if ckpt_path is None:
@@ -128,13 +138,31 @@ class ServeReplica:
             prefix_blocks=prefix_blocks,
             prefix_block=prefix_block,
         )
-        self.metrics = ServeMetrics(self.engine.num_slots)
+        self._registry = get_registry()
+        self._registry.gauge(
+            "rlt_serve_compiled_executables",
+            "Engine executables compiled at construction",
+        ).set(self.engine.compiled_count)
+        # Warm the PRNGKey builder before the compile baseline: the first
+        # submit would otherwise compile it in a fresh process and
+        # spuriously trip compiles_since_init.
+        import jax
+
+        jax.random.PRNGKey(0)
+        self._compiles_at_init = self._compile_stats.count("backend_compile")
+        self.metrics = ServeMetrics(
+            self.engine.num_slots, registry=self._registry
+        )
+        self.tracer = RequestTracer(
+            capacity=trace_capacity, enabled=bool(tracing)
+        )
         self.scheduler = Scheduler(
             self.engine,
             metrics=self.metrics,
             max_prefills_per_step=max_prefills_per_step,
             max_prefill_chunks_per_step=max_prefill_chunks_per_step,
             priority_age_s=priority_age_s,
+            tracer=self.tracer,
         )
         self._tick = float(tick_s)
         #: request_id -> {"tokens": [...], "done": bool, "status": str}
@@ -245,12 +273,20 @@ class ServeReplica:
         return ok
 
     def stats(self) -> Dict[str, Any]:
-        """The stats endpoint: metrics snapshot + engine anatomy."""
+        """The stats endpoint: metrics snapshot + engine anatomy +
+        embedded registry values."""
         snap = self.metrics.snapshot()
         snap.update(
             {
                 "active_slots": self.engine.num_active,
                 "compiled_count": self.engine.compiled_count,
+                # The frozen-compile contract as a metric: backend
+                # compiles observed since construction ended. Non-zero in
+                # steady state means a shape leaked into the hot path.
+                "compiles_since_init": (
+                    self._compile_stats.count("backend_compile")
+                    - self._compiles_at_init
+                ),
                 "max_seq": self.engine.max_seq,
                 "prefill_buckets": list(self.engine.prefill_buckets),
                 "decode_fold": self.engine.decode_fold,
@@ -258,11 +294,52 @@ class ServeReplica:
                 "prefill_chunk": self.engine.prefill_chunk,
                 "prefix_cache": self.engine.prefix_blocks > 0,
                 "int8": self.int8,
+                "tracing": self.tracer.enabled,
+                "metrics": self._registry.to_dict(),
             }
         )
         if self.engine.prefix_blocks:
             snap["prefix"] = self.engine.prefix_stats()
         return snap
+
+    # -- observability RPCs ----------------------------------------------
+    def trace(self, request_id: str) -> list:
+        """One request's recorded spans (oldest first); [] when unknown
+        or already rotated out of the ring buffer."""
+        return self.tracer.trace(request_id)
+
+    def recent_traces(self, n: int = 8) -> Dict[str, list]:
+        return self.tracer.recent_traces(n)
+
+    def export_trace(
+        self, request_id: Optional[str] = None, n: int = 8
+    ) -> Dict[str, Any]:
+        """Chrome trace-event JSON (a dict — ``json.dump`` it and open in
+        Perfetto) of one request, or the ``n`` most recent."""
+        from ray_lightning_tpu.obs.trace import to_chrome_trace
+
+        traces = (
+            {request_id: self.tracer.trace(request_id)}
+            if request_id is not None
+            else self.tracer.recent_traces(n)
+        )
+        return to_chrome_trace(
+            {rid: evs for rid, evs in traces.items() if evs}
+        )
+
+    def metrics_text(self) -> str:
+        """This replica process's registry in Prometheus text format."""
+        return self._registry.render()
+
+    def profile(
+        self, duration_s: float = 1.0, outdir: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Capture ``duration_s`` of jax.profiler trace while the loop
+        thread keeps serving (this RPC only sleeps); returns the artifact
+        paths. Serialized with any other capture in the process."""
+        from ray_lightning_tpu.obs.profiling import capture_profile
+
+        return capture_profile(duration_s, outdir)
 
     def stop(self) -> None:
         self._stop.set()

@@ -630,6 +630,15 @@ class TrainingLoop:
         import time as _time
 
         self.state = {"status": "running", "stage": "fit"}
+        # Observability: per-step breakdown (data wait / compiled step /
+        # drain) + compile events into the process registry; throughput
+        # (tokens/s, MFU) lands at fit end. A few monotonic() reads per
+        # dispatched chunk — noise next to a compiled step.
+        from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+        from ray_lightning_tpu.obs.telemetry import TrainTelemetry
+
+        install_compile_listener()
+        self.telemetry = TrainTelemetry()
         self._fit_deadline = (
             _time.monotonic() + self.spec.max_time
             if self.spec.max_time is not None
@@ -850,8 +859,20 @@ class TrainingLoop:
                 stack=fold if fold > 1 else 0,
             )
             batch_idx = -1
+            # Explicit iterator so each chunk's wall time splits into the
+            # three host-observable segments (obs.telemetry): data wait
+            # (blocking on the staged pipeline — where device compute
+            # surfaces under async dispatch), the step call (dispatch),
+            # and the drain (log fetch, callbacks, mid-epoch val).
+            stream = iter(() if stop else staged)
             try:
-                for item in (() if stop else staged):
+                while True:
+                    t_pull = _time.monotonic()
+                    try:
+                        item = next(stream)
+                    except StopIteration:
+                        break
+                    t_fetch = _time.monotonic()
                     n_chunk, payload = item if fold > 1 else (1, item)
                     start_step = self.global_step
                     if n_chunk > 1:
@@ -872,6 +893,7 @@ class TrainingLoop:
                             start_step,
                         )
                         pending_logs.append((logs, 1))
+                    t_dispatch = _time.monotonic()
                     batch_idx += n_chunk
                     self.global_step += n_chunk
                     if self._update_count is not None:
@@ -913,6 +935,12 @@ class TrainingLoop:
                         # safe point for the max_time consensus check.
                         if self._out_of_time(synced=True):
                             self.should_stop = True
+                    self.telemetry.record_chunk(
+                        n_chunk,
+                        data_wait=t_fetch - t_pull,
+                        step=t_dispatch - t_fetch,
+                        drain=_time.monotonic() - t_dispatch,
+                    )
                     if (
                         (
                             self.spec.max_steps is not None
@@ -981,6 +1009,7 @@ class TrainingLoop:
             if self._out_of_time(synced=True):
                 self.should_stop = True
 
+        self._record_fit_throughput(mult)
         self.state = {"status": "finished", "stage": "fit"}
         self.module.params = self.params
         self.module.on_fit_end()
@@ -990,6 +1019,44 @@ class TrainingLoop:
         self.finalize_checkpoints()
         self.strategy.teardown_worker()
         return self._collect_rank_zero_results(results=None)
+
+    def _record_fit_throughput(self, mult: int) -> None:
+        """Tokens/s + MFU into the telemetry when the module's shape is
+        known (duck-typed: ``batch_size`` + ``config.max_seq``, i.e. LM
+        modules). MFU additionally needs a known chip peak
+        (utils/flops); on CPU it is omitted, never fabricated."""
+        tel = getattr(self, "telemetry", None)
+        if tel is None or tel.wall_s <= 0 or tel.steps == 0:
+            return
+        bs = getattr(self.module, "batch_size", None)
+        seq = getattr(getattr(self.module, "config", None), "max_seq", None)
+        if not bs or not seq:
+            return
+        tokens = int(bs) * max(1, int(mult)) * int(seq) * tel.steps
+        fpt = peak = None
+        if self.params is not None:
+            import jax
+
+            from ray_lightning_tpu.obs.telemetry import (
+                flops_per_token,
+                peak_flops_total,
+            )
+
+            n_params = sum(
+                int(np.prod(np.shape(x)))
+                for x in jax.tree_util.tree_leaves(self.params)
+            )
+            cfg = self.module.config
+            n_layer = getattr(cfg, "n_layer", None)
+            d_model = getattr(cfg, "d_model", None)
+            if n_layer and d_model:
+                fpt = flops_per_token(n_params, n_layer, d_model, int(seq))
+                devs = jax.local_devices()
+                if devs:
+                    peak = peak_flops_total(
+                        devs[0].device_kind, jax.device_count()
+                    )
+        tel.record_throughput(tokens, tel.wall_s, fpt, peak)
 
     def _ema_params(self) -> Optional[Any]:
         """Debias-corrected EMA weights from opt_state (None when EMA is
@@ -1406,6 +1473,10 @@ class TrainingLoop:
             trainer_state["mid_epoch"] = not getattr(
                 self, "_epoch_complete", True
             )
+            if getattr(self, "telemetry", None) is not None:
+                # Step-time breakdown + compile events + throughput; the
+                # driver surfaces it as trainer.state["telemetry"].
+                trainer_state["telemetry"] = self.telemetry.snapshot()
         return WorkerOutput(
             best_model_path=best_model_path,
             state_stream=state_stream,

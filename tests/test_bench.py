@@ -88,6 +88,16 @@ def test_bench_smoke_cpu():
         assert r["engine_tokens_per_sec"] > 0, r
         assert r["engine_vs_oneshot"] > 0, r
     assert out["extra"]["decode_cpu_control"] is True  # this run is CPU
+    # Observer effect: tracing on the decode hot loop must stay under 5%
+    # tokens/s (the obs layer's near-zero-cost contract, measured
+    # best-of-3 per mode so scheduler jitter doesn't fail the gate).
+    obs_modes = {
+        r["mode"]
+        for r in out["extra"]["serve_rows"]
+        if r["workload"] == "obs_overhead"
+    }
+    assert obs_modes == {"tracing_off", "tracing_on"}, out["extra"]
+    assert out["extra"]["obs_overhead"] < 1.05, out["extra"]
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

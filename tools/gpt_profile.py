@@ -67,9 +67,13 @@ def main() -> None:
     import shutil
     import time
 
+    from ray_lightning_tpu.obs import profiling as obs_profiling
+
     shutil.rmtree(args.outdir, ignore_errors=True)
     t0 = time.time()
-    with jax.profiler.trace(args.outdir):
+    # obs.profiling.trace == jax.profiler.trace + the process-wide
+    # one-capture lock shared with the on-demand profile() RPCs.
+    with obs_profiling.trace(args.outdir):
         for _ in range(args.steps):
             params, opt_state, loss = step(params, opt_state, toks)
         jax.block_until_ready(loss)

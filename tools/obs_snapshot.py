@@ -1,0 +1,118 @@
+"""Obs artifact snapshot: scrape a live metrics endpoint + export a trace.
+
+Spins an in-process ServeReplica over a tiny randomly-initialized GPT,
+serves a handful of shared-prefix prompts through the chunked-prefill +
+prefix-cache path, then:
+
+- starts the obs HTTP endpoint and scrapes it over real HTTP (the same
+  bytes Prometheus would ingest) into ``--out-metrics``;
+- exports the requests' traces as Chrome trace-event JSON (opens in
+  Perfetto) into ``--out-trace``;
+- prints a one-line JSON summary (span counts, prefix hit rate,
+  compiles_since_init — which must be 0) to stdout.
+
+The tpu_watch `obs` manifest stage runs this and archives both files, so
+every healthy TPU window leaves a scrapeable-metrics + viewable-trace
+artifact alongside the bench JSONs. Runs fine on CPU.
+"""
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out-metrics", default="/tmp/obs_metrics.prom")
+    p.add_argument("--out-trace", default="/tmp/obs_trace.json")
+    p.add_argument("--requests", type=int, default=4)
+    p.add_argument("--new-tokens", type=int, default=16)
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu import obs
+    from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+    from ray_lightning_tpu.serve.server import ServeReplica
+
+    cfg = GPTConfig(
+        vocab_size=257, n_layer=2, n_head=4, d_model=64, max_seq=128,
+        attn_impl="reference",
+    )
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    rep = ServeReplica(
+        params=params,
+        model_config=cfg,
+        num_slots=4,
+        prefill_chunk=16,
+        prefix_blocks=16,
+        prefix_block=16,
+        decode_fold=4,
+        max_prefills_per_step=2,
+    )
+    try:
+        g = np.random.default_rng(0)
+        prefix = g.integers(0, 257, size=48).tolist()
+
+        def submit_one():
+            return rep.submit(
+                prefix + g.integers(0, 257, size=8).tolist(),
+                max_new_tokens=args.new_tokens,
+            )
+
+        deadline = time.monotonic() + 300
+
+        def wait(rid):
+            while not rep.result(rid, wait_s=1.0)["done"]:
+                if time.monotonic() > deadline:
+                    print("timeout waiting for decode", file=sys.stderr)
+                    sys.exit(1)
+
+        # First request completes alone so its prefix blocks are in the
+        # pool before the rest arrive — the trace then shows both a cold
+        # chunked prefill and genuine prefix_seed hits.
+        first = submit_one()
+        wait(first)
+        rids = [first] + [submit_one() for _ in range(args.requests - 1)]
+        for rid in rids[1:]:
+            wait(rid)
+
+        # Scrape over real HTTP — the artifact is what Prometheus sees.
+        srv = obs.MetricsHTTPServer(collect_text=rep.metrics_text).start()
+        try:
+            body = urllib.request.urlopen(srv.url, timeout=10).read()
+        finally:
+            srv.close()
+        with open(args.out_metrics, "wb") as f:
+            f.write(body)
+
+        chrome = rep.export_trace(n=args.requests)
+        with open(args.out_trace, "w") as f:
+            json.dump(chrome, f)
+
+        stats = rep.stats()
+        parsed = obs.parse_prometheus_text(body.decode())
+        print(
+            json.dumps(
+                {
+                    "requests": args.requests,
+                    "trace_events": len(chrome["traceEvents"]),
+                    "metrics_series": len(parsed),
+                    "finished": parsed.get(
+                        "rlt_serve_requests_total", {}
+                    ).get('{kind="finished"}'),
+                    "prefix_hit_rate": stats.get("prefix_hit_rate"),
+                    "compiles_since_init": stats["compiles_since_init"],
+                    "out_metrics": args.out_metrics,
+                    "out_trace": args.out_trace,
+                }
+            )
+        )
+    finally:
+        rep.stop()
+
+
+if __name__ == "__main__":
+    main()

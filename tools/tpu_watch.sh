@@ -16,9 +16,11 @@ have_headline=0
 have_full=0
 have_gpt=0
 have_serve=0
+have_obs=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
+obs_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -26,6 +28,7 @@ headline_status=pending
 full_status=pending
 gpt_status=pending
 serve_status=pending
+obs_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -40,6 +43,7 @@ write_manifest() {
     echo "stage=full status=$full_status fails=$full_fails"
     echo "stage=gpt_ab status=$gpt_status fails=$gpt_fails"
     echo "stage=serve status=$serve_status fails=$serve_fails"
+    echo "stage=obs status=$obs_status fails=$obs_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -144,8 +148,33 @@ while true; do
             echo "$(date -u +%H:%M:%S) serve bench SKIPPED after $serve_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
+      elif [ "$have_obs" -eq 0 ]; then
+        # Stage 5: observability artifact — scrape the metrics endpoint
+        # over real HTTP and save one exported Chrome trace (opens in
+        # Perfetto), so each healthy window leaves an on-chip obs record.
+        echo "$(date -u +%H:%M:%S) launching OBS snapshot" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 1200 python tools/obs_snapshot.py \
+            --out-metrics /tmp/obs_metrics.prom \
+            --out-trace /tmp/obs_trace.json \
+            > /tmp/obs_snapshot.json 2> /tmp/obs_snapshot.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/obs_metrics.prom ] && [ -s /tmp/obs_trace.json ]; then
+          have_obs=1
+          obs_status=ok
+          echo "$(date -u +%H:%M:%S) OBS snapshot SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          obs_fails=$((obs_fails+1))
+          obs_status=failed
+          echo "$(date -u +%H:%M:%S) obs snapshot failed rc=$rc (fail $obs_fails)" >> /tmp/tpu_watch.log
+          if [ "$obs_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_obs=1
+            obs_status=skipped
+            echo "$(date -u +%H:%M:%S) obs snapshot SKIPPED after $obs_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
       else
-        # Stage 5: flash-vs-dense attention timings (VERDICT r4 item 3).
+        # Stage 6: flash-vs-dense attention timings (VERDICT r4 item 3).
         echo "$(date -u +%H:%M:%S) launching flash A/B" >> /tmp/tpu_watch.log
         flash_attempts=$((flash_attempts+1))
         ( cd /tmp/bench_snap2 && \
