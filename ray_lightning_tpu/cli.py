@@ -35,7 +35,7 @@ import yaml
 
 _SUBCOMMANDS = (
     "fit", "validate", "test", "predict", "generate", "convert-hf",
-    "tokenize", "serve",
+    "tokenize", "serve", "doctor",
 )
 
 
@@ -139,7 +139,7 @@ def _apply_dotted(
             continue
         if section not in (
             "model", "strategy", "trainer", "data", "generate", "tokenize",
-            "serve",
+            "serve", "doctor",
         ):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
@@ -154,7 +154,9 @@ def _apply_dotted(
     # Pass 2: typed field values.
     for section, field, raw in field_overrides:
         node = config[section]
-        if section in ("trainer", "generate", "tokenize", "serve"):  # plain dicts
+        if section in (
+            "trainer", "generate", "tokenize", "serve", "doctor",
+        ):  # plain dicts
             node[field] = yaml.safe_load(raw)
             continue
         init_args = node.setdefault("init_args", {})
@@ -213,6 +215,15 @@ def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
     while i < len(rest):
         arg = rest[i]
         if not arg.startswith("--"):
+            # ``rlt doctor <addr>``: the one positional the CLI accepts —
+            # the serve obs endpoint to interrogate.
+            if (
+                known.subcommand == "doctor"
+                and "addr" not in (config.get("doctor") or {})
+            ):
+                config.setdefault("doctor", {})["addr"] = arg
+                i += 1
+                continue
             raise ValueError(f"unexpected argument {arg!r}")
         key = arg[2:]
         if "=" in key:
@@ -397,6 +408,20 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
       profile_s: capture an on-demand jax.profiler trace of replica 0
         for this many seconds while the submitted prompts decode; the
         artifact directory prints to stderr.
+      watchdog: per-replica health watchdog (default on) — engine
+        stall / admission wedge / compile-storm detection driving the
+        health() RPC, rlt_health gauges, and automatic flight-recorder
+        bundles. stall_s: seconds of no progress before a stall verdict
+        (default 10); watchdog_interval_s: evaluation cadence.
+      slo.<metric> <limit>: declarative SLO upper bounds evaluated
+        against the replica stats snapshot (e.g. --serve.slo.ttft_p95_s
+        0.5, --serve.slo.inter_token_p95_s 0.05, --serve.slo.error_rate
+        0.01); breaches flip /healthz to 503 and count in
+        rlt_slo_breaches_total{rule=...}.
+      blackbox_dir / blackbox_keep: where automatic forensic bundles
+        land (default RLT_BLACKBOX_DIR or the tempdir) and how many to
+        retain. Inspect with `rlt doctor <host:port>` against
+        metrics_port.
       prompts: path to a prompts file ("-" = stdin), one request per
         line as comma/space-separated token ids.
       max_new_tokens, temperature, top_k, top_p, seed, eos_token:
@@ -451,6 +476,25 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     if age is not None:
         replica_kwargs["priority_age_s"] = float(age)
     replica_kwargs["tracing"] = bool(serve_cfg.pop("tracing", True))
+    replica_kwargs["watchdog"] = bool(serve_cfg.pop("watchdog", True))
+    for knob, cast in (
+        ("watchdog_interval_s", float),
+        ("stall_s", float),
+        ("blackbox_dir", str),
+        ("blackbox_keep", int),
+    ):
+        val = serve_cfg.pop(knob, None)
+        if val is not None:
+            replica_kwargs[knob] = cast(val)
+    # SLO rules: YAML ``serve: {slo: {metric: limit}}`` and/or dotted
+    # ``--serve.slo.<metric> <limit>`` flags (all upper bounds).
+    slo_cfg = dict(serve_cfg.pop("slo", None) or {})
+    for key in [k for k in serve_cfg if k.startswith("slo.")]:
+        slo_cfg[key[len("slo."):]] = serve_cfg.pop(key)
+    if slo_cfg:
+        replica_kwargs["slo"] = {
+            str(m): float(v) for m, v in slo_cfg.items()
+        }
     metrics_port = serve_cfg.pop("metrics_port", None)
     trace_out = serve_cfg.pop("trace_out", None)
     profile_s = serve_cfg.pop("profile_s", None)
@@ -498,11 +542,19 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         if metrics_port is not None:
             # Driver-side Prometheus endpoint for the run's duration:
             # each scrape pulls every replica's registry live (plus the
-            # driver's own, which carries fabric heartbeat gauges).
+            # driver's own, which carries fabric heartbeat gauges), and
+            # /healthz aggregates fabric heartbeat verdicts + every
+            # replica's health() RPC — 200 only while nothing is
+            # unhealthy, so an external LB can act on it.
             from ray_lightning_tpu import obs
             from ray_lightning_tpu.fabric import core as fabric_core
+            from ray_lightning_tpu.obs import health as obs_health
 
             driver_reg = obs.get_registry()
+            driver_wd = obs_health.Watchdog(registry=driver_reg)
+            driver_wd.add_check(
+                obs_health.heartbeat_check(fabric_core.heartbeats)
+            )
 
             def _collect() -> str:
                 obs.heartbeats_to_registry(
@@ -510,9 +562,27 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
                 )
                 return client.metrics_text() + driver_reg.render()
 
+            def _collect_health():
+                report = driver_wd.evaluate()
+                payload = report.to_dict()
+                healthy = report.healthy
+                replicas = client.health()
+                payload["replicas"] = replicas
+                healthy = healthy and all(
+                    r.get("healthy", True) for r in replicas
+                )
+                payload["healthy"] = healthy
+                if not healthy:
+                    payload["verdict"] = "unhealthy"
+                return healthy, payload
+
             metrics_server = obs.MetricsHTTPServer(
                 collect_text=_collect,
                 collect_json=lambda: {"serve_stats": client.stats()},
+                collect_health=_collect_health,
+                collect_bundle=lambda: client.debug_dump(
+                    reason="http", pull=True
+                ),
                 port=int(metrics_port),
             ).start()
             print(
@@ -558,6 +628,91 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         if metrics_server is not None:
             metrics_server.close()
         client.shutdown()
+
+
+def run_doctor(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``doctor``: interrogate a live serve obs endpoint.
+
+    Usage: ``rlt doctor <host:port> [--doctor.bundle DIR]`` where
+    ``<host:port>`` is the ``--serve.metrics_port`` endpoint (or any
+    :class:`obs.MetricsHTTPServer` with a health collector). Prints the
+    health report — overall verdict, per-component verdicts with
+    reasons, per-replica sections — and, with ``--doctor.bundle``,
+    pulls a flight-recorder bundle over ``/debug/bundle`` into DIR.
+    Returns ``{"status": <http code>, "report": ..., "bundle": ...}``;
+    status 200 means healthy, 503 carries the reason.
+    """
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    cfg = dict(config.pop("doctor", None) or {})
+    addr = cfg.pop("addr", None) or cfg.pop("url", None)
+    bundle_dir = cfg.pop("bundle", None)
+    timeout = float(cfg.pop("timeout_s", 30.0))
+    if cfg:
+        raise ValueError(f"unknown doctor options: {sorted(cfg)}")
+    if not addr:
+        raise ValueError(
+            "doctor requires the serve obs endpoint: rlt doctor <host:port>"
+        )
+    base = str(addr) if "://" in str(addr) else f"http://{addr}"
+    base = base.rstrip("/")
+
+    def fetch(path: str):
+        try:
+            resp = urllib.request.urlopen(base + path, timeout=timeout)
+            return resp.status, resp.read()
+        except urllib.error.HTTPError as exc:
+            # 503 is an ANSWER (unhealthy + JSON reason), not a failure.
+            return exc.code, exc.read()
+
+    status, body = fetch("/healthz")
+    try:
+        report = _json.loads(body)
+    except ValueError:
+        report = {
+            "raw": body.decode(errors="replace").strip(),
+            "healthy": status == 200,
+        }
+
+    def show(rep: Dict[str, Any], indent: str = "") -> None:
+        verdict = rep.get("verdict", "healthy" if status == 200 else "?")
+        print(f"{indent}overall: {verdict}")
+        for name, comp in sorted((rep.get("components") or {}).items()):
+            reasons = "; ".join(comp.get("reasons") or [])
+            line = f"{indent}  {name:<28} {comp.get('verdict', '?')}"
+            print(line + (f"   {reasons}" if reasons else ""))
+
+    print(f"doctor {base} -> HTTP {status}")
+    show(report)
+    for i, rep in enumerate(report.get("replicas") or []):
+        print(f"replica {i}:")
+        show(rep, indent="  ")
+
+    out: Dict[str, Any] = {"status": status, "report": report}
+    if bundle_dir:
+        b_status, b_body = fetch("/debug/bundle")
+        if b_status != 200:
+            raise RuntimeError(
+                f"bundle pull failed: HTTP {b_status} "
+                f"{b_body[:200].decode(errors='replace')}"
+            )
+        manifest = _json.loads(b_body)
+        files = manifest.get("files_content") or {}
+        import os as _os
+
+        dest = _os.path.join(
+            str(bundle_dir),
+            _os.path.basename(manifest.get("dir", "bundle")),
+        )
+        _os.makedirs(dest, exist_ok=True)
+        for name, content in files.items():
+            with open(_os.path.join(dest, name), "w") as f:
+                f.write(content)
+        print(f"bundle pulled: {dest} ({len(files)} files)")
+        out["bundle"] = dest
+    return out
 
 
 def run_tokenize(config: Dict[str, Any]) -> Dict[str, Any]:
@@ -635,6 +790,8 @@ def main(argv: Optional[List[str]] = None) -> Any:
         return run_generate(config)
     if subcommand == "serve":
         return run_serve(config)
+    if subcommand == "doctor":
+        return run_doctor(config)
     trainer, model, datamodule = build(config)
     fn = getattr(trainer, subcommand)
     if datamodule is not None:
@@ -653,7 +810,15 @@ def cli_entry(argv: Optional[List[str]] = None) -> Any:
     from ray_lightning_tpu.utils.platform import apply_jax_platform_env
 
     apply_jax_platform_env()
-    return main(argv)
+    out = main(argv)
+    args = sys.argv[1:] if argv is None else argv
+    if args and args[0] == "doctor":
+        # The console wrapper sys.exit()s our return value, and for
+        # doctor the EXIT STATUS is the contract (scriptable health
+        # probe): 0 healthy, 1 unhealthy — not the report dict, which
+        # a truthy sys.exit would turn into a constant failure.
+        return 0 if out.get("status") == 200 else 1
+    return out
 
 
 if __name__ == "__main__":

@@ -24,6 +24,18 @@ import cloudpickle
 from ray_lightning_tpu.utils.ports import get_node_ip
 
 
+def _record_event(name: str, level: str = "info", **kv: Any) -> None:
+    """Driver-side actor lifecycle into the process event log
+    (obs.events) — best-effort: the reader threads also reach here
+    during interpreter teardown, where imports can fail."""
+    try:
+        from ray_lightning_tpu.obs.events import get_event_log
+
+        get_event_log().record("fabric", name, level=level, **kv)
+    except Exception:  # noqa: BLE001 - forensics must never break fabric
+        pass
+
+
 class FabricError(RuntimeError):
     pass
 
@@ -819,12 +831,20 @@ class ActorHandle:
         if sess is not None:
             with sess.cv:
                 exitcode = self._process.exitcode
+                # Only the FIRST death record is news: kill() already
+                # logged an intentional termination.
+                fresh = self.actor_id not in sess.dead_actors
                 sess.dead_actors.setdefault(
                     self.actor_id, f"process exited (exitcode={exitcode})"
                 )
                 if sess.actors.pop(self.actor_id, None) is not None:
                     _release_actor_resources(self)
                 sess.cv.notify_all()
+            if fresh:
+                _record_event(
+                    "actor_death", level="warn",
+                    actor=self.actor_id, exitcode=exitcode,
+                )
 
     def _send(self, msg: Any) -> None:
         if not self._alive:
@@ -975,6 +995,10 @@ def _spawn_actor(
     except BaseException:
         kill(handle)
         raise
+    _record_event(
+        "actor_start", actor=actor_id, node=node.node_id,
+        cls=cls.__name__,
+    )
     return handle
 
 
@@ -1103,6 +1127,10 @@ def kill(handle: ActorHandle, no_restart: bool = True) -> None:  # noqa: ARG001
         _c.kill(handle)
         return
     sess = _require_session()
+    # Record the intent BEFORE the process dies, so the reader thread's
+    # subsequent death record is recognizably a consequence of this kill.
+    if handle._alive:
+        _record_event("actor_kill", actor=handle.actor_id)
     handle._shutdown(force=True)
     with sess.lock:
         if handle.actor_id in sess.actors:

@@ -56,6 +56,22 @@ class TrainWorker:
 
         return capture_profile(duration_s, outdir)
 
+    def debug_dump(
+        self, reason: str = "rpc", pull: bool = False
+    ) -> Dict[str, Any]:
+        """Flight-recorder bundle of THIS worker process (obs.blackbox):
+        registry, event-log tail, all-thread stacks — the forensic RPC
+        for a training worker that looks stalled. ``pull`` inlines the
+        bundle files so the driver needs no shared filesystem."""
+        from ray_lightning_tpu.obs import blackbox
+
+        manifest = blackbox.default_recorder().dump(reason=reason)
+        if pull:
+            manifest["files_content"] = blackbox.read_bundle(
+                manifest["dir"]
+            )
+        return manifest
+
 
 _train_worker_cls = TrainWorker
 

@@ -2,7 +2,9 @@
 
 The repo's third subsystem (after the trainer and the serving engine):
 one place where serve, trainer, and fabric report what they are doing,
-and one place operators read it back.
+and one place operators read it back. Two halves:
+
+PASSIVE (telemetry — the eyes):
 
 - :mod:`obs.trace` — request tracing: typed lifecycle spans in a bounded
   per-replica ring buffer (:class:`RequestTracer`), exported as Chrome
@@ -10,8 +12,9 @@ and one place operators read it back.
 - :mod:`obs.registry` — counter/gauge/histogram registry
   (:class:`MetricsRegistry`, :func:`get_registry` for the process
   default) rendered in Prometheus text format.
-- :mod:`obs.httpd` — the /metrics + /stats HTTP endpoint
-  (:class:`MetricsHTTPServer`) behind ``rlt serve --serve.metrics_port``.
+- :mod:`obs.events` — structured event log (:class:`EventLog`,
+  :func:`get_event_log`): a bounded process-wide ring of typed events
+  (admissions, cancels, epoch boundaries, actor deaths, verdicts).
 - :mod:`obs.telemetry` — trainer step breakdown, tokens/s + MFU, fabric
   heartbeat aggregation (:class:`TrainTelemetry`).
 - :mod:`obs.jaxmon` — JAX compile-event counters
@@ -20,10 +23,38 @@ and one place operators read it back.
 - :mod:`obs.profiling` — on-demand ``jax.profiler`` capture
   (:func:`capture_profile`) behind the ``profile(duration_s)`` RPCs.
 
+ACTIVE (judgment — something looks through the eyes):
+
+- :mod:`obs.health` — the watchdog + SLO engine (:class:`Watchdog`):
+  passive telemetry in, per-component ``healthy|degraded|unhealthy``
+  verdicts out, backing a real ``/healthz`` (200/503) and the
+  ``rlt_health{component=...}`` gauges.
+- :mod:`obs.blackbox` — the flight recorder (:func:`dump_bundle`,
+  :class:`FlightRecorder`): self-contained forensic bundles (metrics,
+  events, traces, health, stacks) dumped automatically on unhealthy
+  transitions and fit crashes, or on demand via ``debug_dump`` RPCs and
+  ``rlt doctor``.
+- :mod:`obs.httpd` — the /metrics + /stats + /healthz + /debug/bundle
+  HTTP endpoint (:class:`MetricsHTTPServer`) behind
+  ``rlt serve --serve.metrics_port``.
+
 Import cost: everything here is stdlib-only at import time; jax loads
 only when profiling/monitoring is actually used, so the fabric can ship
 this module into workers whose platform env is not yet applied.
 """
+from ray_lightning_tpu.obs.blackbox import (
+    FlightRecorder,
+    dump_bundle,
+    read_bundle,
+)
+from ray_lightning_tpu.obs.events import EventLog, get_event_log
+from ray_lightning_tpu.obs.health import (
+    ComponentHealth,
+    HealthReport,
+    SLORule,
+    Watchdog,
+    parse_slo_rules,
+)
 from ray_lightning_tpu.obs.httpd import MetricsHTTPServer
 from ray_lightning_tpu.obs.jaxmon import compile_stats, install_compile_listener
 from ray_lightning_tpu.obs.profiling import capture_profile, profiler_available
@@ -45,19 +76,29 @@ from ray_lightning_tpu.obs.trace import (
 )
 
 __all__ = [
+    "ComponentHealth",
     "Counter",
+    "EventLog",
+    "FlightRecorder",
     "Gauge",
+    "HealthReport",
     "Histogram",
-    "MetricsRegistry",
     "MetricsHTTPServer",
+    "MetricsRegistry",
     "RequestTracer",
+    "SLORule",
     "TrainTelemetry",
+    "Watchdog",
     "capture_profile",
     "compile_stats",
+    "dump_bundle",
+    "get_event_log",
     "get_registry",
     "heartbeats_to_registry",
     "install_compile_listener",
     "parse_prometheus_text",
+    "parse_slo_rules",
     "profiler_available",
+    "read_bundle",
     "to_chrome_trace",
 ]

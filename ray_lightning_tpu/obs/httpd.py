@@ -1,9 +1,10 @@
-"""Tiny metrics HTTP endpoint: /metrics (Prometheus text), /stats (JSON).
+"""Tiny obs HTTP endpoint: /metrics, /stats, /healthz, /debug/bundle.
 
 Standard-library only (http.server in a daemon thread). The handler
 calls the collector functions PER REQUEST, so a scrape always sees
 current values; collectors must therefore be thread-safe (the fabric's
-driver surface and :class:`obs.registry.MetricsRegistry` both are).
+driver surface, :class:`obs.registry.MetricsRegistry`, and
+:class:`obs.health.Watchdog` all are).
 
 Used by ``rlt serve --serve.metrics_port`` (driver-side, aggregating
 replica scrapes) and usable standalone next to any registry::
@@ -12,13 +13,23 @@ replica scrapes) and usable standalone next to any registry::
     srv.start()           # -> srv.port (0 picks a free port)
     ...
     srv.close()
+
+``/healthz`` is a REAL readiness probe when ``collect_health`` is
+wired: the callable returns ``(healthy, report_dict)`` and the endpoint
+answers 200 with the JSON report while healthy, 503 with the same
+report (the reason, machine-readable) when not — so an external load
+balancer can act on it. Without a collector it keeps the legacy
+unconditional ``ok`` (a liveness probe: the process answers HTTP).
+``/debug/bundle`` triggers ``collect_bundle`` — a flight-recorder dump
+returning its manifest (and, typically, the bundle files inline) — the
+transport behind ``rlt doctor --doctor.bundle``.
 """
 from __future__ import annotations
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -28,11 +39,17 @@ class MetricsHTTPServer:
         self,
         collect_text: Callable[[], str],
         collect_json: Optional[Callable[[], Dict[str, Any]]] = None,
+        collect_health: Optional[
+            Callable[[], Tuple[bool, Dict[str, Any]]]
+        ] = None,
+        collect_bundle: Optional[Callable[[], Dict[str, Any]]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ) -> None:
         self._collect_text = collect_text
         self._collect_json = collect_json
+        self._collect_health = collect_health
+        self._collect_bundle = collect_bundle
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -41,6 +58,7 @@ class MetricsHTTPServer:
 
             def do_GET(self) -> None:  # noqa: N802 - http.server API
                 path = self.path.split("?", 1)[0]
+                code = 200
                 try:
                     if path in ("/metrics", "/"):
                         body = outer._collect_text().encode()
@@ -49,14 +67,28 @@ class MetricsHTTPServer:
                         body = json.dumps(outer._collect_json()).encode()
                         ctype = "application/json"
                     elif path == "/healthz":
-                        body, ctype = b"ok\n", "text/plain"
+                        if outer._collect_health is None:
+                            body, ctype = b"ok\n", "text/plain"
+                        else:
+                            healthy, report = outer._collect_health()
+                            body = json.dumps(report, default=str).encode()
+                            ctype = "application/json"
+                            code = 200 if healthy else 503
+                    elif (
+                        path == "/debug/bundle"
+                        and outer._collect_bundle is not None
+                    ):
+                        body = json.dumps(
+                            outer._collect_bundle(), default=str
+                        ).encode()
+                        ctype = "application/json"
                     else:
                         self.send_error(404)
                         return
                 except Exception as exc:  # noqa: BLE001 - scrape-visible
                     self.send_error(500, str(exc)[:200])
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -81,8 +113,13 @@ class MetricsHTTPServer:
         return f"http://{self.host}:{self.port}/metrics"
 
     def close(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        # shutdown() handshakes with a RUNNING serve_forever loop (it
+        # blocks on an event that loop sets); when start() was never
+        # called — e.g. a caller erroring out between construction and
+        # start — it would wait forever. Only the socket close is needed
+        # then. Idempotent: a second close() is a no-op.
         if self._thread is not None:
+            self._httpd.shutdown()
             self._thread.join(timeout=5.0)
             self._thread = None
+        self._httpd.server_close()

@@ -84,6 +84,15 @@ class _Metric:
         with self._lock:
             return dict(self._samples)
 
+    def remove(self, **labels: Any) -> bool:
+        """Drop one labelled series (e.g. a dead worker's gauges) so a
+        scrape stops reporting stale values forever; returns whether the
+        series existed. Series re-appear on the next record, exactly
+        like first touch."""
+        key = _label_key(labels)
+        with self._lock:
+            return self._samples.pop(key, None) is not None
+
     def render(self) -> List[str]:
         out = [
             f"# HELP {self.name} {self.help}",
@@ -165,6 +174,14 @@ class Histogram(_Metric):
     def count(self, **labels: Any) -> int:
         with self._lock:
             return self._counts.get(_label_key(labels), 0)
+
+    def remove(self, **labels: Any) -> bool:
+        key = _label_key(labels)
+        with self._lock:
+            found = self._bucket_counts.pop(key, None) is not None
+            self._samples.pop(key, None)
+            self._counts.pop(key, None)
+            return found
 
     def render(self) -> List[str]:
         out = [

@@ -28,6 +28,7 @@ Prometheus scrape covers serve, trainer, and fabric.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional
 
 from ray_lightning_tpu.obs.registry import MetricsRegistry, get_registry
@@ -61,10 +62,17 @@ class TrainTelemetry:
         self.tokens_per_sec: Optional[float] = None
         self.mfu: Optional[float] = None
         self.tokens_total = 0
+        # Watchdog progress stamps (obs.health.fit_stall_check): the fit
+        # is stalled when neither construction nor the last chunk is
+        # recent and the fit has not finished.
+        self.created_t = time.monotonic()
+        self.last_progress_t: Optional[float] = None
+        self.fit_done = False
 
     def record_chunk(
         self, n_steps: int, data_wait: float, step: float, drain: float
     ) -> None:
+        self.last_progress_t = time.monotonic()
         self.steps += int(n_steps)
         self.chunks += 1
         self.data_wait_s += data_wait
@@ -175,3 +183,12 @@ def heartbeats_to_registry(
             val = hb.get(key)
             if val is not None:
                 gauge.set(float(val), actor=actor_id)
+    # Drop series whose actor is absent from this snapshot: a killed or
+    # crashed worker leaves heartbeats(), and its gauges must leave the
+    # scrape with it instead of reporting stale values forever.
+    for gauge in gauges.values():
+        for label_key in gauge.samples():
+            labels = dict(label_key)
+            actor = labels.get("actor")
+            if actor is not None and actor not in heartbeats:
+                gauge.remove(**labels)

@@ -143,6 +143,23 @@ class ServeClient:
             )
         return fabric.get(self._replicas[0].export_trace.remote(None, n))
 
+    def health(self) -> List[Dict[str, Any]]:
+        """Per-replica health reports (obs.health), index-aligned with
+        the replica list — the driver aggregates them replica-labelled
+        exactly like metrics_text()."""
+        return fabric.get([r.health.remote() for r in self._replicas])
+
+    def debug_dump(
+        self, reason: str = "rpc", replica: int = 0, pull: bool = True
+    ) -> Dict[str, Any]:
+        """Flight-recorder bundle from one replica: the manifest plus
+        (``pull``) the bundle files inline, so the driver/doctor can
+        save them without a shared filesystem."""
+        return fabric.get(
+            self._replicas[int(replica)].debug_dump.remote(reason, pull),
+            timeout=120.0,
+        )
+
     def metrics_text(self) -> str:
         """All replicas' registries as ONE Prometheus exposition: each
         replica's series gets a ``replica="<i>"`` label so identical

@@ -302,6 +302,10 @@ class DecodeEngine:
         #: seeds). Set by the Scheduler/ServeReplica after construction;
         #: None keeps the hot paths branch-only.
         self.tracer: Optional[Any] = None
+        #: Optional obs.events.EventLog: coarse engine happenings only a
+        #: forensic log cares about (prefix-pool evictions). Set by the
+        #: Scheduler/ServeReplica after construction; None = off.
+        self.events: Optional[Any] = None
 
         self.compiled_count = 0
         self._compile()
@@ -1017,6 +1021,11 @@ class DecodeEngine:
         del self._pool_map[self._pool_meta[victim].digest]
         self._pool_meta[victim] = None
         self.prefix_evictions += 1
+        if self.events is not None:
+            self.events.record(
+                "engine", "prefix_evict", block=victim,
+                evictions=self.prefix_evictions,
+            )
         return victim
 
     def _insert_prefix(self, slot: int, tokens: np.ndarray) -> None:

@@ -38,6 +38,7 @@ from ray_lightning_tpu.obs import trace as _trace
 from ray_lightning_tpu.serve.metrics import ServeMetrics
 
 if TYPE_CHECKING:  # engine pulls jax; keep the package import light
+    from ray_lightning_tpu.obs.events import EventLog
     from ray_lightning_tpu.obs.trace import RequestTracer
     from ray_lightning_tpu.serve.engine import DecodeEngine
 
@@ -96,6 +97,7 @@ class Scheduler:
         max_prefill_chunks_per_step: int = 1,
         priority_age_s: Optional[float] = None,
         tracer: Optional["RequestTracer"] = None,
+        events: Optional["EventLog"] = None,
     ) -> None:
         self.engine = engine
         self.metrics = metrics or ServeMetrics(engine.num_slots)
@@ -105,6 +107,13 @@ class Scheduler:
         self.tracer = tracer
         if tracer is not None and getattr(engine, "tracer", None) is None:
             engine.tracer = tracer
+        #: Structured event log (obs.events): coarse lifecycle happenings
+        #: (admission bursts, cancels, expiries) — one event per
+        #: occurrence, never per token; the engine shares it for its
+        #: prefix-pool evictions. None = off (zero cost).
+        self.events = events
+        if events is not None and getattr(engine, "events", None) is None:
+            engine.events = events
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
         #: Chunk-vs-fold interleave budget: prefill chunks advanced per
         #: step (chunked engines only; sits next to the admission budget).
@@ -135,6 +144,10 @@ class Scheduler:
     ) -> None:
         if self.tracer is not None:
             self.tracer.event(rid, span, t=t, attrs=attrs or None)
+
+    def _event(self, name: str, level: str = "info", **kv: Any) -> None:
+        if self.events is not None:
+            self.events.record("scheduler", name, level=level, **kv)
 
     # -- intake (thread-safe) --------------------------------------------
     def submit(
@@ -266,6 +279,8 @@ class Scheduler:
                         queue_depth=len(self._pending)
                     )
                     self._trace(req.request_id, _trace.SPAN_CANCEL)
+                    self._event("cancel", request_id=req.request_id,
+                                where="queued")
                     events.append(
                         TokenEvent(req.request_id, None, True, "cancelled")
                     )
@@ -275,6 +290,8 @@ class Scheduler:
                         queue_depth=len(self._pending)
                     )
                     self._trace(req.request_id, _trace.SPAN_EXPIRE)
+                    self._event("expire", level="warn",
+                                request_id=req.request_id, where="queued")
                     events.append(
                         TokenEvent(req.request_id, None, True, "expired")
                     )
@@ -292,6 +309,11 @@ class Scheduler:
                 req.request_id,
                 _trace.SPAN_CANCEL if cancelled else _trace.SPAN_EXPIRE,
                 slot=slot,
+            )
+            self._event(
+                "cancel" if cancelled else "expire",
+                level="info" if cancelled else "warn",
+                request_id=req.request_id, where="slot", slot=slot,
             )
             events.append(
                 TokenEvent(
@@ -322,6 +344,12 @@ class Scheduler:
                     )
                     for req in admits
                 ]
+            )
+            # One event per BURST, not per admission — the hot loop's
+            # event budget.
+            self._event(
+                "admit_burst", n=len(admits),
+                queue_depth=self.queue_depth(),
             )
             for req, (slot, first_tok, done) in zip(admits, results):
                 req.admitted_at = t_admit

@@ -95,8 +95,17 @@ class ServeReplica:
         tick_s: float = 0.002,
         tracing: bool = True,
         trace_capacity: int = 8192,
+        watchdog: bool = True,
+        watchdog_interval_s: float = 1.0,
+        stall_s: float = 10.0,
+        slo: Optional[Dict[str, Any]] = None,
+        blackbox_dir: Optional[str] = None,
+        blackbox_keep: int = 3,
     ) -> None:
         from ray_lightning_tpu.models.gpt import GPTConfig
+        from ray_lightning_tpu.obs import blackbox as obs_blackbox
+        from ray_lightning_tpu.obs import health as obs_health
+        from ray_lightning_tpu.obs.events import get_event_log
         from ray_lightning_tpu.obs.jaxmon import install_compile_listener
         from ray_lightning_tpu.obs.registry import get_registry
         from ray_lightning_tpu.obs.trace import RequestTracer
@@ -156,6 +165,7 @@ class ServeReplica:
         self.tracer = RequestTracer(
             capacity=trace_capacity, enabled=bool(tracing)
         )
+        self.events = get_event_log()
         self.scheduler = Scheduler(
             self.engine,
             metrics=self.metrics,
@@ -163,7 +173,80 @@ class ServeReplica:
             max_prefill_chunks_per_step=max_prefill_chunks_per_step,
             priority_age_s=priority_age_s,
             tracer=self.tracer,
+            events=self.events,
         )
+        self._serve_config: Dict[str, Any] = {
+            "num_slots": self.engine.num_slots,
+            "max_seq": self.engine.max_seq,
+            "decode_fold": self.engine.decode_fold,
+            "pipeline": self.engine.pipeline,
+            "prefill_chunk": self.engine.prefill_chunk,
+            "prefix_blocks": self.engine.prefix_blocks,
+            "int8": self.int8,
+            "watchdog": bool(watchdog),
+            "stall_s": float(stall_s),
+            "slo": dict(slo or {}),
+        }
+        self.events.record(
+            "serve", "replica_init",
+            slots=self.engine.num_slots,
+            compiled=self.engine.compiled_count,
+        )
+        # -- the active half: flight recorder + watchdog ------------------
+        self.blackbox = obs_blackbox.FlightRecorder(
+            outdir=blackbox_dir,
+            keep=blackbox_keep,
+            registry=self._registry,
+            events=self.events,
+            tracer=self.tracer,
+            # The LAST report, not a fresh evaluation: a dump triggered
+            # from inside evaluate() (on_unhealthy) must capture the
+            # verdict that fired it, and must not recurse.
+            health_fn=lambda: (
+                self.watchdog.report().to_dict()
+                if self.watchdog is not None
+                else self.health()
+            ),
+            config=self._serve_config,
+        )
+        self.watchdog: Optional[Any] = None
+        if watchdog:
+            reg = self._registry
+            tokens = reg.counter("rlt_serve_tokens_emitted_total")
+            lifecycle = reg.counter("rlt_serve_requests_total")
+            wd = obs_health.Watchdog(
+                interval_s=float(watchdog_interval_s),
+                registry=reg,
+                events=self.events,
+                on_unhealthy=lambda comp, rep: self.blackbox.maybe_dump(
+                    f"unhealthy:{comp}"
+                ),
+            )
+            # Every check only READS state the hot paths already publish
+            # (registry counters, slot counts) — zero hot-loop cost.
+            wd.add_check(obs_health.engine_stall_check(
+                lambda: self.engine.num_active, tokens.value, float(stall_s)
+            ))
+            wd.add_check(obs_health.admission_wedge_check(
+                self.scheduler.queue_depth,
+                lambda: lifecycle.value(kind="admitted"),
+                float(stall_s),
+                free_slots_fn=lambda: len(self.engine.free_slots()),
+            ))
+            wd.add_check(obs_health.compile_storm_check(
+                lambda: (
+                    self._compile_stats.count("backend_compile")
+                    - self._compiles_at_init
+                ),
+            ))
+            if slo:
+                wd.add_check(obs_health.slo_check(
+                    obs_health.parse_slo_rules(dict(slo)),
+                    self.metrics.snapshot,
+                    registry=reg,
+                    events=self.events,
+                ))
+            self.watchdog = wd.start()
         self._tick = float(tick_s)
         #: request_id -> {"tokens": [...], "done": bool, "status": str}
         self._buffers: Dict[str, Dict[str, Any]] = {}
@@ -300,7 +383,42 @@ class ServeReplica:
         )
         if self.engine.prefix_blocks:
             snap["prefix"] = self.engine.prefix_stats()
+        snap["health"] = self.health()["verdict"]
         return snap
+
+    # -- health / forensics RPCs ------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """This replica's health report (obs.health): per-component
+        verdicts with reasons, evaluated FRESH — the RPC is the
+        aggregation surface the driver's /healthz pulls, so it must not
+        serve a stale verdict at a recovery boundary."""
+        if self.watchdog is None:
+            return {
+                "verdict": "healthy", "healthy": True, "reasons": [],
+                "components": {}, "watchdog": False,
+            }
+        out = self.watchdog.evaluate().to_dict()
+        out["watchdog"] = True
+        return out
+
+    def debug_dump(
+        self, reason: str = "rpc", pull: bool = False
+    ) -> Dict[str, Any]:
+        """Write a flight-recorder bundle NOW (not rate-limited — an
+        operator asked); returns its manifest, plus the bundle files
+        inline when ``pull`` (the ``rlt doctor`` transport)."""
+        from ray_lightning_tpu.obs import blackbox as obs_blackbox
+
+        manifest = self.blackbox.dump(reason=reason)
+        if pull:
+            manifest["files_content"] = obs_blackbox.read_bundle(
+                manifest["dir"]
+            )
+        return manifest
+
+    def recent_events(self, n: int = 64) -> list:
+        """Tail of this process's structured event log (obs.events)."""
+        return self.events.tail(n)
 
     # -- observability RPCs ----------------------------------------------
     def trace(self, request_id: str) -> list:
@@ -342,6 +460,8 @@ class ServeReplica:
         return capture_profile(duration_s, outdir)
 
     def stop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self._stop.set()
         self._work.set()
         self._thread.join(timeout=5.0)

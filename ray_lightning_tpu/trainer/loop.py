@@ -492,6 +492,12 @@ class TrainingLoop:
         process writes its own shards via orbax — no gather, scales with
         GSPMD/ZeRO state (call from ALL ranks).
         """
+        events = getattr(self, "_events", None)  # None outside a fit
+        if events is not None:
+            events.record(
+                "trainer", "checkpoint", path=str(path), sharded=sharded,
+                epoch=self.current_epoch, step=self.global_step,
+            )
         if sharded:
             from ray_lightning_tpu.trainer.checkpoint_io import (
                 OrbaxCheckpointIO,
@@ -621,7 +627,31 @@ class TrainingLoop:
 
     def run_fit(self, ckpt_stream: Optional[bytes] = None) -> Optional[WorkerOutput]:
         with self._anomaly_guard():
-            return self._run_fit_impl(ckpt_stream)
+            try:
+                return self._run_fit_impl(ckpt_stream)
+            except (SystemExit, KeyboardInterrupt):
+                raise
+            except BaseException as exc:
+                # Forensics BEFORE the raise unwinds: a structured event
+                # plus a rate-limited flight-recorder bundle (metrics,
+                # event tail, all-thread stacks) so a crashed fit leaves
+                # a black box, not just a traceback. crash_dump never
+                # raises — it must not mask the real error.
+                from ray_lightning_tpu.obs.blackbox import crash_dump
+                from ray_lightning_tpu.obs.events import get_event_log
+
+                get_event_log().record(
+                    "trainer", "fit_exception", level="error",
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                    epoch=self.current_epoch, step=self.global_step,
+                )
+                crash_dump(f"fit_exception:{type(exc).__name__}")
+                raise
+            finally:
+                wd = getattr(self, "_watchdog", None)
+                if wd is not None:
+                    wd.stop()
+                    self._watchdog = None
 
     def _run_fit_impl(
         self, ckpt_stream: Optional[bytes] = None
@@ -634,11 +664,42 @@ class TrainingLoop:
         # drain) + compile events into the process registry; throughput
         # (tokens/s, MFU) lands at fit end. A few monotonic() reads per
         # dispatched chunk — noise next to a compiled step.
+        from ray_lightning_tpu.obs.events import get_event_log
         from ray_lightning_tpu.obs.jaxmon import install_compile_listener
         from ray_lightning_tpu.obs.telemetry import TrainTelemetry
 
         install_compile_listener()
         self.telemetry = TrainTelemetry()
+        self._events = get_event_log()
+        self._events.record(
+            "trainer", "fit_start",
+            max_epochs=self.spec.max_epochs, resume_step=self.global_step,
+        )
+        # Opt-in fit-stall watchdog (obs.health): RLT_TRAIN_WATCHDOG_S=N
+        # flags (event + rate-limited black-box bundle) a fit that
+        # records no optimizer step for N seconds. Off by default — the
+        # driver cannot distinguish a giant compile from a hang without
+        # an operator-chosen budget.
+        self._watchdog = None
+        try:
+            wd_s = float(os.environ.get("RLT_TRAIN_WATCHDOG_S", "0") or 0)
+        except ValueError:
+            wd_s = 0.0
+        if wd_s > 0:
+            from ray_lightning_tpu.obs import blackbox as obs_blackbox
+            from ray_lightning_tpu.obs import health as obs_health
+
+            wd = obs_health.Watchdog(
+                interval_s=max(0.25, min(wd_s / 4.0, 5.0)),
+                events=self._events,
+                on_unhealthy=lambda comp, rep: obs_blackbox.crash_dump(
+                    f"unhealthy:{comp}"
+                ),
+            )
+            wd.add_check(
+                obs_health.fit_stall_check(self.telemetry, wd_s)
+            )
+            self._watchdog = wd.start()
         self._fit_deadline = (
             _time.monotonic() + self.spec.max_time
             if self.spec.max_time is not None
@@ -734,6 +795,9 @@ class TrainingLoop:
             # (val_check_interval) must resume by RE-RUNNING this epoch,
             # not skipping its remaining batches.
             self._train_loader.set_epoch(epoch)
+            self._events.record(
+                "trainer", "epoch_start", epoch=epoch, step=self.global_step
+            )
             self.module.on_train_epoch_start(epoch)
             self._call_callbacks("on_train_epoch_start")
 
@@ -1004,12 +1068,20 @@ class TrainingLoop:
 
             self.module.on_train_epoch_end(epoch, dict(self.callback_metrics))
             self._call_callbacks("on_train_epoch_end")
+            self._events.record(
+                "trainer", "epoch_end", epoch=epoch, step=self.global_step
+            )
             # Epoch end is the multi-process max_time boundary (and catches
             # budget expiry during the val epoch in any topology).
             if self._out_of_time(synced=True):
                 self.should_stop = True
 
         self._record_fit_throughput(mult)
+        self.telemetry.fit_done = True  # the fit-stall watchdog stands down
+        self._events.record(
+            "trainer", "fit_end", epochs=self.current_epoch + 1,
+            step=self.global_step,
+        )
         self.state = {"status": "finished", "stage": "fit"}
         self.module.params = self.params
         self.module.on_fit_end()
@@ -1106,6 +1178,12 @@ class TrainingLoop:
     ) -> Dict[str, float]:
         import jax
 
+        events = getattr(self, "_events", None)  # None outside a fit
+        if events is not None:
+            events.record(
+                "trainer", "eval_epoch", stage=prefix,
+                epoch=self.current_epoch, step=self.global_step,
+            )
         mult = self.strategy.batch_multiplier
         limit = (
             self.spec.limit_test_batches

@@ -98,6 +98,16 @@ def test_bench_smoke_cpu():
     }
     assert obs_modes == {"tracing_off", "tracing_on"}, out["extra"]
     assert out["extra"]["obs_overhead"] < 1.05, out["extra"]
+    # Same gate for the ACTIVE half: a background watchdog evaluating 50x
+    # faster than the production cadence must still cost < 5% tokens/s
+    # (it only reads published state; this measures the lock contention).
+    wd_modes = {
+        r["mode"]
+        for r in out["extra"]["serve_rows"]
+        if r["workload"] == "watchdog_overhead"
+    }
+    assert wd_modes == {"watchdog_off", "watchdog_on"}, out["extra"]
+    assert out["extra"]["watchdog_overhead"] < 1.05, out["extra"]
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

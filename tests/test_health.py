@@ -1,0 +1,783 @@
+"""Active health layer tests: watchdog state machine under injected
+faults, the SLO engine, real /healthz semantics (200 -> 503 -> 200),
+flight-recorder bundles, `rlt doctor`, and the PR's regressions
+(MetricsHTTPServer.close() before start(), stale dead-worker gauges).
+
+The load-bearing property is the END-TO-END loop: inject a fault (a
+stalled engine, a worker that stops heartbeating, a tripped SLO) ->
+the watchdog flips the component verdict and /healthz to 503 with the
+reason within the configured window -> a self-contained forensic bundle
+lands on disk -> recovery flips /healthz back to 200.
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import obs
+from ray_lightning_tpu.obs import blackbox as obs_blackbox
+from ray_lightning_tpu.obs import health as obs_health
+from ray_lightning_tpu.obs.events import EventLog
+from ray_lightning_tpu.obs.health import (
+    DEGRADED,
+    HEALTHY,
+    UNHEALTHY,
+    ComponentHealth,
+)
+
+HEALTH_CFG_FIELDS = dict(
+    vocab_size=97,
+    n_layer=2,
+    n_head=4,
+    n_kv_head=2,
+    d_model=32,
+    max_seq=64,
+    attn_impl="reference",
+    compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def health_params():
+    import jax
+
+    from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+
+    cfg = GPTConfig(**HEALTH_CFG_FIELDS)
+    return init_gpt_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _get(url):
+    """(status, parsed-json body) — 503 is an answer, not an error."""
+    try:
+        resp = urllib.request.urlopen(url, timeout=10)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+# ---------------------------------------------------------------------------
+# Satellite regressions
+# ---------------------------------------------------------------------------
+def test_httpd_close_without_start_does_not_deadlock():
+    # shutdown() waits on the serve_forever loop; with start() never
+    # called that loop never runs, and close() used to block forever.
+    srv = obs.MetricsHTTPServer(collect_text=lambda: "")
+    t0 = time.monotonic()
+    srv.close()
+    assert time.monotonic() - t0 < 2.0
+    # Started servers still close cleanly, and close() is idempotent.
+    srv2 = obs.MetricsHTTPServer(collect_text=lambda: "").start()
+    srv2.close()
+    srv2.close()
+
+
+def test_heartbeats_to_registry_drops_dead_workers():
+    reg = obs.MetricsRegistry()
+    hb = {"rss_bytes": 100.0, "cpu_s": 1.0, "age_s": 0.1}
+    obs.heartbeats_to_registry({"actor-a": dict(hb), "actor-b": dict(hb)}, reg)
+    parsed = obs.parse_prometheus_text(reg.render())
+    assert '{actor="actor-a"}' in parsed["rlt_fabric_worker_rss_bytes"]
+    assert '{actor="actor-b"}' in parsed["rlt_fabric_worker_rss_bytes"]
+    # actor-a vanishes from the snapshot (killed/crashed): its series
+    # must leave the scrape, not report stale values forever.
+    obs.heartbeats_to_registry({"actor-b": dict(hb)}, reg)
+    parsed = obs.parse_prometheus_text(reg.render())
+    for name, series in parsed.items():
+        if name.startswith("rlt_fabric_worker_"):
+            assert '{actor="actor-a"}' not in series, name
+    assert '{actor="actor-b"}' in parsed["rlt_fabric_worker_rss_bytes"]
+
+
+class _HBActor:
+    def ping(self):
+        return "ok"
+
+
+def test_killed_fabric_worker_series_leave_the_scrape(start_fabric):
+    fabric = start_fabric(num_cpus=2)
+    actor = (
+        fabric.remote(_HBActor)
+        .options(num_cpus=1, env={"RLT_HEARTBEAT_S": "0.2"})
+        .remote()
+    )
+    assert fabric.get(actor.ping.remote()) == "ok"
+    deadline = time.monotonic() + 15
+    while not fabric.heartbeats():
+        assert time.monotonic() < deadline, "no heartbeat within 15s"
+        time.sleep(0.1)
+    reg = obs.MetricsRegistry()
+    obs.heartbeats_to_registry(fabric.heartbeats(), reg)
+    assert any(
+        v > 0
+        for v in obs.parse_prometheus_text(reg.render())[
+            "rlt_fabric_worker_rss_bytes"
+        ].values()
+    )
+    fabric.kill(actor)
+    # A killed worker leaves heartbeats(); the next fold must drop it.
+    obs.heartbeats_to_registry(fabric.heartbeats(), reg)
+    parsed = obs.parse_prometheus_text(reg.render())
+    assert parsed.get("rlt_fabric_worker_rss_bytes", {}) == {}
+
+
+# ---------------------------------------------------------------------------
+# Event log
+# ---------------------------------------------------------------------------
+def test_event_log_ring_tail_and_jsonl():
+    log = EventLog(capacity=4)
+    for i in range(6):
+        log.record("serve", f"e{i}", level="info", i=i)
+    assert len(log) == 4
+    tail = log.tail()
+    assert [e["name"] for e in tail] == ["e2", "e3", "e4", "e5"]
+    assert all(e["subsystem"] == "serve" and "ts" in e for e in tail)
+    assert [e["name"] for e in log.tail(2)] == ["e4", "e5"]
+    log.record("other", "x", level="warn")
+    assert [e["name"] for e in log.tail(subsystem="other")] == ["x"]
+    lines = [ln for ln in log.to_jsonl().splitlines() if ln]
+    assert len(lines) == 4
+    assert json.loads(lines[-1])["name"] == "x"
+    log.enabled = False
+    log.record("serve", "dropped")
+    assert [e["name"] for e in log.tail()][-1] == "x"
+
+
+# ---------------------------------------------------------------------------
+# Watchdog checks under injected faults (virtual clock — no sleeps)
+# ---------------------------------------------------------------------------
+def test_engine_stall_check_state_machine():
+    state = {"active": 0, "tokens": 0, "t": 0.0}
+    check = obs_health.engine_stall_check(
+        lambda: state["active"], lambda: state["tokens"],
+        stall_s=5.0, clock=lambda: state["t"],
+    )
+    assert check()[0].verdict == HEALTHY  # idle
+    # Idle time never counts toward a stall: the flatline resets.
+    state["t"] = 100.0
+    state["active"] = 1
+    assert check()[0].verdict == HEALTHY
+    state["t"] = 106.0  # active, tokens flat past stall_s -> unhealthy
+    (ch,) = check()
+    assert ch.verdict == UNHEALTHY
+    assert "no fold progress" in ch.reasons[0]
+    state["tokens"] = 7  # progress -> immediate recovery
+    assert check()[0].verdict == HEALTHY
+
+
+def test_admission_wedge_check_gated_on_free_slots():
+    state = {"depth": 0, "admits": 0, "free": 1, "t": 0.0}
+    check = obs_health.admission_wedge_check(
+        lambda: state["depth"], lambda: state["admits"], stall_s=5.0,
+        free_slots_fn=lambda: state["free"], clock=lambda: state["t"],
+    )
+    assert check()[0].verdict == HEALTHY
+    state.update(depth=3, t=10.0)
+    assert check()[0].verdict == HEALTHY  # flatline just started
+    state["t"] = 16.0
+    (ch,) = check()
+    assert ch.verdict == UNHEALTHY
+    assert "no admission" in ch.reasons[0]
+    # A full engine legitimately admits nothing: not a wedge.
+    state["free"] = 0
+    assert check()[0].verdict == HEALTHY
+    state.update(free=1, admits=1)
+    assert check()[0].verdict == HEALTHY
+
+
+def test_heartbeat_check_suspect_and_dead():
+    hb = {"w0": {"age_s": 0.5}, "w1": {"age_s": 0.5}}
+    check = obs_health.heartbeat_check(
+        lambda: hb, interval_s=1.0, suspect_k=3.0, dead_k=6.0
+    )
+    verdicts = {c.component: c.verdict for c in check()}
+    assert verdicts == {"fabric:w0": HEALTHY, "fabric:w1": HEALTHY}
+    hb["w0"]["age_s"] = 4.0  # > 3x interval: suspect
+    hb["w1"]["age_s"] = 10.0  # > 6x interval: presumed dead
+    by_name = {c.component: c for c in check()}
+    assert by_name["fabric:w0"].verdict == DEGRADED
+    assert by_name["fabric:w1"].verdict == UNHEALTHY
+    assert "no heartbeat" in by_name["fabric:w1"].reasons[0]
+
+
+def test_compile_storm_check_flags_rising_then_clears():
+    state = {"compiles": 0, "t": 0.0}
+    check = obs_health.compile_storm_check(
+        lambda: state["compiles"], window_s=10.0, clock=lambda: state["t"]
+    )
+    assert check()[0].verdict == HEALTHY
+    state.update(compiles=3, t=1.0)  # counter moved -> storm
+    (ch,) = check()
+    assert ch.verdict == DEGRADED
+    assert "compile storm" in ch.reasons[0]
+    state["t"] = 20.0  # flat past the window -> flag clears
+    assert check()[0].verdict == HEALTHY
+
+
+def test_fit_stall_check_reads_telemetry_stamps():
+    reg = obs.MetricsRegistry()
+    tel = obs.TrainTelemetry(registry=reg)
+    now = {"t": tel.created_t}
+    check = obs_health.fit_stall_check(
+        tel, stall_s=5.0, clock=lambda: now["t"]
+    )
+    assert check()[0].verdict == HEALTHY
+    now["t"] += 6.0  # mid-fit, no chunk ever recorded -> stalled
+    (ch,) = check()
+    assert ch.verdict == UNHEALTHY
+    assert "no optimizer step" in ch.reasons[0]
+    tel.record_chunk(1, 0.01, 0.01, 0.01)  # progress (real clock stamp)
+    now["t"] = tel.last_progress_t + 1.0
+    assert check()[0].verdict == HEALTHY
+    now["t"] = tel.last_progress_t + 50.0
+    assert check()[0].verdict == UNHEALTHY
+    tel.fit_done = True  # the watchdog stands down after the fit
+    assert check()[0].verdict == HEALTHY
+
+
+def test_slo_check_breach_counter_events_and_recovery():
+    reg = obs.MetricsRegistry()
+    log = EventLog()
+    rules = obs_health.parse_slo_rules(
+        {"ttft_p95_s": 0.1, "error_rate": 0.25}
+    )
+    snap = {"ttft_p95_s": 0.5, "finished": 1, "cancelled": 2, "expired": 1}
+    check = obs_health.slo_check(
+        rules, lambda: dict(snap), registry=reg, events=log
+    )
+    by_name = {c.component: c for c in check()}
+    # Both rules breach: the latency directly, the error rate derived
+    # ((2+1)/4 = 0.75 > 0.25).
+    assert by_name["slo:ttft_p95_s"].verdict == UNHEALTHY
+    assert by_name["slo:error_rate"].verdict == UNHEALTHY
+    breaches = reg.counter("rlt_slo_breaches_total")
+    assert breaches.value(rule="ttft_p95_s<0.1") == 1
+    assert breaches.value(rule="error_rate<0.25") == 1
+    assert {e["rule"] for e in log.tail(name="slo_breach")} == {
+        "ttft_p95_s<0.1", "error_rate<0.25",
+    }
+    # Recovery: metric back under the bound -> healthy, counter frozen.
+    snap.update(ttft_p95_s=0.05, finished=100)
+    by_name = {c.component: c for c in check()}
+    assert by_name["slo:ttft_p95_s"].verdict == HEALTHY
+    assert by_name["slo:error_rate"].verdict == HEALTHY
+    assert breaches.value(rule="ttft_p95_s<0.1") == 1
+    # A metric with no data yet is healthy (no traffic != breach).
+    empty_check = obs_health.slo_check(rules, dict, registry=reg)
+    assert all(c.verdict == HEALTHY for c in empty_check())
+
+
+def test_watchdog_transitions_gauges_events_and_unhealthy_hook():
+    reg = obs.MetricsRegistry()
+    log = EventLog()
+    state = {"verdict": HEALTHY, "present": True}
+    fired = []
+
+    def check():
+        if not state["present"]:
+            return []
+        return [ComponentHealth("engine", state["verdict"], ["injected"])]
+
+    wd = obs_health.Watchdog(
+        checks=[check], registry=reg, events=log,
+        on_unhealthy=lambda comp, rep: fired.append(comp),
+    )
+    gauge = reg.gauge("rlt_health")
+    assert wd.evaluate().healthy
+    assert gauge.value(component="engine") == 0
+    state["verdict"] = UNHEALTHY
+    rep = wd.evaluate()
+    assert not rep.healthy and rep.verdict == UNHEALTHY
+    assert rep.reasons() == ["engine: injected"]
+    assert gauge.value(component="engine") == 2
+    assert fired == ["engine"]
+    wd.evaluate()  # still unhealthy: no re-fire, no duplicate event
+    assert fired == ["engine"]
+    changes = log.tail(name="verdict_change")
+    assert len(changes) == 1 and changes[0]["now"] == UNHEALTHY
+    state["verdict"] = HEALTHY
+    assert wd.evaluate().healthy
+    assert log.tail(name="verdict_change")[-1]["now"] == HEALTHY
+    # A vanished component's gauge series leaves the scrape.
+    state["present"] = False
+    wd.evaluate()
+    parsed = obs.parse_prometheus_text(reg.render())
+    assert parsed.get("rlt_health", {}) == {}
+    # A crashing check degrades the watchdog instead of killing it.
+    wd.add_check(lambda: 1 / 0)
+    rep = wd.evaluate()
+    assert rep.components["watchdog"].verdict == DEGRADED
+
+
+# ---------------------------------------------------------------------------
+# /healthz semantics over a live endpoint
+# ---------------------------------------------------------------------------
+def test_healthz_flips_503_and_recovers_with_heartbeat_fault():
+    hb = {"w0": {"age_s": 0.0}}
+    wd = obs_health.Watchdog(
+        registry=obs.MetricsRegistry(), events=EventLog()
+    )
+    wd.add_check(obs_health.heartbeat_check(lambda: hb, interval_s=0.1))
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "",
+        collect_health=lambda: (
+            lambda r: (r.healthy, r.to_dict())
+        )(wd.evaluate()),
+    ).start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        status, report = _get(base + "/healthz")
+        assert status == 200 and report["healthy"] is True
+        # Kill the worker's heartbeat: age grows far past k x interval.
+        hb["w0"]["age_s"] = 60.0
+        status, report = _get(base + "/healthz")
+        assert status == 503
+        assert report["components"]["fabric:w0"]["verdict"] == UNHEALTHY
+        assert any("no heartbeat" in r for r in report["reasons"])
+        # Recovery: heartbeats resume -> 200 again.
+        hb["w0"]["age_s"] = 0.0
+        status, report = _get(base + "/healthz")
+        assert status == 200 and report["verdict"] == HEALTHY
+    finally:
+        srv.close()
+
+
+def test_healthz_without_collector_keeps_legacy_ok():
+    srv = obs.MetricsHTTPServer(collect_text=lambda: "").start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/healthz", timeout=10
+        ).read()
+        assert body == b"ok\n"
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+def test_dump_bundle_contents(tmp_path):
+    reg = obs.MetricsRegistry()
+    reg.counter("rlt_bundle_test_total").inc(3)
+    log = EventLog()
+    log.record("serve", "admit_burst", n=2)
+    tracer = obs.RequestTracer()
+    tracer.event("r1", "submit")
+    tracer.event("r1", "finish")
+    manifest = obs.dump_bundle(
+        str(tmp_path),
+        registry=reg,
+        events=log,
+        tracer=tracer,
+        health={"verdict": "unhealthy", "reasons": ["engine: stalled"]},
+        heartbeats={"w0": {"age_s": 1.0}},
+        config={"num_slots": 4},
+        reason="test reason!",
+    )
+    assert not manifest["errors"]
+    d = manifest["dir"]
+    assert os.path.isdir(d) and "test-reason" in os.path.basename(d)
+    # Every artifact parseable in its native format.
+    parsed = obs.parse_prometheus_text(
+        open(os.path.join(d, "metrics.prom")).read()
+    )
+    assert parsed["rlt_bundle_test_total"][""] == 3.0
+    events = [
+        json.loads(ln)
+        for ln in open(os.path.join(d, "events.jsonl"))
+        if ln.strip()
+    ]
+    assert events[0]["name"] == "admit_burst"
+    trace = json.load(open(os.path.join(d, "trace.json")))
+    assert trace["traceEvents"]
+    health = json.load(open(os.path.join(d, "health.json")))
+    assert health["verdict"] == "unhealthy"
+    assert json.load(open(os.path.join(d, "config.json")))["num_slots"] == 4
+    assert "python" in json.load(open(os.path.join(d, "versions.json")))
+    stacks = open(os.path.join(d, "stacks.txt")).read()
+    # faulthandler output: thread headers + frame lines.
+    assert "most recent call first" in stacks and "File" in stacks
+    listed = json.load(open(os.path.join(d, "manifest.json")))
+    assert set(listed["files"]) == set(manifest["files"])
+    # read_bundle round-trips the files for wire pulls.
+    pulled = obs.read_bundle(d)
+    assert "stacks.txt" in pulled and "manifest.json" in pulled
+
+
+def test_flight_recorder_rate_limit_and_retention(tmp_path):
+    reg = obs.MetricsRegistry()
+    fr = obs.FlightRecorder(
+        outdir=str(tmp_path), keep=2, min_interval_s=60.0, registry=reg
+    )
+    assert fr.maybe_dump("first") is not None
+    assert fr.maybe_dump("suppressed") is None  # rate-limited
+    time.sleep(1.1)  # distinct bundle dir timestamps (1s granularity)
+    fr.dump("second")  # on-demand dumps always fire
+    time.sleep(1.1)
+    fr.dump("third")
+    bundles = fr.bundles()
+    assert len(bundles) == 2  # pruned to keep=2, oldest gone
+    assert all("first" not in b for b in bundles)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: replica watchdog closes the loop (acceptance criterion)
+# ---------------------------------------------------------------------------
+def test_replica_watchdog_end_to_end(health_params, tmp_path):
+    """Stall the engine under an active request -> the watchdog flips
+    `engine` to unhealthy and /healthz to 503 with the reason, a bundle
+    with parseable metrics + event tail + stack dump lands on disk ->
+    un-stall -> /healthz returns to 200."""
+    from ray_lightning_tpu.serve.server import ServeReplica
+
+    params, cfg = health_params
+    bb = str(tmp_path / "blackbox")
+    rep = ServeReplica(
+        params=params,
+        model_config=cfg,
+        num_slots=2,
+        max_seq=48,
+        prefill_buckets=[16],
+        watchdog=True,
+        watchdog_interval_s=0.05,
+        stall_s=0.4,
+        blackbox_dir=bb,
+        slo={"ttft_p95_s": 1000.0},  # generous: must NOT breach
+    )
+    srv = obs.MetricsHTTPServer(
+        collect_text=rep.metrics_text,
+        collect_health=lambda: (rep.health()["healthy"], rep.health()),
+    ).start()
+    base = f"http://{srv.host}:{srv.port}"
+    rng = np.random.default_rng(0)
+    try:
+        rid = rep.submit(
+            rng.integers(0, 97, size=8).tolist(), max_new_tokens=4
+        )
+        deadline = time.monotonic() + 60
+        while not rep.result(rid, wait_s=0.5)["done"]:
+            assert time.monotonic() < deadline
+        status, report = _get(base + "/healthz")
+        assert status == 200 and report["healthy"] is True
+        assert report["components"]["engine"]["verdict"] == HEALTHY
+        assert report["components"]["slo:ttft_p95_s"]["verdict"] == HEALTHY
+
+        # Fault injection: the fold loop stops making progress while a
+        # request occupies a slot.
+        orig_step = rep.engine.step
+        rep.engine.step = lambda: []
+        rid2 = rep.submit(
+            rng.integers(0, 97, size=8).tolist(), max_new_tokens=39
+        )
+        deadline = time.monotonic() + 15
+        status = 200
+        while time.monotonic() < deadline and status == 200:
+            status, report = _get(base + "/healthz")
+            time.sleep(0.05)
+        assert status == 503, "watchdog never flipped /healthz"
+        assert report["components"]["engine"]["verdict"] == UNHEALTHY
+        assert any("no fold progress" in r for r in report["reasons"])
+
+        # The transition dumped a bundle (watchdog-triggered, automatic).
+        deadline = time.monotonic() + 10
+        bundles = []
+        while time.monotonic() < deadline and not bundles:
+            bundles = rep.blackbox.bundles()
+            time.sleep(0.05)
+        assert bundles, "no flight-recorder bundle landed"
+        pulled = obs.read_bundle(bundles[0])
+        assert obs.parse_prometheus_text(pulled["metrics.prom"])
+        tail = [
+            json.loads(ln)
+            for ln in pulled["events.jsonl"].splitlines()
+            if ln.strip()
+        ]
+        assert any(e["name"] == "verdict_change" for e in tail)
+        assert "most recent call first" in pulled["stacks.txt"]
+        health = json.loads(pulled["health.json"])
+        assert health["verdict"] == UNHEALTHY
+
+        # Recovery: un-stall, drain, /healthz back to 200.
+        rep.engine.step = orig_step
+        deadline = time.monotonic() + 60
+        while not rep.result(rid2, wait_s=0.5)["done"]:
+            assert time.monotonic() < deadline, "decode never resumed"
+        deadline = time.monotonic() + 15
+        status = 503
+        while time.monotonic() < deadline and status != 200:
+            status, report = _get(base + "/healthz")
+            time.sleep(0.05)
+        assert status == 200, report
+        # The forensic RPC surface: on-demand dump + event tail.
+        manifest = rep.debug_dump(reason="test", pull=True)
+        assert "stacks.txt" in manifest["files_content"]
+        names = [e["name"] for e in rep.recent_events(64)]
+        assert "replica_init" in names and "admit_burst" in names
+        assert rep.stats()["health"] == HEALTHY
+    finally:
+        srv.close()
+        rep.stop()
+
+
+def test_scheduler_admission_wedge_with_stubbed_engine():
+    """A scheduler with queued requests over an engine that refuses to
+    admit (free slots, flat admit counter) flips the scheduler verdict;
+    admission resumes -> healthy."""
+
+    class _StubEngine:
+        """Host-only engine double: fixed slots, scriptable admission."""
+
+        num_slots = 2
+        max_seq = 1024
+        decode_fold = 1
+        tracer = None
+        events = None
+
+        def __init__(self):
+            self._slots = [None, None]
+            self.admit_enabled = True
+
+        @property
+        def num_active(self):
+            return sum(1 for s in self._slots if s is not None)
+
+        def free_slots(self):
+            if not self.admit_enabled:
+                return []  # models a full engine (capacity-gated case)
+            return [i for i, s in enumerate(self._slots) if s is None]
+
+        def check_prompt_len(self, n):
+            pass
+
+        def admit_many(self, reqs):
+            out = []
+            for req in reqs:
+                slot = self.free_slots()[0]
+                self._slots[slot] = [req["request_id"],
+                                     req["max_new_tokens"] - 1]
+                out.append((slot, 1, req["max_new_tokens"] == 1))
+            return out
+
+        def prefill_step(self, budget):
+            return []
+
+        def step(self):
+            out = []
+            for slot, st in enumerate(self._slots):
+                if st is None:
+                    continue
+                st[1] -= 1
+                done = st[1] <= 0
+                out.append((slot, st[0], 1, done))
+                if done:
+                    self._slots[slot] = None
+            return out
+
+        def release(self, slot):
+            self._slots[slot] = None
+
+    from ray_lightning_tpu.serve.metrics import ServeMetrics
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    reg = obs.MetricsRegistry()
+    log = EventLog()
+    eng = _StubEngine()
+    sched = Scheduler(
+        eng, metrics=ServeMetrics(2, registry=reg), events=log,
+        max_prefills_per_step=2,
+    )
+    clock = {"t": 0.0}
+    lifecycle = reg.counter("rlt_serve_requests_total")
+    wd = obs_health.Watchdog(registry=reg, events=log)
+    wd.add_check(obs_health.admission_wedge_check(
+        sched.queue_depth,
+        lambda: lifecycle.value(kind="admitted"),
+        stall_s=5.0,
+        free_slots_fn=lambda: len(eng.free_slots()),
+        clock=lambda: clock["t"],
+    ))
+    # Healthy traffic: requests admit and drain.
+    sched.submit([1, 2, 3], SamplingParams(max_new_tokens=3))
+    sched.run_until_idle()
+    assert wd.evaluate().healthy
+    assert log.tail(name="admit_burst"), "admission burst not logged"
+    # Wedge: admission refuses while requests queue up. The scheduler's
+    # admission budget sees no free slots, so the queue just sits.
+    eng.admit_enabled = False
+    sched.submit([1, 2, 3], SamplingParams(max_new_tokens=3))
+    for _ in range(5):
+        sched.step()
+    clock["t"] = 10.0
+    assert wd.evaluate().healthy  # capacity-gated: full != wedged
+    # Now the wedge proper: capacity visible, admits still flat
+    # (simulates a scheduler bug / poisoned admission path).
+    eng.admit_enabled = True
+    queue_depth = sched.queue_depth
+
+    # Freeze the queue by never calling step(): depth > 0, free slots
+    # > 0, admit counter flat while the virtual clock passes stall_s.
+    assert queue_depth() == 1
+    clock["t"] = 11.0
+    wd.evaluate()  # flatline baseline with capacity visible
+    clock["t"] = 20.0
+    rep = wd.evaluate()
+    assert rep.components["scheduler"].verdict == UNHEALTHY
+    # Recovery: the loop runs again, the queue drains.
+    sched.run_until_idle()
+    assert wd.evaluate().healthy
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: fit exception -> event + crash bundle
+# ---------------------------------------------------------------------------
+def test_trainer_fit_exception_leaves_event_and_bundle(
+    tmp_path, monkeypatch
+):
+    from ray_lightning_tpu.models import BoringModule
+    from ray_lightning_tpu.obs.events import get_event_log
+    from ray_lightning_tpu.trainer import Trainer
+
+    class _ExplodingModule(BoringModule):
+        def on_train_epoch_start(self, epoch):
+            raise RuntimeError("injected fit crash")
+
+    bb = tmp_path / "bb"
+    monkeypatch.setenv("RLT_BLACKBOX_DIR", str(bb))
+    t = Trainer(
+        max_epochs=1,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        default_root_dir=str(tmp_path),
+    )
+    with pytest.raises(RuntimeError, match="injected fit crash"):
+        t.fit(_ExplodingModule())
+    evs = get_event_log().tail(name="fit_exception")
+    assert evs and "injected fit crash" in evs[-1]["error"]
+    bundles = [p for p in os.listdir(bb) if p.startswith("bundle-")]
+    assert bundles, "crash left no flight-recorder bundle"
+    pulled = obs.read_bundle(str(bb / bundles[0]))
+    assert "stacks.txt" in pulled and "metrics.prom" in pulled
+    tail = [
+        json.loads(ln)
+        for ln in pulled["events.jsonl"].splitlines()
+        if ln.strip()
+    ]
+    assert any(e["name"] == "fit_exception" for e in tail)
+
+
+def test_trainer_fit_records_lifecycle_events(tmp_path):
+    from ray_lightning_tpu.models import BoringModule
+    from ray_lightning_tpu.obs.events import get_event_log
+    from ray_lightning_tpu.trainer import Trainer
+
+    log = get_event_log()
+    before = len(log.tail(subsystem="trainer", name="fit_end"))
+    t = Trainer(
+        max_epochs=2,
+        enable_checkpointing=False,
+        seed=0,
+        num_sanity_val_steps=0,
+        default_root_dir=str(tmp_path),
+    )
+    t.fit(BoringModule())
+    names = [e["name"] for e in log.tail(subsystem="trainer")]
+    assert names.count("fit_end") == before + 1
+    assert "fit_start" in names and "epoch_start" in names
+    assert "epoch_end" in names and "eval_epoch" in names
+
+
+# ---------------------------------------------------------------------------
+# rlt doctor
+# ---------------------------------------------------------------------------
+def test_cli_doctor_reports_and_pulls_bundle(tmp_path, capsys):
+    from ray_lightning_tpu.cli import main as cli_main
+
+    report = {
+        "verdict": UNHEALTHY, "healthy": False,
+        "reasons": ["engine: no fold progress for 12.0s"],
+        "components": {
+            "engine": {
+                "verdict": UNHEALTHY,
+                "reasons": ["no fold progress for 12.0s"],
+            }
+        },
+        "replicas": [
+            {"verdict": HEALTHY, "healthy": True, "components": {}}
+        ],
+    }
+    bundle = {
+        "dir": "/remote/bundle-x",
+        "files_content": {
+            "health.json": json.dumps(report),
+            "stacks.txt": "Thread 0x1 (most recent call first):",
+        },
+    }
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "",
+        collect_health=lambda: (False, report),
+        collect_bundle=lambda: bundle,
+    ).start()
+    try:
+        out = cli_main([
+            "doctor", f"{srv.host}:{srv.port}",
+            "--doctor.bundle", str(tmp_path / "pull"),
+        ])
+    finally:
+        srv.close()
+    assert out["status"] == 503
+    assert out["report"]["verdict"] == UNHEALTHY
+    printed = capsys.readouterr().out
+    assert "unhealthy" in printed and "no fold progress" in printed
+    assert "replica 0" in printed
+    pulled_dir = out["bundle"]
+    assert os.path.basename(pulled_dir) == "bundle-x"
+    assert json.load(
+        open(os.path.join(pulled_dir, "health.json"))
+    )["verdict"] == UNHEALTHY
+    assert "Thread" in open(os.path.join(pulled_dir, "stacks.txt")).read()
+
+
+def test_cli_doctor_requires_addr():
+    from ray_lightning_tpu.cli import main as cli_main
+
+    with pytest.raises(ValueError, match="doctor requires"):
+        cli_main(["doctor"])
+
+
+def test_cli_entry_doctor_exit_status(capsys):
+    """The console wrapper sys.exit()s cli_entry's return value; for
+    doctor that must be the probe as an exit STATUS (0 healthy /
+    1 unhealthy), not the report dict (truthy -> constant failure)."""
+    from ray_lightning_tpu.cli import cli_entry
+
+    healthy = {"verdict": HEALTHY, "healthy": True, "components": {}}
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "",
+        collect_health=lambda: (True, healthy),
+    ).start()
+    try:
+        assert cli_entry(["doctor", f"{srv.host}:{srv.port}"]) == 0
+    finally:
+        srv.close()
+
+    sick = {
+        "verdict": UNHEALTHY, "healthy": False,
+        "reasons": ["engine: stalled"],
+        "components": {
+            "engine": {"verdict": UNHEALTHY, "reasons": ["stalled"]}
+        },
+    }
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "",
+        collect_health=lambda: (False, sick),
+    ).start()
+    try:
+        assert cli_entry(["doctor", f"{srv.host}:{srv.port}"]) == 1
+    finally:
+        srv.close()
+    capsys.readouterr()

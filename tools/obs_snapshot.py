@@ -8,14 +8,22 @@ prefix-cache path, then:
   bytes Prometheus would ingest) into ``--out-metrics``;
 - exports the requests' traces as Chrome trace-event JSON (opens in
   Perfetto) into ``--out-trace``;
+- with ``--out-bundle DIR``: runs the real ``rlt doctor`` CLI against
+  the live endpoint (health report over /healthz, flight-recorder
+  bundle over /debug/bundle) and leaves the pulled bundle in DIR — the
+  `doctor` manifest stage's artifact;
 - prints a one-line JSON summary (span counts, prefix hit rate,
-  compiles_since_init — which must be 0) to stdout.
+  compiles_since_init — which must be 0 — health verdict, bundle path)
+  to stdout.
 
-The tpu_watch `obs` manifest stage runs this and archives both files, so
-every healthy TPU window leaves a scrapeable-metrics + viewable-trace
-artifact alongside the bench JSONs. Runs fine on CPU.
+The tpu_watch `obs` and `doctor` manifest stages run this and archive
+the files, so every healthy TPU window leaves a scrapeable-metrics +
+viewable-trace + pullable-bundle record alongside the bench JSONs.
+Runs fine on CPU.
 """
 import argparse
+import contextlib
+import io
 import json
 import sys
 import time
@@ -26,6 +34,11 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--out-metrics", default="/tmp/obs_metrics.prom")
     p.add_argument("--out-trace", default="/tmp/obs_trace.json")
+    p.add_argument(
+        "--out-bundle", default="",
+        help="run `rlt doctor` against the live endpoint and pull a "
+        "flight-recorder bundle into this directory",
+    )
     p.add_argument("--requests", type=int, default=4)
     p.add_argument("--new-tokens", type=int, default=16)
     args = p.parse_args()
@@ -51,6 +64,8 @@ def main() -> None:
         prefix_block=16,
         decode_fold=4,
         max_prefills_per_step=2,
+        watchdog_interval_s=0.25,
+        blackbox_dir=args.out_bundle or None,
     )
     try:
         g = np.random.default_rng(0)
@@ -80,9 +95,32 @@ def main() -> None:
             wait(rid)
 
         # Scrape over real HTTP — the artifact is what Prometheus sees.
-        srv = obs.MetricsHTTPServer(collect_text=rep.metrics_text).start()
+        # The endpoint carries the full active surface (health + bundle)
+        # so `rlt doctor` below exercises the real wire path.
+        srv = obs.MetricsHTTPServer(
+            collect_text=rep.metrics_text,
+            collect_health=lambda: (
+                rep.health()["healthy"], rep.health(),
+            ),
+            collect_bundle=lambda: rep.debug_dump(
+                reason="doctor", pull=True
+            ),
+        ).start()
+        doctor = None
         try:
             body = urllib.request.urlopen(srv.url, timeout=10).read()
+            if args.out_bundle:
+                from ray_lightning_tpu.cli import main as cli_main
+
+                # The real CLI path; its human-readable report goes to
+                # stderr-adjacent capture so stdout stays one JSON line.
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    doctor = cli_main([
+                        "doctor", f"{srv.host}:{srv.port}",
+                        "--doctor.bundle", args.out_bundle,
+                    ])
+                print(buf.getvalue(), file=sys.stderr, end="")
         finally:
             srv.close()
         with open(args.out_metrics, "wb") as f:
@@ -94,22 +132,23 @@ def main() -> None:
 
         stats = rep.stats()
         parsed = obs.parse_prometheus_text(body.decode())
-        print(
-            json.dumps(
-                {
-                    "requests": args.requests,
-                    "trace_events": len(chrome["traceEvents"]),
-                    "metrics_series": len(parsed),
-                    "finished": parsed.get(
-                        "rlt_serve_requests_total", {}
-                    ).get('{kind="finished"}'),
-                    "prefix_hit_rate": stats.get("prefix_hit_rate"),
-                    "compiles_since_init": stats["compiles_since_init"],
-                    "out_metrics": args.out_metrics,
-                    "out_trace": args.out_trace,
-                }
-            )
-        )
+        summary = {
+            "requests": args.requests,
+            "trace_events": len(chrome["traceEvents"]),
+            "metrics_series": len(parsed),
+            "finished": parsed.get(
+                "rlt_serve_requests_total", {}
+            ).get('{kind="finished"}'),
+            "prefix_hit_rate": stats.get("prefix_hit_rate"),
+            "compiles_since_init": stats["compiles_since_init"],
+            "health": stats.get("health"),
+            "out_metrics": args.out_metrics,
+            "out_trace": args.out_trace,
+        }
+        if doctor is not None:
+            summary["doctor_status"] = doctor["status"]
+            summary["bundle"] = doctor.get("bundle")
+        print(json.dumps(summary))
     finally:
         rep.stop()
 

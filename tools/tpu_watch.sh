@@ -17,10 +17,12 @@ have_full=0
 have_gpt=0
 have_serve=0
 have_obs=0
+have_doctor=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
 obs_fails=0
+doctor_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -29,6 +31,7 @@ full_status=pending
 gpt_status=pending
 serve_status=pending
 obs_status=pending
+doctor_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -44,6 +47,7 @@ write_manifest() {
     echo "stage=gpt_ab status=$gpt_status fails=$gpt_fails"
     echo "stage=serve status=$serve_status fails=$serve_fails"
     echo "stage=obs status=$obs_status fails=$obs_fails"
+    echo "stage=doctor status=$doctor_status fails=$doctor_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -173,8 +177,35 @@ while true; do
             echo "$(date -u +%H:%M:%S) obs snapshot SKIPPED after $obs_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
+      elif [ "$have_doctor" -eq 0 ]; then
+        # Stage 6: active-health artifact — run the real `rlt doctor` CLI
+        # against a live replica's obs endpoint and save one pulled
+        # flight-recorder bundle, so each healthy window proves the
+        # health/forensics wire path end-to-end on-chip.
+        echo "$(date -u +%H:%M:%S) launching DOCTOR snapshot" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 1200 python tools/obs_snapshot.py \
+            --out-metrics /tmp/doctor_metrics.prom \
+            --out-trace /tmp/doctor_trace.json \
+            --out-bundle /tmp/doctor_bundle \
+            > /tmp/doctor_snapshot.json 2> /tmp/doctor_snapshot.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/doctor_snapshot.json ] && [ -n "$(ls -A /tmp/doctor_bundle 2>/dev/null)" ]; then
+          have_doctor=1
+          doctor_status=ok
+          echo "$(date -u +%H:%M:%S) DOCTOR snapshot SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          doctor_fails=$((doctor_fails+1))
+          doctor_status=failed
+          echo "$(date -u +%H:%M:%S) doctor snapshot failed rc=$rc (fail $doctor_fails)" >> /tmp/tpu_watch.log
+          if [ "$doctor_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_doctor=1
+            doctor_status=skipped
+            echo "$(date -u +%H:%M:%S) doctor snapshot SKIPPED after $doctor_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
       else
-        # Stage 6: flash-vs-dense attention timings (VERDICT r4 item 3).
+        # Stage 7: flash-vs-dense attention timings (VERDICT r4 item 3).
         echo "$(date -u +%H:%M:%S) launching flash A/B" >> /tmp/tpu_watch.log
         flash_attempts=$((flash_attempts+1))
         ( cd /tmp/bench_snap2 && \
