@@ -376,6 +376,25 @@ def run_convert_hf(config: Dict[str, Any]) -> str:
     return out
 
 
+#: Every option ``rlt serve`` accepts (``--serve.<key>`` / YAML
+#: ``serve:``). Validated UP FRONT so a typo'd flag fails instantly with
+#: the valid vocabulary, instead of being silently swallowed or erroring
+#: after replicas spawned. ``slo.<metric>`` rules are open-ended.
+_SERVE_KEYS = frozenset((
+    "ckpt_path", "config", "int8", "prompts",
+    "max_new_tokens", "temperature", "top_k", "top_p", "seed",
+    "eos_token", "replicas", "num_slots", "max_seq",
+    "prefill_buckets", "max_prefills_per_step", "decode_fold",
+    "pipeline", "prefill_chunk", "prefix_cache", "prefix_block",
+    "max_prefill_chunks_per_step", "priority_age_s",
+    "spec", "spec_depth", "spec_draft_ckpt", "spec_draft_config",
+    "spec_draft_int8", "spec_window",
+    "metrics_port", "tracing", "trace_out", "profile_s",
+    "watchdog", "watchdog_interval_s", "stall_s", "slo",
+    "blackbox_dir", "blackbox_keep",
+))
+
+
 def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     """``serve``: spawn replica actors on the fabric and serve prompts.
 
@@ -398,6 +417,17 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         (implies chunked prefill). prefix_block: tokens per pool block.
       priority_age_s: queued requests age toward priority 0 at this rate
         (seconds per priority level); unset = strict priority order.
+      spec: speculative decoding — "off" (default), "ngram" (in-graph
+        prompt-lookup drafter, zero extra weights), or "model" (small
+        draft model); bare off/on parse as YAML booleans and normalize
+        to "off"/"ngram". spec_depth: draft tokens proposed per verify
+        forward (accepted prefix advances up to depth+1 tokens per
+        forward). spec_draft_ckpt / spec_draft_config /
+        spec_draft_int8: the draft model's checkpoint (spec=model),
+        config overrides, and weight-only int8. spec_window: history
+        window the draft model conditions on. Greedy output stays
+        bit-identical to spec off; accept rates land in
+        stats.spec_stats and the spec_accept_rate metric.
       metrics_port: serve a Prometheus /metrics endpoint (plus /stats
         JSON) on this driver-side port for the duration of the run,
         aggregating every replica's registry (0 picks a free port; the
@@ -438,6 +468,17 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     from ray_lightning_tpu.serve import start_replicas
 
     serve_cfg = dict(config.pop("serve", None) or {})
+    # Reject mistyped --serve.* keys FIRST, naming the valid vocabulary
+    # — before any checkpoint loads or replicas spawn.
+    unknown = sorted(
+        k for k in serve_cfg
+        if k not in _SERVE_KEYS and not k.startswith("slo.")
+    )
+    if unknown:
+        raise ValueError(
+            f"unknown serve option(s) {unknown}; valid --serve.* keys: "
+            f"{sorted(_SERVE_KEYS)} (plus slo.<metric> rules)"
+        )
     ckpt_path = serve_cfg.pop("ckpt_path", None)
     if ckpt_path is None:
         raise ValueError("serve requires --serve.ckpt_path")
@@ -475,6 +516,29 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     age = serve_cfg.pop("priority_age_s", None)
     if age is not None:
         replica_kwargs["priority_age_s"] = float(age)
+    # Speculative decoding: --serve.spec {off|ngram|model} with
+    # --serve.spec_depth draft tokens per verify; spec=model drafts with
+    # the (optionally int8) checkpoint at --serve.spec_draft_ckpt.
+    # Dotted values parse as YAML, where bare off/on are 1.1 booleans —
+    # map them back to the words the flag documents (on = the
+    # zero-weight n-gram drafter).
+    spec_raw = serve_cfg.pop("spec", "off")
+    if spec_raw is False:
+        spec_raw = "off"
+    elif spec_raw is True:
+        spec_raw = "ngram"
+    replica_kwargs["spec"] = str(spec_raw)
+    replica_kwargs["spec_depth"] = int(serve_cfg.pop("spec_depth", 4))
+    replica_kwargs["spec_window"] = int(serve_cfg.pop("spec_window", 32))
+    replica_kwargs["spec_draft_int8"] = bool(
+        serve_cfg.pop("spec_draft_int8", False)
+    )
+    draft_ckpt = serve_cfg.pop("spec_draft_ckpt", None)
+    if draft_ckpt is not None:
+        replica_kwargs["spec_draft_ckpt"] = str(draft_ckpt)
+    draft_cfg = serve_cfg.pop("spec_draft_config", None)
+    if draft_cfg is not None:
+        replica_kwargs["spec_draft_config"] = dict(draft_cfg)
     replica_kwargs["tracing"] = bool(serve_cfg.pop("tracing", True))
     replica_kwargs["watchdog"] = bool(serve_cfg.pop("watchdog", True))
     for knob, cast in (
@@ -514,7 +578,13 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     if pb is not None:
         replica_kwargs["prefill_buckets"] = [int(b) for b in pb]
     if serve_cfg:
-        raise ValueError(f"unknown serve options: {sorted(serve_cfg)}")
+        # _SERVE_KEYS said these were valid but nothing consumed them:
+        # the vocabulary and the pops drifted apart — a bug here, not a
+        # user typo (those were rejected up front).
+        raise RuntimeError(
+            f"serve options {sorted(serve_cfg)} are listed in _SERVE_KEYS "
+            "but unhandled"
+        )
 
     if prompts_src == "-":
         lines = [ln.strip() for ln in sys.stdin]
@@ -813,12 +883,14 @@ def cli_entry(argv: Optional[List[str]] = None) -> Any:
     out = main(argv)
     args = sys.argv[1:] if argv is None else argv
     if args and args[0] == "doctor":
-        # The console wrapper sys.exit()s our return value, and for
-        # doctor the EXIT STATUS is the contract (scriptable health
-        # probe): 0 healthy, 1 unhealthy — not the report dict, which
-        # a truthy sys.exit would turn into a constant failure.
+        # The EXIT STATUS is doctor's contract (scriptable health
+        # probe): 0 healthy, 1 unhealthy.
         return 0 if out.get("status") == 200 else 1
-    return out
+    # The console wrapper sys.exit()s our return value; any other
+    # command's result dict is already on stdout, and a truthy
+    # sys.exit(dict) would dump it to stderr and exit 1 — a successful
+    # `rlt serve` must exit 0.
+    return 0
 
 
 if __name__ == "__main__":

@@ -1447,6 +1447,405 @@ def gpt_decode_fold(
     )
 
 
+def gpt_decode_verify(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    toks: jax.Array,
+    pos: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """ONE batched forward over Q candidate tokens per slot — the verify
+    half of speculative decoding.
+
+    ``toks`` (B, Q) int32 holds, per slot, the current token followed by
+    Q-1 draft proposals; ``pos`` (B,) int32 is the position the current
+    token occupies, so row ``i`` sits at absolute position ``pos[b] + i``.
+    The forward computes every row's K/V, writes them into the slot's
+    cache rows ``[pos, pos + Q)`` (masked row-gather — a block write
+    would clamp near the cache end and corrupt real rows), attends each
+    query to ``position <= pos[b] + i`` with exact ``-inf`` masking, and
+    returns fp32 logits (B, Q, V): ``logits[:, i]`` predicts the token at
+    position ``pos + i + 1`` GIVEN inputs ``toks[:, :i+1]``.
+
+    Exactness: this is :func:`gpt_decode_step` with a query axis — same
+    einsum contractions, same fp32 score/softmax order, same grouped-KV
+    fold, same per-row norms — so ``logits[:, i]`` is bit-identical to
+    running ``gpt_decode_step`` sequentially over ``toks[:, :i+1]``
+    (asserted in tests/test_serve.py under the reference config). Rows
+    whose draft is later rejected leave garbage K/V behind; those rows
+    sit at ``position > pos`` after the accept shrinks ``pos`` back, so
+    the slot masks hide them and the next verify's own writes refresh
+    them before any read — the PR 3 masked-gather discipline.
+    """
+    from ray_lightning_tpu.ops.attention import band_allowed
+
+    cfg.validate_variants()
+    cdt = jnp.dtype(cfg.compute_dtype)
+    norm_fn = _make_norm(cfg)
+    L, H, hd = cfg.n_layer, cfg.n_head, cfg.head_dim
+    Hkv = cfg.kv_head
+    rep = H // Hkv
+    B, Q = toks.shape
+    S = k_cache.shape[2]
+
+    positions = pos[:, None] + jnp.arange(Q, dtype=jnp.int32)[None]  # (B,Q)
+    x = embed_rows(params["wte"], toks)
+    if cfg.pos_embed == "learned":
+        # Clip only the (garbage) rows running past the table — a real
+        # (accepted) row always sits below max_seq.
+        x = x + params["wpe"][jnp.clip(positions, 0, cfg.max_seq - 1)]
+    x = x.astype(cdt)  # (B, Q, D)
+    if cfg.pos_embed == "rope":
+        half = hd // 2
+        freqs = cfg.rope_theta ** (
+            -jnp.arange(half, dtype=jnp.float32) / half
+        )
+        ang = positions.astype(jnp.float32)[..., None] * freqs  # (B,Q,half)
+        rope_tables = (jnp.cos(ang), jnp.sin(ang))
+    else:
+        rope_tables = None
+
+    def _rope_rows(y: jax.Array) -> jax.Array:
+        # (B, Q, H*, hd): _rope_slot with a query axis.
+        cos, sin = rope_tables
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+        half = y.shape[-1] // 2
+        y32 = y.astype(jnp.float32)
+        y1, y2 = y32[..., :half], y32[..., half:]
+        return jnp.concatenate(
+            [y1 * c - y2 * s, y1 * s + y2 * c], axis=-1
+        ).astype(y.dtype)
+
+    rows = jnp.arange(S, dtype=jnp.int32)
+    idx = rows[None] - pos[:, None]  # (B, S): row's index into the chunk
+    wvalid = (idx >= 0) & (idx < Q)
+    gidx = jnp.clip(idx, 0, Q - 1)
+
+    def layer(h, args):
+        lp, kc_l, vc_l = args  # caches (B, S, Hkv, hd)
+        a = norm_fn(h, lp["ln1_g"], lp["ln1_b"])
+        if Hkv == H:
+            qkv = (
+                jnp.einsum("bqd,dthk->bqthk", a, dequant(lp["wqkv"], cdt))
+                + lp["bqkv"].astype(cdt)
+            )
+            q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            q = (
+                jnp.einsum("bqd,dhk->bqhk", a, dequant(lp["wq"], cdt))
+                + lp["bq"].astype(cdt)
+            )
+            kv = (
+                jnp.einsum("bqd,dthk->bqthk", a, dequant(lp["wkv"], cdt))
+                + lp["bkv"].astype(cdt)
+            )
+            k_new, v_new = kv[:, :, 0], kv[:, :, 1]
+        if rope_tables is not None:
+            q = _rope_rows(q)
+            k_new = _rope_rows(k_new)
+        # Masked row-gather write of all Q rows into [pos, pos + Q).
+        wmask = wvalid[:, :, None, None]
+        kc_l = jnp.where(
+            wmask,
+            jnp.take_along_axis(
+                k_new.astype(cdt), gidx[:, :, None, None], axis=1
+            ),
+            kc_l,
+        )
+        vc_l = jnp.where(
+            wmask,
+            jnp.take_along_axis(
+                v_new.astype(cdt), gidx[:, :, None, None], axis=1
+            ),
+            vc_l,
+        )
+        # gpt_decode_step's grouped attention, one extra query axis: q
+        # heads fold to (Hkv, rep) groups; scale BEFORE the einsum, fp32
+        # scores, exact -inf band mask on absolute positions.
+        qg = q.reshape(B, Q, Hkv, rep, hd).astype(jnp.float32)
+        s = jnp.einsum(
+            "bqgrk,bsgk->bqgrs",
+            qg * (1.0 / np.sqrt(hd)),
+            kc_l.astype(jnp.float32),
+        )
+        pos_ids = rows[None, None, None, None]
+        s = jnp.where(
+            band_allowed(
+                positions[:, :, None, None, None], pos_ids,
+                cfg.attn_window, cfg.attn_sinks,
+            ),
+            s,
+            float("-inf"),
+        )
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum(
+            "bqgrs,bsgk->bqgrk", p, vc_l.astype(jnp.float32)
+        ).reshape(B, Q, H, hd).astype(cdt)
+        h = h + jnp.einsum(
+            "bqhk,hkd->bqd", o, dequant(lp["wo"], cdt)
+        ) + lp["bo"].astype(cdt)
+        m = norm_fn(h, lp["ln2_g"], lp["ln2_b"])
+        if cfg.n_experts > 0:
+            from ray_lightning_tpu.parallel.moe import moe_ffn
+
+            m_out, _ = moe_ffn(
+                _moe_layer_params(lp),
+                m,
+                capacity_factor=float(cfg.n_experts),  # never drop
+                compute_dtype=cdt,
+                top_k=cfg.moe_top_k,
+            )
+        else:
+            m_out = _dense_mlp(m, lp, cfg, cdt)
+        return h + m_out, (kc_l, vc_l)
+
+    h = x
+    new_k, new_v = [], []
+    for li in range(L):
+        lp = jax.tree_util.tree_map(lambda a: a[li], params["blocks"])
+        h, (kc_l, vc_l) = layer(h, (lp, k_cache[li], v_cache[li]))
+        new_k.append(kc_l)
+        new_v.append(vc_l)
+    k_cache = jnp.stack(new_k)
+    v_cache = jnp.stack(new_v)
+    h = norm_fn(h, params["lnf_g"], params["lnf_b"])
+    logits = _lm_head(h, _head_weight(params, cfg))
+    return logits, k_cache, v_cache
+
+
+def ngram_propose(
+    hist: jax.Array,
+    pos: jax.Array,
+    cur: jax.Array,
+    *,
+    depth: int,
+) -> jax.Array:
+    """In-graph n-gram / prompt-lookup drafter — zero extra weights.
+
+    ``hist`` (B, S) int32 is each slot's own token history (``hist[p]`` =
+    the token at position p, live for ``p <= pos[b]``); ``cur`` (B,) is
+    the token at ``pos``. Finds the most recent earlier occurrence of the
+    bigram ending at ``cur`` and proposes the ``depth`` tokens that
+    followed it (Saxena-style prompt lookup); falls back to the last
+    occurrence of ``cur`` alone, then to repeating ``cur``. Reads past
+    the live region are masked to ``cur`` — stale rows from an evicted
+    tenant can only lower the accept rate, never correctness (rejected
+    drafts never touch real state). O(S) compares per slot, negligible
+    next to the verify forward.
+    """
+    B, S = hist.shape
+    rows = jnp.arange(S, dtype=jnp.int32)[None]  # (1, S)
+    prev = jnp.take_along_axis(
+        hist, jnp.maximum(pos - 1, 0)[:, None], axis=1
+    )[:, 0]
+    hist_prev = jnp.concatenate(
+        [jnp.zeros((B, 1), hist.dtype), hist[:, :-1]], axis=1
+    )
+    in_past = (rows >= 1) & (rows <= pos[:, None] - 1)
+    bi = in_past & (hist == cur[:, None]) & (hist_prev == prev[:, None])
+    uni = in_past & (hist == cur[:, None])
+    j_bi = jnp.max(jnp.where(bi, rows, -1), axis=1)  # (B,)
+    j_uni = jnp.max(jnp.where(uni, rows, -1), axis=1)
+    j = jnp.where(j_bi >= 0, j_bi, j_uni)
+    cont = j[:, None] + 1 + jnp.arange(depth, dtype=jnp.int32)[None]
+    ok = (j[:, None] >= 0) & (cont <= pos[:, None])
+    drafts = jnp.take_along_axis(hist, jnp.clip(cont, 0, S - 1), axis=1)
+    return jnp.where(ok, drafts, cur[:, None]).astype(jnp.int32)
+
+
+def model_propose(
+    draft_params: Dict[str, Any],
+    draft_cfg: GPTConfig,
+    hist: jax.Array,
+    pos: jax.Array,
+    cur: jax.Array,
+    *,
+    depth: int,
+    window: int,
+) -> jax.Array:
+    """Draft-model drafter: a small (optionally int8) GPT proposes
+    ``depth`` greedy continuations from a sliding window of history.
+
+    Per verify, the draft model runs one prefill over each slot's last
+    ``window`` tokens (relative positions — the drafter is a proposal
+    heuristic, it owes the main model nothing numerically) and then
+    ``depth`` greedy :func:`gpt_decode_step` steps on its own throwaway
+    cache. Stateless by design: no persistent draft KV to keep in sync
+    across variable-length accepts, slot recycles, or prefix-cache
+    seeds — the cost is O(window + depth) draft-model tokens per verify,
+    which a draft much smaller than the main model amortizes. Sequences
+    shorter than the window left-fill with their first live token
+    (degrades early proposals, never correctness).
+    """
+    B, S = hist.shape
+    idx = pos[:, None] - window + 1 + jnp.arange(window, dtype=jnp.int32)
+    toks_w = jnp.take_along_axis(hist, jnp.clip(idx, 0, S - 1), axis=1)
+    # Left-fill short sequences with the first live token (position 0).
+    toks_w = jnp.where(idx >= 0, toks_w, hist[:, :1])
+    h_pf, pf_k, pf_v = gpt_prefill(draft_params, draft_cfg, toks_w)
+    cdt = jnp.dtype(draft_cfg.compute_dtype)
+    norm_fn = _make_norm(draft_cfg)
+    Hkv, hd = draft_cfg.kv_head, draft_cfg.head_dim
+    Ld = draft_cfg.n_layer
+    kc = jnp.zeros((Ld, B, window + depth, Hkv, hd), cdt)
+    vc = jnp.zeros_like(kc)
+    kc = kc.at[:, :, :window].set(pf_k)
+    vc = vc.at[:, :, :window].set(pf_v)
+    h_last = norm_fn(
+        h_pf[:, window - 1 : window],
+        draft_params["lnf_g"], draft_params["lnf_b"],
+    )[:, 0]
+    t = jnp.argmax(
+        _lm_head(h_last, _head_weight(draft_params, draft_cfg)), axis=-1
+    ).astype(jnp.int32)
+    drafts = [t]
+    for i in range(depth - 1):
+        logits, kc, vc = gpt_decode_step(
+            draft_params, draft_cfg, t,
+            jnp.full((B,), window + i, jnp.int32), kc, vc,
+        )
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        drafts.append(t)
+    return jnp.stack(drafts, axis=1)  # (B, depth)
+
+
+def gpt_decode_fold_spec(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    cur: jax.Array,
+    pos: jax.Array,
+    keys: jax.Array,
+    temps: jax.Array,
+    top_ks: jax.Array,
+    top_ps: jax.Array,
+    active: jax.Array,
+    remaining: jax.Array,
+    eos_toks: jax.Array,
+    hist: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    *,
+    fold: int,
+    depth: int,
+    draft_fn: Any,
+) -> Tuple[jax.Array, ...]:
+    """Speculative :func:`gpt_decode_fold`: each of the ``fold``
+    iterations proposes up to ``depth`` tokens per slot (``draft_fn``),
+    scores positions ``pos..pos+depth`` with ONE batched verify forward
+    (:func:`gpt_decode_verify`), and accepts the longest exactly-matching
+    prefix in-graph — converting one forward into 1..depth+1 emitted
+    tokens per slot.
+
+    The accept scan consumes the rng chain one split per EMITTED token,
+    samples each emission from the verify logits of its own position, and
+    stops the chain at the first sampled token that differs from its
+    draft — so every emitted token is sampled from logits computed
+    against already-verified inputs, and the output is bit-identical to
+    the unfolded engine by construction: greedy emissions accept only
+    exact argmax matches, and sampled slots draw from the same
+    (key, logits, knobs) triples an unfolded run would. The mismatching
+    sample itself IS the correct next token (its logits saw only verified
+    inputs), so a miss still emits one token, exactly like a plain step.
+    Per-slot variable advance, mid-fold EOS/length freeze, and the rng
+    chain of frozen slots all follow :func:`gpt_decode_fold`'s rules.
+
+    ``hist`` (B, S) int32 is the device-resident token history the
+    drafters read; the fold writes ``cur`` at ``pos`` and every accepted
+    token at its position, so the history is live up to ``pos[b]`` at
+    every draft. Returns ``(tok_block (fold * (depth+1), B) int32 with
+    -1 at non-emitted lanes, emit_block, cur, pos, keys, active,
+    remaining, hist, k_cache, v_cache)``.
+    """
+    D = int(depth)
+
+    def body(carry, _):
+        cur, pos, keys, active, remaining, hist, k_cache, v_cache = carry
+        # The current token enters the history before drafting (covers
+        # the admission-sampled token; idempotent afterwards).
+        hist = _hist_write_at(hist, pos, cur)
+        drafts = draft_fn(hist, pos, cur)  # (B, D)
+        toks_in = jnp.concatenate([cur[:, None], drafts], axis=1)
+        logits, k_cache, v_cache = gpt_decode_verify(
+            params, cfg, toks_in, pos, k_cache, v_cache
+        )
+        pos0 = pos
+        # Drafts padded with a -1 sentinel at the bonus index: the last
+        # sampled token has no draft to match, so the chain always stops
+        # there (tokens are >= 0, the sentinel never matches).
+        drafts_pad = jnp.concatenate(
+            [drafts, jnp.full((drafts.shape[0], 1), -1, jnp.int32)], axis=1
+        )
+
+        def accept(c, xs):
+            cur, pos, keys, active, remaining, accepting = c
+            lg, draft_i = xs
+            emit = active & accepting
+            split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+            new_keys, subs = split[:, 0], split[:, 1]
+            toks = sample_logits_batched(subs, lg, temps, top_ks, top_ps)
+            cur = jnp.where(emit, toks, cur)
+            pos = jnp.where(emit, pos + 1, pos)
+            keys = jnp.where(emit[:, None], new_keys, keys)
+            remaining = jnp.where(emit, remaining - 1, remaining)
+            live = (remaining > 0) & (toks != eos_toks)
+            active = jnp.where(emit, live, active)
+            accepting = emit & live & (toks == draft_i)
+            return (cur, pos, keys, active, remaining, accepting), (
+                jnp.where(emit, toks, -1),
+                emit,
+            )
+
+        (cur, pos, keys, active, remaining, _), (tok_sub, emit_sub) = (
+            jax.lax.scan(
+                accept,
+                (cur, pos, keys, active, remaining,
+                 jnp.ones_like(active)),
+                (logits.swapaxes(0, 1), drafts_pad.T),
+            )
+        )
+        # Accepted tokens enter the history at positions pos0+1..pos.
+        S = hist.shape[1]
+        rows = jnp.arange(S, dtype=jnp.int32)[None]
+        offs = rows - (pos0[:, None] + 1)  # (B, S)
+        n_emit = pos - pos0
+        hvalid = (offs >= 0) & (offs < n_emit[:, None])
+        emitted = tok_sub.swapaxes(0, 1)  # (B, D+1)
+        hist = jnp.where(
+            hvalid,
+            jnp.take_along_axis(emitted, jnp.clip(offs, 0, D), axis=1),
+            hist,
+        )
+        return (
+            cur, pos, keys, active, remaining, hist, k_cache, v_cache,
+        ), (tok_sub, emit_sub)
+
+    carry, (tok_block, emit_block) = jax.lax.scan(
+        body,
+        (cur, pos, keys, active, remaining, hist, k_cache, v_cache),
+        None,
+        length=int(fold),
+    )
+    cur, pos, keys, active, remaining, hist, k_cache, v_cache = carry
+    B = cur.shape[0]
+    return (
+        tok_block.reshape(int(fold) * (D + 1), B),
+        emit_block.reshape(int(fold) * (D + 1), B),
+        cur, pos, keys, active, remaining, hist, k_cache, v_cache,
+    )
+
+
+def _hist_write_at(
+    hist: jax.Array, pos: jax.Array, tok: jax.Array
+) -> jax.Array:
+    """``hist[b, pos[b]] = tok[b]`` for every slot (one one-hot mask —
+    cheaper than a scatter for the (B, S) int history)."""
+    S = hist.shape[1]
+    rows = jnp.arange(S, dtype=jnp.int32)[None]
+    return jnp.where(rows == pos[:, None], tok[:, None], hist)
+
+
 def gpt_generate(
     params: Dict[str, Any],
     cfg: GPTConfig,

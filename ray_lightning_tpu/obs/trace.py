@@ -43,6 +43,9 @@ SPAN_PREFILL = "prefill"        #: monolithic (fused) prefill dispatched
 SPAN_PREFILL_CHUNK = "prefill_chunk"  #: one chunk of a chunked prefill
 SPAN_FIRST_TOKEN = "first_token"
 SPAN_DECODE_FOLD = "decode_fold"  #: one engine fold this request rode
+#: draft/verify accounting of one speculative fold this request rode
+#: (attrs: tokens emitted, drafted, accepted)
+SPAN_SPEC_VERIFY = "spec_verify"
 SPAN_FINISH = "finish"
 SPAN_CANCEL = "cancel"
 SPAN_EXPIRE = "expire"

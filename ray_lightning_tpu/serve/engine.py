@@ -93,6 +93,34 @@ construction (chunk executables replace the per-bucket fused admits; one
 copy executable), and greedy outputs stay bit-identical to solo
 ``gpt_generate`` across chunking x hit/miss x mid-prefill cancel
 (asserted in tests/test_serve.py).
+
+With admission fixed, the fold itself is the last per-token ceiling:
+every emitted token still pays one full forward. Speculative decoding
+(``spec='ngram'|'model'``, Leviathan-style propose-then-verify) converts
+one forward into up to ``spec_depth + 1`` tokens per slot per fold
+iteration: a cheap drafter proposes ``spec_depth`` tokens, ONE batched
+verify forward (``models/gpt.py:gpt_decode_verify``) scores positions
+``pos..pos+depth`` against the slot cache, and an in-graph accept scan
+keeps the longest exactly-matching prefix — per-slot variable advance of
+``pos``/``remaining``, masked row writes, rejected rows never touching
+real state (the chunked-prefill masked-gather discipline). Two drafters
+share the interface: ``ngram`` matches the tail of the slot's own token
+history (``models/gpt.py:ngram_propose`` — zero extra weights, wins on
+repetitive/code/chat suffixes), ``model`` runs a small separate GPT
+(optionally int8) over a sliding history window. The token history the
+drafters read is a device-resident (slots, max_seq) int32 array
+maintained like the KV cache: one compiled write seeds the prompt at
+admission, chunk executables heal their ranges, and the fold appends
+accepted tokens in-graph. Both contracts hold by construction: drafter +
+verify live INSIDE the one folded step executable (compile count frozen
+at construction, ``compiles_since_init`` 0 in steady state), and every
+emitted token is sampled from verify logits computed against
+already-verified inputs — greedy accepts only exact argmax matches, so
+outputs stay bit-identical to solo ``gpt_generate``, sampled slots
+consume the identical rng chain, and a drafter can only ever change HOW
+FAST tokens arrive, never WHICH tokens (asserted in tests/test_serve.py
+across spec x depth x fold, mid-fold EOS inside an accepted block, and
+cancel + recycle with a verify in flight).
 """
 from __future__ import annotations
 
@@ -148,15 +176,6 @@ class _PoolBlock:
     stamp: int = 0  # LRU clock (higher = more recently used)
 
 
-def _sample_rows(keys, logits, temps, top_ks, top_ps):
-    """Alias for :func:`models.gpt.sample_logits_batched` (the sampler
-    moved next to ``sample_logits`` when the folded decode scan landed in
-    models/gpt.py; kept so engine-level callers/tests don't churn)."""
-    from ray_lightning_tpu.models.gpt import sample_logits_batched
-
-    return sample_logits_batched(keys, logits, temps, top_ks, top_ps)
-
-
 def default_buckets(max_seq: int, lo: int = 16) -> Tuple[int, ...]:
     """Power-of-two prefill buckets up to ``max_seq`` (inclusive)."""
     out: List[int] = []
@@ -200,6 +219,11 @@ class DecodeEngine:
         prefill_chunk: int = 0,
         prefix_blocks: int = 0,
         prefix_block: int = 16,
+        spec: str = "off",
+        spec_depth: int = 4,
+        spec_params: Any = None,
+        spec_config: Any = None,
+        spec_window: int = 32,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -256,6 +280,51 @@ class DecodeEngine:
                     f"prefix_block {self.prefix_block} must be in "
                     f"[1, max_seq={self.max_seq}]"
                 )
+        # Speculative decoding: drafter + depth, validated before any
+        # compile so a bad spec rejects instantly.
+        self.spec = str(spec)
+        if self.spec not in ("off", "ngram", "model"):
+            raise ValueError(
+                f"unknown spec mode {spec!r}; use 'off', 'ngram', or "
+                "'model'"
+            )
+        self.spec_depth = int(spec_depth)
+        if self.spec != "off" and self.spec_depth < 1:
+            raise ValueError("spec_depth must be >= 1")
+        self.spec_window = int(spec_window)
+        self._spec_params = None
+        self._spec_cfg: Optional[GPTConfig] = None
+        if self.spec == "model":
+            if spec_params is None or spec_config is None:
+                raise ValueError(
+                    "spec='model' needs spec_params and spec_config (the "
+                    "draft model's weights and GPTConfig)"
+                )
+            if isinstance(spec_config, dict):
+                spec_config = GPTConfig(**spec_config)
+            spec_config.validate_variants()
+            if spec_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft model vocab {spec_config.vocab_size} != main "
+                    f"vocab {config.vocab_size}"
+                )
+            if self.spec_window < 1:
+                raise ValueError("spec_window must be >= 1")
+            if self.spec_window + self.spec_depth > spec_config.max_seq:
+                raise ValueError(
+                    f"spec_window ({self.spec_window}) + spec_depth "
+                    f"({self.spec_depth}) exceeds the draft model's "
+                    f"max_seq ({spec_config.max_seq})"
+                )
+            self._spec_cfg = spec_config
+            self._spec_params = jax.tree_util.tree_map(
+                jnp.asarray, spec_params
+            )
+        # Host accept accounting (read by spec_stats / the scheduler).
+        self.spec_verifies = 0
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_emitted_tokens = 0
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
         cdt = jnp.dtype(config.compute_dtype)
@@ -291,6 +360,14 @@ class DecodeEngine:
         self._active = jnp.zeros(B, jnp.bool_)
         self._remaining = jnp.zeros(B, jnp.int32)
         self._eos = jnp.full(B, -1, jnp.int32)
+        #: Device-resident per-slot token history (hist[b, p] = token at
+        #: position p) — what the spec drafters read. Maintained like the
+        #: KV cache: prompt seeded by a compiled write at admission,
+        #: chunk executables heal their ranges, the fold appends accepted
+        #: tokens in-graph. None when spec is off (zero cost).
+        self._hist = (
+            jnp.zeros((B, S), jnp.int32) if self.spec != "off" else None
+        )
         self._slots: List[Optional[SlotInfo]] = [None] * B
         #: slot -> in-progress chunked admission (chunked mode only).
         self._prefills: Dict[int, PrefillTask] = {}
@@ -320,8 +397,11 @@ class DecodeEngine:
             _lm_head,
             _make_norm,
             gpt_decode_fold,
+            gpt_decode_fold_spec,
             gpt_prefill,
             gpt_prefill_chunk,
+            model_propose,
+            ngram_propose,
             sample_logits_batched,
         )
 
@@ -387,6 +467,46 @@ class DecodeEngine:
                 active, remaining, eos_toks, k_cache, v_cache,
                 fold=self.decode_fold,
             )
+
+        # Speculative step: drafter + verify + accept live INSIDE the one
+        # folded executable — one dispatch per fold iteration, compile
+        # count unchanged by the drafter choice.
+        def step_spec_impl(
+            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps,
+            keys, active, remaining, eos_toks, hist,
+        ):
+            return gpt_decode_fold_spec(
+                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                active, remaining, eos_toks, hist, k_cache, v_cache,
+                fold=self.decode_fold, depth=self.spec_depth,
+                draft_fn=lambda h, p, c: ngram_propose(
+                    h, p, c, depth=self.spec_depth
+                ),
+            )
+
+        def step_spec_model_impl(
+            params, dparams, k_cache, v_cache, cur, pos, temps, top_ks,
+            top_ps, keys, active, remaining, eos_toks, hist,
+        ):
+            return gpt_decode_fold_spec(
+                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                active, remaining, eos_toks, hist, k_cache, v_cache,
+                fold=self.decode_fold, depth=self.spec_depth,
+                draft_fn=lambda h, p, c: model_propose(
+                    dparams, self._spec_cfg, h, p, c,
+                    depth=self.spec_depth, window=self.spec_window,
+                ),
+            )
+
+        def hist_write_impl(hist, slot, row, length):
+            # Seed one slot's token history rows [0, length) from a
+            # padded (1, S) prompt row — the history analog of the
+            # per-bucket cache writes (one executable, any prompt len).
+            S_ = hist.shape[1]
+            rows_ = jnp.arange(S_, dtype=jnp.int32)
+            old = jax.lax.dynamic_slice(hist, (slot, 0), (1, S_))
+            new = jnp.where((rows_ < length)[None], row, old)
+            return jax.lax.dynamic_update_slice(hist, new, (slot, 0))
 
         def slot_write_impl(
             cur, pos, temps, top_ks, top_ps, keys, active, remaining,
@@ -488,6 +608,31 @@ class DecodeEngine:
                 tok,
             )
 
+        def chunk_spec_impl(
+            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps,
+            keys, active, remaining, eos_toks, hist, chunk, start,
+            true_len, slot, key0, temp, tk, tp, n_new, eos, is_final,
+        ):
+            # chunk_impl plus the token-history heal: rewrite hist rows
+            # [start, start + true_len) from the chunk, so a parked
+            # slot's row an interleaved fold scribbled on is refreshed
+            # before any drafter reads it — the history analog of the
+            # chunk's own KV rewrite of its parked row.
+            out = chunk_impl(
+                params, k_cache, v_cache, cur, pos, temps, top_ks,
+                top_ps, keys, active, remaining, eos_toks, chunk, start,
+                true_len, slot, key0, temp, tk, tp, n_new, eos, is_final,
+            )
+            S_ = hist.shape[1]
+            rows_ = jnp.arange(S_, dtype=jnp.int32)
+            hidx = rows_ - start
+            hvalid = (hidx >= 0) & (hidx < true_len)
+            vals = chunk[0][jnp.clip(hidx, 0, chunk.shape[1] - 1)]
+            old = jax.lax.dynamic_slice(hist, (slot, 0), (1, S_))
+            new = jnp.where(hvalid[None], vals[None], old)
+            hist = jax.lax.dynamic_update_slice(hist, new, (slot, 0))
+            return out + (hist,)
+
         bs = self.prefix_block
 
         def copy_impl(pool_k, pool_v, k_cache, v_cache, block, slot, row,
@@ -525,35 +670,67 @@ class DecodeEngine:
             )
             return pool_k, pool_v, k_cache, v_cache
 
+        spec_on = self.spec != "off"
+        hist_spec = spec(self._hist) if spec_on else None
         self._admit_exec: Dict[int, Any] = {}
         self._chunk_exec: Dict[int, Any] = {}
         if self.chunked:
             # Chunked mode: admission flows through the chunk state
             # machine exclusively — one executable per CHUNK bucket
-            # replaces the per-prompt-bucket fused admits.
+            # replaces the per-prompt-bucket fused admits. With spec on
+            # the chunk executable also heals its token-history range.
             for cb in self.chunk_buckets:
-                chunk_spec = jax.ShapeDtypeStruct((1, cb), np.int32)
-                self._chunk_exec[cb] = (
-                    jax.jit(chunk_impl, donate_argnums=tuple(range(1, 12)))
-                    .lower(
-                        p_spec,
-                        cache_spec,
-                        cache_spec,
-                        *state_specs,
-                        chunk_spec,
-                        i32,
-                        i32,
-                        i32,
-                        key_spec,
-                        f32,
-                        i32,
-                        f32,
-                        i32,
-                        i32,
-                        b1,
+                chunk_tok_spec = jax.ShapeDtypeStruct((1, cb), np.int32)
+                if spec_on:
+                    self._chunk_exec[cb] = (
+                        jax.jit(
+                            chunk_spec_impl,
+                            donate_argnums=tuple(range(1, 13)),
+                        )
+                        .lower(
+                            p_spec,
+                            cache_spec,
+                            cache_spec,
+                            *state_specs,
+                            hist_spec,
+                            chunk_tok_spec,
+                            i32,
+                            i32,
+                            i32,
+                            key_spec,
+                            f32,
+                            i32,
+                            f32,
+                            i32,
+                            i32,
+                            b1,
+                        )
+                        .compile()
                     )
-                    .compile()
-                )
+                else:
+                    self._chunk_exec[cb] = (
+                        jax.jit(
+                            chunk_impl, donate_argnums=tuple(range(1, 12))
+                        )
+                        .lower(
+                            p_spec,
+                            cache_spec,
+                            cache_spec,
+                            *state_specs,
+                            chunk_tok_spec,
+                            i32,
+                            i32,
+                            i32,
+                            key_spec,
+                            f32,
+                            i32,
+                            f32,
+                            i32,
+                            i32,
+                            b1,
+                        )
+                        .compile()
+                    )
                 self.compiled_count += 1
         else:
             for pb in self.prefill_buckets:
@@ -591,13 +768,52 @@ class DecodeEngine:
             self.compiled_count += 1
         # The folded step: caches + in-graph-updated state donated; the
         # sampling knobs and eos table are read-only inputs (slot writes
-        # own their updates).
-        self._step_exec = (
-            jax.jit(step_impl, donate_argnums=(1, 2, 3, 4, 8, 9, 10))
-            .lower(p_spec, cache_spec, cache_spec, *state_specs)
-            .compile()
-        )
+        # own their updates). With spec on the token history rides the
+        # same donation chain, and the drafter (n-gram search or draft
+        # model) compiles INTO this one executable.
+        if not spec_on:
+            self._step_exec = (
+                jax.jit(step_impl, donate_argnums=(1, 2, 3, 4, 8, 9, 10))
+                .lower(p_spec, cache_spec, cache_spec, *state_specs)
+                .compile()
+            )
+        elif self.spec == "ngram":
+            self._step_exec = (
+                jax.jit(
+                    step_spec_impl,
+                    donate_argnums=(1, 2, 3, 4, 8, 9, 10, 12),
+                )
+                .lower(p_spec, cache_spec, cache_spec, *state_specs,
+                       hist_spec)
+                .compile()
+            )
+        else:
+            dp_spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._spec_params,
+            )
+            self._step_exec = (
+                jax.jit(
+                    step_spec_model_impl,
+                    donate_argnums=(2, 3, 4, 5, 9, 10, 11, 13),
+                )
+                .lower(p_spec, dp_spec, cache_spec, cache_spec,
+                       *state_specs, hist_spec)
+                .compile()
+            )
         self.compiled_count += 1
+        if spec_on:
+            self._hist_write_exec = (
+                jax.jit(hist_write_impl, donate_argnums=(0,))
+                .lower(
+                    hist_spec,
+                    i32,
+                    jax.ShapeDtypeStruct((1, self.max_seq), np.int32),
+                    i32,
+                )
+                .compile()
+            )
+            self.compiled_count += 1
         self._slot_write_exec = (
             jax.jit(
                 slot_write_impl,
@@ -636,10 +852,26 @@ class DecodeEngine:
             key_v, np.bool_(active_v), np.int32(rem_v), np.int32(eos_v),
         )
 
+    def _hist_seed(self, slot: int, prompt: np.ndarray) -> None:
+        """Seed one slot's token history with its prompt (spec only):
+        one compiled write, queued after any in-flight fold through the
+        history's donation chain."""
+        row = np.zeros((1, self.max_seq), np.int32)
+        row[0, : len(prompt)] = prompt
+        self._hist = self._hist_write_exec(
+            self._hist, np.int32(slot), row, np.int32(len(prompt))
+        )
+
     def device_state(self) -> Dict[str, np.ndarray]:
         """Host snapshot of the device-resident per-slot state. This is a
         SYNC POINT: it blocks on any in-flight fold (debug/tests only —
         the steady-state loop never calls it)."""
+        if self.spec != "off":
+            return {**self._base_device_state(),
+                    "hist": np.asarray(self._hist)}
+        return self._base_device_state()
+
+    def _base_device_state(self) -> Dict[str, np.ndarray]:
         return {
             "cur": np.asarray(self._cur),
             "pos": np.asarray(self._pos),
@@ -806,6 +1038,12 @@ class DecodeEngine:
                     slot, 0, matched, 0.0, 0, 1.0,
                     np.zeros(2, np.uint32), False, 0, -1,
                 )
+                if self.spec != "off":
+                    # The whole prompt (matched prefix included — the
+                    # KV copy below carries no tokens) enters the
+                    # drafters' history up front; chunk executables
+                    # re-heal their own ranges against fold scribbles.
+                    self._hist_seed(slot, prompt)
                 for j, b in enumerate(matched_idxs):
                     self._copy_block(
                         b, slot, j * self.prefix_block, to_slot=True
@@ -840,6 +1078,11 @@ class DecodeEngine:
             return out
         pending = []
         for slot, r, prompt, P, n_new, pb, eos in staged:
+            if self.spec != "off":
+                # Prompt into the drafters' history; the fold writes the
+                # admission-sampled token itself (hist[pos] = cur at the
+                # top of every iteration).
+                self._hist_seed(slot, prompt)
             padded = np.zeros((1, pb), np.int32)
             padded[0, :P] = prompt
             temp = np.float32(r.get("temperature", 0.0))
@@ -916,20 +1159,37 @@ class DecodeEngine:
                     task.next : task.next + this_len
                 ]
                 is_final = task.next + this_len >= P
-                (
-                    self._k, self._v, self._cur, self._pos, self._temps,
-                    self._top_ks, self._top_ps, self._keys, self._active,
-                    self._remaining, self._eos, tok,
-                ) = self._chunk_exec[cb](
-                    self.params, self._k, self._v, self._cur, self._pos,
-                    self._temps, self._top_ks, self._top_ps, self._keys,
-                    self._active, self._remaining, self._eos,
+                scalars = (
                     padded, np.int32(task.next), np.int32(this_len),
                     np.int32(slot), task.key0,
                     np.float32(task.temperature), np.int32(task.top_k),
                     np.float32(task.top_p), np.int32(task.max_new_tokens),
                     np.int32(task.eos_token), np.bool_(is_final),
                 )
+                if self.spec != "off":
+                    (
+                        self._k, self._v, self._cur, self._pos,
+                        self._temps, self._top_ks, self._top_ps,
+                        self._keys, self._active, self._remaining,
+                        self._eos, tok, self._hist,
+                    ) = self._chunk_exec[cb](
+                        self.params, self._k, self._v, self._cur,
+                        self._pos, self._temps, self._top_ks,
+                        self._top_ps, self._keys, self._active,
+                        self._remaining, self._eos, self._hist, *scalars,
+                    )
+                else:
+                    (
+                        self._k, self._v, self._cur, self._pos,
+                        self._temps, self._top_ks, self._top_ps,
+                        self._keys, self._active, self._remaining,
+                        self._eos, tok,
+                    ) = self._chunk_exec[cb](
+                        self.params, self._k, self._v, self._cur,
+                        self._pos, self._temps, self._top_ks,
+                        self._top_ps, self._keys, self._active,
+                        self._remaining, self._eos, *scalars,
+                    )
                 task.next += this_len
                 task.chunks += 1
                 if self.tracer is not None:
@@ -1119,24 +1379,40 @@ class DecodeEngine:
     def _dispatch(self) -> Tuple[Tuple[Any, Any], List[Optional[SlotInfo]]]:
         """Launch one fold against the current device state (async); the
         donated state arrays are replaced by the fold's outputs, so
-        subsequent writes (admission, eviction) queue after it."""
+        subsequent writes (admission, eviction) queue after it. With
+        spec on the fold is propose-then-verify: the token block grows to
+        ``fold * (spec_depth + 1)`` rows, most of them non-emitted."""
+        if self.spec == "off":
+            (
+                tok_block, emit_block, self._cur, self._pos, self._keys,
+                self._active, self._remaining, self._k, self._v,
+            ) = self._step_exec(
+                self.params,
+                self._k,
+                self._v,
+                self._cur,
+                self._pos,
+                self._temps,
+                self._top_ks,
+                self._top_ps,
+                self._keys,
+                self._active,
+                self._remaining,
+                self._eos,
+            )
+            return (tok_block, emit_block), list(self._slots)
+        args = [self.params]
+        if self.spec == "model":
+            args.append(self._spec_params)
+        args += [
+            self._k, self._v, self._cur, self._pos, self._temps,
+            self._top_ks, self._top_ps, self._keys, self._active,
+            self._remaining, self._eos, self._hist,
+        ]
         (
             tok_block, emit_block, self._cur, self._pos, self._keys,
-            self._active, self._remaining, self._k, self._v,
-        ) = self._step_exec(
-            self.params,
-            self._k,
-            self._v,
-            self._cur,
-            self._pos,
-            self._temps,
-            self._top_ks,
-            self._top_ps,
-            self._keys,
-            self._active,
-            self._remaining,
-            self._eos,
-        )
+            self._active, self._remaining, self._hist, self._k, self._v,
+        ) = self._step_exec(*args)
         return (tok_block, emit_block), list(self._slots)
 
     def _want_next(self, snapshot: List[Optional[SlotInfo]]) -> bool:
@@ -1144,6 +1420,11 @@ class DecodeEngine:
         N iff some occupied slot can outlive fold N by token count. (An
         EOS inside fold N can still idle the speculative fold — frozen
         slots emit nothing, so it only costs compute, never correctness.)
+        With spec on, fold N consumes AT LEAST decode_fold tokens per
+        live slot (each verify emits >= 1) and up to (depth+1)x that;
+        speculating on the minimum keeps the pipeline full on low-accept
+        workloads at the price of an occasional idle fold on high-accept
+        ones.
         """
         K = self.decode_fold
         for slot, info in enumerate(self._slots):
@@ -1178,10 +1459,17 @@ class DecodeEngine:
         outs: Tuple[Any, Any],
         snapshot: List[Optional[SlotInfo]],
     ) -> List[Tuple[int, str, int, bool]]:
-        # The ONE D2H sync per fold: the (K, B) token block + emit mask.
+        # The ONE D2H sync per fold: the (K, B) token block + emit mask
+        # (K = fold * (spec_depth + 1) with spec on).
         toks = np.asarray(outs[0])
         emits = np.asarray(outs[1])
         out: List[Tuple[int, str, int, bool]] = []
+        spec_on = self.spec != "off"
+        group = self.spec_depth + 1 if spec_on else 1
+        #: (fold_iteration, slot) -> tokens this verify emitted; feeds
+        #: the accept-rate accounting (zombie tokens of released tenants
+        #: are dropped above AND excluded here).
+        counts: Dict[Tuple[int, int], int] = {}
         for kk in range(toks.shape[0]):
             for slot, info in enumerate(snapshot):
                 if info is None or info.released or not emits[kk, slot]:
@@ -1193,6 +1481,40 @@ class DecodeEngine:
                     or tok == info.eos_token
                 )
                 out.append((slot, info.request_id, tok, done))
+                if spec_on:
+                    key = (kk // group, slot)
+                    counts[key] = counts.get(key, 0) + 1
                 if done:
                     self._release_synced(slot, info)
+        if counts:
+            # Per (verify, slot): depth tokens proposed, emitted - 1 of
+            # them accepted (the final emission is the verify's own
+            # sample — a mismatch, a bonus token, or an EOS).
+            self.spec_verifies += len(counts)
+            self.spec_drafted_tokens += self.spec_depth * len(counts)
+            self.spec_emitted_tokens += sum(counts.values())
+            self.spec_accepted_tokens += sum(
+                m - 1 for m in counts.values()
+            )
         return out
+
+    def spec_stats(self) -> Dict[str, Any]:
+        """Speculative-decoding counters for stats/bench: accept_rate =
+        accepted draft tokens / proposed draft tokens in [0, 1];
+        tokens_per_verify = emitted tokens per verify forward in
+        [1, spec_depth + 1] (the per-forward multiplier spec buys)."""
+        v, d = self.spec_verifies, self.spec_drafted_tokens
+        return {
+            "mode": self.spec,
+            "depth": self.spec_depth,
+            "verifies": v,
+            "drafted_tokens": d,
+            "accepted_tokens": self.spec_accepted_tokens,
+            "emitted_tokens": self.spec_emitted_tokens,
+            "accept_rate": (
+                round(self.spec_accepted_tokens / d, 4) if d else 0.0
+            ),
+            "tokens_per_verify": (
+                round(self.spec_emitted_tokens / v, 4) if v else 0.0
+            ),
+        }

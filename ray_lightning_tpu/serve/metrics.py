@@ -69,6 +69,22 @@ class ServeMetrics:
                 "step_time": registry.histogram(
                     "rlt_serve_step_seconds", "Scheduler step wall time"
                 ),
+                "spec_verifies": registry.counter(
+                    "rlt_serve_spec_verifies_total",
+                    "Speculative verify forwards run",
+                ),
+                "spec_drafted": registry.counter(
+                    "rlt_serve_spec_drafted_tokens_total",
+                    "Draft tokens proposed to verify forwards",
+                ),
+                "spec_accepted": registry.counter(
+                    "rlt_serve_spec_accepted_tokens_total",
+                    "Draft tokens accepted by verify forwards",
+                ),
+                "spec_accept_rate": registry.gauge(
+                    "rlt_serve_spec_accept_rate",
+                    "Sliding-window draft-token accept rate (0-1)",
+                ),
             }
         # Lifecycle counters (monotonic).
         self.submitted = 0
@@ -89,6 +105,9 @@ class ServeMetrics:
         self._prefix_tokens: deque = deque(maxlen=window)
         #: (wall_s, active_slots, tokens_emitted) per engine step.
         self._steps: deque = deque(maxlen=window)
+        #: (verifies, drafted, accepted) per engine step with spec on —
+        #: the propose-then-verify accounting behind spec_accept_rate.
+        self._spec: deque = deque(maxlen=window)
         self._queue_depth = 0
         self._started = time.monotonic()
         self._last_log = 0.0
@@ -185,6 +204,30 @@ class ServeMetrics:
                 self._reg["tokens"].inc(int(tokens_emitted))
             self._reg["step_time"].observe(float(wall_s))
 
+    def record_spec(
+        self, verifies: int, drafted: int, accepted: int
+    ) -> None:
+        """One step's speculative-decoding delta: ``verifies`` verify
+        forwards ran, proposing ``drafted`` draft tokens of which
+        ``accepted`` matched exactly (engine.spec_stats deltas, recorded
+        by the scheduler after each fold)."""
+        if not verifies:
+            return
+        with self._lock:
+            self._spec.append(
+                (int(verifies), int(drafted), int(accepted))
+            )
+            if self._reg is not None:
+                d = sum(s[1] for s in self._spec)
+                a = sum(s[2] for s in self._spec)
+                self._reg["spec_accept_rate"].set(
+                    round(a / d, 4) if d else 0.0
+                )
+        if self._reg is not None:
+            self._reg["spec_verifies"].inc(int(verifies))
+            self._reg["spec_drafted"].inc(int(drafted))
+            self._reg["spec_accepted"].inc(int(accepted))
+
     # -- aggregates ------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """Aggregate view over the sliding window (the stats payload)."""
@@ -260,6 +303,17 @@ class ServeMetrics:
             out["decode_tokens_per_sec"] = (
                 round(d_tokens / d_wall, 3) if d_wall > 0 else 0.0
             )
+            # Speculative decoding (only when spec ran in the window):
+            # accept rate in [0, 1] and draft tokens proposed per verify
+            # forward — the depth-vs-accept tradeoff, observable.
+            if self._spec:
+                v = sum(s[0] for s in self._spec)
+                d = sum(s[1] for s in self._spec)
+                a = sum(s[2] for s in self._spec)
+                out["spec_accept_rate"] = round(a / d, 4) if d else 0.0
+                out["draft_tokens_per_verify"] = (
+                    round(d / v, 4) if v else 0.0
+                )
             return out
 
     def maybe_log(self, every_s: float = 10.0) -> Optional[Dict[str, Any]]:

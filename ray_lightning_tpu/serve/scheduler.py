@@ -133,6 +133,9 @@ class Scheduler:
         self._pending: List[Any] = []
         self._cancelled: set = set()
         self._slot_req: Dict[int, Request] = {}
+        #: Last-seen engine speculative-decoding counters (cumulative);
+        #: step() diffs them into per-step metrics deltas.
+        self._spec_seen = (0, 0, 0)
         #: Requests popped for admission but not yet registered in
         #: _slot_req (engine.admit runs OUTSIDE the lock); cancel() must
         #: still find them so a cancel racing an admission is honored at
@@ -432,6 +435,32 @@ class Scheduler:
         emitted = 0
         finished_slots: List[int] = []
         fold_results = self.engine.step()
+        if getattr(self.engine, "spec", "off") != "off":
+            # Accept accounting: the engine's cumulative counters diffed
+            # into this step's delta (zombie tokens already excluded at
+            # harvest). One metrics record per step, never per token.
+            v = self.engine.spec_verifies
+            d = self.engine.spec_drafted_tokens
+            a = self.engine.spec_accepted_tokens
+            dv = v - self._spec_seen[0]
+            if dv:
+                self.metrics.record_spec(
+                    dv, d - self._spec_seen[1], a - self._spec_seen[2]
+                )
+                if self.tracer is not None:
+                    spec_tokens: Dict[str, int] = {}
+                    for _, rid, _, _ in fold_results:
+                        spec_tokens[rid] = spec_tokens.get(rid, 0) + 1
+                    for rid, n in spec_tokens.items():
+                        self.tracer.event(
+                            rid, _trace.SPAN_SPEC_VERIFY,
+                            attrs={
+                                "tokens": n,
+                                "drafted": d - self._spec_seen[1],
+                                "accepted": a - self._spec_seen[2],
+                            },
+                        )
+            self._spec_seen = (v, d, a)
         if self.tracer is not None and fold_results:
             # One event per request per fold (not per token): "this fold,
             # this request rode it for n tokens" — the decode-side trace

@@ -91,6 +91,12 @@ class ServeReplica:
         prefix_blocks: int = 0,
         prefix_block: int = 16,
         max_prefill_chunks_per_step: int = 1,
+        spec: str = "off",
+        spec_depth: int = 4,
+        spec_draft_ckpt: Optional[str] = None,
+        spec_draft_config: Optional[Dict[str, Any]] = None,
+        spec_draft_int8: bool = False,
+        spec_window: int = 32,
         priority_age_s: Optional[float] = None,
         tick_s: float = 0.002,
         tracing: bool = True,
@@ -135,6 +141,27 @@ class ServeReplica:
 
             params = quantize_params_int8(params)
         self.int8 = bool(int8)
+        # Speculative decoding: the draft model (spec='model') loads like
+        # the main checkpoint — state stream with embedded config, or
+        # spec_draft_config overrides — and may quantize to int8 (draft
+        # quality only gates the accept rate, never correctness).
+        spec_params = None
+        spec_cfg = None
+        if spec == "model":
+            if spec_draft_ckpt is None:
+                raise ValueError(
+                    "spec='model' needs spec_draft_ckpt (the draft "
+                    "model's checkpoint)"
+                )
+            spec_params, spec_cfg = load_serve_params(
+                spec_draft_ckpt, spec_draft_config
+            )
+            if spec_draft_int8:
+                from ray_lightning_tpu.utils.quantize import (
+                    quantize_params_int8,
+                )
+
+                spec_params = quantize_params_int8(spec_params)
         self.engine = DecodeEngine(
             params,
             cfg,
@@ -146,6 +173,11 @@ class ServeReplica:
             prefill_chunk=prefill_chunk,
             prefix_blocks=prefix_blocks,
             prefix_block=prefix_block,
+            spec=spec,
+            spec_depth=spec_depth,
+            spec_params=spec_params,
+            spec_config=spec_cfg,
+            spec_window=spec_window,
         )
         self._registry = get_registry()
         self._registry.gauge(
@@ -182,6 +214,8 @@ class ServeReplica:
             "pipeline": self.engine.pipeline,
             "prefill_chunk": self.engine.prefill_chunk,
             "prefix_blocks": self.engine.prefix_blocks,
+            "spec": self.engine.spec,
+            "spec_depth": self.engine.spec_depth,
             "int8": self.int8,
             "watchdog": bool(watchdog),
             "stall_s": float(stall_s),
@@ -383,6 +417,9 @@ class ServeReplica:
         )
         if self.engine.prefix_blocks:
             snap["prefix"] = self.engine.prefix_stats()
+        snap["spec"] = self.engine.spec
+        if self.engine.spec != "off":
+            snap["spec_stats"] = self.engine.spec_stats()
         snap["health"] = self.health()["verdict"]
         return snap
 

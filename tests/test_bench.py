@@ -88,6 +88,21 @@ def test_bench_smoke_cpu():
         assert r["engine_tokens_per_sec"] > 0, r
         assert r["engine_vs_oneshot"] > 0, r
     assert out["extra"]["decode_cpu_control"] is True  # this run is CPU
+    # Speculative decoding sweep: spec off/ngram/model rows on the
+    # repetitive-suffix workload, per fold, each with a sane accept rate
+    # and the proposed-per-verify depth — the propose-then-verify
+    # machinery measured, not assumed.
+    spec_rows = out["extra"]["decode_spec_rows"]
+    assert {r["mode"] for r in spec_rows} == {"off", "ngram", "model"}
+    assert {r["decode_fold"] for r in spec_rows} == {1, 4}
+    for r in spec_rows:
+        assert 0.0 <= r["spec_accept_rate"] <= 1.0, r
+        assert r["decode_tokens_per_sec"] > 0, r
+        if r["mode"] != "off":
+            assert r["draft_tokens_per_verify"] > 0, r
+    # The dispatch-bound regime (fold 1) is where spec must pay for
+    # itself; the n-gram drafter on a repetitive suffix clears >= 1.5x.
+    assert out["extra"]["decode_spec_vs_off_best"] >= 1.5, spec_rows
     # Observer effect: tracing on the decode hot loop must stay under 5%
     # tokens/s (the obs layer's near-zero-cost contract, measured
     # best-of-3 per mode so scheduler jitter doesn't fail the gate).

@@ -325,6 +325,52 @@ def test_cli_generate_errors(tmp_path):
         })
 
 
+def test_cli_serve_rejects_unknown_keys_listing_valid():
+    """Satellite regression: a typo'd --serve.* flag must fail INSTANTLY
+    with an error naming the typo and the valid vocabulary — before any
+    checkpoint loads or replicas spawn (it used to surface only after
+    the config dance, without the valid keys)."""
+    with pytest.raises(ValueError, match=r"promts.*prompts"):
+        cli.run_serve({"serve": {"promts": "x"}})
+    # The error lists the vocabulary, including the new spec knobs.
+    with pytest.raises(ValueError, match="spec_depth"):
+        cli.run_serve({"serve": {"spec_dept": 4}})
+    # Typo rejection outranks every other validation: even with an
+    # otherwise-complete config the unknown key wins.
+    with pytest.raises(ValueError, match="unknown serve option"):
+        cli.run_serve(
+            {"serve": {"ckpt_path": "x", "prompts": "y", "decode_flod": 4}}
+        )
+    # Valid keys (spec included) pass the vocabulary check and proceed
+    # to the next requirement — proving the gate rejects typos, not
+    # features.
+    with pytest.raises(ValueError, match="ckpt_path"):
+        cli.run_serve({"serve": {"spec": "ngram", "spec_depth": 2}})
+    # SLO rules stay open-ended (slo.<metric> is not a typo).
+    with pytest.raises(ValueError, match="ckpt_path"):
+        cli.run_serve({"serve": {"slo.ttft_p95_s": 0.5}})
+
+
+def test_cli_entry_successful_command_exits_zero(tmp_path, capsys):
+    """Satellite regression: the console wrapper sys.exit()s cli_entry's
+    return value, so a successful non-doctor command must return 0 —
+    returning the result dict made EVERY successful `rlt serve`/`rlt
+    tokenize` exit 1 with the dict dumped to stderr (doctor keeps its
+    0-healthy/1-unhealthy contract, tested in test_health)."""
+    from ray_lightning_tpu.cli import cli_entry
+
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("\n".join(["the cat sat"] * 50))
+    rc = cli_entry([
+        "tokenize",
+        "--tokenize.input", str(corpus),
+        "--tokenize.vocab_size", "280",
+        "--tokenize.out", str(tmp_path / "tok.json"),
+    ])
+    assert rc == 0
+    capsys.readouterr()
+
+
 def test_cli_tokenize(tmp_path, capsys):
     """tokenize: train from a text file, save JSON, encode a shard that
     TokenBinDataset can serve."""

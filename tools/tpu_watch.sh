@@ -16,11 +16,13 @@ have_headline=0
 have_full=0
 have_gpt=0
 have_serve=0
+have_spec=0
 have_obs=0
 have_doctor=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
+spec_fails=0
 obs_fails=0
 doctor_fails=0
 flash_fails=0
@@ -30,6 +32,7 @@ headline_status=pending
 full_status=pending
 gpt_status=pending
 serve_status=pending
+spec_status=pending
 obs_status=pending
 doctor_status=pending
 flash_status=pending
@@ -46,6 +49,7 @@ write_manifest() {
     echo "stage=full status=$full_status fails=$full_fails"
     echo "stage=gpt_ab status=$gpt_status fails=$gpt_fails"
     echo "stage=serve status=$serve_status fails=$serve_fails"
+    echo "stage=spec status=$spec_status fails=$spec_fails"
     echo "stage=obs status=$obs_status fails=$obs_fails"
     echo "stage=doctor status=$doctor_status fails=$doctor_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
@@ -152,8 +156,34 @@ while true; do
             echo "$(date -u +%H:%M:%S) serve bench SKIPPED after $serve_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
+      elif [ "$have_spec" -eq 0 ]; then
+        # Stage 5: speculative-decoding artifact — the decode sweep now
+        # carries spec off/ngram/model rows on the repetitive-suffix
+        # workload, so the next healthy window archives an ON-CHIP
+        # accept-rate + spec-vs-off record next to BENCH_r09's CPU
+        # control.
+        echo "$(date -u +%H:%M:%S) launching SPEC decode bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --decode-only \
+            > /tmp/spec_bench.json 2> /tmp/spec_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/spec_bench.json ] && \
+           grep -q decode_spec_rows /tmp/spec_bench.json; then
+          have_spec=1
+          spec_status=ok
+          echo "$(date -u +%H:%M:%S) SPEC bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          spec_fails=$((spec_fails+1))
+          spec_status=failed
+          echo "$(date -u +%H:%M:%S) spec bench failed rc=$rc (fail $spec_fails)" >> /tmp/tpu_watch.log
+          if [ "$spec_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_spec=1
+            spec_status=skipped
+            echo "$(date -u +%H:%M:%S) spec bench SKIPPED after $spec_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
       elif [ "$have_obs" -eq 0 ]; then
-        # Stage 5: observability artifact — scrape the metrics endpoint
+        # Stage 6: observability artifact — scrape the metrics endpoint
         # over real HTTP and save one exported Chrome trace (opens in
         # Perfetto), so each healthy window leaves an on-chip obs record.
         echo "$(date -u +%H:%M:%S) launching OBS snapshot" >> /tmp/tpu_watch.log
@@ -178,7 +208,7 @@ while true; do
           fi
         fi
       elif [ "$have_doctor" -eq 0 ]; then
-        # Stage 6: active-health artifact — run the real `rlt doctor` CLI
+        # Stage 7: active-health artifact — run the real `rlt doctor` CLI
         # against a live replica's obs endpoint and save one pulled
         # flight-recorder bundle, so each healthy window proves the
         # health/forensics wire path end-to-end on-chip.
@@ -205,7 +235,7 @@ while true; do
           fi
         fi
       else
-        # Stage 7: flash-vs-dense attention timings (VERDICT r4 item 3).
+        # Stage 8: flash-vs-dense attention timings (VERDICT r4 item 3).
         echo "$(date -u +%H:%M:%S) launching flash A/B" >> /tmp/tpu_watch.log
         flash_attempts=$((flash_attempts+1))
         ( cd /tmp/bench_snap2 && \
