@@ -70,8 +70,15 @@ def _fit_and_rates(
     return rates, trainer
 
 
-def _in_worker(closure, use_tpu: bool, timeout: float = 2400.0):
-    """Run a closure in a fresh worker actor (fresh XLA runtime)."""
+def _in_worker(
+    closure, use_tpu: bool, timeout: float = 2400.0, cpu_devices: int = 1
+):
+    """Run a closure in a fresh worker actor (fresh XLA runtime).
+
+    ``cpu_devices`` forces that many virtual host devices in a CPU
+    worker (the mesh-sharded sweeps need a multi-device process; real
+    TPU workers always see their real chips).
+    """
     from ray_lightning_tpu import fabric
     from ray_lightning_tpu.launchers.utils import TrainWorker
 
@@ -80,7 +87,10 @@ def _in_worker(closure, use_tpu: bool, timeout: float = 2400.0):
         if use_tpu
         else {
             "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "XLA_FLAGS": (
+                "--xla_force_host_platform_device_count="
+                f"{int(cpu_devices)}"
+            ),
         }
     )
     resources = {"TPU": 1.0} if use_tpu else {}
@@ -1006,6 +1016,134 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
     return _in_worker(run, use_tpu, timeout=2400.0)
 
 
+def bench_serve_sharded(use_tpu: bool) -> Dict[str, Any]:
+    """Mesh-sharded decode sweep (``decode_sharded_rows``): the serving
+    engine at mesh 1x1 (single-device control) vs model-axis meshes over
+    the worker's devices (forced host devices on CPU — 8 virtual chips —
+    real chips on TPU), same requests, greedy. Each row records decode
+    tokens/s, per-device KV-cache bytes, and their total, so the
+    artifact shows BOTH halves of the tensor-parallel story: per-device
+    resident footprint shrinking ~linearly in the model axis, and
+    whatever tokens/s the collectives buy (on CPU the virtual devices
+    share one socket, so the throughput column is an overhead control,
+    not a speedup claim — ``sharded_cpu_control`` flags it)."""
+
+    def run():
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+        from ray_lightning_tpu.parallel.mesh import build_mesh
+        from ray_lightning_tpu.serve.engine import DecodeEngine
+        from ray_lightning_tpu.serve.scheduler import (
+            SamplingParams,
+            Scheduler,
+        )
+
+        n_dev = len(jax.devices())
+        # Head counts divisible by every model-axis size swept (2, 4,
+        # ..., n_dev); MHA so kv heads match.
+        if _tiny():
+            cfg = GPTConfig(
+                vocab_size=256, n_layer=2, n_head=8, d_model=64,
+                max_seq=96, attn_impl="reference",
+                compute_dtype="bfloat16",
+            )
+            prompt_len, n_new = 16, 16
+        else:
+            cfg = GPTConfig(
+                vocab_size=8192, n_layer=4, n_head=8, d_model=256,
+                max_seq=256, attn_impl="reference",
+                compute_dtype="bfloat16",
+            )
+            prompt_len, n_new = 64, 64
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        g = np.random.default_rng(0)
+        batch = 4
+        prompts = g.integers(
+            0, cfg.vocab_size, size=(batch, prompt_len)
+        ).astype(np.int32)
+
+        # Mesh ladder: 1x1 control, then model=2 (if it divides), then
+        # the full model axis — enough points to see the ~1/N line.
+        meshes = [("1x1", None)]
+        for m in sorted({2, n_dev}):
+            if 1 < m <= n_dev and n_dev % m == 0 and cfg.n_head % m == 0:
+                meshes.append(
+                    (
+                        f"{m}x{n_dev // m}",
+                        build_mesh((m, n_dev // m), ("model", "data")),
+                    )
+                )
+
+        rows = []
+        for label, mesh in meshes:
+            engine = DecodeEngine(
+                params, cfg, num_slots=batch,
+                max_seq=prompt_len + n_new,
+                prefill_buckets=[prompt_len], decode_fold=4, mesh=mesh,
+            )
+            sched = Scheduler(engine, max_prefills_per_step=batch)
+
+            def sweep():
+                for p in prompts:
+                    sched.submit(
+                        p.tolist(), SamplingParams(max_new_tokens=n_new)
+                    )
+                return sched.run_until_idle()
+
+            sweep()  # warm the executables' first dispatch
+            best_tps, toks = 0.0, None
+            for _ in range(3):
+                t0 = _time.monotonic()
+                evs = sweep()
+                tps = batch * n_new / (_time.monotonic() - t0)
+                if tps > best_tps:
+                    best_tps = tps
+                    toks = [e.token for e in evs if e.token is not None]
+            mem = engine.memory_stats()
+            rows.append(
+                {
+                    "mesh": label,
+                    "model_axis": (
+                        mesh.shape["model"] if mesh is not None else 1
+                    ),
+                    "batch": batch,
+                    "decode_fold": 4,
+                    "decode_tokens_per_sec": round(best_tps, 2),
+                    "kv_bytes_total": mem["kv_cache"]["bytes"],
+                    "kv_bytes_per_device": mem["kv_cache"][
+                        "per_device_bytes"
+                    ],
+                    "hbm_bytes_per_device": mem["total"][
+                        "per_device_bytes"
+                    ],
+                    # bf16 fusion can drift an argmax by an ulp; the
+                    # hard bit-exactness contract is test-asserted under
+                    # the fp32 reference config — here it's RECORDED.
+                    "matches_1x1": (
+                        toks == rows[0].get("_toks") if rows else True
+                    ),
+                    "_toks": toks,
+                }
+            )
+        for r in rows:
+            r.pop("_toks", None)
+        return {
+            "decode_sharded_rows": rows,
+            "sharded_config": (
+                f"layers={cfg.n_layer} d_model={cfg.d_model} "
+                f"heads={cfg.n_head} prompt={prompt_len} new={n_new} "
+                f"devices={n_dev}"
+            ),
+            "sharded_cpu_control": not use_tpu,
+        }
+
+    return _in_worker(run, use_tpu, timeout=2400.0, cpu_devices=8)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=3)
@@ -1148,6 +1286,10 @@ def main() -> None:
             extra.update(bench_serve(use_tpu))
         except Exception as exc:  # noqa: BLE001 - still emit a record
             extra["serve_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_serve_sharded(use_tpu))
+        except Exception as exc:  # noqa: BLE001 - still emit a record
+            extra["sharded_error"] = f"{type(exc).__name__}: {exc}"
         extra["bench_wall_s"] = round(time.time() - t0, 1)
         val = extra.get("serve_shared_prefix_ttft_speedup", 0.0)
         print(
@@ -1272,6 +1414,10 @@ def main() -> None:
             extra.update(bench_serve(use_tpu))
         except Exception as exc:  # noqa: BLE001
             extra["serve_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_serve_sharded(use_tpu))
+        except Exception as exc:  # noqa: BLE001
+            extra["sharded_error"] = f"{type(exc).__name__}: {exc}"
     extra["bench_wall_s"] = round(time.time() - t0, 1)
 
     print(

@@ -383,7 +383,8 @@ def run_convert_hf(config: Dict[str, Any]) -> str:
 _SERVE_KEYS = frozenset((
     "ckpt_path", "config", "int8", "prompts",
     "max_new_tokens", "temperature", "top_k", "top_p", "seed",
-    "eos_token", "replicas", "num_slots", "max_seq",
+    "eos_token", "replicas", "num_slots", "max_seq", "mesh",
+    "hosts_per_replica",
     "prefill_buckets", "max_prefills_per_step", "decode_fold",
     "pipeline", "prefill_chunk", "prefix_cache", "prefix_block",
     "max_prefill_chunks_per_step", "priority_age_s",
@@ -405,6 +406,15 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
       config: GPTConfig field dict (overrides/completes the stored one).
       int8: quantize weights at load (weight-only int8 decode).
       replicas, num_slots, max_seq, max_prefills_per_step: topology knobs.
+      mesh: "MODELxDATA" serving mesh (e.g. 4x1) — tensor-parallel
+        decode: attention heads, the KV cache, and the prefix pool shard
+        over MODEL devices (head counts must be divisible; greedy output
+        stays bit-identical to 1x1); MODEL*DATA must equal the replica
+        process's device count. Per-device footprint lands in stats
+        "memory" and rlt_serve_hbm_bytes{component=}.
+      hosts_per_replica: gang-launch one replica PROCESS GROUP per mesh
+        on multi-host topologies (leader + followers rendezvoused via
+        jax.distributed; single-host default 1).
       decode_fold: decode iterations per compiled dispatch (K tokens per
         slot per engine step; amortizes dispatch/sync, admissions land at
         fold boundaries). pipeline: double-buffer fold dispatch (default
@@ -479,6 +489,19 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             f"unknown serve option(s) {unknown}; valid --serve.* keys: "
             f"{sorted(_SERVE_KEYS)} (plus slo.<metric> rules)"
         )
+    # Mesh spec: validated up front like the key vocabulary — a
+    # malformed --serve.mesh must fail before a checkpoint loads or a
+    # replica spawns, naming the valid format. Normalized to the
+    # canonical "MODELxDATA" string (YAML coerces a bare "8" to int).
+    from ray_lightning_tpu.parallel.mesh import parse_mesh_spec
+
+    mesh_raw = serve_cfg.pop("mesh", None)
+    mesh_spec = None
+    if mesh_raw is not None:
+        mesh_spec = "{}x{}".format(*parse_mesh_spec(mesh_raw))
+    hosts_per_replica = int(serve_cfg.pop("hosts_per_replica", 1))
+    if hosts_per_replica < 1:
+        raise ValueError("--serve.hosts_per_replica must be >= 1")
     ckpt_path = serve_cfg.pop("ckpt_path", None)
     if ckpt_path is None:
         raise ValueError("serve requires --serve.ckpt_path")
@@ -513,6 +536,8 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             serve_cfg.pop("max_prefill_chunks_per_step", 1)
         ),
     }
+    if mesh_spec is not None:
+        replica_kwargs["mesh"] = mesh_spec
     age = serve_cfg.pop("priority_age_s", None)
     if age is not None:
         replica_kwargs["priority_age_s"] = float(age)
@@ -600,13 +625,27 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     if not fabric.is_initialized():
         fabric.init()
     # Replicas on a chipless fabric decode on CPU; pin the platform so the
-    # actor does not stall probing for devices it will not get.
+    # actor does not stall probing for devices it will not get. A mesh
+    # spec on CPU additionally forces that many VIRTUAL host devices in
+    # the replica process (the same trick the strategies' CPU worker
+    # planning uses) — a "4x2" mesh needs 8 devices wherever it runs.
     env = (
         {"JAX_PLATFORMS": "cpu"}
         if fabric.cluster_resources().get("TPU", 0) < 1
         else {}
     )
-    client = start_replicas(replicas, env=env, **replica_kwargs)
+    if env and mesh_spec is not None:
+        model, data = parse_mesh_spec(mesh_spec)
+        if model * data > 1:
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={model * data}"
+            )
+    client = start_replicas(
+        replicas,
+        env=env,
+        hosts_per_replica=hosts_per_replica,
+        **replica_kwargs,
+    )
     metrics_server = None
     try:
         if metrics_port is not None:

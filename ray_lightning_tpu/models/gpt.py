@@ -335,6 +335,86 @@ def gpt_logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
     return out
 
 
+#: Logical axes of the serving engine's (L, slots, S, Hkv, hd) KV tensors
+#: — the per-slot decode cache and the prefix-pool blocks share the
+#: layout. KV heads shard over the mesh's "model" axis (DEFAULT_RULES
+#: "heads" -> "model"); slots, positions, and head_dim stay replicated so
+#: slot bookkeeping and the per-fold token harvest never cross devices.
+DECODE_CACHE_AXES: Tuple[Optional[str], ...] = (
+    "layers", None, None, "heads", "kv",
+)
+
+
+def check_decode_mesh(cfg: GPTConfig, mesh: Any) -> None:
+    """Fail fast when a serving mesh cannot shard this config's heads.
+
+    Tensor-parallel decode splits attention heads (and the Hkv-headed KV
+    cache) over the mesh's "model" axis, so each device must own a whole
+    number of q heads AND kv heads. Checked before anything compiles —
+    ``spec_from_logical`` would otherwise silently fall through to
+    replicated caches, quietly forfeiting the memory split the mesh was
+    asked for.
+    """
+    m = int(mesh.shape.get("model", 1))
+    if m <= 1:
+        return
+    if cfg.n_head % m or cfg.kv_head % m:
+        raise ValueError(
+            f"mesh model axis ({m}) must divide n_head ({cfg.n_head}) and "
+            f"n_kv_head ({cfg.kv_head}): attention heads and the KV cache "
+            "shard over the model axis, so each device needs a whole "
+            "number of q and kv heads — use a smaller model axis or a "
+            "head count divisible by it"
+        )
+
+
+def gpt_param_shardings(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    mesh: Any,
+    rules: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """NamedSharding tree for a (possibly int8-quantized) GPT param tree.
+
+    ``parallel.logical.tree_logical_shardings`` resolved against
+    :func:`gpt_logical_axes`, extended to the weight-only int8 layout
+    (utils/quantize): a quantized ``{"q", "s"}`` node takes the original
+    leaf's logical axes on ``q`` (same rank), while the per-channel
+    scales ``s`` stay replicated (keepdims-1 on the contraction axes —
+    sharding them buys nothing and a broadcast against a sharded ``q``
+    is free).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_lightning_tpu.parallel.logical import (
+        DEFAULT_RULES,
+        spec_from_logical,
+    )
+    from ray_lightning_tpu.utils.quantize import is_quantized
+
+    rule_list = tuple(rules) if rules is not None else DEFAULT_RULES
+    axes_tree = gpt_logical_axes(cfg)
+
+    def walk(node: Any, axes: Any) -> Any:
+        if is_quantized(node):
+            return {
+                "q": NamedSharding(
+                    mesh,
+                    spec_from_logical(
+                        np.shape(node["q"]), axes, rule_list, mesh
+                    ),
+                ),
+                "s": NamedSharding(mesh, P()),
+            }
+        if isinstance(node, dict):
+            return {k: walk(v, axes[k]) for k, v in node.items()}
+        return NamedSharding(
+            mesh, spec_from_logical(np.shape(node), axes, rule_list, mesh)
+        )
+
+    return walk(params, axes_tree)
+
+
 #: (ep, pp, B, n_experts) combinations already warned about — the auto
 #: fallback message fires once per distinct cause, not once per traced step.
 _moe_auto_fallback_warned: set = set()

@@ -8,7 +8,7 @@ across slices based on the mesh axes.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 from jax.sharding import Mesh
@@ -16,6 +16,65 @@ from jax.sharding import Mesh
 
 def local_chip_count() -> int:
     return len(jax.local_devices())
+
+
+def parse_mesh_spec(spec: Union[str, int, None]) -> Tuple[int, int]:
+    """Parse a serving mesh spec ``"MODELxDATA"`` into ``(model, data)``.
+
+    The serve CLI's ``--serve.mesh`` vocabulary: ``"1x1"`` (single
+    device), ``"4x1"`` (4-way tensor parallel), ``"4x2"``, or a bare
+    integer/``"8"`` meaning ``8x1`` (model axis only — YAML coerces the
+    undecorated form to int). Rejects anything else up front with the
+    valid vocabulary, so a malformed flag fails before checkpoints load
+    or replicas spawn. Whether the sizes actually factor the device
+    count is :func:`build_mesh`'s check — that needs live devices, this
+    one doesn't.
+    """
+    if spec is None:
+        return (1, 1)
+    if isinstance(spec, bool):  # YAML 1.1: a bare "on"/"off" typo
+        raise ValueError(
+            f"malformed mesh spec {spec!r}: use 'MODELxDATA' (e.g. '1x1', "
+            "'4x1', '4x2') or a bare model-axis size like '8'"
+        )
+    if isinstance(spec, int):
+        parts: Tuple[Union[str, int], ...] = (spec, 1)
+    else:
+        text = str(spec).strip().lower()
+        parts = tuple(text.split("x")) if text else ()
+        if len(parts) == 1:
+            parts = (parts[0], 1)
+    try:
+        if len(parts) != 2:
+            raise ValueError
+        model, data = (int(p) for p in parts)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"malformed mesh spec {spec!r}: use 'MODELxDATA' with positive "
+            "integer axis sizes (e.g. '1x1', '4x1', '4x2'), or a bare "
+            "model-axis size like '8'"
+        ) from None
+    if model < 1 or data < 1:
+        raise ValueError(
+            f"malformed mesh spec {spec!r}: 'MODELxDATA' axis sizes must "
+            "be >= 1 (e.g. '1x1', '4x1', '4x2')"
+        )
+    return model, data
+
+
+def mesh_from_spec(spec: Union[str, int, None]) -> Optional[Mesh]:
+    """A serving ``("model", "data")`` mesh from a ``"MODELxDATA"`` spec.
+
+    ``None``/``"1x1"`` (one device total) returns None — the engine's
+    single-device path, byte-for-byte the pre-mesh behavior. Anything
+    larger builds a mesh over ALL global devices; the sizes must factor
+    the device count exactly (:func:`build_mesh` raises the friendly
+    error naming both otherwise).
+    """
+    model, data = parse_mesh_spec(spec)
+    if model * data == 1:
+        return None
+    return build_mesh((model, data), ("model", "data"))
 
 
 def build_mesh(
@@ -41,9 +100,17 @@ def build_mesh(
     for s in axis_shape:
         total *= s
     if total != len(devices):
+        named = ", ".join(
+            f"{n}={s}" for n, s in zip(axis_names, axis_shape)
+        )
         raise ValueError(
-            f"mesh shape {tuple(axis_shape)} needs {total} devices, "
-            f"have {len(devices)}"
+            f"mesh shape ({named}) covers {total} device(s) but this "
+            f"process sees {len(devices)}: the axis sizes must multiply to "
+            f"EXACTLY the global device count. Pick sizes that factor "
+            f"{len(devices)} (e.g. shrink an axis), or change the device "
+            f"count — on CPU, virtual devices come from "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={total} set "
+            f"before jax initializes."
         )
     if hasattr(jax.sharding, "AxisType"):
         axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)

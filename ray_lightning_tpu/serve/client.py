@@ -25,12 +25,23 @@ class RequestHandle:
 
 
 class ServeClient:
-    """Driver-side handle to one or more serving replicas."""
+    """Driver-side handle to one or more serving replicas.
 
-    def __init__(self, replicas: List[Any], pg: Any = None) -> None:
+    ``followers`` are the rank>0 members of sharded gangs (see
+    ``start_replicas`` ``hosts_per_replica``): they take no requests —
+    the client only has to tear them down after their leaders.
+    """
+
+    def __init__(
+        self,
+        replicas: List[Any],
+        pg: Any = None,
+        followers: Optional[List[Any]] = None,
+    ) -> None:
         if not replicas:
             raise ValueError("need at least one replica")
         self._replicas = list(replicas)
+        self._followers = list(followers or [])
         self._pg = pg
         self._rr = itertools.cycle(range(len(self._replicas)))
 
@@ -189,6 +200,8 @@ class ServeClient:
         )
 
     def shutdown(self) -> None:
+        # Leaders first: their stop() pushes the gang sentinel, so any
+        # followers drain their op streams before being killed.
         for r in self._replicas:
             try:
                 fabric.get(r.stop.remote(), timeout=10.0)
@@ -198,12 +211,30 @@ class ServeClient:
                 fabric.kill(r)
             except Exception:  # noqa: BLE001
                 pass
+        for f in self._followers:
+            try:
+                fabric.get(f.stop.remote(), timeout=10.0)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                fabric.kill(f)
+            except Exception:  # noqa: BLE001
+                pass
+        self._followers = []
         if self._pg is not None:
             try:
                 fabric.remove_placement_group(self._pg)
             except Exception:  # noqa: BLE001
                 pass
             self._pg = None
+
+
+def _find_free_port() -> int:
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return int(s.getsockname()[1])
 
 
 def start_replicas(
@@ -214,6 +245,8 @@ def start_replicas(
     placement_strategy: str = "PACK",
     env: Optional[Dict[str, Any]] = None,
     init_timeout: float = 300.0,
+    hosts_per_replica: int = 1,
+    coordinator_host: str = "127.0.0.1",
     **replica_kwargs: Any,
 ) -> ServeClient:
     """Spawn a replica gang on the fabric and return a connected client.
@@ -221,39 +254,103 @@ def start_replicas(
     Multi-replica gangs reserve their bundles atomically through a
     placement group (so a partially-placeable gang fails fast instead of
     deadlocking half-started); ``replica_kwargs`` go to ServeReplica
-    (ckpt_path/model_config/int8/num_slots/...).
+    (ckpt_path/model_config/int8/num_slots/mesh/...).
+
+    ``hosts_per_replica > 1`` gang-launches ONE ServeReplica PROCESS
+    GROUP per replica for a mesh spanning multiple hosts: the leader
+    (host_rank 0, the RPC surface) plus N-1 ``ServeShardFollower``
+    actors, all rendezvoused through ``jax.distributed`` (reusing
+    ``parallel.mesh.setup_distributed``) so every process sees the
+    global device list the ``mesh`` spec spans; the leader streams its
+    engine-op sequence to the followers over fabric queues
+    (multi-controller lockstep — see ``server._GangLeaderEngine``).
+    ``coordinator_host`` must be an address of the machine the leader
+    lands on (the default suits a single-machine fabric; on a real pod
+    pass the leader host's reachable IP).
     """
     if num_replicas < 1:
         raise ValueError("num_replicas must be >= 1")
+    hosts = int(hosts_per_replica)
+    if hosts < 1:
+        raise ValueError("hosts_per_replica must be >= 1")
     bundle: Dict[str, float] = {"CPU": float(num_cpus_per_replica)}
     if num_tpus_per_replica:
         bundle["TPU"] = float(num_tpus_per_replica)
     pg = None
-    if num_replicas > 1:
+    if num_replicas * hosts > 1:
         pg = fabric.placement_group(
-            [dict(bundle) for _ in range(num_replicas)],
+            [dict(bundle) for _ in range(num_replicas * hosts)],
             strategy=placement_strategy,
         )
     actor_cls = fabric.remote(ServeReplica)
     replicas = []
+    followers = []
     try:
         for i in range(num_replicas):
-            opts: Dict[str, Any] = {
-                "num_cpus": num_cpus_per_replica,
-                "env": dict(env or {}),
-                "init_timeout": init_timeout,
-            }
-            if num_tpus_per_replica:
-                opts["num_tpus"] = num_tpus_per_replica
-            if pg is not None:
-                opts["placement_group"] = pg
-                opts["placement_group_bundle_index"] = i
-            replicas.append(
-                actor_cls.options(**opts).remote(**replica_kwargs)
+            def opts_for(bundle_index: int) -> Dict[str, Any]:
+                o: Dict[str, Any] = {
+                    "num_cpus": num_cpus_per_replica,
+                    "env": dict(env or {}),
+                    "init_timeout": init_timeout,
+                }
+                if num_tpus_per_replica:
+                    o["num_tpus"] = num_tpus_per_replica
+                if pg is not None:
+                    o["placement_group"] = pg
+                    o["placement_group_bundle_index"] = bundle_index
+                return o
+
+            if hosts == 1:
+                replicas.append(
+                    actor_cls.options(**opts_for(i)).remote(**replica_kwargs)
+                )
+                continue
+            # One process group per mesh: leader + followers share a
+            # jax.distributed rendezvous; the op stream rides one fabric
+            # queue per follower. Spawns are async, so the whole gang is
+            # up and joining the rendezvous before anyone is pinged.
+            from ray_lightning_tpu.serve.server import (
+                ENGINE_KEYS,
+                ServeShardFollower,
             )
-        fabric.get([r.ping.remote() for r in replicas], timeout=init_timeout)
+
+            coordinator = f"{coordinator_host}:{_find_free_port()}"
+            queues = [fabric.Queue() for _ in range(hosts - 1)]
+            engine_kwargs = {
+                k: v for k, v in replica_kwargs.items() if k in ENGINE_KEYS
+            }
+            follower_cls = fabric.remote(ServeShardFollower)
+            for rank in range(1, hosts):
+                followers.append(
+                    follower_cls.options(
+                        **opts_for(i * hosts + rank)
+                    ).remote(
+                        op_queue=queues[rank - 1],
+                        dist={
+                            "num_hosts": hosts,
+                            "host_rank": rank,
+                            "coordinator_address": coordinator,
+                        },
+                        **engine_kwargs,
+                    )
+                )
+            replicas.append(
+                actor_cls.options(**opts_for(i * hosts)).remote(
+                    dist={
+                        "num_hosts": hosts,
+                        "host_rank": 0,
+                        "coordinator_address": coordinator,
+                    },
+                    gang_queues=queues,
+                    **replica_kwargs,
+                )
+            )
+        fabric.get(
+            [r.ping.remote() for r in replicas + followers],
+            timeout=init_timeout,
+        )
     except BaseException:
-        for r in replicas:
+        for r in replicas + followers:
             try:
                 fabric.kill(r)
             except Exception:  # noqa: BLE001
@@ -264,4 +361,4 @@ def start_replicas(
             except Exception:  # noqa: BLE001
                 pass
         raise
-    return ServeClient(replicas, pg=pg)
+    return ServeClient(replicas, pg=pg, followers=followers)

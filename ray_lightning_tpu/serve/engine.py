@@ -121,6 +121,26 @@ consume the identical rng chain, and a drafter can only ever change HOW
 FAST tokens arrive, never WHICH tokens (asserted in tests/test_serve.py
 across spec x depth x fold, mid-fold EOS inside an accepted block, and
 cancel + recycle with a verify in flight).
+
+All of the above is single-device; ``mesh=`` makes the engine
+MESH-NATIVE (tensor-parallel decode across chips — the serving-side
+analogue of the training meshes in ``parallel/``): attention heads, the
+Hkv-headed KV cache, and the prefix pool shard over the mesh's "model"
+axis (``models/gpt.py:DECODE_CACHE_AXES`` resolved through the same
+``spec_from_logical`` rules the trainer uses; weights through
+``gpt_param_shardings``), while slot metadata and the token history stay
+replicated so admission bookkeeping and the per-fold harvest never cross
+devices. Every executable above is lowered ONCE under the mesh with
+donated sharded buffers — the compile count stays frozen at construction
+with sharding on, and the per-fold D2H sync still moves only the
+replicated token block. Exactness carries over: the sharded engine's
+greedy output is bit-identical to the single-device engine for the same
+model/config (the sharded contractions reassociate partial sums at the
+~1e-7 level, orders of magnitude under greedy argmax margins; asserted
+under the fp32 reference config in tests/test_serve_sharded.py across
+plain x chunked-prefill-with-prefix-hit x spec=ngram). ``memory_stats()``
+reports per-component resident bytes per device — the tp=N footprint
+division, measured from the live shards.
 """
 from __future__ import annotations
 
@@ -224,6 +244,7 @@ class DecodeEngine:
         spec_params: Any = None,
         spec_config: Any = None,
         spec_window: int = 32,
+        mesh: Any = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -280,6 +301,58 @@ class DecodeEngine:
                     f"prefix_block {self.prefix_block} must be in "
                     f"[1, max_seq={self.max_seq}]"
                 )
+        # Mesh-native serving (tensor-parallel decode): with a mesh
+        # bound, every per-slot device tensor becomes a mesh-sharded
+        # jax.Array — attention heads (and the Hkv-headed KV cache +
+        # prefix pool) split over the "model" axis, slot metadata and
+        # token history replicated so harvest/bookkeeping never cross
+        # devices — and every executable below is lowered ONCE under the
+        # mesh with donated sharded buffers. ``mesh=None`` is the
+        # single-device engine, unchanged byte for byte.
+        self.mesh = mesh
+        self._rep_sh = None
+        self._cache_sh = None
+        self._pool_sh = None
+        self._params_sh = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_lightning_tpu.models.gpt import (
+                DECODE_CACHE_AXES,
+                check_decode_mesh,
+                gpt_param_shardings,
+            )
+            from ray_lightning_tpu.parallel.logical import (
+                DEFAULT_RULES,
+                spec_from_logical,
+            )
+
+            # Before anything is placed or compiled: a mesh that cannot
+            # shard this config's heads must reject instantly.
+            check_decode_mesh(config, mesh)
+            self._rep_sh = NamedSharding(mesh, P())
+            L_, Hkv_, hd_ = config.n_layer, config.kv_head, config.head_dim
+            self._cache_sh = NamedSharding(
+                mesh,
+                spec_from_logical(
+                    (L_, self.num_slots, self.max_seq, Hkv_, hd_),
+                    DECODE_CACHE_AXES,
+                    DEFAULT_RULES,
+                    mesh,
+                ),
+            )
+            if self.prefix_blocks:
+                self._pool_sh = NamedSharding(
+                    mesh,
+                    spec_from_logical(
+                        (L_, self.prefix_blocks, self.prefix_block, Hkv_,
+                         hd_),
+                        DECODE_CACHE_AXES,
+                        DEFAULT_RULES,
+                        mesh,
+                    ),
+                )
+            self._params_sh = gpt_param_shardings(params, config, mesh)
         # Speculative decoding: drafter + depth, validated before any
         # compile so a bad spec rejects instantly.
         self.spec = str(spec)
@@ -317,28 +390,46 @@ class DecodeEngine:
                     f"max_seq ({spec_config.max_seq})"
                 )
             self._spec_cfg = spec_config
+            # Draft weights stay REPLICATED under a mesh: the drafter is
+            # small by design, and a replicated draft keeps its proposals
+            # (and therefore the accept scan) a pure per-device SPMD
+            # computation with zero collective traffic.
             self._spec_params = jax.tree_util.tree_map(
-                jnp.asarray, spec_params
+                (
+                    (lambda a: jax.device_put(jnp.asarray(a), self._rep_sh))
+                    if mesh is not None
+                    else jnp.asarray
+                ),
+                spec_params,
             )
         # Host accept accounting (read by spec_stats / the scheduler).
         self.spec_verifies = 0
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
         self.spec_emitted_tokens = 0
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        if mesh is not None:
+            self.params = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(jnp.asarray(a), s),
+                params,
+                self._params_sh,
+            )
+        else:
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
 
         cdt = jnp.dtype(config.compute_dtype)
         L, Hkv, hd = config.n_layer, config.kv_head, config.head_dim
         B, S = self.num_slots, self.max_seq
-        self._k = jnp.zeros((L, B, S, Hkv, hd), cdt)
-        self._v = jnp.zeros((L, B, S, Hkv, hd), cdt)
+        self._k = self._dfull((L, B, S, Hkv, hd), cdt, self._cache_sh)
+        self._v = self._dfull((L, B, S, Hkv, hd), cdt, self._cache_sh)
         # Prefix pool: device-resident K/V blocks + host digest map/LRU.
         if self.prefix_blocks:
-            self._pool_k = jnp.zeros(
-                (L, self.prefix_blocks, self.prefix_block, Hkv, hd), cdt
+            self._pool_k = self._dfull(
+                (L, self.prefix_blocks, self.prefix_block, Hkv, hd), cdt,
+                self._pool_sh,
             )
-            self._pool_v = jnp.zeros(
-                (L, self.prefix_blocks, self.prefix_block, Hkv, hd), cdt
+            self._pool_v = self._dfull(
+                (L, self.prefix_blocks, self.prefix_block, Hkv, hd), cdt,
+                self._pool_sh,
             )
         self._pool_map: Dict[bytes, int] = {}
         self._pool_meta: List[Optional[_PoolBlock]] = [None] * self.prefix_blocks
@@ -350,23 +441,28 @@ class DecodeEngine:
         self.prefix_inserts = 0
         self.prefix_evictions = 0
 
-        # Per-slot DEVICE state (fixed shapes: one step signature forever).
-        self._cur = jnp.zeros(B, jnp.int32)
-        self._pos = jnp.zeros(B, jnp.int32)
-        self._temps = jnp.zeros(B, jnp.float32)
-        self._top_ks = jnp.zeros(B, jnp.int32)
-        self._top_ps = jnp.ones(B, jnp.float32)
-        self._keys = jnp.zeros((B, 2), jnp.uint32)
-        self._active = jnp.zeros(B, jnp.bool_)
-        self._remaining = jnp.zeros(B, jnp.int32)
-        self._eos = jnp.full(B, -1, jnp.int32)
+        # Per-slot DEVICE state (fixed shapes: one step signature forever;
+        # replicated under a mesh — slot writes and the per-fold harvest
+        # stay device-local).
+        rep = self._rep_sh
+        self._cur = self._dfull((B,), jnp.int32, rep)
+        self._pos = self._dfull((B,), jnp.int32, rep)
+        self._temps = self._dfull((B,), jnp.float32, rep)
+        self._top_ks = self._dfull((B,), jnp.int32, rep)
+        self._top_ps = self._dfull((B,), jnp.float32, rep, fill=1)
+        self._keys = self._dfull((B, 2), jnp.uint32, rep)
+        self._active = self._dfull((B,), jnp.bool_, rep)
+        self._remaining = self._dfull((B,), jnp.int32, rep)
+        self._eos = self._dfull((B,), jnp.int32, rep, fill=-1)
         #: Device-resident per-slot token history (hist[b, p] = token at
         #: position p) — what the spec drafters read. Maintained like the
         #: KV cache: prompt seeded by a compiled write at admission,
         #: chunk executables heal their ranges, the fold appends accepted
         #: tokens in-graph. None when spec is off (zero cost).
         self._hist = (
-            jnp.zeros((B, S), jnp.int32) if self.spec != "off" else None
+            self._dfull((B, S), jnp.int32, rep)
+            if self.spec != "off"
+            else None
         )
         self._slots: List[Optional[SlotInfo]] = [None] * B
         #: slot -> in-progress chunked admission (chunked mode only).
@@ -386,6 +482,30 @@ class DecodeEngine:
 
         self.compiled_count = 0
         self._compile()
+
+    @staticmethod
+    def _dfull(shape, dtype, sharding, fill=0):
+        """Fresh device state, placed: plain ``jnp.full`` single-device,
+        or a sharded jax.Array assembled shard-by-shard under a mesh —
+        the full tensor is never materialized on one device (holding
+        state bigger than one chip's HBM is the point of the mesh), and
+        the buffers are fresh, so donation can never free a caller's
+        array."""
+        import jax
+        import jax.numpy as jnp
+
+        dtype = jnp.dtype(dtype)
+        if sharding is None:
+            return jnp.full(shape, fill, dtype)
+
+        def shard(idx):
+            dims = []
+            for dim, sl in zip(shape, idx):
+                start, stop, _ = sl.indices(dim)
+                dims.append(stop - start)
+            return np.full(tuple(dims), fill, dtype)
+
+        return jax.make_array_from_callback(tuple(shape), sharding, shard)
 
     # -- compilation (all of it, up front) -------------------------------
     def _compile(self) -> None:
@@ -407,12 +527,36 @@ class DecodeEngine:
 
         cfg = self.cfg
         norm_fn = _make_norm(cfg)
+        # Mesh mode: every aval carries its array's sharding, so each
+        # executable lowers ONCE under the mesh with the partitioner
+        # seeing exactly the layouts the donated buffers will arrive in;
+        # out_shardings pin the round-tripped state to the same layouts
+        # (donation aliasing + a stable call signature forever).
+        mesh_on = self.mesh is not None
         p_spec = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.params
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=a.sharding if mesh_on else None
+            ),
+            self.params,
         )
 
         def spec(arr):
-            return jax.ShapeDtypeStruct(np.shape(arr), np.asarray(arr).dtype)
+            return jax.ShapeDtypeStruct(
+                np.shape(arr),
+                arr.dtype,
+                sharding=arr.sharding if mesh_on else None,
+            )
+
+        def jit_exec(fn, donate, out_sh):
+            kw: Dict[str, Any] = {"donate_argnums": donate}
+            if mesh_on:
+                kw["out_shardings"] = out_sh
+            return jax.jit(fn, **kw)
+
+        rep_sh = self._rep_sh  # None single-device; unused then
+        cache_out = self._cache_sh
+        pool_out = self._pool_sh
+        state_out = (rep_sh,) * 9
 
         def admit_impl(
             params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps,
@@ -543,10 +687,11 @@ class DecodeEngine:
             spec(self._remaining),
             spec(self._eos),
         )
-        i32 = jax.ShapeDtypeStruct((), np.int32)
-        f32 = jax.ShapeDtypeStruct((), np.float32)
-        b1 = jax.ShapeDtypeStruct((), np.bool_)
-        key_spec = jax.ShapeDtypeStruct((2,), np.uint32)
+        sc_sh = rep_sh if mesh_on else None  # host scalars: replicated
+        i32 = jax.ShapeDtypeStruct((), np.int32, sharding=sc_sh)
+        f32 = jax.ShapeDtypeStruct((), np.float32, sharding=sc_sh)
+        b1 = jax.ShapeDtypeStruct((), np.bool_, sharding=sc_sh)
+        key_spec = jax.ShapeDtypeStruct((2,), np.uint32, sharding=sc_sh)
 
         L = cfg.n_layer
         Hkv, hd = cfg.kv_head, cfg.head_dim
@@ -679,13 +824,19 @@ class DecodeEngine:
             # machine exclusively — one executable per CHUNK bucket
             # replaces the per-prompt-bucket fused admits. With spec on
             # the chunk executable also heals its token-history range.
+            admit_out = None
+            if mesh_on:
+                admit_out = (cache_out, cache_out) + state_out + (rep_sh,)
             for cb in self.chunk_buckets:
-                chunk_tok_spec = jax.ShapeDtypeStruct((1, cb), np.int32)
+                chunk_tok_spec = jax.ShapeDtypeStruct(
+                    (1, cb), np.int32, sharding=sc_sh
+                )
                 if spec_on:
                     self._chunk_exec[cb] = (
-                        jax.jit(
+                        jit_exec(
                             chunk_spec_impl,
-                            donate_argnums=tuple(range(1, 13)),
+                            tuple(range(1, 13)),
+                            admit_out + (rep_sh,) if mesh_on else None,
                         )
                         .lower(
                             p_spec,
@@ -709,8 +860,8 @@ class DecodeEngine:
                     )
                 else:
                     self._chunk_exec[cb] = (
-                        jax.jit(
-                            chunk_impl, donate_argnums=tuple(range(1, 12))
+                        jit_exec(
+                            chunk_impl, tuple(range(1, 12)), admit_out
                         )
                         .lower(
                             p_spec,
@@ -733,10 +884,15 @@ class DecodeEngine:
                     )
                 self.compiled_count += 1
         else:
+            admit_out = None
+            if mesh_on:
+                admit_out = (cache_out, cache_out) + state_out + (rep_sh,)
             for pb in self.prefill_buckets:
-                prompt_spec = jax.ShapeDtypeStruct((1, pb), np.int32)
+                prompt_spec = jax.ShapeDtypeStruct(
+                    (1, pb), np.int32, sharding=sc_sh
+                )
                 self._admit_exec[pb] = (
-                    jax.jit(admit_impl, donate_argnums=tuple(range(1, 12)))
+                    jit_exec(admit_impl, tuple(range(1, 12)), admit_out)
                     .lower(
                         p_spec,
                         cache_spec,
@@ -758,7 +914,13 @@ class DecodeEngine:
         if self.prefix_blocks:
             pool_spec = spec(self._pool_k)
             self._copy_exec = (
-                jax.jit(copy_impl, donate_argnums=(0, 1, 2, 3))
+                jit_exec(
+                    copy_impl,
+                    (0, 1, 2, 3),
+                    (pool_out, pool_out, cache_out, cache_out)
+                    if mesh_on
+                    else None,
+                )
                 .lower(
                     pool_spec, pool_spec, cache_spec, cache_spec,
                     i32, i32, i32, b1,
@@ -771,17 +933,23 @@ class DecodeEngine:
         # own their updates). With spec on the token history rides the
         # same donation chain, and the drafter (n-gram search or draft
         # model) compiles INTO this one executable.
+        step_out = None
+        step_spec_out = None
+        if mesh_on:
+            step_out = (rep_sh,) * 7 + (cache_out, cache_out)
+            step_spec_out = (rep_sh,) * 8 + (cache_out, cache_out)
         if not spec_on:
             self._step_exec = (
-                jax.jit(step_impl, donate_argnums=(1, 2, 3, 4, 8, 9, 10))
+                jit_exec(step_impl, (1, 2, 3, 4, 8, 9, 10), step_out)
                 .lower(p_spec, cache_spec, cache_spec, *state_specs)
                 .compile()
             )
         elif self.spec == "ngram":
             self._step_exec = (
-                jax.jit(
+                jit_exec(
                     step_spec_impl,
-                    donate_argnums=(1, 2, 3, 4, 8, 9, 10, 12),
+                    (1, 2, 3, 4, 8, 9, 10, 12),
+                    step_spec_out,
                 )
                 .lower(p_spec, cache_spec, cache_spec, *state_specs,
                        hist_spec)
@@ -789,13 +957,18 @@ class DecodeEngine:
             )
         else:
             dp_spec = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape,
+                    a.dtype,
+                    sharding=a.sharding if mesh_on else None,
+                ),
                 self._spec_params,
             )
             self._step_exec = (
-                jax.jit(
+                jit_exec(
                     step_spec_model_impl,
-                    donate_argnums=(2, 3, 4, 5, 9, 10, 11, 13),
+                    (2, 3, 4, 5, 9, 10, 11, 13),
+                    step_spec_out,
                 )
                 .lower(p_spec, dp_spec, cache_spec, cache_spec,
                        *state_specs, hist_spec)
@@ -804,20 +977,23 @@ class DecodeEngine:
         self.compiled_count += 1
         if spec_on:
             self._hist_write_exec = (
-                jax.jit(hist_write_impl, donate_argnums=(0,))
+                jit_exec(hist_write_impl, (0,), rep_sh if mesh_on else None)
                 .lower(
                     hist_spec,
                     i32,
-                    jax.ShapeDtypeStruct((1, self.max_seq), np.int32),
+                    jax.ShapeDtypeStruct(
+                        (1, self.max_seq), np.int32, sharding=sc_sh
+                    ),
                     i32,
                 )
                 .compile()
             )
             self.compiled_count += 1
         self._slot_write_exec = (
-            jax.jit(
+            jit_exec(
                 slot_write_impl,
-                donate_argnums=tuple(range(9)),
+                tuple(range(9)),
+                state_out if mesh_on else None,
             )
             .lower(
                 *state_specs,
@@ -885,6 +1061,53 @@ class DecodeEngine:
         }
 
     # -- introspection ---------------------------------------------------
+    @property
+    def mesh_desc(self) -> str:
+        """``"MODELxDATA"`` of the bound mesh; ``"1x1"`` single-device."""
+        if self.mesh is None:
+            return "1x1"
+        return "{}x{}".format(
+            self.mesh.shape.get("model", 1), self.mesh.shape.get("data", 1)
+        )
+
+    def memory_stats(self) -> Dict[str, Dict[str, int]]:
+        """Resident device-state footprint by component: logical
+        ``bytes`` plus ``per_device_bytes`` — what one device actually
+        holds, measured from the live shards (not inferred from the
+        spec). The KV cache and prefix pool shard their head axis over
+        the mesh's model axis, so their per-device bytes must shrink
+        ~linearly in it; the token history and slot scalars replicate.
+        Metadata only — reads buffer sizes, never syncs values."""
+
+        def row(*arrs) -> Dict[str, int]:
+            live = [a for a in arrs if a is not None]
+            total = sum(int(a.nbytes) for a in live)
+            if self.mesh is None:
+                return {"bytes": total, "per_device_bytes": total}
+            per = 0.0
+            for a in live:
+                n_local = max(1, len(a.sharding.addressable_devices))
+                per += (
+                    sum(int(s.data.nbytes) for s in a.addressable_shards)
+                    / n_local
+                )
+            return {"bytes": total, "per_device_bytes": int(per)}
+
+        out = {
+            "kv_cache": row(self._k, self._v),
+            "prefix_pool": row(
+                getattr(self, "_pool_k", None), getattr(self, "_pool_v", None)
+            ),
+            "token_history": row(self._hist),
+        }
+        out["total"] = {
+            "bytes": sum(r["bytes"] for r in out.values()),
+            "per_device_bytes": sum(
+                r["per_device_bytes"] for r in out.values()
+            ),
+        }
+        return out
+
     @property
     def num_active(self) -> int:
         """Occupied slots: decoding residents PLUS in-progress chunked

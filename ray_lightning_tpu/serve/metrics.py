@@ -85,6 +85,11 @@ class ServeMetrics:
                     "rlt_serve_spec_accept_rate",
                     "Sliding-window draft-token accept rate (0-1)",
                 ),
+                "hbm": registry.gauge(
+                    "rlt_serve_hbm_bytes",
+                    "Per-device resident bytes of engine device state "
+                    "by component",
+                ),
             }
         # Lifecycle counters (monotonic).
         self.submitted = 0
@@ -227,6 +232,22 @@ class ServeMetrics:
             self._reg["spec_verifies"].inc(int(verifies))
             self._reg["spec_drafted"].inc(int(drafted))
             self._reg["spec_accepted"].inc(int(accepted))
+
+    def record_memory(self, mem: Dict[str, Any]) -> None:
+        """Resident-footprint gauges from ``engine.memory_stats()``:
+        ``rlt_serve_hbm_bytes{component=...}`` carries PER-DEVICE bytes
+        after sharding — the number that must shrink ~linearly in the
+        serve mesh's model axis (tp=N really dividing the footprint by
+        ~N is validated against this series, not assumed). Engine state
+        shapes are frozen at construction, so one call per engine is
+        enough."""
+        if self._reg is None or not mem:
+            return
+        for comp, row in mem.items():
+            if isinstance(row, dict) and "per_device_bytes" in row:
+                self._reg["hbm"].set(
+                    float(row["per_device_bytes"]), component=comp
+                )
 
     # -- aggregates ------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:

@@ -67,12 +67,256 @@ def load_serve_params(
     return params, GPTConfig(**cfg_fields)
 
 
+#: Engine-facing construction kwargs a sharded-gang follower consumes —
+#: leader-only knobs (scheduler, watchdog, obs, blackbox, RPC plumbing)
+#: are absent from this set and are dropped before a follower builds its
+#: engine mirror.
+ENGINE_KEYS = frozenset((
+    "ckpt_path", "model_config", "params", "int8", "num_slots", "max_seq",
+    "prefill_buckets", "decode_fold", "pipeline", "prefill_chunk",
+    "prefix_blocks", "prefix_block", "spec", "spec_depth",
+    "spec_draft_ckpt", "spec_draft_config", "spec_draft_int8",
+    "spec_window", "mesh",
+))
+
+
+def build_engine(
+    ckpt_path: Optional[str] = None,
+    model_config: Optional[Dict[str, Any]] = None,
+    params: Any = None,
+    int8: bool = False,
+    num_slots: int = 4,
+    max_seq: Optional[int] = None,
+    prefill_buckets: Optional[Sequence[int]] = None,
+    decode_fold: int = 1,
+    pipeline: bool = True,
+    prefill_chunk: int = 0,
+    prefix_blocks: int = 0,
+    prefix_block: int = 16,
+    spec: str = "off",
+    spec_depth: int = 4,
+    spec_draft_ckpt: Optional[str] = None,
+    spec_draft_config: Optional[Dict[str, Any]] = None,
+    spec_draft_int8: bool = False,
+    spec_window: int = 32,
+    mesh: Optional[str] = None,
+) -> Any:
+    """Load weights (+ optional draft model) and construct the engine.
+
+    Shared by the replica leader AND sharded-gang followers, so every
+    process in a gang builds a bit-identical engine from the same
+    checkpoint. ``mesh`` is a ``"MODELxDATA"`` spec string
+    (``parallel.mesh.mesh_from_spec``); ``"1x1"``/None is the
+    single-device engine.
+    """
+    from ray_lightning_tpu.models.gpt import GPTConfig
+    from ray_lightning_tpu.parallel.mesh import mesh_from_spec
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+
+    if params is None:
+        if ckpt_path is None:
+            raise ValueError("need ckpt_path or params")
+        params, cfg = load_serve_params(ckpt_path, model_config)
+    else:
+        if model_config is None:
+            raise ValueError("explicit params need model_config")
+        cfg = (
+            model_config
+            if isinstance(model_config, GPTConfig)
+            else GPTConfig(**model_config)
+        )
+    if int8:
+        from ray_lightning_tpu.utils.quantize import quantize_params_int8
+
+        params = quantize_params_int8(params)
+    # Speculative decoding: the draft model (spec='model') loads like
+    # the main checkpoint — state stream with embedded config, or
+    # spec_draft_config overrides — and may quantize to int8 (draft
+    # quality only gates the accept rate, never correctness).
+    spec_params = None
+    spec_cfg = None
+    if spec == "model":
+        if spec_draft_ckpt is None:
+            raise ValueError(
+                "spec='model' needs spec_draft_ckpt (the draft "
+                "model's checkpoint)"
+            )
+        spec_params, spec_cfg = load_serve_params(
+            spec_draft_ckpt, spec_draft_config
+        )
+        if spec_draft_int8:
+            from ray_lightning_tpu.utils.quantize import (
+                quantize_params_int8,
+            )
+
+            spec_params = quantize_params_int8(spec_params)
+    return DecodeEngine(
+        params,
+        cfg,
+        num_slots=num_slots,
+        max_seq=max_seq,
+        prefill_buckets=prefill_buckets,
+        decode_fold=decode_fold,
+        pipeline=pipeline,
+        prefill_chunk=prefill_chunk,
+        prefix_blocks=prefix_blocks,
+        prefix_block=prefix_block,
+        spec=spec,
+        spec_depth=spec_depth,
+        spec_params=spec_params,
+        spec_config=spec_cfg,
+        spec_window=spec_window,
+        mesh=mesh_from_spec(mesh),
+    )
+
+
+def _setup_gang_rendezvous(dist: Dict[str, Any]) -> None:
+    """Rendezvous this process with its gang peers (multi-host sharded
+    serving): after ``jax.distributed.initialize`` every gang member
+    sees the global device list the serve mesh spans. Must run before
+    ANY jax work in the process."""
+    if int(dist.get("num_hosts", 1)) <= 1:
+        return
+    from ray_lightning_tpu.parallel import mesh as mesh_lib
+    from ray_lightning_tpu.parallel.env import DistEnv
+
+    mesh_lib.setup_distributed(
+        DistEnv(
+            num_hosts=int(dist["num_hosts"]),
+            host_rank=int(dist.get("host_rank", 0)),
+            coordinator_address=dist.get("coordinator_address"),
+        )
+    )
+
+
+class _GangLeaderEngine:
+    """Leader-side engine proxy for a multi-host sharded serving gang.
+
+    The multi-controller SPMD contract: every process in the gang must
+    issue the IDENTICAL sequence of compiled dispatches against its
+    shard of the mesh. The scheduler mutates the engine through exactly
+    four methods (``admit_many`` / ``prefill_step`` / ``step`` /
+    ``release``, plus the ``admit`` convenience wrapper); the leader
+    ships each call's name + args to every follower BEFORE executing it
+    locally, and followers replay the stream on bit-identical engines —
+    all host-side bookkeeping (slot choice, prefix-pool walk, LRU) is a
+    deterministic function of the op sequence alone, so the gang stays
+    in lockstep without sharing any state. Reads delegate without
+    broadcasting.
+    """
+
+    def __init__(self, engine: Any, queues: Sequence[Any]) -> None:
+        self._engine = engine
+        self._queues = list(queues)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._engine, name)
+
+    def _broadcast(self, name: str, args: tuple, kwargs: dict) -> None:
+        for q in self._queues:
+            q.put((name, args, kwargs))
+
+    def admit(self, *args: Any, **kwargs: Any) -> Any:
+        self._broadcast("admit", args, kwargs)
+        return self._engine.admit(*args, **kwargs)
+
+    def admit_many(self, *args: Any, **kwargs: Any) -> Any:
+        self._broadcast("admit_many", args, kwargs)
+        return self._engine.admit_many(*args, **kwargs)
+
+    def prefill_step(self, *args: Any, **kwargs: Any) -> Any:
+        self._broadcast("prefill_step", args, kwargs)
+        return self._engine.prefill_step(*args, **kwargs)
+
+    def step(self, *args: Any, **kwargs: Any) -> Any:
+        self._broadcast("step", args, kwargs)
+        return self._engine.step(*args, **kwargs)
+
+    def release(self, *args: Any, **kwargs: Any) -> Any:
+        self._broadcast("release", args, kwargs)
+        return self._engine.release(*args, **kwargs)
+
+    def close(self) -> None:
+        """End-of-life sentinel: followers drain and exit their loops."""
+        for q in self._queues:
+            try:
+                q.put(None)
+            except Exception:  # noqa: BLE001 - best-effort drain
+                pass
+
+
+class ServeShardFollower:
+    """``host_rank > 0`` member of a sharded serving gang (fabric actor).
+
+    Rendezvouses with the gang (``setup_distributed``), builds the SAME
+    engine under the SAME global mesh as the leader, then replays the
+    leader's op stream (see :class:`_GangLeaderEngine`) on a daemon
+    thread, so every process issues the identical SPMD dispatch
+    sequence. No request surface — traffic enters through the leader
+    only; a follower exists to hold its shard of the weights/KV and run
+    its slice of every collective.
+    """
+
+    def __init__(
+        self,
+        op_queue: Any,
+        dist: Optional[Dict[str, Any]] = None,
+        **engine_kwargs: Any,
+    ) -> None:
+        _setup_gang_rendezvous(dict(dist or {}))
+        self.engine = build_engine(
+            **{k: v for k, v in engine_kwargs.items() if k in ENGINE_KEYS}
+        )
+        self._queue = op_queue
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-shard-follower", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import queue as _q
+        import sys
+
+        while not self._stop.is_set():
+            try:
+                op = self._queue.get(timeout=0.25)
+            except (_q.Empty, EOFError, BrokenPipeError, ConnectionError):
+                continue
+            if op is None:
+                break
+            name, args, kwargs = op
+            try:
+                getattr(self.engine, name)(*args, **kwargs)
+            except Exception as exc:  # noqa: BLE001 - gang is broken
+                # A desynced follower cannot be healed in place (every
+                # subsequent collective would hang the gang); stop loud.
+                print(
+                    f"serve shard follower desync on {name}: "
+                    f"{type(exc).__name__}: {exc}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                break
+
+    def ping(self) -> str:
+        return "ok"
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+
 class ServeReplica:
     """One serving replica (designed to run as a fabric actor).
 
     ``params`` may be passed directly (tests/bench) or loaded from
     ``ckpt_path``; ``int8=True`` quantizes the tree at load
     (utils.quantize_params_int8), which the engine consumes directly.
+    ``mesh`` ("MODELxDATA", e.g. "4x1") makes the engine mesh-sharded
+    over this process's devices; ``dist``/``gang_queues`` wire a
+    multi-host gang (one process group per mesh — see
+    ``serve.client.start_replicas`` ``hosts_per_replica``).
     """
 
     def __init__(
@@ -107,64 +351,34 @@ class ServeReplica:
         slo: Optional[Dict[str, Any]] = None,
         blackbox_dir: Optional[str] = None,
         blackbox_keep: int = 3,
+        mesh: Optional[str] = None,
+        dist: Optional[Dict[str, Any]] = None,
+        gang_queues: Optional[Sequence[Any]] = None,
     ) -> None:
-        from ray_lightning_tpu.models.gpt import GPTConfig
         from ray_lightning_tpu.obs import blackbox as obs_blackbox
         from ray_lightning_tpu.obs import health as obs_health
         from ray_lightning_tpu.obs.events import get_event_log
         from ray_lightning_tpu.obs.jaxmon import install_compile_listener
         from ray_lightning_tpu.obs.registry import get_registry
-        from ray_lightning_tpu.obs.trace import RequestTracer
-        from ray_lightning_tpu.serve.engine import DecodeEngine
         from ray_lightning_tpu.serve.metrics import ServeMetrics
         from ray_lightning_tpu.serve.scheduler import Scheduler
+        from ray_lightning_tpu.obs.trace import RequestTracer
 
+        # Gang leader on a multi-host mesh: rendezvous FIRST — after
+        # jax.distributed.initialize every gang member sees the global
+        # device list the serve mesh spans.
+        self._dist = dict(dist or {})
+        _setup_gang_rendezvous(self._dist)
         # Before anything compiles: the listener turns the engine's
         # frozen-compile contract into a metric (stats() ships
         # compiles_since_init, which must stay 0 in steady state).
         self._compile_stats = install_compile_listener()
 
-        if params is None:
-            if ckpt_path is None:
-                raise ValueError("need ckpt_path or params")
-            params, cfg = load_serve_params(ckpt_path, model_config)
-        else:
-            if model_config is None:
-                raise ValueError("explicit params need model_config")
-            cfg = (
-                model_config
-                if isinstance(model_config, GPTConfig)
-                else GPTConfig(**model_config)
-            )
-        if int8:
-            from ray_lightning_tpu.utils.quantize import quantize_params_int8
-
-            params = quantize_params_int8(params)
-        self.int8 = bool(int8)
-        # Speculative decoding: the draft model (spec='model') loads like
-        # the main checkpoint — state stream with embedded config, or
-        # spec_draft_config overrides — and may quantize to int8 (draft
-        # quality only gates the accept rate, never correctness).
-        spec_params = None
-        spec_cfg = None
-        if spec == "model":
-            if spec_draft_ckpt is None:
-                raise ValueError(
-                    "spec='model' needs spec_draft_ckpt (the draft "
-                    "model's checkpoint)"
-                )
-            spec_params, spec_cfg = load_serve_params(
-                spec_draft_ckpt, spec_draft_config
-            )
-            if spec_draft_int8:
-                from ray_lightning_tpu.utils.quantize import (
-                    quantize_params_int8,
-                )
-
-                spec_params = quantize_params_int8(spec_params)
-        self.engine = DecodeEngine(
-            params,
-            cfg,
+        self.engine = build_engine(
+            ckpt_path=ckpt_path,
+            model_config=model_config,
+            params=params,
+            int8=int8,
             num_slots=num_slots,
             max_seq=max_seq,
             prefill_buckets=prefill_buckets,
@@ -175,10 +389,23 @@ class ServeReplica:
             prefix_block=prefix_block,
             spec=spec,
             spec_depth=spec_depth,
-            spec_params=spec_params,
-            spec_config=spec_cfg,
+            spec_draft_ckpt=spec_draft_ckpt,
+            spec_draft_config=spec_draft_config,
+            spec_draft_int8=spec_draft_int8,
             spec_window=spec_window,
+            mesh=mesh,
         )
+        self.int8 = bool(int8)
+        # Multi-host gang: the scheduler drives a proxy that ships every
+        # device-mutating call to the follower hosts before running it
+        # locally (multi-controller lockstep); reads and stats stay on
+        # the real engine.
+        self._gang_queues = list(gang_queues or [])
+        self._sched_engine: Any = self.engine
+        if self._gang_queues:
+            self._sched_engine = _GangLeaderEngine(
+                self.engine, self._gang_queues
+            )
         self._registry = get_registry()
         self._registry.gauge(
             "rlt_serve_compiled_executables",
@@ -194,12 +421,16 @@ class ServeReplica:
         self.metrics = ServeMetrics(
             self.engine.num_slots, registry=self._registry
         )
+        # Resident-footprint gauges (rlt_serve_hbm_bytes{component=}):
+        # shapes freeze at construction, so record once — the per-device
+        # series is how a tp=N mesh proves it divided the footprint.
+        self.metrics.record_memory(self.engine.memory_stats())
         self.tracer = RequestTracer(
             capacity=trace_capacity, enabled=bool(tracing)
         )
         self.events = get_event_log()
         self.scheduler = Scheduler(
-            self.engine,
+            self._sched_engine,
             metrics=self.metrics,
             max_prefills_per_step=max_prefills_per_step,
             max_prefill_chunks_per_step=max_prefill_chunks_per_step,
@@ -217,6 +448,8 @@ class ServeReplica:
             "spec": self.engine.spec,
             "spec_depth": self.engine.spec_depth,
             "int8": self.int8,
+            "mesh": self.engine.mesh_desc,
+            "gang_hosts": int(self._dist.get("num_hosts", 1)),
             "watchdog": bool(watchdog),
             "stall_s": float(stall_s),
             "slo": dict(slo or {}),
@@ -411,6 +644,11 @@ class ServeReplica:
                 "prefill_chunk": self.engine.prefill_chunk,
                 "prefix_cache": self.engine.prefix_blocks > 0,
                 "int8": self.int8,
+                "mesh": self.engine.mesh_desc,
+                # Per-component resident bytes (total + per-device after
+                # sharding): the row that validates tp=N divides the
+                # footprint by ~N.
+                "memory": self.engine.memory_stats(),
                 "tracing": self.tracer.enabled,
                 "metrics": self._registry.to_dict(),
             }
@@ -499,6 +737,8 @@ class ServeReplica:
     def stop(self) -> None:
         if self.watchdog is not None:
             self.watchdog.stop()
+        if isinstance(self._sched_engine, _GangLeaderEngine):
+            self._sched_engine.close()  # followers drain and exit
         self._stop.set()
         self._work.set()
         self._thread.join(timeout=5.0)

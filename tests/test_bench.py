@@ -123,6 +123,19 @@ def test_bench_smoke_cpu():
     }
     assert wd_modes == {"watchdog_off", "watchdog_on"}, out["extra"]
     assert out["extra"]["watchdog_overhead"] < 1.05, out["extra"]
+    # Mesh-sharded decode sweep: a 1x1 control plus >= 1 model-axis
+    # mesh over the forced host devices, per-device KV bytes shrinking
+    # ~linearly in the model axis (the tp=N footprint story, measured).
+    sh_rows = out["extra"]["decode_sharded_rows"]
+    assert sh_rows[0]["mesh"] == "1x1"
+    assert any(r["model_axis"] > 1 for r in sh_rows), sh_rows
+    for r in sh_rows:
+        assert r["decode_tokens_per_sec"] > 0, r
+        assert (
+            r["kv_bytes_per_device"]
+            == r["kv_bytes_total"] // r["model_axis"]
+        ), r
+    assert out["extra"]["sharded_cpu_control"] is True
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

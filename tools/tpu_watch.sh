@@ -16,12 +16,14 @@ have_headline=0
 have_full=0
 have_gpt=0
 have_serve=0
+have_sharded=0
 have_spec=0
 have_obs=0
 have_doctor=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
+sharded_fails=0
 spec_fails=0
 obs_fails=0
 doctor_fails=0
@@ -32,6 +34,7 @@ headline_status=pending
 full_status=pending
 gpt_status=pending
 serve_status=pending
+sharded_status=pending
 spec_status=pending
 obs_status=pending
 doctor_status=pending
@@ -49,6 +52,7 @@ write_manifest() {
     echo "stage=full status=$full_status fails=$full_fails"
     echo "stage=gpt_ab status=$gpt_status fails=$gpt_fails"
     echo "stage=serve status=$serve_status fails=$serve_fails"
+    echo "stage=sharded_serve status=$sharded_status fails=$sharded_fails"
     echo "stage=spec status=$spec_status fails=$spec_fails"
     echo "stage=obs status=$obs_status fails=$obs_fails"
     echo "stage=doctor status=$doctor_status fails=$doctor_fails"
@@ -154,6 +158,32 @@ while true; do
             have_serve=1
             serve_status=skipped
             echo "$(date -u +%H:%M:%S) serve bench SKIPPED after $serve_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_sharded" -eq 0 ]; then
+        # Stage 4b: mesh-sharded serving artifact — the serve sweep now
+        # carries decode_sharded_rows (mesh 1x1 vs modelxN, tokens/s +
+        # per-device KV bytes), so the next healthy window records the
+        # tensor-parallel footprint/throughput story ON CHIP next to the
+        # forced-host-device CPU control.
+        echo "$(date -u +%H:%M:%S) launching SHARDED serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/sharded_serve_bench.json 2> /tmp/sharded_serve_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/sharded_serve_bench.json ] && \
+           grep -q decode_sharded_rows /tmp/sharded_serve_bench.json; then
+          have_sharded=1
+          sharded_status=ok
+          echo "$(date -u +%H:%M:%S) SHARDED serve bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          sharded_fails=$((sharded_fails+1))
+          sharded_status=failed
+          echo "$(date -u +%H:%M:%S) sharded serve bench failed rc=$rc (fail $sharded_fails)" >> /tmp/tpu_watch.log
+          if [ "$sharded_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_sharded=1
+            sharded_status=skipped
+            echo "$(date -u +%H:%M:%S) sharded serve bench SKIPPED after $sharded_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_spec" -eq 0 ]; then
