@@ -35,7 +35,7 @@ import yaml
 
 _SUBCOMMANDS = (
     "fit", "validate", "test", "predict", "generate", "convert-hf",
-    "tokenize", "serve", "doctor",
+    "tokenize", "serve", "doctor", "top",
 )
 
 
@@ -139,7 +139,7 @@ def _apply_dotted(
             continue
         if section not in (
             "model", "strategy", "trainer", "data", "generate", "tokenize",
-            "serve", "doctor",
+            "serve", "doctor", "top",
         ):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
@@ -155,7 +155,7 @@ def _apply_dotted(
     for section, field, raw in field_overrides:
         node = config[section]
         if section in (
-            "trainer", "generate", "tokenize", "serve", "doctor",
+            "trainer", "generate", "tokenize", "serve", "doctor", "top",
         ):  # plain dicts
             node[field] = yaml.safe_load(raw)
             continue
@@ -215,13 +215,13 @@ def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
     while i < len(rest):
         arg = rest[i]
         if not arg.startswith("--"):
-            # ``rlt doctor <addr>``: the one positional the CLI accepts —
-            # the serve obs endpoint to interrogate.
+            # ``rlt doctor <addr>`` / ``rlt top <addr>``: the one
+            # positional the CLI accepts — the serve obs endpoint.
             if (
-                known.subcommand == "doctor"
-                and "addr" not in (config.get("doctor") or {})
+                known.subcommand in ("doctor", "top")
+                and "addr" not in (config.get(known.subcommand) or {})
             ):
-                config.setdefault("doctor", {})["addr"] = arg
+                config.setdefault(known.subcommand, {})["addr"] = arg
                 i += 1
                 continue
             raise ValueError(f"unexpected argument {arg!r}")
@@ -393,7 +393,132 @@ _SERVE_KEYS = frozenset((
     "metrics_port", "tracing", "trace_out", "profile_s",
     "watchdog", "watchdog_interval_s", "stall_s", "slo",
     "blackbox_dir", "blackbox_keep",
+    "fleet", "fleet_interval_s", "fleet_history",
 ))
+
+
+def _serve_obs_server(
+    client: Any,
+    metrics_port: int,
+    fleet: bool = True,
+    fleet_interval_s: float = 2.0,
+    fleet_history: int = 128,
+) -> Tuple[Any, Optional[Any]]:
+    """Build (started) the driver-side obs HTTP server ``rlt serve``
+    runs next to a replica gang, plus its FleetPoller (None when
+    ``fleet`` is off). Routes:
+
+    - ``/metrics``: every replica's registry (replica-labelled) + the
+      driver's own (fabric heartbeat gauges, ``rlt_fleet_*``);
+    - ``/stats``: per-replica stats snapshots;
+    - ``/healthz``: 200/503 aggregating fabric heartbeat verdicts +
+      every replica's health() RPC;
+    - ``/fleet``: the latest FleetSnapshot + history ring (``rlt top``'s
+      feed);
+    - ``/events``: the merged structured event rings as JSONL;
+    - ``/traces``: the stitched cross-process Chrome trace;
+    - ``/debug/bundle``: a replica flight-recorder bundle augmented
+      driver-side with ``fleet.json`` + ``trace_stitched.json`` so a
+      pulled post-mortem shows the whole fleet, not one process.
+
+    Factored out of run_serve so the wire path is testable against any
+    client-shaped object without spawning the CLI.
+    """
+    import json as _json
+
+    from ray_lightning_tpu import obs
+    from ray_lightning_tpu.fabric import core as fabric_core
+    from ray_lightning_tpu.obs import health as obs_health
+    from ray_lightning_tpu.obs.fleet import FleetPoller
+
+    driver_reg = obs.get_registry()
+    driver_wd = obs_health.Watchdog(registry=driver_reg)
+    driver_wd.add_check(obs_health.heartbeat_check(fabric_core.heartbeats))
+
+    fleet_poller = None
+    if fleet:
+        fleet_poller = FleetPoller(
+            pull_fn=lambda: (
+                client.stats(), client.health(), fabric_core.heartbeats()
+            ),
+            interval_s=float(fleet_interval_s),
+            history=int(fleet_history),
+            registry=driver_reg,
+            events=obs.get_event_log(),
+        ).start()
+
+    def _collect() -> str:
+        obs.heartbeats_to_registry(fabric_core.heartbeats(), driver_reg)
+        return client.metrics_text() + driver_reg.render()
+
+    def _collect_health():
+        report = driver_wd.evaluate()
+        payload = report.to_dict()
+        healthy = report.healthy
+        replicas = client.health()
+        payload["replicas"] = replicas
+        healthy = healthy and all(
+            r.get("healthy", True) for r in replicas
+        )
+        payload["healthy"] = healthy
+        if not healthy:
+            payload["verdict"] = "unhealthy"
+        return healthy, payload
+
+    def _collect_events() -> str:
+        rows = client.recent_events(512)
+        rows += [
+            dict(ev, replica="driver")
+            for ev in obs.get_event_log().tail(128)
+        ]
+        rows.sort(key=lambda e: e.get("ts", 0))
+        return "\n".join(
+            _json.dumps(r, default=str) for r in rows
+        ) + ("\n" if rows else "")
+
+    def _collect_bundle() -> Dict[str, Any]:
+        manifest = client.debug_dump(reason="http", pull=True)
+        files = manifest.setdefault("files_content", {})
+        extra = []
+        # Fleet context rides INTO the bundle driver-side: the replica
+        # wrote its own process's forensics; the driver is the only one
+        # holding the fleet snapshot and the cross-process trace.
+        if fleet_poller is not None:
+            try:
+                files["fleet.json"] = _json.dumps(
+                    fleet_poller.to_dict(), default=str
+                )
+                extra.append("fleet.json")
+            except Exception as exc:  # noqa: BLE001 - record, keep bundle
+                manifest.setdefault("errors", {})["fleet.json"] = repr(exc)
+        try:
+            files["trace_stitched.json"] = _json.dumps(
+                client.export_stitched_trace(n=16)
+            )
+            extra.append("trace_stitched.json")
+        except Exception as exc:  # noqa: BLE001
+            manifest.setdefault("errors", {})[
+                "trace_stitched.json"
+            ] = repr(exc)
+        if extra:
+            manifest["files"] = sorted(
+                set(manifest.get("files", [])) | set(extra)
+            )
+        return manifest
+
+    server = obs.MetricsHTTPServer(
+        collect_text=_collect,
+        collect_json=lambda: {"serve_stats": client.stats()},
+        collect_health=_collect_health,
+        collect_bundle=_collect_bundle,
+        collect_fleet=(
+            fleet_poller.to_dict if fleet_poller is not None else None
+        ),
+        collect_events=_collect_events,
+        collect_traces=lambda: client.export_stitched_trace(n=16),
+        port=int(metrics_port),
+    ).start()
+    return server, fleet_poller
 
 
 def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
@@ -439,9 +564,15 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         bit-identical to spec off; accept rates land in
         stats.spec_stats and the spec_accept_rate metric.
       metrics_port: serve a Prometheus /metrics endpoint (plus /stats
-        JSON) on this driver-side port for the duration of the run,
-        aggregating every replica's registry (0 picks a free port; the
-        chosen URL prints to stderr).
+        JSON, /healthz, /debug/bundle, /fleet, /events, /traces) on
+        this driver-side port for the duration of the run, aggregating
+        every replica's registry (0 picks a free port; the chosen URL
+        prints to stderr). Point `rlt top <host:port>` at it for a live
+        fleet dashboard.
+      fleet: drive the driver-side fleet aggregator behind /fleet
+        (default on; needs metrics_port to be reachable).
+        fleet_interval_s: poll cadence (default 2s); fleet_history:
+        snapshots retained in the history ring (default 128).
       tracing: record request traces on the replicas (default on);
         trace_out: after serving, write the replicas' recent traces as
         Chrome trace-event JSON to this path (opens in Perfetto).
@@ -587,6 +718,11 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     metrics_port = serve_cfg.pop("metrics_port", None)
     trace_out = serve_cfg.pop("trace_out", None)
     profile_s = serve_cfg.pop("profile_s", None)
+    # Fleet aggregation (rides the metrics endpoint): the driver-side
+    # puller behind /fleet, rlt top, and the fleet.json bundle file.
+    fleet_enabled = bool(serve_cfg.pop("fleet", True))
+    fleet_interval_s = float(serve_cfg.pop("fleet_interval_s", 2.0))
+    fleet_history = int(serve_cfg.pop("fleet_history", 128))
     pc = serve_cfg.pop("prefix_cache", "off")
     if isinstance(pc, str):
         pc_norm = pc.strip().lower()
@@ -647,6 +783,7 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         **replica_kwargs,
     )
     metrics_server = None
+    fleet_poller = None
     try:
         if metrics_port is not None:
             # Driver-side Prometheus endpoint for the run's duration:
@@ -654,46 +791,16 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             # driver's own, which carries fabric heartbeat gauges), and
             # /healthz aggregates fabric heartbeat verdicts + every
             # replica's health() RPC — 200 only while nothing is
-            # unhealthy, so an external LB can act on it.
-            from ray_lightning_tpu import obs
-            from ray_lightning_tpu.fabric import core as fabric_core
-            from ray_lightning_tpu.obs import health as obs_health
-
-            driver_reg = obs.get_registry()
-            driver_wd = obs_health.Watchdog(registry=driver_reg)
-            driver_wd.add_check(
-                obs_health.heartbeat_check(fabric_core.heartbeats)
+            # unhealthy, so an external LB can act on it. /fleet,
+            # /events, and /traces serve the fleet plane (rlt top,
+            # post-mortems, the stitched cross-process trace).
+            metrics_server, fleet_poller = _serve_obs_server(
+                client,
+                int(metrics_port),
+                fleet=fleet_enabled,
+                fleet_interval_s=fleet_interval_s,
+                fleet_history=fleet_history,
             )
-
-            def _collect() -> str:
-                obs.heartbeats_to_registry(
-                    fabric_core.heartbeats(), driver_reg
-                )
-                return client.metrics_text() + driver_reg.render()
-
-            def _collect_health():
-                report = driver_wd.evaluate()
-                payload = report.to_dict()
-                healthy = report.healthy
-                replicas = client.health()
-                payload["replicas"] = replicas
-                healthy = healthy and all(
-                    r.get("healthy", True) for r in replicas
-                )
-                payload["healthy"] = healthy
-                if not healthy:
-                    payload["verdict"] = "unhealthy"
-                return healthy, payload
-
-            metrics_server = obs.MetricsHTTPServer(
-                collect_text=_collect,
-                collect_json=lambda: {"serve_stats": client.stats()},
-                collect_health=_collect_health,
-                collect_bundle=lambda: client.debug_dump(
-                    reason="http", pull=True
-                ),
-                port=int(metrics_port),
-            ).start()
             print(
                 f"serve metrics endpoint: {metrics_server.url}",
                 file=sys.stderr,
@@ -734,6 +841,8 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         print(_json.dumps({"serve_stats": stats}))
         return {"outputs": outputs, "stats": stats}
     finally:
+        if fleet_poller is not None:
+            fleet_poller.stop()
         if metrics_server is not None:
             metrics_server.close()
         client.shutdown()
@@ -824,6 +933,128 @@ def run_doctor(config: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _fmt_cell(v: Any, width: int, digits: int = 3) -> str:
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.{digits}f}"
+    else:
+        s = str(v)
+    return s.rjust(width)
+
+
+def render_fleet(payload: Dict[str, Any]) -> str:
+    """One terminal frame of the fleet dashboard from a ``/fleet``
+    payload (latest snapshot + history ring): a header line, one row
+    per replica, and the fleet roll-up. Plain text — the same string
+    pipes cleanly and paints a tty frame."""
+    import datetime as _dt
+
+    latest = payload.get("latest") or {}
+    rows = latest.get("replicas") or []
+    fleet = latest.get("fleet") or {}
+    ts = latest.get("ts")
+    when = (
+        _dt.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+        if ts else "-"
+    )
+    history = payload.get("history") or []
+    out = [
+        f"rlt top — {len(rows)} replica(s) @ {when}  "
+        f"(polls={payload.get('polls', 0)} "
+        f"errors={payload.get('errors', 0)} "
+        f"history={len(history)})",
+        (
+            f"{'replica':>7} {'health':>9} {'queue':>5} {'slots':>7} "
+            f"{'tok/s':>9} {'ttft_p50':>9} {'ttft_p95':>9} "
+            f"{'accept':>7} {'hit':>6} {'goodput':>9}"
+        ),
+    ]
+    for r in rows:
+        out.append(
+            f"{_fmt_cell(r.get('replica'), 7)} "
+            f"{_fmt_cell(r.get('health'), 9)} "
+            f"{_fmt_cell(r.get('queue_depth'), 5)} "
+            + _fmt_cell(
+                f"{r.get('active_slots', 0)}/{r.get('num_slots', 0)}", 7
+            )
+            + f" {_fmt_cell(r.get('tokens_per_sec'), 9, 1)} "
+            f"{_fmt_cell(r.get('ttft_p50_s'), 9, 4)} "
+            f"{_fmt_cell(r.get('ttft_p95_s'), 9, 4)} "
+            f"{_fmt_cell(r.get('spec_accept_rate'), 7, 2)} "
+            f"{_fmt_cell(r.get('prefix_hit_rate'), 6, 2)} "
+            f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)}"
+        )
+    if fleet:
+        out.append(
+            f"fleet: healthy={fleet.get('healthy', 0)}"
+            f"/{fleet.get('replicas', 0)} "
+            f"queue={fleet.get('queue_depth', 0)} "
+            f"tok/s={fleet.get('tokens_per_sec', 0.0)} "
+            f"goodput={fleet.get('goodput_tokens_per_device_s', 0.0)} "
+            f"ttft_p95_worst={fleet.get('ttft_p95_s_worst')}"
+        )
+    return "\n".join(out)
+
+
+def run_top(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``top``: live terminal dashboard over a serve fleet endpoint.
+
+    Usage: ``rlt top <host:port>`` where ``<host:port>`` is the
+    ``--serve.metrics_port`` endpoint (its ``/fleet`` route feeds the
+    dashboard). On a tty it repaints every ``--top.interval_s`` (default
+    2s) until Ctrl-C; piped (or with ``--top.plain true``) it prints
+    one plain-text frame and exits, so ``rlt top addr | grep unhealthy``
+    works in scripts. ``--top.iterations N`` bounds the refresh loop.
+    Returns ``{"snapshot": <last /fleet payload>}``.
+    """
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    cfg = dict(config.pop("top", None) or {})
+    addr = cfg.pop("addr", None) or cfg.pop("url", None)
+    interval_s = float(cfg.pop("interval_s", 2.0))
+    iterations = cfg.pop("iterations", None)
+    plain = bool(cfg.pop("plain", False))
+    timeout = float(cfg.pop("timeout_s", 10.0))
+    if cfg:
+        raise ValueError(f"unknown top options: {sorted(cfg)}")
+    if not addr:
+        raise ValueError(
+            "top requires the serve obs endpoint: rlt top <host:port>"
+        )
+    base = str(addr) if "://" in str(addr) else f"http://{addr}"
+    base = base.rstrip("/")
+    plain = plain or not sys.stdout.isatty()
+    if iterations is None:
+        iterations = 1 if plain else 0  # 0 = refresh until Ctrl-C
+    iterations = int(iterations)
+    count = 0
+    last: Optional[Dict[str, Any]] = None
+    try:
+        while True:
+            body = urllib.request.urlopen(
+                base + "/fleet", timeout=timeout
+            ).read()
+            last = _json.loads(body)
+            frame = render_fleet(last)
+            if plain:
+                print(frame)
+            else:
+                # Clear + home, one repaint per poll — a dumb-terminal
+                # dashboard, no curses dependency.
+                sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+                sys.stdout.flush()
+            count += 1
+            if iterations and count >= iterations:
+                break
+            _time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return {"snapshot": last}
+
+
 def run_tokenize(config: Dict[str, Any]) -> Dict[str, Any]:
     """``tokenize``: train (or load) a ByteBPETokenizer and optionally
     encode the corpus into a pretraining shard.
@@ -901,6 +1132,8 @@ def main(argv: Optional[List[str]] = None) -> Any:
         return run_serve(config)
     if subcommand == "doctor":
         return run_doctor(config)
+    if subcommand == "top":
+        return run_top(config)
     trainer, model, datamodule = build(config)
     fn = getattr(trainer, subcommand)
     if datamodule is not None:

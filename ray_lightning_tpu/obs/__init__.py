@@ -35,8 +35,13 @@ ACTIVE (judgment — something looks through the eyes):
   transitions and fit crashes, or on demand via ``debug_dump`` RPCs and
   ``rlt doctor``.
 - :mod:`obs.httpd` — the /metrics + /stats + /healthz + /debug/bundle
-  HTTP endpoint (:class:`MetricsHTTPServer`) behind
-  ``rlt serve --serve.metrics_port``.
+  (+ /fleet + /events + /traces) HTTP endpoint
+  (:class:`MetricsHTTPServer`) behind ``rlt serve --serve.metrics_port``.
+- :mod:`obs.fleet` — the fleet aggregator (:class:`FleetPoller`,
+  :class:`FleetSnapshot`): a driver-side puller condensing every
+  replica's stats/health into one bounded-history snapshot stream —
+  the ``/fleet`` route's and ``rlt top``'s feed, and the signal plane a
+  router/autoscaler consumes.
 
 Import cost: everything here is stdlib-only at import time; jax loads
 only when profiling/monitoring is actually used, so the fabric can ship
@@ -48,6 +53,12 @@ from ray_lightning_tpu.obs.blackbox import (
     read_bundle,
 )
 from ray_lightning_tpu.obs.events import EventLog, get_event_log
+from ray_lightning_tpu.obs.fleet import (
+    FleetPoller,
+    FleetSnapshot,
+    aggregate_fleet,
+    summarize_replica,
+)
 from ray_lightning_tpu.obs.health import (
     ComponentHealth,
     HealthReport,
@@ -72,6 +83,7 @@ from ray_lightning_tpu.obs.telemetry import (
 )
 from ray_lightning_tpu.obs.trace import (
     RequestTracer,
+    merge_chrome_trace,
     to_chrome_trace,
 )
 
@@ -79,6 +91,8 @@ __all__ = [
     "ComponentHealth",
     "Counter",
     "EventLog",
+    "FleetPoller",
+    "FleetSnapshot",
     "FlightRecorder",
     "Gauge",
     "HealthReport",
@@ -89,6 +103,7 @@ __all__ = [
     "SLORule",
     "TrainTelemetry",
     "Watchdog",
+    "aggregate_fleet",
     "capture_profile",
     "compile_stats",
     "dump_bundle",
@@ -96,9 +111,11 @@ __all__ = [
     "get_registry",
     "heartbeats_to_registry",
     "install_compile_listener",
+    "merge_chrome_trace",
     "parse_prometheus_text",
     "parse_slo_rules",
     "profiler_available",
     "read_bundle",
+    "summarize_replica",
     "to_chrome_trace",
 ]

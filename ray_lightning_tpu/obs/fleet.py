@@ -1,0 +1,290 @@
+"""Fleet aggregation: one queryable surface over N serving replicas.
+
+PR 4/5 made each PROCESS observable (its own registry, tracer, event
+ring, watchdog); this module rolls the fleet up. A driver-side
+:class:`FleetPoller` periodically pulls every replica's stats snapshot
+and health verdict (plus the fabric heartbeat table) through one
+``pull_fn`` and condenses them into a :class:`FleetSnapshot`:
+
+- ``replicas``: one compact row per replica — queue depth, active
+  slots, tokens/s, TTFT p50/p95, spec accept rate, prefix hit rate,
+  health verdict, and goodput (emitted tokens per device-second, from
+  the cost ledger) — the exact surface a router/autoscaler consumes;
+- ``fleet``: the roll-up — replica/healthy counts, total queue depth,
+  aggregate tokens/s, fleet goodput (sum of emitted tokens over sum of
+  device-seconds, NOT a mean of ratios), worst TTFT p95;
+- ``heartbeats``: the fabric's worker heartbeat table, verbatim.
+
+Snapshots land in a bounded history ring (so ``/fleet`` can show a
+short trend without unbounded memory) and, when a registry is wired,
+in ``rlt_fleet_*`` gauges next to the per-replica series. The poller
+owns one daemon thread; a pull that raises is recorded (``errors``
+counter + an event) and skipped — a dead replica must not kill the
+control plane that would report it dead.
+
+Consumed by ``rlt serve --serve.metrics_port`` (the ``/fleet`` route),
+``rlt top`` (the live terminal dashboard), and ``rlt doctor`` bundles
+(``fleet.json``). The observer effect of an aggressive poll cadence is
+benched as ``fleet_overhead`` next to ``obs_overhead``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: Map verdict strings onto the rlt_fleet_replica_health gauge.
+_VERDICT_SCORE = {"healthy": 1.0, "degraded": 0.5, "unhealthy": 0.0}
+
+
+def summarize_replica(
+    stats: Dict[str, Any],
+    health: Optional[Dict[str, Any]] = None,
+    index: int = 0,
+) -> Dict[str, Any]:
+    """One replica's dashboard row from its stats snapshot + health
+    report — the compact, stable schema FleetSnapshot.replicas carries
+    (full snapshots stay on the replica; the fleet plane only ships
+    what a router/autoscaler/dashboard acts on)."""
+    cost = dict(stats.get("cost") or {})
+    verdict = (health or {}).get("verdict")
+    if verdict is None:
+        verdict = stats.get("health", "unknown")
+    return {
+        "replica": int(index),
+        "health": str(verdict),
+        "queue_depth": int(stats.get("queue_depth", 0)),
+        "active_slots": int(stats.get("active_slots", 0)),
+        "num_slots": int(stats.get("num_slots", 0)),
+        "occupancy": float(stats.get("occupancy", 0.0)),
+        "tokens_per_sec": float(stats.get("tokens_per_sec", 0.0)),
+        "decode_tokens_per_sec": float(
+            stats.get("decode_tokens_per_sec", 0.0)
+        ),
+        "ttft_p50_s": stats.get("ttft_p50_s"),
+        "ttft_p95_s": stats.get("ttft_p95_s"),
+        "spec_accept_rate": stats.get("spec_accept_rate"),
+        "prefix_hit_rate": stats.get("prefix_hit_rate"),
+        "submitted": int(stats.get("submitted", 0)),
+        "finished": int(stats.get("finished", 0)),
+        "compiles_since_init": int(stats.get("compiles_since_init", 0)),
+        # Goodput inputs ride along so the fleet ratio can be computed
+        # as sum/sum instead of a mean of per-replica ratios.
+        "cost_emitted_tokens": int(cost.get("emitted_tokens", 0)),
+        "cost_device_seconds": float(cost.get("device_seconds", 0.0)),
+        "goodput_tokens_per_device_s": float(
+            cost.get("goodput_tokens_per_device_s", 0.0)
+        ),
+    }
+
+
+def aggregate_fleet(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The fleet roll-up over per-replica rows (sum/sum goodput, worst
+    TTFT p95, healthy count)."""
+    toks = sum(r["cost_emitted_tokens"] for r in rows)
+    dev = sum(r["cost_device_seconds"] for r in rows)
+    p95s = [r["ttft_p95_s"] for r in rows if r.get("ttft_p95_s") is not None]
+    return {
+        "replicas": len(rows),
+        "healthy": sum(1 for r in rows if r["health"] == "healthy"),
+        "queue_depth": sum(r["queue_depth"] for r in rows),
+        "active_slots": sum(r["active_slots"] for r in rows),
+        "num_slots": sum(r["num_slots"] for r in rows),
+        "tokens_per_sec": round(
+            sum(r["tokens_per_sec"] for r in rows), 3
+        ),
+        "emitted_tokens": toks,
+        "device_seconds": round(dev, 6),
+        "goodput_tokens_per_device_s": (
+            round(toks / dev, 3) if dev > 0 else 0.0
+        ),
+        "ttft_p95_s_worst": max(p95s) if p95s else None,
+    }
+
+
+@dataclass
+class FleetSnapshot:
+    """One poll of the whole fleet (the ``/fleet`` payload unit)."""
+
+    ts: float
+    replicas: List[Dict[str, Any]]
+    fleet: Dict[str, Any]
+    heartbeats: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "ts": self.ts,
+            "replicas": self.replicas,
+            "fleet": self.fleet,
+            "heartbeats": self.heartbeats,
+        }
+
+
+#: pull_fn contract: () -> (stats_list, health_list_or_None,
+#: heartbeats_or_None); stats_list[i] is replica i's stats snapshot.
+PullFn = Callable[
+    [],
+    Tuple[
+        List[Dict[str, Any]],
+        Optional[List[Dict[str, Any]]],
+        Optional[Dict[str, Any]],
+    ],
+]
+
+
+class FleetPoller:
+    """Background fleet aggregator: pull -> condense -> ring + gauges.
+
+    ``history`` bounds the ring; ``interval_s`` is the poll cadence
+    (production default seconds — the bench runs it 100x faster to
+    measure the observer effect). ``to_dict()`` is the ``/fleet``
+    payload: the latest snapshot plus the history ring.
+    """
+
+    def __init__(
+        self,
+        pull_fn: PullFn,
+        interval_s: float = 2.0,
+        history: int = 128,
+        registry: Optional[Any] = None,
+        events: Optional[Any] = None,
+    ) -> None:
+        self._pull = pull_fn
+        self.interval_s = float(interval_s)
+        self.history = max(1, int(history))
+        self._events = events
+        self._lock = threading.Lock()
+        self._ring: List[Dict[str, Any]] = []
+        self._errors = 0
+        self._polls = 0
+        self._reg = None
+        if registry is not None:
+            self._reg = {
+                "replicas": registry.gauge(
+                    "rlt_fleet_replicas", "Replicas in the fleet snapshot"
+                ),
+                "healthy": registry.gauge(
+                    "rlt_fleet_replicas_healthy",
+                    "Replicas whose verdict is healthy",
+                ),
+                "queue": registry.gauge(
+                    "rlt_fleet_queue_depth", "Fleet-wide queued requests"
+                ),
+                "tps": registry.gauge(
+                    "rlt_fleet_tokens_per_sec",
+                    "Fleet-wide emitted tokens per second",
+                ),
+                "goodput": registry.gauge(
+                    "rlt_fleet_goodput_tokens_per_device_second",
+                    "Fleet emitted tokens per estimated device-second",
+                ),
+                "health": registry.gauge(
+                    "rlt_fleet_replica_health",
+                    "Per-replica health (1 healthy, 0.5 degraded, "
+                    "0 unhealthy)",
+                ),
+                "polls": registry.counter(
+                    "rlt_fleet_polls_total", "Fleet snapshot pulls"
+                ),
+                "errors": registry.counter(
+                    "rlt_fleet_poll_errors_total",
+                    "Fleet pulls that raised and were skipped",
+                ),
+            }
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one poll ---------------------------------------------------------
+    def poll_now(self) -> FleetSnapshot:
+        """Pull + condense one snapshot NOW (raises on pull failure —
+        the loop wraps it; direct callers see the real error)."""
+        stats_list, health_list, heartbeats = self._pull()
+        health_list = health_list or []
+        rows = [
+            summarize_replica(
+                stats,
+                health_list[i] if i < len(health_list) else None,
+                index=i,
+            )
+            for i, stats in enumerate(stats_list)
+        ]
+        snap = FleetSnapshot(
+            ts=time.time(),
+            replicas=rows,
+            fleet=aggregate_fleet(rows),
+            heartbeats=dict(heartbeats or {}),
+        )
+        with self._lock:
+            self._ring.append(snap.to_dict())
+            if len(self._ring) > self.history:
+                del self._ring[: len(self._ring) - self.history]
+            self._polls += 1
+        if self._reg is not None:
+            f = snap.fleet
+            self._reg["replicas"].set(f["replicas"])
+            self._reg["healthy"].set(f["healthy"])
+            self._reg["queue"].set(f["queue_depth"])
+            self._reg["tps"].set(f["tokens_per_sec"])
+            self._reg["goodput"].set(f["goodput_tokens_per_device_s"])
+            for r in rows:
+                self._reg["health"].set(
+                    _VERDICT_SCORE.get(r["health"], 0.0),
+                    replica=r["replica"],
+                )
+            self._reg["polls"].inc(1)
+        return snap
+
+    # -- read side --------------------------------------------------------
+    def latest(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._ring[-1]) if self._ring else None
+
+    def history_list(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The ``/fleet`` payload: latest snapshot + bounded history."""
+        with self._lock:
+            ring = list(self._ring)
+            errors = self._errors
+            polls = self._polls
+        return {
+            "latest": ring[-1] if ring else None,
+            "history": ring,
+            "polls": polls,
+            "errors": errors,
+            "interval_s": self.interval_s,
+        }
+
+    # -- thread lifecycle -------------------------------------------------
+    def start(self) -> "FleetPoller":
+        self._thread = threading.Thread(
+            target=self._loop, name="obs-fleet-poller", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_now()
+            except Exception as exc:  # noqa: BLE001 - a dead replica
+                # must not kill the plane that would report it dead.
+                with self._lock:
+                    self._errors += 1
+                if self._reg is not None:
+                    self._reg["errors"].inc(1)
+                if self._events is not None:
+                    self._events.record(
+                        "fleet", "poll_error", level="warn",
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                    )
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,4 +1,5 @@
-"""Tiny obs HTTP endpoint: /metrics, /stats, /healthz, /debug/bundle.
+"""Tiny obs HTTP endpoint: /metrics, /stats, /healthz, /debug/bundle,
+/fleet, /events, /traces.
 
 Standard-library only (http.server in a daemon thread). The handler
 calls the collector functions PER REQUEST, so a scrape always sees
@@ -23,6 +24,14 @@ unconditional ``ok`` (a liveness probe: the process answers HTTP).
 ``/debug/bundle`` triggers ``collect_bundle`` — a flight-recorder dump
 returning its manifest (and, typically, the bundle files inline) — the
 transport behind ``rlt doctor --doctor.bundle``.
+
+The fleet routes (PR 8): ``/fleet`` serves ``collect_fleet`` (the
+latest :class:`obs.fleet.FleetSnapshot` + history ring — ``rlt top``'s
+feed), ``/events`` serves ``collect_events`` as JSONL (the merged
+structured event rings), and ``/traces`` serves ``collect_traces``
+(the stitched cross-process Chrome trace — save it and open in
+Perfetto). All three are collector-gated exactly like the others: an
+endpoint without the collector 404s.
 """
 from __future__ import annotations
 
@@ -43,6 +52,9 @@ class MetricsHTTPServer:
             Callable[[], Tuple[bool, Dict[str, Any]]]
         ] = None,
         collect_bundle: Optional[Callable[[], Dict[str, Any]]] = None,
+        collect_fleet: Optional[Callable[[], Dict[str, Any]]] = None,
+        collect_events: Optional[Callable[[], str]] = None,
+        collect_traces: Optional[Callable[[], Dict[str, Any]]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ) -> None:
@@ -50,6 +62,9 @@ class MetricsHTTPServer:
         self._collect_json = collect_json
         self._collect_health = collect_health
         self._collect_bundle = collect_bundle
+        self._collect_fleet = collect_fleet
+        self._collect_events = collect_events
+        self._collect_traces = collect_traces
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -80,6 +95,28 @@ class MetricsHTTPServer:
                     ):
                         body = json.dumps(
                             outer._collect_bundle(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif (
+                        path == "/fleet"
+                        and outer._collect_fleet is not None
+                    ):
+                        body = json.dumps(
+                            outer._collect_fleet(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif (
+                        path == "/events"
+                        and outer._collect_events is not None
+                    ):
+                        body = outer._collect_events().encode()
+                        ctype = "application/x-ndjson"
+                    elif (
+                        path == "/traces"
+                        and outer._collect_traces is not None
+                    ):
+                        body = json.dumps(
+                            outer._collect_traces(), default=str
                         ).encode()
                         ctype = "application/json"
                     else:

@@ -35,6 +35,12 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 # -- span names (the typed vocabulary) ---------------------------------
+#: Request left the DRIVER-side client (recorded by ServeClient in its
+#: own process-local tracer — the cross-process anchor every stitched
+#: trace hangs off: replica/follower spans resolve back to it by
+#: request id, and the client→admitted gap becomes the derived
+#: ``client_wait`` span in :func:`merge_chrome_trace`).
+SPAN_CLIENT_SUBMIT = "client_submit"
 SPAN_SUBMIT = "submit"          #: request arrived at the RPC surface
 SPAN_QUEUED = "queued"          #: entered the scheduler queue
 SPAN_ADMITTED = "admitted"      #: entered an engine slot
@@ -67,6 +73,11 @@ class RequestTracer:
         self.enabled = bool(enabled)
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=self.capacity)
+        #: Wall-clock minus monotonic at construction. Events record on
+        #: the cheap monotonic clock; cross-process merges add this
+        #: offset so rings recorded in different processes (each with its
+        #: own monotonic base) align on one wall-clock timeline.
+        self.wall_offset = time.time() - time.monotonic()
 
     # -- hot path ---------------------------------------------------------
     def event(
@@ -129,6 +140,16 @@ class RequestTracer:
                 seen.append(rid)
         return seen
 
+    def dump(self, n: int = 16) -> Dict[str, Any]:
+        """The wire form of this process's ring for cross-process trace
+        stitching: the ``n`` most recent traces plus the wall-clock
+        offset :func:`merge_chrome_trace` needs to align them with rings
+        from other processes."""
+        return {
+            "wall_offset": self.wall_offset,
+            "traces": self.recent_traces(n),
+        }
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._events)
@@ -150,34 +171,15 @@ def _first_t(evs: List[Dict[str, Any]], spans: Tuple[str, ...]) -> Optional[floa
     return None
 
 
-def to_chrome_trace(
+def _emit_tracks(
+    events: List[Dict[str, Any]],
+    pid: int,
     traces: Dict[str, List[Dict[str, Any]]],
-    process_name: str = "rlt-serve",
-    pid: int = 0,
-) -> Dict[str, Any]:
-    """Convert ``{request_id: [event, ...]}`` into Chrome trace-event
-    JSON (dict form; ``json.dump`` it to get a file Perfetto opens).
-
-    Each request gets its own thread track (tid). Derived lifecycle
-    phases become complete ("X") events; every raw marker becomes an
-    instant ("i") event carrying its attrs as args. Timestamps are
-    microseconds relative to the earliest event in the export.
-    """
-    all_t = [ev["t"] for evs in traces.values() for ev in evs]
-    t0 = min(all_t) if all_t else 0.0
-
-    def us(t: float) -> float:
-        return round((t - t0) * 1e6, 1)
-
-    events: List[Dict[str, Any]] = [
-        {
-            "ph": "M",
-            "name": "process_name",
-            "pid": pid,
-            "tid": 0,
-            "args": {"name": process_name},
-        }
-    ]
+    us,
+) -> None:
+    """Append one process's request tracks (thread metadata, derived
+    lifecycle phases, raw markers) onto ``events``. Shared by the
+    single-process and merged exports so both render identically."""
     for tid, (rid, evs) in enumerate(sorted(traces.items()), start=1):
         events.append(
             {
@@ -219,6 +221,122 @@ def to_chrome_trace(
                     "tid": tid,
                     "ts": us(ev["t"]),
                     "args": args,
+                }
+            )
+
+
+def to_chrome_trace(
+    traces: Dict[str, List[Dict[str, Any]]],
+    process_name: str = "rlt-serve",
+    pid: int = 0,
+) -> Dict[str, Any]:
+    """Convert ``{request_id: [event, ...]}`` into Chrome trace-event
+    JSON (dict form; ``json.dump`` it to get a file Perfetto opens).
+
+    Each request gets its own thread track (tid). Derived lifecycle
+    phases become complete ("X") events; every raw marker becomes an
+    instant ("i") event carrying its attrs as args. Timestamps are
+    microseconds relative to the earliest event in the export.
+    """
+    all_t = [ev["t"] for evs in traces.values() for ev in evs]
+    t0 = min(all_t) if all_t else 0.0
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 1)
+
+    events: List[Dict[str, Any]] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": process_name},
+        }
+    ]
+    _emit_tracks(events, pid, traces, us)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def merge_chrome_trace(
+    processes: List[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Stitch several processes' trace rings into ONE Chrome trace.
+
+    ``processes`` is a list of ``{"name", "traces", "wall_offset"}``
+    dicts — the :meth:`RequestTracer.dump` wire form plus a display
+    name (``client`` / ``replica0`` / ``follower1`` ...). Each process
+    becomes its own pid track (process_name metadata), each request its
+    own thread track within it, and every event's monotonic timestamp
+    is shifted by its process's ``wall_offset`` so spans recorded on
+    different monotonic bases line up on one wall-clock timeline.
+
+    Cross-process derivation: a request with a :data:`SPAN_CLIENT_SUBMIT`
+    in one process and a :data:`SPAN_ADMITTED` (or first token) in
+    another gets a ``client_wait`` complete span on the client's track —
+    the client-observed queue time (RPC hop + scheduler queue) that no
+    single process's ring can see.
+    """
+    norm: List[Tuple[int, str, Dict[str, List[Dict[str, Any]]]]] = []
+    for pid, proc in enumerate(processes):
+        off = float(proc.get("wall_offset") or 0.0)
+        traces = {
+            rid: [dict(ev, t=float(ev["t"]) + off) for ev in evs]
+            for rid, evs in (proc.get("traces") or {}).items()
+            if evs
+        }
+        norm.append((pid, str(proc.get("name") or f"process{pid}"), traces))
+
+    all_t = [
+        ev["t"] for _, _, traces in norm
+        for evs in traces.values() for ev in evs
+    ]
+    t0 = min(all_t) if all_t else 0.0
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 1)
+
+    events: List[Dict[str, Any]] = []
+    for pid, name, traces in norm:
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": name},
+            }
+        )
+        _emit_tracks(events, pid, traces, us)
+
+    # The cross-process span: client submit -> remote admission (falling
+    # back to the first token for engines driven without a scheduler).
+    landed: Dict[str, float] = {}
+    for _, _, traces in norm:
+        for rid, evs in traces.items():
+            t_adm = _first_t(
+                sorted(evs, key=lambda e: e["t"]),
+                (SPAN_ADMITTED, SPAN_FIRST_TOKEN),
+            )
+            if t_adm is not None and (
+                rid not in landed or t_adm < landed[rid]
+            ):
+                landed[rid] = t_adm
+    for pid, _, traces in norm:
+        for tid, (rid, evs) in enumerate(sorted(traces.items()), start=1):
+            t_sub = _first_t(evs, (SPAN_CLIENT_SUBMIT,))
+            t_adm = landed.get(rid)
+            if t_sub is None or t_adm is None or t_adm < t_sub:
+                continue
+            events.append(
+                {
+                    "ph": "X",
+                    "name": "client_wait",
+                    "cat": "lifecycle",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": us(t_sub),
+                    "dur": max(round((t_adm - t_sub) * 1e6, 1), 0.1),
+                    "args": {"request_id": rid},
                 }
             )
     return {"traceEvents": events, "displayTimeUnit": "ms"}

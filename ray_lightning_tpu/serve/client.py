@@ -6,15 +6,24 @@ Tuner gang-schedules trials) and hands back a ServeClient. The client
 round-robins submissions across replicas and streams tokens by polling
 each replica's ``result`` endpoint (the poll blocks briefly replica-side,
 so streaming costs ~one RPC per emitted token burst, not per token).
+
+The client is also the fleet's trace anchor: it mints each request id
+before the submit RPC departs and records a ``client_submit`` span in
+its own ring, so ``export_stitched_trace()`` can merge the client,
+every replica, and every gang follower into ONE wall-clock-aligned
+Chrome trace (see obs.trace.merge_chrome_trace).
 """
 from __future__ import annotations
 
 import itertools
+import json
 import time
+import uuid
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from ray_lightning_tpu import fabric
+from ray_lightning_tpu.obs import trace as _trace
 from ray_lightning_tpu.serve.server import ServeReplica
 
 
@@ -37,6 +46,7 @@ class ServeClient:
         replicas: List[Any],
         pg: Any = None,
         followers: Optional[List[Any]] = None,
+        tracer: Optional[Any] = None,
     ) -> None:
         if not replicas:
             raise ValueError("need at least one replica")
@@ -44,6 +54,11 @@ class ServeClient:
         self._followers = list(followers or [])
         self._pg = pg
         self._rr = itertools.cycle(range(len(self._replicas)))
+        #: Driver-side trace ring: the client records a ``client_submit``
+        #: span per request (under the SAME id the replica traces carry
+        #: — the client mints it), so the stitched export shows the
+        #: client-observed queue time that no replica ring can see.
+        self.tracer = tracer or _trace.RequestTracer(capacity=4096)
 
     # -- request API -----------------------------------------------------
     def submit(
@@ -54,11 +69,20 @@ class ServeClient:
         **sampling: Any,
     ) -> RequestHandle:
         """Queue a request (round-robin across replicas unless pinned);
-        sampling kwargs mirror ServeReplica.submit."""
+        sampling kwargs mirror ServeReplica.submit (including ``tenant``
+        for cost-ledger attribution)."""
         idx = next(self._rr) if replica is None else int(replica)
+        # The client mints the id so its submit span and every remote
+        # span share it BEFORE the RPC departs (trace context carried
+        # across the process hop).
+        rid = sampling.pop("request_id", None) or uuid.uuid4().hex[:12]
+        self.tracer.event(
+            rid, _trace.SPAN_CLIENT_SUBMIT,
+            attrs={"replica": idx, "prompt_tokens": len(prompt)},
+        )
         rid = fabric.get(
             self._replicas[idx].submit.remote(
-                [int(t) for t in prompt], **sampling
+                [int(t) for t in prompt], request_id=rid, **sampling
             )
         )
         return RequestHandle(replica=idx, request_id=rid)
@@ -145,7 +169,8 @@ class ServeClient:
         self, handle: Optional[RequestHandle] = None, n: int = 8
     ) -> Dict[str, Any]:
         """Chrome trace-event JSON for one request (or replica 0's ``n``
-        most recent when no handle is given)."""
+        most recent when no handle is given). Single-process view; see
+        :meth:`export_stitched_trace` for the cross-process merge."""
         if handle is not None:
             return fabric.get(
                 self._replicas[handle.replica].export_trace.remote(
@@ -153,6 +178,55 @@ class ServeClient:
                 )
             )
         return fabric.get(self._replicas[0].export_trace.remote(None, n))
+
+    def trace_dumps(self, n: int = 16) -> List[Dict[str, Any]]:
+        """Every process's trace ring in the stitching wire form: the
+        client's own, each replica's, and each gang follower's, tagged
+        with display names (``client`` / ``replica{i}`` /
+        ``follower{j}``). Follower pulls are best-effort — a wedged
+        follower must not block the trace of the gang that wedged it."""
+        dumps = [{"name": "client", **self.tracer.dump(n)}]
+        for i, d in enumerate(
+            fabric.get([r.trace_dump.remote(n) for r in self._replicas])
+        ):
+            dumps.append({"name": f"replica{i}", **d})
+        for j, f in enumerate(self._followers):
+            try:
+                d = fabric.get(f.trace_dump.remote(n), timeout=30.0)
+            except Exception:  # noqa: BLE001 - best-effort forensics
+                continue
+            dumps.append({"name": f"follower{j}", **d})
+        return dumps
+
+    def export_stitched_trace(self, n: int = 16) -> Dict[str, Any]:
+        """ONE Chrome trace across every process a request touched:
+        client submit spans, each replica's scheduler/engine spans, and
+        gang-follower spans, on distinct process tracks aligned on the
+        wall clock (the ``/traces`` route's and ``rlt doctor``'s
+        stitched artifact)."""
+        from ray_lightning_tpu.obs.trace import merge_chrome_trace
+
+        return merge_chrome_trace(self.trace_dumps(n))
+
+    def recent_events(self, n: int = 256) -> List[Dict[str, Any]]:
+        """The fleet's structured event rings merged on wall-clock ts,
+        each event tagged with its source replica."""
+        rows: List[Dict[str, Any]] = []
+        for i, evs in enumerate(
+            fabric.get(
+                [r.recent_events.remote(n) for r in self._replicas]
+            )
+        ):
+            rows.extend({**ev, "replica": i} for ev in evs)
+        rows.sort(key=lambda e: e.get("ts", 0))
+        return rows[-int(n):]
+
+    def events_jsonl(self, n: int = 256) -> str:
+        """The merged event tail as JSONL (the ``/events`` route body)."""
+        rows = self.recent_events(n)
+        return "\n".join(
+            json.dumps(r, default=str) for r in rows
+        ) + ("\n" if rows else "")
 
     def health(self) -> List[Dict[str, Any]]:
         """Per-replica health reports (obs.health), index-aligned with

@@ -90,6 +90,30 @@ class ServeMetrics:
                     "Per-device resident bytes of engine device state "
                     "by component",
                 ),
+                # Cost ledger: one record per terminal request
+                # (finish/cancel/expire), tenant-labelled so a
+                # multi-tenant deployment can bill/attribute per key.
+                "cost_requests": registry.counter(
+                    "rlt_serve_request_cost_requests_total",
+                    "Terminal requests in the cost ledger by outcome",
+                ),
+                "cost_tokens": registry.counter(
+                    "rlt_serve_request_cost_tokens_total",
+                    "Tokens emitted, attributed per request at terminal",
+                ),
+                "cost_device_seconds": registry.counter(
+                    "rlt_serve_request_cost_device_seconds_total",
+                    "Estimated device-seconds consumed per request",
+                ),
+                "cost_queue_seconds": registry.counter(
+                    "rlt_serve_request_cost_queue_seconds_total",
+                    "Seconds spent queued before admission per request",
+                ),
+                "goodput": registry.gauge(
+                    "rlt_serve_goodput_tokens_per_device_second",
+                    "Sliding-window emitted tokens per estimated "
+                    "device-second",
+                ),
             }
         # Lifecycle counters (monotonic).
         self.submitted = 0
@@ -113,6 +137,10 @@ class ServeMetrics:
         #: (verifies, drafted, accepted) per engine step with spec on —
         #: the propose-then-verify accounting behind spec_accept_rate.
         self._spec: deque = deque(maxlen=window)
+        #: Cost-ledger records (one dict per terminal request — see
+        #: Scheduler's ledger): the sliding window behind the ``cost``
+        #: stats block and the goodput gauge.
+        self._costs: deque = deque(maxlen=window)
         self._queue_depth = 0
         self._started = time.monotonic()
         self._last_log = 0.0
@@ -233,6 +261,41 @@ class ServeMetrics:
             self._reg["spec_drafted"].inc(int(drafted))
             self._reg["spec_accepted"].inc(int(accepted))
 
+    def record_cost(self, record: Dict[str, Any]) -> None:
+        """One terminal request's accounting record (the scheduler's
+        cost ledger emits it at finish/cancel/expire): windowed for the
+        stats ``cost`` block, mirrored into the tenant-labelled
+        ``rlt_serve_request_cost_*`` counters, and folded into the
+        sliding-window goodput gauge (emitted tokens per estimated
+        device-second)."""
+        with self._lock:
+            self._costs.append(dict(record))
+            if self._reg is not None:
+                toks = sum(r["emitted_tokens"] for r in self._costs)
+                dev = sum(r["device_s"] for r in self._costs)
+        if self._reg is not None:
+            tenant = record.get("tenant") or "default"
+            self._reg["cost_requests"].inc(
+                1, tenant=tenant, outcome=record.get("outcome", "finished")
+            )
+            self._reg["cost_tokens"].inc(
+                int(record.get("emitted_tokens", 0)), tenant=tenant
+            )
+            self._reg["cost_device_seconds"].inc(
+                float(record.get("device_s", 0.0)), tenant=tenant
+            )
+            self._reg["cost_queue_seconds"].inc(
+                float(record.get("queue_s", 0.0)), tenant=tenant
+            )
+            self._reg["goodput"].set(
+                round(toks / dev, 3) if dev > 0 else 0.0
+            )
+
+    def cost_records(self) -> list:
+        """The cost-ledger window, oldest first (tests, fleet tooling)."""
+        with self._lock:
+            return [dict(r) for r in self._costs]
+
     def record_memory(self, mem: Dict[str, Any]) -> None:
         """Resident-footprint gauges from ``engine.memory_stats()``:
         ``rlt_serve_hbm_bytes{component=...}`` carries PER-DEVICE bytes
@@ -335,6 +398,35 @@ class ServeMetrics:
                 out["draft_tokens_per_verify"] = (
                     round(d / v, 4) if v else 0.0
                 )
+            # Cost ledger: per-request accounting aggregated over the
+            # window; goodput = emitted tokens per estimated
+            # device-second (sum/sum — the fleet plane rolls replicas up
+            # the same way so the fleet ratio stays a true ratio).
+            if self._costs:
+                costs = list(self._costs)
+                c_toks = sum(r["emitted_tokens"] for r in costs)
+                c_dev = sum(r["device_s"] for r in costs)
+                out["cost"] = {
+                    "requests": len(costs),
+                    "emitted_tokens": c_toks,
+                    "device_seconds": round(c_dev, 6),
+                    "goodput_tokens_per_device_s": (
+                        round(c_toks / c_dev, 3) if c_dev > 0 else 0.0
+                    ),
+                    "queue_s_mean": round(
+                        sum(r["queue_s"] for r in costs) / len(costs), 6
+                    ),
+                    "decode_folds": sum(r["decode_folds"] for r in costs),
+                    "prefill_chunks": sum(
+                        r["prefill_chunks"] for r in costs
+                    ),
+                    "prefix_hit_tokens": sum(
+                        r["prefix_hit_tokens"] for r in costs
+                    ),
+                    "spec_accepted_tokens": round(
+                        sum(r["spec_accepted_tokens"] for r in costs), 3
+                    ),
+                }
             return out
 
     def maybe_log(self, every_s: float = 10.0) -> Optional[Dict[str, Any]]:

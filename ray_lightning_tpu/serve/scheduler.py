@@ -15,6 +15,17 @@ within a priority, with optional aging toward priority 0 via
 ``priority_age_s`` so sustained high-priority traffic can't starve the
 rest forever), and an optional deadline.
 
+The scheduler also keeps the COST LEDGER: per-request accounting
+(queue seconds, prefill chunks, prefix-cache hits, decode folds,
+speculative accept shares, emitted tokens, and an estimated
+device-seconds figure — each step's wall time split over its resident
+requests) accumulated from submit to terminal and emitted as one
+record at finish/cancel/expire through ``ServeMetrics.record_cost``
+(windowed ``cost`` stats + tenant-labelled ``rlt_serve_request_cost_*``
+series) and a ``request_cost`` typed event. Emitted-token totals
+balance exactly against the engine token counter (test-asserted), so
+goodput — emitted tokens per device-second — is a true ratio.
+
 The scheduler owns no threads: ``step()`` is driven by whoever hosts the
 engine (ServeReplica's loop thread, a test, the bench). ``submit`` /
 ``cancel`` are thread-safe so a replica's RPC surface can feed the loop.
@@ -62,6 +73,10 @@ class Request:
     sampling: SamplingParams = field(default_factory=SamplingParams)
     request_id: str = ""
     priority: int = 0
+    #: Optional tenant/API-key label: rides into the cost ledger and the
+    #: tenant-labelled ``rlt_serve_request_cost_*`` series (None bills
+    #: to the "default" tenant).
+    tenant: Optional[str] = None
     #: Relative deadline in seconds from submission; queued requests past
     #: it are expired, in-flight ones are cancelled at the next boundary.
     deadline_s: Optional[float] = None
@@ -141,6 +156,56 @@ class Scheduler:
         #: still find them so a cancel racing an admission is honored at
         #: the next boundary instead of reported unknown.
         self._admitting: set = set()
+        #: Cost ledger: per-request accounting accumulated from submit
+        #: to terminal (queue_s, chunks, folds, emitted tokens, an
+        #: estimated device-seconds share) and emitted as ONE record at
+        #: finish/cancel/expire via metrics.record_cost + a typed event.
+        self._acct: Dict[str, Dict[str, Any]] = {}
+
+    # -- cost ledger ------------------------------------------------------
+    def _acct_open(self, req: Request) -> None:
+        self._acct[req.request_id] = {
+            "request_id": req.request_id,
+            "tenant": req.tenant,
+            "prompt_tokens": len(req.prompt),
+            "submitted_at": req.submitted_at,
+            "queue_s": 0.0,
+            "prefill_chunks": 0,
+            "prefix_hit_tokens": 0,
+            "decode_folds": 0,
+            "spec_verifies": 0.0,
+            "spec_accepted_tokens": 0.0,
+            "emitted_tokens": 0,
+            "device_s": 0.0,
+        }
+
+    def _acct_close(self, rid: str, outcome: str) -> None:
+        """Finalize one request's ledger record and emit it (metrics
+        window + Prometheus series + a typed event). Safe to call for
+        unknown ids (already flushed / submitted before a restart)."""
+        rec = self._acct.pop(rid, None)
+        if rec is None:
+            return
+        rec["outcome"] = outcome
+        rec["total_s"] = round(
+            time.monotonic() - rec.pop("submitted_at"), 6
+        )
+        rec["queue_s"] = round(rec["queue_s"], 6)
+        rec["device_s"] = round(rec["device_s"], 6)
+        rec["spec_verifies"] = round(rec["spec_verifies"], 3)
+        rec["spec_accepted_tokens"] = round(
+            rec["spec_accepted_tokens"], 3
+        )
+        self.metrics.record_cost(rec)
+        self._event(
+            "request_cost",
+            request_id=rid,
+            tenant=rec["tenant"] or "default",
+            outcome=outcome,
+            emitted_tokens=rec["emitted_tokens"],
+            device_s=rec["device_s"],
+            queue_s=rec["queue_s"],
+        )
 
     def _trace(
         self, rid: str, span: str, t: Optional[float] = None, **attrs: Any
@@ -161,6 +226,7 @@ class Scheduler:
         request_id: Optional[str] = None,
         priority: int = 0,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> str:
         """Queue a request; returns its id. Rejects (ValueError) requests
         that can never fit the engine, instead of queueing them to fail."""
@@ -184,6 +250,7 @@ class Scheduler:
             priority=int(priority),
             deadline_s=deadline_s,
             submitted_at=time.monotonic(),
+            tenant=tenant,
         )
         with self._lock:
             heapq.heappush(
@@ -191,6 +258,7 @@ class Scheduler:
             )
             depth = len(self._pending)
             self.metrics.record_submit(depth)
+            self._acct_open(req)
         if self.tracer is not None:
             self.tracer.event(
                 req.request_id, _trace.SPAN_SUBMIT, t=req.submitted_at,
@@ -239,7 +307,14 @@ class Scheduler:
         t0 = time.monotonic()
         to_evict: List[Any] = []
         admits: List[Request] = []
+        #: (rid, outcome) terminals from ENGINE work this step; their
+        #: ledger records flush after this step's device-seconds are
+        #: attributed, so a request's final fold is in its bill.
+        closed: List[Any] = []
         with self._lock:
+            resident_rids = [
+                r.request_id for r in self._slot_req.values()
+            ]
             # 0) Priority aging: re-score the queue so long-waiting
             # requests drift toward priority 0 (FIFO seq breaks ties, so
             # an aged request outranks younger same-priority arrivals).
@@ -284,6 +359,7 @@ class Scheduler:
                     self._trace(req.request_id, _trace.SPAN_CANCEL)
                     self._event("cancel", request_id=req.request_id,
                                 where="queued")
+                    self._acct_close(req.request_id, "cancelled")
                     events.append(
                         TokenEvent(req.request_id, None, True, "cancelled")
                     )
@@ -295,6 +371,7 @@ class Scheduler:
                     self._trace(req.request_id, _trace.SPAN_EXPIRE)
                     self._event("expire", level="warn",
                                 request_id=req.request_id, where="queued")
+                    self._acct_close(req.request_id, "expired")
                     events.append(
                         TokenEvent(req.request_id, None, True, "expired")
                     )
@@ -317,6 +394,9 @@ class Scheduler:
                 "cancel" if cancelled else "expire",
                 level="info" if cancelled else "warn",
                 request_id=req.request_id, where="slot", slot=slot,
+            )
+            closed.append(
+                (req.request_id, "cancelled" if cancelled else "expired")
             )
             events.append(
                 TokenEvent(
@@ -359,6 +439,9 @@ class Scheduler:
                 self.metrics.record_admit(
                     t_admit - req.submitted_at, self.queue_depth()
                 )
+                acct = self._acct.get(req.request_id)
+                if acct is not None:
+                    acct["queue_s"] = t_admit - req.submitted_at
                 # Record-time timestamp (not t_admit): the engine's own
                 # admission-block events (prefix_seed) land between
                 # queued and here, and a trace's timestamps must be
@@ -381,6 +464,8 @@ class Scheduler:
                     req.request_id, _trace.SPAN_FIRST_TOKEN, t=now,
                     ttft_s=round(now - req.submitted_at, 6),
                 )
+                if acct is not None:
+                    acct["emitted_tokens"] += 1
                 events.append(
                     TokenEvent(
                         req.request_id, first_tok, done,
@@ -393,6 +478,7 @@ class Scheduler:
                     )
                     self._trace(req.request_id, _trace.SPAN_FINISH)
                     finished_rids.append(req.request_id)
+                    closed.append((req.request_id, "finished"))
                 else:
                     newly[slot] = req
         # 3) Advance chunked prefills — the chunk-vs-fold interleave.
@@ -418,6 +504,11 @@ class Scheduler:
                     chunks=task.chunks,
                     prefix_hit_tokens=task.matched_tokens,
                 )
+            acct = self._acct.get(task.request_id)
+            if acct is not None:
+                acct["prefill_chunks"] = task.chunks
+                acct["prefix_hit_tokens"] = task.matched_tokens
+                acct["emitted_tokens"] += 1
             events.append(
                 TokenEvent(
                     task.request_id, tok, done,
@@ -428,6 +519,7 @@ class Scheduler:
                 self.metrics.record_finish(queue_depth=self.queue_depth())
                 self._trace(task.request_id, _trace.SPAN_FINISH)
                 finished_rids.append(task.request_id)
+                closed.append((task.request_id, "finished"))
                 newly.pop(slot, None)
         # 4) One engine fold for everything resident (up to decode_fold
         # tokens per slot fan out of a single dispatch+harvest).
@@ -435,6 +527,12 @@ class Scheduler:
         emitted = 0
         finished_slots: List[int] = []
         fold_results = self.engine.step()
+        # Tokens per request this fold: the shared granularity of the
+        # decode-side trace events, the spec attribution, and the cost
+        # ledger (one dict pass per fold, never per token).
+        fold_tokens: Dict[str, int] = {}
+        for _, rid, _, _ in fold_results:
+            fold_tokens[rid] = fold_tokens.get(rid, 0) + 1
         if getattr(self.engine, "spec", "off") != "off":
             # Accept accounting: the engine's cumulative counters diffed
             # into this step's delta (zombie tokens already excluded at
@@ -444,32 +542,41 @@ class Scheduler:
             a = self.engine.spec_accepted_tokens
             dv = v - self._spec_seen[0]
             if dv:
-                self.metrics.record_spec(
-                    dv, d - self._spec_seen[1], a - self._spec_seen[2]
-                )
+                da = a - self._spec_seen[2]
+                self.metrics.record_spec(dv, d - self._spec_seen[1], da)
+                # Ledger attribution: the verify forwards are batched
+                # over slots, so per-request shares are estimates —
+                # accepted tokens proportional to tokens emitted this
+                # fold, verifies split evenly among the riders.
+                total = sum(fold_tokens.values())
+                for rid, n in fold_tokens.items():
+                    acct = self._acct.get(rid)
+                    if acct is not None:
+                        acct["spec_verifies"] += dv / len(fold_tokens)
+                        if total:
+                            acct["spec_accepted_tokens"] += da * n / total
                 if self.tracer is not None:
-                    spec_tokens: Dict[str, int] = {}
-                    for _, rid, _, _ in fold_results:
-                        spec_tokens[rid] = spec_tokens.get(rid, 0) + 1
-                    for rid, n in spec_tokens.items():
+                    for rid, n in fold_tokens.items():
                         self.tracer.event(
                             rid, _trace.SPAN_SPEC_VERIFY,
                             attrs={
                                 "tokens": n,
                                 "drafted": d - self._spec_seen[1],
-                                "accepted": a - self._spec_seen[2],
+                                "accepted": da,
                             },
                         )
             self._spec_seen = (v, d, a)
-        if self.tracer is not None and fold_results:
+        for rid, n in fold_tokens.items():
+            acct = self._acct.get(rid)
+            if acct is not None:
+                acct["decode_folds"] += 1
+                acct["emitted_tokens"] += n
+        if self.tracer is not None and fold_tokens:
             # One event per request per fold (not per token): "this fold,
             # this request rode it for n tokens" — the decode-side trace
             # granularity the hot loop can afford. Recorded before the
             # finish events below so a trace's fold events always precede
             # its terminal span.
-            fold_tokens: Dict[str, int] = {}
-            for _, rid, _, _ in fold_results:
-                fold_tokens[rid] = fold_tokens.get(rid, 0) + 1
             for rid, n in fold_tokens.items():
                 self.tracer.event(
                     rid, _trace.SPAN_DECODE_FOLD, attrs={"tokens": n}
@@ -484,6 +591,7 @@ class Scheduler:
                 self._trace(rid, _trace.SPAN_FINISH)
                 finished_slots.append(slot)
                 finished_rids.append(rid)
+                closed.append((rid, "finished"))
         with self._lock:
             self._slot_req.update(newly)
             for req in admits:
@@ -496,9 +604,36 @@ class Scheduler:
             # engine section ran would pin the id in _cancelled forever
             # and spuriously evict a later request reusing it.
             self._cancelled.difference_update(finished_rids)
+        # Device-seconds attribution: this step's wall time split evenly
+        # over the requests that held engine state through it (resident
+        # slots + this step's admissions). An estimate by construction —
+        # the fold executes all resident slots in one batched dispatch —
+        # but it sums exactly to serving wall time, so fleet goodput
+        # (tokens per device-second) is conserved.
+        wall = time.monotonic() - t0
+        participants = set(resident_rids)
+        participants.update(req.request_id for req in admits)
+        participants.update(fold_tokens)
+        participants.update(ev[1].request_id for ev in chunk_events)
+        if participants:
+            share = wall / len(participants)
+            for rid in participants:
+                acct = self._acct.get(rid)
+                if acct is not None:
+                    acct["device_s"] += share
+        for rid, outcome in closed:
+            self._acct_close(rid, outcome)
+        # Token accounting must be EXACT (the ledger balances against
+        # it): count only admissions that really emitted a first token —
+        # chunked admissions return None and their token is counted at
+        # prefill completion.
+        admit_tokens = sum(
+            1 for _, first_tok, _ in (results if admits else [])
+            if first_tok is not None
+        )
         self.metrics.record_step(
-            time.monotonic() - t0, active,
-            emitted + prefilled + len(admits), self.queue_depth(),
+            wall, active,
+            emitted + prefilled + admit_tokens, self.queue_depth(),
         )
         return events
 

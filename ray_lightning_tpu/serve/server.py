@@ -263,10 +263,19 @@ class ServeShardFollower:
         dist: Optional[Dict[str, Any]] = None,
         **engine_kwargs: Any,
     ) -> None:
+        from ray_lightning_tpu.obs.trace import RequestTracer
+
         _setup_gang_rendezvous(dict(dist or {}))
         self.engine = build_engine(
             **{k: v for k, v in engine_kwargs.items() if k in ENGINE_KEYS}
         )
+        # Follower-side trace ring: the replayed op stream carries each
+        # request's id (admit_many kwargs), so the engine's admission /
+        # prefix-seed / chunk events land here under the SAME ids the
+        # leader and client recorded — trace_dump() feeds them into the
+        # stitched export as this process's track.
+        self.tracer = RequestTracer(capacity=4096)
+        self.engine.tracer = self.tracer
         self._queue = op_queue
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -301,6 +310,10 @@ class ServeShardFollower:
 
     def ping(self) -> str:
         return "ok"
+
+    def trace_dump(self, n: int = 16) -> Dict[str, Any]:
+        """This follower's trace ring in the stitching wire form."""
+        return self.tracer.dump(n)
 
     def stop(self) -> None:
         self._stop.set()
@@ -568,7 +581,13 @@ class ServeReplica:
         eos_token: Optional[int] = None,
         priority: int = 0,
         deadline_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> str:
+        """``request_id`` lets the CLIENT mint the id before the RPC —
+        the trace-stitching anchor: its client_submit span and this
+        replica's spans share the id, so the merged export ties them.
+        ``tenant`` labels the request's cost-ledger record."""
         from ray_lightning_tpu.serve.scheduler import SamplingParams
 
         rid = self.scheduler.submit(
@@ -581,8 +600,10 @@ class ServeReplica:
                 seed=seed,
                 eos_token=eos_token,
             ),
+            request_id=request_id,
             priority=priority,
             deadline_s=deadline_s,
+            tenant=tenant,
         )
         with self._cond:
             self._buffers[rid] = {
@@ -703,6 +724,12 @@ class ServeReplica:
 
     def recent_traces(self, n: int = 8) -> Dict[str, list]:
         return self.tracer.recent_traces(n)
+
+    def trace_dump(self, n: int = 16) -> Dict[str, Any]:
+        """This process's trace ring in the stitching wire form (recent
+        traces + wall-clock offset) — ``ServeClient.trace_dumps`` pulls
+        one per process and merges them into ONE cross-process trace."""
+        return self.tracer.dump(n)
 
     def export_trace(
         self, request_id: Optional[str] = None, n: int = 8

@@ -123,6 +123,17 @@ def test_bench_smoke_cpu():
     }
     assert wd_modes == {"watchdog_off", "watchdog_on"}, out["extra"]
     assert out["extra"]["watchdog_overhead"] < 1.05, out["extra"]
+    # And for the FLEET plane: a driver-side puller snapshotting the
+    # metrics window 100x faster than the production cadence must also
+    # cost < 5% tokens/s (it reads under the same ServeMetrics lock the
+    # hot loop records under — this measures that contention).
+    fl_modes = {
+        r["mode"]
+        for r in out["extra"]["serve_rows"]
+        if r["workload"] == "fleet_overhead"
+    }
+    assert fl_modes == {"fleet_off", "fleet_on"}, out["extra"]
+    assert out["extra"]["fleet_overhead"] < 1.05, out["extra"]
     # Mesh-sharded decode sweep: a 1x1 control plus >= 1 model-axis
     # mesh over the forced host devices, per-device KV bytes shrinking
     # ~linearly in the model axis (the tp=N footprint story, measured).

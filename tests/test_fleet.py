@@ -1,0 +1,712 @@
+"""Fleet-scope observability tests: cross-process trace stitching, the
+per-request cost ledger, the fleet aggregator + /fleet|/events|/traces
+routes, and the `rlt top` dashboard.
+
+The load-bearing properties: (1) a stitched export puts every process a
+request touched on its own track, wall-clock aligned, with each remote
+span's request id resolving to a client-side submit span and the
+client-observed queue time derived as a real span; (2) the cost ledger
+BALANCES — the sum of per-request emitted tokens equals the engine's
+token counter exactly, so goodput (tokens per device-second) is a true
+ratio, not an estimate of one; (3) the fleet snapshot aggregates >= 2
+replicas with per-replica health/tokens_per_sec/goodput and survives a
+dead replica's pull error; (4) every metric name in the registry obeys
+the ``rlt_[a-z0-9_]+`` convention with no cross-subsystem collisions.
+"""
+import json
+import os
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import obs
+from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+from ray_lightning_tpu.obs import trace as obs_trace
+
+FLEET_CFG = GPTConfig(
+    vocab_size=97,
+    n_layer=2,
+    n_head=4,
+    n_kv_head=2,
+    d_model=32,
+    max_seq=64,
+    attn_impl="reference",
+    compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def fleet_params():
+    import jax
+
+    return init_gpt_params(jax.random.PRNGKey(0), FLEET_CFG)
+
+
+# ---------------------------------------------------------------------------
+# Trace stitching (pure)
+# ---------------------------------------------------------------------------
+def test_merge_chrome_trace_aligns_processes_and_derives_client_wait():
+    """Two rings on different monotonic bases merge onto one wall-clock
+    timeline: distinct process tracks, per-process lifecycle phases, and
+    the cross-process client_wait span with the RIGHT duration."""
+    client = obs.RequestTracer()
+    client.wall_offset = 100.0  # process A booted at wall 100
+    rep = obs.RequestTracer()
+    rep.wall_offset = 50.0  # process B's monotonic runs 50 ahead
+    client.event("r1", obs_trace.SPAN_CLIENT_SUBMIT, t=1.0,
+                 attrs={"replica": 0})
+    rep.event("r1", obs_trace.SPAN_SUBMIT, t=51.2)
+    rep.event("r1", obs_trace.SPAN_QUEUED, t=51.3)
+    rep.event("r1", obs_trace.SPAN_ADMITTED, t=51.5)
+    rep.event("r1", obs_trace.SPAN_FIRST_TOKEN, t=51.6)
+    rep.event("r1", obs_trace.SPAN_FINISH, t=51.9)
+    merged = obs.merge_chrome_trace([
+        {"name": "client", **client.dump()},
+        {"name": "replica0", **rep.dump()},
+    ])
+    evs = json.loads(json.dumps(merged))["traceEvents"]  # serializable
+    procs = {
+        e["args"]["name"]: e["pid"]
+        for e in evs
+        if e.get("name") == "process_name"
+    }
+    assert set(procs) == {"client", "replica0"}
+    assert procs["client"] != procs["replica0"]  # distinct tracks
+    (cw,) = [e for e in evs if e.get("name") == "client_wait"]
+    assert cw["ph"] == "X" and cw["pid"] == procs["client"]
+    # client_submit at wall 101.0, admitted at wall 101.5 -> 0.5 s.
+    assert abs(cw["dur"] - 5e5) < 1.0
+    x_names = {e["name"] for e in evs if e["ph"] == "X"}
+    assert {"queued", "prefill", "decode", "client_wait"} <= x_names
+    # Wall alignment: the replica's submit marker lands AFTER the
+    # client's submit on the merged timeline (monotonic bases differ by
+    # 50s, which would invert the order without the offset).
+    ts = {
+        (e["pid"], e["name"]): e["ts"] for e in evs if e["ph"] == "i"
+    }
+    assert ts[(procs["client"], obs_trace.SPAN_CLIENT_SUBMIT)] < ts[
+        (procs["replica0"], obs_trace.SPAN_SUBMIT)
+    ]
+
+
+def test_tracer_dump_is_the_stitching_wire_form():
+    tr = obs.RequestTracer()
+    tr.event("a", obs_trace.SPAN_SUBMIT)
+    d = tr.dump(4)
+    assert set(d) == {"wall_offset", "traces"}
+    assert "a" in d["traces"]
+    # wall_offset really maps monotonic onto wall clock.
+    assert abs((time.monotonic() + d["wall_offset"]) - time.time()) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Cost ledger (in-process scheduler)
+# ---------------------------------------------------------------------------
+def test_cost_ledger_balances_and_bills_tenants(fleet_params):
+    """The acceptance balance: ledger emitted tokens == engine token
+    counter == observed token events, across chunked prefill + prefix
+    hits + a mid-decode cancel; records carry tenant labels into the
+    rlt_serve_request_cost_* series and goodput is sum/sum."""
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.metrics import ServeMetrics
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    reg = MetricsRegistry()
+    eng = DecodeEngine(
+        fleet_params, FLEET_CFG, num_slots=2, max_seq=64,
+        prefill_buckets=[32], prefill_chunk=8, prefix_blocks=8,
+        prefix_block=8, decode_fold=2,
+    )
+    sched = Scheduler(eng, metrics=ServeMetrics(2, registry=reg))
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, 97, size=24).tolist()
+    toks = []
+
+    def drain():
+        toks.extend(
+            e for e in sched.run_until_idle() if e.token is not None
+        )
+
+    sched.submit(
+        prefix + rng.integers(0, 97, size=4).tolist(),
+        SamplingParams(max_new_tokens=6), tenant="acme",
+    )
+    drain()
+    sched.submit(  # prefix hit, default tenant
+        prefix + rng.integers(0, 97, size=4).tolist(),
+        SamplingParams(max_new_tokens=6),
+    )
+    drain()
+    r_cancel = sched.submit(
+        rng.integers(0, 97, size=12).tolist(),
+        SamplingParams(max_new_tokens=50),
+    )
+    for _ in range(60):
+        toks.extend(e for e in sched.step() if e.token is not None)
+        if any(t.request_id == r_cancel for t in toks):
+            break
+    assert sched.cancel(r_cancel)
+    drain()
+
+    recs = sched.metrics.cost_records()
+    assert len(recs) == 3
+    by_rid = {r["request_id"]: r for r in recs}
+    assert by_rid[r_cancel]["outcome"] == "cancelled"
+    assert {r["outcome"] for r in recs} == {"finished", "cancelled"}
+    # The balance: every emitted token is billed exactly once.
+    ledger_tokens = sum(r["emitted_tokens"] for r in recs)
+    counter = reg.counter("rlt_serve_tokens_emitted_total").value()
+    assert ledger_tokens == len(toks) == int(counter)
+    # Anatomy: the prefix-hit request billed its seeded tokens and fewer
+    # chunks; everyone consumed device time and queued >= 0 seconds.
+    hit = [r for r in recs if r["prefix_hit_tokens"] > 0]
+    assert len(hit) == 1 and hit[0]["prefill_chunks"] == 1
+    for r in recs:
+        assert r["device_s"] > 0 and r["queue_s"] >= 0
+        assert r["decode_folds"] >= 1
+        assert r["total_s"] >= r["device_s"] * 0  # present + finite
+    # Tenant labelling survives into the Prometheus series.
+    parsed = obs.parse_prometheus_text(reg.render())
+    cost_tokens = parsed["rlt_serve_request_cost_tokens_total"]
+    assert cost_tokens['{tenant="acme"}'] == by_rid[
+        recs[0]["request_id"]
+    ]["emitted_tokens"]
+    assert '{tenant="default"}' in cost_tokens
+    outcomes = parsed["rlt_serve_request_cost_requests_total"]
+    assert outcomes['{outcome="cancelled",tenant="default"}'] == 1.0
+    # Goodput: windowed sum/sum, in the snapshot AND the gauge.
+    snap = sched.metrics.snapshot()
+    cost = snap["cost"]
+    want = round(
+        cost["emitted_tokens"] / cost["device_seconds"], 3
+    )
+    assert cost["goodput_tokens_per_device_s"] == want
+    assert parsed[
+        "rlt_serve_goodput_tokens_per_device_second"
+    ][""] == want
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregator (pure)
+# ---------------------------------------------------------------------------
+def _stats_row(**kw):
+    base = {
+        "queue_depth": 0, "active_slots": 0, "num_slots": 4,
+        "tokens_per_sec": 0.0, "health": "healthy",
+        "cost": {"emitted_tokens": 0, "device_seconds": 0.0,
+                 "goodput_tokens_per_device_s": 0.0},
+    }
+    base.update(kw)
+    return base
+
+
+def test_fleet_poller_ring_aggregates_and_gauges():
+    from ray_lightning_tpu.obs.fleet import FleetPoller
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    stats = [
+        _stats_row(
+            queue_depth=2, active_slots=1, tokens_per_sec=10.0,
+            ttft_p95_s=0.5,
+            cost={"emitted_tokens": 100, "device_seconds": 2.0,
+                  "goodput_tokens_per_device_s": 50.0},
+        ),
+        _stats_row(
+            queue_depth=1, active_slots=2, tokens_per_sec=20.0,
+            ttft_p95_s=0.1,
+            cost={"emitted_tokens": 60, "device_seconds": 3.0,
+                  "goodput_tokens_per_device_s": 20.0},
+        ),
+    ]
+    health = [{"verdict": "healthy"}, {"verdict": "degraded"}]
+    reg = MetricsRegistry()
+    p = FleetPoller(
+        lambda: (stats, health, {"w0": {"age_s": 1.0}}),
+        history=3, registry=reg,
+    )
+    for _ in range(5):
+        p.poll_now()
+    d = p.to_dict()
+    assert d["polls"] == 5 and d["errors"] == 0
+    assert len(d["history"]) == 3  # bounded ring
+    latest = d["latest"]
+    assert [r["replica"] for r in latest["replicas"]] == [0, 1]
+    assert latest["replicas"][1]["health"] == "degraded"
+    f = latest["fleet"]
+    assert f["replicas"] == 2 and f["healthy"] == 1
+    assert f["queue_depth"] == 3 and f["tokens_per_sec"] == 30.0
+    # Fleet goodput is sum/sum (32.0), NOT the mean of ratios (35.0).
+    assert f["goodput_tokens_per_device_s"] == round(160 / 5.0, 3)
+    assert f["ttft_p95_s_worst"] == 0.5
+    assert latest["heartbeats"] == {"w0": {"age_s": 1.0}}
+    assert reg.gauge("rlt_fleet_replicas").value() == 2
+    assert reg.gauge("rlt_fleet_replica_health").value(replica=1) == 0.5
+    assert reg.gauge(
+        "rlt_fleet_goodput_tokens_per_device_second"
+    ).value() == 32.0
+
+
+def test_fleet_poller_survives_pull_errors():
+    """A dead replica (pull raises) must not kill the poller thread —
+    errors count, the loop keeps going, and the next good pull lands."""
+    from ray_lightning_tpu.obs.fleet import FleetPoller
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionError("replica is gone")
+        return ([_stats_row()], None, None)
+
+    events = obs.EventLog()
+    p = FleetPoller(flaky, interval_s=0.01, events=events).start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if p.latest() is not None:
+                break
+            time.sleep(0.01)
+    finally:
+        p.stop()
+    d = p.to_dict()
+    assert d["errors"] >= 2 and d["latest"] is not None
+    assert events.tail(subsystem="fleet", name="poll_error")
+
+
+# ---------------------------------------------------------------------------
+# Metric-name hygiene lint
+# ---------------------------------------------------------------------------
+_NAME_RE = re.compile(r"^rlt_[a-z0-9_]+$")
+
+
+def test_metric_name_hygiene_after_serve_smoke(fleet_params):
+    """Walk the process registry after a serve smoke (plus the fleet /
+    heartbeat / health feeders) and lint every series name: the
+    rlt_[a-z0-9_]+ convention, and no rendered family resolving to more
+    than one registered metric (catches drift as subsystems keep adding
+    series — e.g. a counter named like another histogram's _count)."""
+    from ray_lightning_tpu.obs.fleet import FleetPoller
+    from ray_lightning_tpu.serve.server import ServeReplica
+
+    rep = ServeReplica(
+        params=fleet_params, model_config=FLEET_CFG, num_slots=2,
+        max_seq=48, prefill_buckets=[16], watchdog=True,
+        slo={"ttft_p95_s": 60.0},
+    )
+    try:
+        rid = rep.submit(list(range(1, 9)), max_new_tokens=4)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if rep.result(rid, wait_s=0.5)["done"]:
+                break
+        else:
+            pytest.fail("request did not finish")
+        reg = obs.get_registry()
+        # Feed the remaining subsystems into the SAME registry so the
+        # lint sees the whole cross-subsystem namespace at once.
+        obs.heartbeats_to_registry(
+            {"worker:0": {
+                "rss_bytes": 1, "cpu_seconds": 0.1, "uptime_s": 1.0,
+                "calls_handled": 1, "calls_in_flight": 0, "age_s": 0.1,
+                "last_call_age_s": 0.1,
+            }},
+            reg,
+        )
+        poller = FleetPoller(
+            lambda: ([rep.stats()], [rep.health()], {}), registry=reg
+        )
+        poller.poll_now()
+        names = reg.names()
+        assert names, "empty registry after a serve smoke"
+        for name in names:
+            assert _NAME_RE.match(name), f"bad metric name {name!r}"
+        assert len(names) == len(set(names))
+        # Cross-subsystem family collisions: every rendered sample
+        # family must resolve back to exactly ONE registered metric
+        # (histograms own their _bucket/_sum/_count derivatives).
+        from ray_lightning_tpu.obs.registry import Histogram
+
+        owners = {}
+        by_name = {n: reg._metrics[n] for n in names}
+        for name, metric in by_name.items():
+            fams = [name]
+            if isinstance(metric, Histogram):
+                fams = [f"{name}_bucket", f"{name}_sum", f"{name}_count"]
+            for fam in fams:
+                assert fam not in owners, (
+                    f"family {fam!r} claimed by both {owners.get(fam)!r} "
+                    f"and {name!r}"
+                )
+                owners[fam] = name
+        rendered = obs.parse_prometheus_text(reg.render())
+        for fam in rendered:
+            assert fam in owners, f"rendered family {fam!r} has no owner"
+        # The serve smoke really exercised the new series.
+        assert "rlt_serve_request_cost_tokens_total" in names
+        assert "rlt_fleet_replicas" in names
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# rlt top
+# ---------------------------------------------------------------------------
+def test_parse_args_top_positional_and_options():
+    from ray_lightning_tpu.cli import parse_args
+
+    sub, cfg = parse_args(["top", "127.0.0.1:9400"])
+    assert sub == "top" and cfg["top"]["addr"] == "127.0.0.1:9400"
+    sub, cfg = parse_args(
+        ["top", "127.0.0.1:9400", "--top.interval_s", "0.5",
+         "--top.plain", "true"]
+    )
+    assert cfg["top"]["interval_s"] == 0.5
+    assert cfg["top"]["plain"] is True
+
+
+def test_run_top_renders_fleet_over_http(capsys):
+    """`rlt top` against a live /fleet endpoint: one plain-text frame
+    (the piping fallback) with per-replica rows and the fleet roll-up;
+    unknown --top.* keys reject with the vocabulary."""
+    from ray_lightning_tpu.cli import run_top
+    from ray_lightning_tpu.obs.fleet import FleetPoller
+
+    p = FleetPoller(
+        lambda: (
+            [
+                _stats_row(tokens_per_sec=12.5, queue_depth=1,
+                           health="healthy"),
+                _stats_row(tokens_per_sec=7.5, health="unhealthy"),
+            ],
+            [{"verdict": "healthy"}, {"verdict": "unhealthy"}],
+            None,
+        )
+    )
+    p.poll_now()
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "", collect_fleet=p.to_dict
+    ).start()
+    try:
+        out = run_top({
+            "top": {
+                "addr": f"{srv.host}:{srv.port}",
+                "iterations": 1, "plain": True,
+            }
+        })
+        frame = capsys.readouterr().out
+        assert "rlt top — 2 replica(s)" in frame
+        assert "unhealthy" in frame and "12.5" in frame
+        assert "fleet: healthy=1/2" in frame
+        assert out["snapshot"]["latest"]["fleet"]["replicas"] == 2
+        with pytest.raises(ValueError, match="unknown top option"):
+            run_top({"top": {"addr": "x:1", "nope": 1}})
+        with pytest.raises(ValueError, match="top requires"):
+            run_top({"top": {}})
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# The serve obs endpoint wiring (real HTTP, stub fleet)
+# ---------------------------------------------------------------------------
+class _StubClient:
+    """Duck-typed ServeClient standing in for a 2-replica fleet: the
+    exact surface cli._serve_obs_server consumes, with canned payloads —
+    so the route wiring `rlt serve` uses is tested over REAL HTTP
+    without spawning actors (the fabric e2e below proves the real
+    thing in the slow tier)."""
+
+    def __init__(self):
+        self.tracer = obs.RequestTracer()
+        self.tracer.event("r1", obs_trace.SPAN_CLIENT_SUBMIT,
+                          attrs={"replica": 0})
+        self._rep = obs.RequestTracer()
+        for span in (obs_trace.SPAN_SUBMIT, obs_trace.SPAN_QUEUED,
+                     obs_trace.SPAN_ADMITTED, obs_trace.SPAN_FIRST_TOKEN,
+                     obs_trace.SPAN_FINISH):
+            self._rep.event("r1", span)
+
+    def stats(self):
+        return [
+            _stats_row(tokens_per_sec=5.0, queue_depth=1,
+                       cost={"emitted_tokens": 10, "device_seconds": 2.0,
+                             "goodput_tokens_per_device_s": 5.0}),
+            _stats_row(tokens_per_sec=3.0),
+        ]
+
+    def health(self):
+        return [
+            {"verdict": "healthy", "healthy": True},
+            {"verdict": "healthy", "healthy": True},
+        ]
+
+    def metrics_text(self):
+        return 'rlt_serve_requests_total{kind="finished"} 2\n'
+
+    def recent_events(self, n):
+        return [
+            {"ts": 1.0, "level": "info", "subsystem": "scheduler",
+             "name": "admit_burst", "replica": 0},
+        ]
+
+    def trace_dumps(self, n=16):
+        return [
+            {"name": "client", **self.tracer.dump(n)},
+            {"name": "replica0", **self._rep.dump(n)},
+        ]
+
+    def export_stitched_trace(self, n=16):
+        return obs.merge_chrome_trace(self.trace_dumps(n))
+
+    def debug_dump(self, reason="rpc", pull=True):
+        return {
+            "reason": reason, "dir": "/tmp/stub-bundle",
+            "files": ["metrics.prom"],
+            "files_content": {"metrics.prom": self.metrics_text()},
+            "errors": {},
+        }
+
+
+def test_serve_obs_server_routes_over_real_http(start_fabric, tmp_path):
+    """The rlt serve endpoint wiring end to end over real HTTP: /fleet
+    aggregates 2 replicas, /events is parseable JSONL, /traces is the
+    stitched export with client_wait, and a doctor pull lands a bundle
+    whose files include the driver-added fleet.json + stitched trace."""
+    from ray_lightning_tpu.cli import _serve_obs_server, run_doctor
+
+    start_fabric(num_cpus=1)  # heartbeat collectors want a live fabric
+    client = _StubClient()
+    server, poller = _serve_obs_server(
+        client, 0, fleet=True, fleet_interval_s=5.0
+    )
+    try:
+        poller.poll_now()
+        base = f"http://{server.host}:{server.port}"
+        fleet = json.loads(
+            urllib.request.urlopen(base + "/fleet", timeout=10).read()
+        )
+        assert fleet["latest"]["fleet"]["replicas"] == 2
+        assert fleet["latest"]["fleet"]["healthy"] == 2
+        assert fleet["latest"]["replicas"][0][
+            "goodput_tokens_per_device_s"
+        ] == 5.0
+        lines = urllib.request.urlopen(
+            base + "/events", timeout=10
+        ).read().decode().splitlines()
+        rows = [json.loads(ln) for ln in lines if ln]
+        assert any(r["name"] == "admit_burst" for r in rows)
+        traces = json.loads(
+            urllib.request.urlopen(base + "/traces", timeout=10).read()
+        )
+        names = {
+            e["args"]["name"] for e in traces["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert names == {"client", "replica0"}
+        assert any(
+            e.get("name") == "client_wait"
+            for e in traces["traceEvents"]
+        )
+        scrape = urllib.request.urlopen(
+            base + "/metrics", timeout=10
+        ).read().decode()
+        assert "rlt_fleet_replicas" in scrape
+        # Doctor pull: the driver augments the replica bundle with the
+        # fleet snapshot + stitched trace before shipping it.
+        out = run_doctor({
+            "doctor": {
+                "addr": f"{server.host}:{server.port}",
+                "bundle": str(tmp_path),
+            }
+        })
+        assert out["status"] == 200
+        names = set(os.listdir(out["bundle"]))
+        assert {"metrics.prom", "fleet.json",
+                "trace_stitched.json"} <= names
+        pulled = json.loads(
+            open(os.path.join(out["bundle"], "fleet.json")).read()
+        )
+        assert pulled["latest"]["fleet"]["replicas"] == 2
+    finally:
+        poller.stop()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# End to end: two replicas, stitched traces, the /fleet plane, doctor
+# ---------------------------------------------------------------------------
+def _write_ckpt(tmp_path, params):
+    import dataclasses
+
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file,
+        to_state_stream,
+    )
+
+    path = os.path.join(tmp_path, "fleet.ckpt")
+    state_stream_to_file(
+        to_state_stream(
+            {"params": params, "gpt_config": dataclasses.asdict(FLEET_CFG)}
+        ),
+        path,
+    )
+    return path
+
+
+@pytest.mark.slow
+def test_fleet_end_to_end_two_replicas(
+    start_fabric, tmp_path, fleet_params
+):
+    """The acceptance path (slow tier — real actors; the tier-1 stub
+    test above covers the same wiring): 2 replica actors behind a
+    ServeClient; a
+    stitched trace spans client + both replicas on distinct tracks with
+    every remote request id resolving to a client submit span; /fleet
+    aggregates both replicas (health, tokens/s, goodput); /events and
+    /traces serve over real HTTP through the same wiring `rlt serve`
+    uses; the cost ledger balances fleet-wide; and a pulled doctor
+    bundle contains fleet.json + the stitched trace."""
+    from ray_lightning_tpu.cli import _serve_obs_server, run_doctor, run_top
+    from ray_lightning_tpu.serve import start_replicas
+
+    start_fabric(num_cpus=4)
+    client = start_replicas(
+        2,
+        ckpt_path=_write_ckpt(tmp_path, fleet_params),
+        num_slots=2,
+        prefill_buckets=[8, 16],
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    server = poller = None
+    try:
+        rng = np.random.default_rng(7)
+        n_new = 5
+        jobs = []
+        for _ in range(4):  # round-robin -> 2 per replica
+            p = rng.integers(0, 97, size=int(rng.integers(3, 9))).tolist()
+            jobs.append((p, client.submit(p, max_new_tokens=n_new)))
+        total_streamed = 0
+        for p, h in jobs:
+            total_streamed += len(
+                list(client.stream_handle(h, timeout_s=120))
+            )
+        assert total_streamed == 4 * n_new
+
+        # -- stitched trace ------------------------------------------------
+        dumps = client.trace_dumps(n=8)
+        assert [d["name"] for d in dumps] == [
+            "client", "replica0", "replica1",
+        ]
+        client_rids = set(dumps[0]["traces"])
+        assert client_rids == {h.request_id for _, h in jobs}
+        for d in dumps[1:]:
+            assert d["traces"], f"{d['name']} recorded no spans"
+            # Every remote span's request id resolves to a client-side
+            # submit span.
+            assert set(d["traces"]) <= client_rids, d["name"]
+        stitched = client.export_stitched_trace(n=8)
+        evs = stitched["traceEvents"]
+        procs = {
+            e["args"]["name"]: e["pid"]
+            for e in evs
+            if e.get("name") == "process_name"
+        }
+        assert set(procs) == {"client", "replica0", "replica1"}
+        assert len(set(procs.values())) == 3  # distinct tracks
+        # The client-observed queue time is a real span per request.
+        waits = [e for e in evs if e.get("name") == "client_wait"]
+        assert len(waits) == 4
+        assert all(e["pid"] == procs["client"] for e in waits)
+
+        # -- the /fleet plane over real HTTP (rlt serve's wiring) ----------
+        server, poller = _serve_obs_server(
+            client, 0, fleet=True, fleet_interval_s=0.2
+        )
+        poller.poll_now()
+        base = f"http://{server.host}:{server.port}"
+        fleet = json.loads(
+            urllib.request.urlopen(base + "/fleet", timeout=10).read()
+        )
+        latest = fleet["latest"]
+        assert latest["fleet"]["replicas"] == 2
+        for row in latest["replicas"]:
+            assert row["health"] == "healthy"
+            assert row["finished"] == 2
+            assert row["goodput_tokens_per_device_s"] > 0
+        assert latest["fleet"]["healthy"] == 2
+        assert latest["fleet"]["goodput_tokens_per_device_s"] > 0
+
+        lines = urllib.request.urlopen(
+            base + "/events", timeout=10
+        ).read().decode().splitlines()
+        rows = [json.loads(ln) for ln in lines if ln]
+        assert any(
+            r["name"] == "admit_burst" and r.get("replica") in (0, 1)
+            for r in rows
+        )
+        traces = json.loads(
+            urllib.request.urlopen(base + "/traces", timeout=10).read()
+        )
+        assert any(
+            e.get("name") == "client_wait"
+            for e in traces["traceEvents"]
+        )
+        scrape = urllib.request.urlopen(
+            base + "/metrics", timeout=10
+        ).read().decode()
+        parsed = obs.parse_prometheus_text(scrape)
+        assert parsed["rlt_fleet_replicas"][""] == 2.0
+
+        # -- fleet-wide ledger balance -------------------------------------
+        stats = client.stats()
+        fleet_tokens = sum(s["cost"]["emitted_tokens"] for s in stats)
+        counter_total = sum(
+            s["metrics"]["rlt_serve_tokens_emitted_total"] for s in stats
+        )
+        assert fleet_tokens == total_streamed == int(counter_total)
+
+        # -- doctor bundle carries the fleet -------------------------------
+        out = run_doctor({
+            "doctor": {
+                "addr": f"{server.host}:{server.port}",
+                "bundle": str(tmp_path / "pulled"),
+            }
+        })
+        assert out["status"] == 200
+        bundle_dir = out["bundle"]
+        names = set(os.listdir(bundle_dir))
+        assert {"fleet.json", "trace_stitched.json"} <= names
+        pulled_fleet = json.loads(
+            open(os.path.join(bundle_dir, "fleet.json")).read()
+        )
+        assert pulled_fleet["latest"]["fleet"]["replicas"] == 2
+        pulled_trace = json.loads(
+            open(
+                os.path.join(bundle_dir, "trace_stitched.json")
+            ).read()
+        )
+        assert any(
+            e.get("name") == "process_name"
+            and e["args"]["name"] == "replica1"
+            for e in pulled_trace["traceEvents"]
+        )
+
+        # -- rlt top against the live endpoint -----------------------------
+        out = run_top({
+            "top": {
+                "addr": f"{server.host}:{server.port}",
+                "iterations": 1, "plain": True,
+            }
+        })
+        assert out["snapshot"]["latest"]["fleet"]["replicas"] == 2
+    finally:
+        if poller is not None:
+            poller.stop()
+        if server is not None:
+            server.close()
+        client.shutdown()

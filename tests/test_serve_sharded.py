@@ -443,6 +443,103 @@ def test_gang_leader_engine_mirrors_op_stream(shard_params):
         assert np.array_equal(s_lead[k], s_mirror[k]), k
 
 
+def test_gang_follower_trace_propagation_stitches(shard_params):
+    """Trace context crosses the gang op stream: the leader broadcasts
+    engine ops carrying request ids, the follower's engine records its
+    own spans under the SAME ids, and the merged export shows follower
+    spans on a DISTINCT process track with every remote request id
+    resolving to a client-side submit span (the PR 8 stitching contract
+    on the in-process leader/follower mirror)."""
+    import queue as _q
+
+    from ray_lightning_tpu.obs.trace import (
+        SPAN_CLIENT_SUBMIT,
+        SPAN_PREFILL_CHUNK,
+        RequestTracer,
+        merge_chrome_trace,
+    )
+    from ray_lightning_tpu.serve.server import _GangLeaderEngine
+
+    local = _q.Queue()
+
+    class Chan:  # fabric.Queue stand-in
+        def put(self, item):
+            local.put(item)
+
+    kw = dict(num_slots=2, max_seq=48, prefill_buckets=[16],
+              prefill_chunk=4, decode_fold=2)
+    leader = _engine(shard_params, None, **kw)
+    mirror = _engine(shard_params, None, **kw)
+    client_tracer = RequestTracer()
+    leader.tracer = RequestTracer()
+    mirror.tracer = RequestTracer()  # what ServeShardFollower wires up
+    gang = _GangLeaderEngine(leader, [Chan()])
+    rng = np.random.default_rng(11)
+    for rid, size, n in (("a", 9, 5), ("b", 6, 4)):
+        client_tracer.event(
+            rid, SPAN_CLIENT_SUBMIT, attrs={"replica": 0}
+        )
+        gang.admit(
+            rng.integers(0, 97, size=size).tolist(),
+            request_id=rid, max_new_tokens=n,
+        )
+    while gang.num_active or leader._prefills:
+        gang.prefill_step(2)
+        gang.step()
+    gang.close()
+    # Replay the op stream on the mirror, exactly like the follower's
+    # daemon loop does.
+    while True:
+        op = local.get_nowait()
+        if op is None:
+            break
+        name, args, kwargs = op
+        getattr(mirror, name)(*args, **kwargs)
+    assert mirror.tracer.request_ids(), "follower recorded no spans"
+
+    merged = merge_chrome_trace([
+        {"name": "client", **client_tracer.dump()},
+        {"name": "replica0", **leader.tracer.dump()},
+        {"name": "follower0", **mirror.tracer.dump()},
+    ])
+    evs = merged["traceEvents"]
+    procs = {
+        e["args"]["name"]: e["pid"]
+        for e in evs
+        if e.get("name") == "process_name"
+    }
+    assert set(procs) == {"client", "replica0", "follower0"}
+    assert len(set(procs.values())) == 3  # distinct process tracks
+    follower_markers = [
+        e for e in evs
+        if e["ph"] == "i" and e["pid"] == procs["follower0"]
+    ]
+    assert any(
+        e["name"] == SPAN_PREFILL_CHUNK for e in follower_markers
+    )
+    # Every span's request id — leader AND follower — resolves to a
+    # client-side submit span.
+    client_rids = set(client_tracer.request_ids())
+    for e in evs:
+        if e["ph"] == "i" and e["pid"] != procs["client"]:
+            assert e["args"]["request_id"] in client_rids, e
+    # And the follower recorded the SAME per-request chunk ladder as
+    # the leader (the op stream is the single source of truth).
+    for rid in ("a", "b"):
+        lead_chunks = [
+            ev for ev in leader.tracer.trace(rid)
+            if ev["span"] == SPAN_PREFILL_CHUNK
+        ]
+        mirror_chunks = [
+            ev for ev in mirror.tracer.trace(rid)
+            if ev["span"] == SPAN_PREFILL_CHUNK
+        ]
+        assert len(lead_chunks) == len(mirror_chunks) >= 1
+        assert [c["index"] for c in lead_chunks] == [
+            c["index"] for c in mirror_chunks
+        ]
+
+
 def test_replica_stats_carry_mesh_and_memory(tp_mesh, shard_params):
     """ServeReplica with a mesh spec end to end (in-process): exact
     output, stats() ships mesh + per-component memory, and the

@@ -16,18 +16,124 @@ prefix-cache path, then:
   compiles_since_init — which must be 0 — health verdict, bundle path)
   to stdout.
 
-The tpu_watch `obs` and `doctor` manifest stages run this and archive
-the files, so every healthy TPU window leaves a scrapeable-metrics +
-viewable-trace + pullable-bundle record alongside the bench JSONs.
-Runs fine on CPU.
+With ``--out-fleet`` (+ ``--out-stitched``) it runs the FLEET path
+instead: a local fabric with TWO replica actors behind a ServeClient,
+the driver-side fleet poller and obs endpoint exactly as ``rlt serve
+--serve.metrics_port`` wires them, and archives one ``/fleet``
+snapshot plus one stitched cross-process ``/traces`` export fetched
+over real HTTP — the tpu_watch ``fleet`` manifest stage's artifact.
+(Replica actors are pinned to CPU: the artifact records the
+aggregation plane, not chip throughput.)
+
+The tpu_watch `obs`, `doctor`, and `fleet` manifest stages run this
+and archive the files, so every healthy TPU window leaves a
+scrapeable-metrics + viewable-trace + pullable-bundle + fleet-snapshot
+record alongside the bench JSONs. Runs fine on CPU.
 """
 import argparse
 import contextlib
 import io
 import json
+import os
 import sys
+import tempfile
 import time
 import urllib.request
+
+
+def fleet_main(args) -> None:
+    """The fleet artifact: 2 replicas, one /fleet snapshot, one
+    stitched cross-process trace, both over real HTTP."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu import fabric
+    from ray_lightning_tpu.cli import _serve_obs_server
+    from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+    from ray_lightning_tpu.serve import start_replicas
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file,
+        to_state_stream,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=257, n_layer=2, n_head=4, d_model=64, max_seq=128,
+        attn_impl="reference",
+    )
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tmp = tempfile.mkdtemp(prefix="rlt_fleet_")
+    ckpt = os.path.join(tmp, "fleet.ckpt")
+    state_stream_to_file(
+        to_state_stream(
+            {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+        ),
+        ckpt,
+    )
+    if not fabric.is_initialized():
+        fabric.init(num_cpus=4)
+    client = start_replicas(
+        2,
+        ckpt_path=ckpt,
+        num_slots=2,
+        prefill_buckets=[16, 64],
+        decode_fold=2,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    server = poller = None
+    try:
+        g = np.random.default_rng(0)
+        handles = [
+            client.submit(
+                g.integers(0, 257, size=12).tolist(),
+                max_new_tokens=args.new_tokens,
+            )
+            for _ in range(args.requests)
+        ]
+        for h in handles:
+            for _ in client.stream_handle(h, timeout_s=300.0):
+                pass
+        server, poller = _serve_obs_server(
+            client, 0, fleet=True, fleet_interval_s=0.2
+        )
+        poller.poll_now()  # at least one snapshot before the fetch
+        base = f"http://{server.host}:{server.port}"
+        fleet_body = urllib.request.urlopen(
+            base + "/fleet", timeout=30
+        ).read()
+        trace_body = urllib.request.urlopen(
+            base + "/traces", timeout=30
+        ).read()
+        with open(args.out_fleet, "wb") as f:
+            f.write(fleet_body)
+        with open(args.out_stitched, "wb") as f:
+            f.write(trace_body)
+        fleet = json.loads(fleet_body)
+        trace = json.loads(trace_body)
+        procs = sorted(
+            e["args"]["name"]
+            for e in trace["traceEvents"]
+            if e.get("name") == "process_name"
+        )
+        print(json.dumps({
+            "requests": args.requests,
+            "fleet_replicas": fleet["latest"]["fleet"]["replicas"],
+            "fleet_goodput": fleet["latest"]["fleet"][
+                "goodput_tokens_per_device_s"
+            ],
+            "history": len(fleet["history"]),
+            "trace_processes": procs,
+            "trace_events": len(trace["traceEvents"]),
+            "out_fleet": args.out_fleet,
+            "out_stitched": args.out_stitched,
+        }))
+    finally:
+        if poller is not None:
+            poller.stop()
+        if server is not None:
+            server.close()
+        client.shutdown()
 
 
 def main() -> None:
@@ -39,9 +145,22 @@ def main() -> None:
         help="run `rlt doctor` against the live endpoint and pull a "
         "flight-recorder bundle into this directory",
     )
+    p.add_argument(
+        "--out-fleet", default="",
+        help="run the 2-replica FLEET path instead and save the /fleet "
+        "snapshot JSON here",
+    )
+    p.add_argument(
+        "--out-stitched", default="/tmp/fleet_trace.json",
+        help="where the fleet path saves the stitched /traces export",
+    )
     p.add_argument("--requests", type=int, default=4)
     p.add_argument("--new-tokens", type=int, default=16)
     args = p.parse_args()
+
+    if args.out_fleet:
+        fleet_main(args)
+        return
 
     import jax
     import numpy as np

@@ -20,6 +20,7 @@ have_sharded=0
 have_spec=0
 have_obs=0
 have_doctor=0
+have_fleet=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -27,6 +28,7 @@ sharded_fails=0
 spec_fails=0
 obs_fails=0
 doctor_fails=0
+fleet_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -38,6 +40,7 @@ sharded_status=pending
 spec_status=pending
 obs_status=pending
 doctor_status=pending
+fleet_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -56,6 +59,7 @@ write_manifest() {
     echo "stage=spec status=$spec_status fails=$spec_fails"
     echo "stage=obs status=$obs_status fails=$obs_fails"
     echo "stage=doctor status=$doctor_status fails=$doctor_fails"
+    echo "stage=fleet status=$fleet_status fails=$fleet_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -262,6 +266,33 @@ while true; do
             have_doctor=1
             doctor_status=skipped
             echo "$(date -u +%H:%M:%S) doctor snapshot SKIPPED after $doctor_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_fleet" -eq 0 ]; then
+        # Stage 7b: fleet artifact — two replica actors behind the real
+        # ServeClient + driver fleet poller, archiving one /fleet
+        # snapshot and one stitched cross-process trace fetched over
+        # real HTTP, so each healthy window proves the fleet control
+        # plane end-to-end next to the single-process obs record.
+        echo "$(date -u +%H:%M:%S) launching FLEET snapshot" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 1200 python tools/obs_snapshot.py \
+            --out-fleet /tmp/fleet_snapshot.json \
+            --out-stitched /tmp/fleet_trace.json \
+            > /tmp/fleet_snapshot_summary.json 2> /tmp/fleet_snapshot.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/fleet_snapshot.json ] && [ -s /tmp/fleet_trace.json ]; then
+          have_fleet=1
+          fleet_status=ok
+          echo "$(date -u +%H:%M:%S) FLEET snapshot SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          fleet_fails=$((fleet_fails+1))
+          fleet_status=failed
+          echo "$(date -u +%H:%M:%S) fleet snapshot failed rc=$rc (fail $fleet_fails)" >> /tmp/tpu_watch.log
+          if [ "$fleet_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_fleet=1
+            fleet_status=skipped
+            echo "$(date -u +%H:%M:%S) fleet snapshot SKIPPED after $fleet_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       else
