@@ -1082,12 +1082,91 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
             )
         fleet_overhead = round(fl_tps_off / max(fl_tps_on, 1e-9), 4)
 
+        # ---- journal observer effect: decode with workload capture -----
+        # off vs on. "On" is the serve DEFAULT (the bounded ring; the
+        # JSONL spill is the opt-in --serve.journal DIR, measured as a
+        # third informational row). The journal's hot-path budget is one
+        # dict append per request lifecycle event — token values ride
+        # list appends inside loops the scheduler already runs. Unlike
+        # the other overhead rows this one ALTERNATES off/on sweeps on
+        # ONE compiled engine (the journal attaches to the scheduler, so
+        # it can): engine-to-engine build variance (XLA layout/autotune
+        # luck) is several times the journal's per-sweep cost and would
+        # dominate a two-engine ratio. The slow smoke pins the default
+        # capture's ratio < 1.05.
+        import tempfile as _tempfile
+
+        from ray_lightning_tpu.obs.journal import (
+            WorkloadJournal,
+            engine_header,
+        )
+
+        jr_eng = DecodeEngine(
+            params, cfg, num_slots=4,
+            max_seq=obs_prompt + obs_new,
+            prefill_buckets=[obs_prompt], decode_fold=4,
+        )
+        jr_sched = Scheduler(jr_eng, max_prefills_per_step=4)
+        jr_ring = WorkloadJournal(capacity=4096)
+        jr_ring.set_header(engine_header(jr_eng))
+        jr_spill = WorkloadJournal(
+            capacity=4096,
+            spill_dir=_tempfile.mkdtemp(prefix="rlt_jr_bench_"),
+        )
+        jr_spill.set_header(engine_header(jr_eng))
+        jr_prompts = [
+            g.integers(0, cfg.vocab_size, size=obs_prompt).tolist()
+            for _ in range(4)
+        ]
+
+        def jr_sweep(journal):
+            jr_sched.journal = journal
+            for p in jr_prompts:
+                jr_sched.submit(
+                    p, SamplingParams(max_new_tokens=obs_new)
+                )
+            jr_sched.run_until_idle()
+
+        for j in (None, jr_ring, jr_spill):
+            jr_sweep(j)  # warm every path's first dispatch
+        jr_tps = {"off": 0.0, "on": 0.0, "spill": 0.0}
+        for _ in range(5):
+            for key, j in (
+                ("off", None), ("on", jr_ring), ("spill", jr_spill),
+            ):
+                t0 = _time.monotonic()
+                jr_sweep(j)
+                jr_tps[key] = max(
+                    jr_tps[key], 4 * obs_new / (_time.monotonic() - t0)
+                )
+        jr_spill.close()
+        for mode, tps in (
+            ("journal_off", jr_tps["off"]),
+            ("journal_on", jr_tps["on"]),
+            ("journal_on_spill", jr_tps["spill"]),
+        ):
+            rows.append(
+                {
+                    "workload": "journal_overhead",
+                    "mode": mode,
+                    "tokens_per_sec": round(tps, 2),
+                }
+            )
+        journal_overhead = round(
+            jr_tps["off"] / max(jr_tps["on"], 1e-9), 4
+        )
+        journal_spill_overhead = round(
+            jr_tps["off"] / max(jr_tps["spill"], 1e-9), 4
+        )
+
         return {
             "serve_rows": rows,
             "serve_shared_prefix_ttft_speedup": speedup,
             "obs_overhead": obs_overhead,
             "watchdog_overhead": watchdog_overhead,
             "fleet_overhead": fleet_overhead,
+            "journal_overhead": journal_overhead,
+            "journal_spill_overhead": journal_spill_overhead,
             "serve_config": (
                 f"layers={cfg.n_layer} d_model={cfg.d_model} "
                 f"prompt={P} (shared={shared}) new={n_new} chunk={chunk}"

@@ -35,7 +35,7 @@ import yaml
 
 _SUBCOMMANDS = (
     "fit", "validate", "test", "predict", "generate", "convert-hf",
-    "tokenize", "serve", "doctor", "top",
+    "tokenize", "serve", "doctor", "top", "replay",
 )
 
 
@@ -139,7 +139,7 @@ def _apply_dotted(
             continue
         if section not in (
             "model", "strategy", "trainer", "data", "generate", "tokenize",
-            "serve", "doctor", "top",
+            "serve", "doctor", "top", "replay",
         ):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
@@ -156,6 +156,7 @@ def _apply_dotted(
         node = config[section]
         if section in (
             "trainer", "generate", "tokenize", "serve", "doctor", "top",
+            "replay",
         ):  # plain dicts
             node[field] = yaml.safe_load(raw)
             continue
@@ -215,13 +216,16 @@ def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
     while i < len(rest):
         arg = rest[i]
         if not arg.startswith("--"):
-            # ``rlt doctor <addr>`` / ``rlt top <addr>``: the one
-            # positional the CLI accepts — the serve obs endpoint.
+            # ``rlt doctor <addr>`` / ``rlt top <addr>`` /
+            # ``rlt replay <journal>``: the one positional the CLI
+            # accepts — the serve obs endpoint, or the journal path.
+            pos_key = {"doctor": "addr", "top": "addr",
+                       "replay": "journal"}.get(known.subcommand)
             if (
-                known.subcommand in ("doctor", "top")
-                and "addr" not in (config.get(known.subcommand) or {})
+                pos_key is not None
+                and pos_key not in (config.get(known.subcommand) or {})
             ):
-                config.setdefault(known.subcommand, {})["addr"] = arg
+                config.setdefault(known.subcommand, {})[pos_key] = arg
                 i += 1
                 continue
             raise ValueError(f"unexpected argument {arg!r}")
@@ -394,6 +398,7 @@ _SERVE_KEYS = frozenset((
     "watchdog", "watchdog_interval_s", "stall_s", "slo",
     "blackbox_dir", "blackbox_keep",
     "fleet", "fleet_interval_s", "fleet_history",
+    "journal", "journal_capacity",
 ))
 
 
@@ -415,8 +420,11 @@ def _serve_obs_server(
       every replica's health() RPC;
     - ``/fleet``: the latest FleetSnapshot + history ring (``rlt top``'s
       feed);
-    - ``/events``: the merged structured event rings as JSONL;
+    - ``/events``: the merged structured event rings as JSONL
+      (``?level=``/``?subsystem=``/``?n=`` filter server-side);
     - ``/traces``: the stitched cross-process Chrome trace;
+    - ``/journal``: the workload journal(s) as JSONL — save it and
+      ``rlt replay`` it (multi-replica output is replica-tagged);
     - ``/debug/bundle``: a replica flight-recorder bundle augmented
       driver-side with ``fleet.json`` + ``trace_stitched.json`` so a
       pulled post-mortem shows the whole fleet, not one process.
@@ -516,6 +524,7 @@ def _serve_obs_server(
         ),
         collect_events=_collect_events,
         collect_traces=lambda: client.export_stitched_trace(n=16),
+        collect_journal=client.journal_jsonl,
         port=int(metrics_port),
     ).start()
     return server, fleet_poller
@@ -593,6 +602,13 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         land (default RLT_BLACKBOX_DIR or the tempdir) and how many to
         retain. Inspect with `rlt doctor <host:port>` against
         metrics_port.
+      journal: workload capture for deterministic replay (default on —
+        a bounded in-memory ring of every submit/cancel + per-request
+        emitted tokens). Pass a DIRECTORY to additionally stream the
+        journal as rotated JSONL there; `false` disables capture.
+        journal_capacity: ring size (default 4096 entries). Export via
+        the /journal route, journal.jsonl in doctor bundles, or the
+        journal_dump RPC; re-drive with `rlt replay <journal>`.
       prompts: path to a prompts file ("-" = stdin), one request per
         line as comma/space-separated token ids.
       max_new_tokens, temperature, top_k, top_p, seed, eos_token:
@@ -697,6 +713,17 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         replica_kwargs["spec_draft_config"] = dict(draft_cfg)
     replica_kwargs["tracing"] = bool(serve_cfg.pop("tracing", True))
     replica_kwargs["watchdog"] = bool(serve_cfg.pop("watchdog", True))
+    # Workload journal: the ring is on by default; --serve.journal DIR
+    # additionally spills JSONL there (rotated), --serve.journal false
+    # turns capture off entirely. YAML parses bare off/on as booleans.
+    jr = serve_cfg.pop("journal", True)
+    if jr is False or jr in ("off",):
+        replica_kwargs["journal"] = False
+    elif jr is not True and jr not in ("on",):
+        replica_kwargs["journal_dir"] = str(jr)
+    jc = serve_cfg.pop("journal_capacity", None)
+    if jc is not None:
+        replica_kwargs["journal_capacity"] = int(jc)
     for knob, cast in (
         ("watchdog_interval_s", float),
         ("stall_s", float),
@@ -933,6 +960,102 @@ def run_doctor(config: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def run_replay(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``replay``: re-drive a captured workload journal bit-exactly.
+
+    Usage: ``rlt replay <journal> [--replay.*]`` where ``<journal>`` is
+    a journal JSONL file (a doctor bundle's ``journal.jsonl``, a saved
+    ``/journal`` body, or a ``--serve.journal`` spill file/directory).
+    The engine + scheduler rebuild from the journal's recorded
+    config/checkpoint header and the recorded request stream is
+    re-driven; per-request token output must match the recorded
+    outcomes bit-exactly, with a first-divergence report (request id,
+    token index, expected vs got) on mismatch. Exit status: 0 exact,
+    1 diverged (the scriptable regression probe).
+
+    Options (``--replay.<key>``):
+      ckpt: checkpoint path override (benchmark a DIFFERENT engine
+        build against the captured trace; default: the recorded path).
+      config: model-config dict override (with ckpt overrides).
+      timing: "virtual" (default — as fast as the engine goes, recorded
+        cancels fire deterministically at their recorded token counts)
+        or "wall" (recorded inter-arrivals honored; emits a perf
+        comparison — tokens/s, TTFT p50/p95, goodput — against the
+        recorded run's ledger, so the trace doubles as a benchmark).
+      replica: which replica's stream to replay from a replica-tagged
+        multi-replica journal (default: lowest tag).
+      max_steps: scheduler-step budget (default 200000).
+      out: also write the verdict JSON to this path.
+    """
+    import json as _json
+
+    from ray_lightning_tpu.obs.journal import load_journal, replay_journal
+
+    cfg = dict(config.pop("replay", None) or {})
+    journal_path = cfg.pop("journal", None)
+    ckpt = cfg.pop("ckpt", None)
+    model_cfg = cfg.pop("config", None)
+    timing = str(cfg.pop("timing", "virtual"))
+    replica = cfg.pop("replica", None)
+    max_steps = int(cfg.pop("max_steps", 200_000))
+    out_path = cfg.pop("out", None)
+    if cfg:
+        raise ValueError(f"unknown replay options: {sorted(cfg)}")
+    if not journal_path:
+        raise ValueError(
+            "replay requires a journal path: rlt replay <journal.jsonl>"
+        )
+    journal = load_journal(
+        str(journal_path),
+        replica=None if replica is None else int(replica),
+    )
+    result = replay_journal(
+        journal,
+        ckpt_path=None if ckpt is None else str(ckpt),
+        model_config=None if model_cfg is None else dict(model_cfg),
+        timing=timing,
+        max_steps=max_steps,
+    )
+    verdict = "EXACT" if result["exact"] else "DIVERGED"
+    print(
+        f"replay {journal_path} -> {verdict}: "
+        f"{result['compared']}/{result['requests']} requests compared, "
+        f"{result['tokens_compared']} tokens, "
+        f"{result['open']} open at capture, timing={result['timing']}",
+        file=sys.stderr,
+        flush=True,
+    )
+    div = result.get("divergence")
+    if div is not None:
+        print(
+            f"first divergence: request {div['request_id']} token "
+            f"{div['token_index']}: expected {div['expected']} got "
+            f"{div['got']}",
+            file=sys.stderr,
+            flush=True,
+        )
+    perf = result.get("perf")
+    if perf is not None:
+        rec, rep = perf["recorded"], perf["replayed"]
+        print(
+            "perf recorded vs replayed: "
+            f"tok/s {rec['tokens_per_sec']} -> {rep['tokens_per_sec']}  "
+            f"ttft_p50 {rec['ttft_p50_s']} -> {rep['ttft_p50_s']}  "
+            f"ttft_p95 {rec['ttft_p95_s']} -> {rep['ttft_p95_s']}  "
+            f"goodput {rec['goodput_tokens_per_device_s']} -> "
+            f"{rep['goodput_tokens_per_device_s']}",
+            file=sys.stderr,
+            flush=True,
+        )
+    if out_path:
+        with open(str(out_path), "w") as f:
+            _json.dump(result, f, indent=2, default=str)
+    print(_json.dumps(
+        {k: v for k, v in result.items() if k != "rows"}, default=str
+    ))
+    return result
+
+
 def _fmt_cell(v: Any, width: int, digits: int = 3) -> str:
     if v is None:
         s = "-"
@@ -1006,6 +1129,11 @@ def run_top(config: Dict[str, Any]) -> Dict[str, Any]:
     2s) until Ctrl-C; piped (or with ``--top.plain true``) it prints
     one plain-text frame and exits, so ``rlt top addr | grep unhealthy``
     works in scripts. ``--top.iterations N`` bounds the refresh loop.
+    ``--top.once`` forces exactly one frame regardless of tty, and
+    ``--top.json`` prints the raw ``/fleet`` payload (the latest
+    FleetSnapshot + history ring) as ONE JSON line instead of the
+    rendered frame — the machine-readable form for scripts/CI
+    (``rlt top addr --top.once --top.json | jq .latest.fleet``).
     Returns ``{"snapshot": <last /fleet payload>}``.
     """
     import json as _json
@@ -1017,6 +1145,8 @@ def run_top(config: Dict[str, Any]) -> Dict[str, Any]:
     interval_s = float(cfg.pop("interval_s", 2.0))
     iterations = cfg.pop("iterations", None)
     plain = bool(cfg.pop("plain", False))
+    once = bool(cfg.pop("once", False))
+    json_out = bool(cfg.pop("json", False))
     timeout = float(cfg.pop("timeout_s", 10.0))
     if cfg:
         raise ValueError(f"unknown top options: {sorted(cfg)}")
@@ -1026,7 +1156,9 @@ def run_top(config: Dict[str, Any]) -> Dict[str, Any]:
         )
     base = str(addr) if "://" in str(addr) else f"http://{addr}"
     base = base.rstrip("/")
-    plain = plain or not sys.stdout.isatty()
+    plain = plain or json_out or not sys.stdout.isatty()
+    if once:
+        iterations = 1
     if iterations is None:
         iterations = 1 if plain else 0  # 0 = refresh until Ctrl-C
     iterations = int(iterations)
@@ -1038,6 +1170,15 @@ def run_top(config: Dict[str, Any]) -> Dict[str, Any]:
                 base + "/fleet", timeout=timeout
             ).read()
             last = _json.loads(body)
+            if json_out:
+                # ONE machine-readable line per poll: the raw /fleet
+                # payload (latest FleetSnapshot + history), no framing.
+                print(_json.dumps(last, default=str))
+                count += 1
+                if iterations and count >= iterations:
+                    break
+                _time.sleep(interval_s)
+                continue
             frame = render_fleet(last)
             if plain:
                 print(frame)
@@ -1134,6 +1275,8 @@ def main(argv: Optional[List[str]] = None) -> Any:
         return run_doctor(config)
     if subcommand == "top":
         return run_top(config)
+    if subcommand == "replay":
+        return run_replay(config)
     trainer, model, datamodule = build(config)
     fn = getattr(trainer, subcommand)
     if datamodule is not None:
@@ -1158,6 +1301,10 @@ def cli_entry(argv: Optional[List[str]] = None) -> Any:
         # The EXIT STATUS is doctor's contract (scriptable health
         # probe): 0 healthy, 1 unhealthy.
         return 0 if out.get("status") == 200 else 1
+    if args and args[0] == "replay":
+        # Replay's contract mirrors doctor: 0 bit-exact, 1 diverged —
+        # `rlt replay journal.jsonl && deploy` is the regression gate.
+        return 0 if out.get("exact") else 1
     # The console wrapper sys.exit()s our return value; any other
     # command's result dict is already on stdout, and a truthy
     # sys.exit(dict) would dump it to stderr and exit 1 — a successful
@@ -1166,4 +1313,7 @@ def cli_entry(argv: Optional[List[str]] = None) -> Any:
 
 
 if __name__ == "__main__":
-    cli_entry()
+    # Mirror the console-script wrapper (which sys.exit()s the return
+    # value): `python -m ray_lightning_tpu.cli doctor|replay ...` must
+    # carry the same exit-status contract as `rlt doctor|replay`.
+    sys.exit(cli_entry())

@@ -37,6 +37,12 @@ ACTIVE (judgment — something looks through the eyes):
 - :mod:`obs.httpd` — the /metrics + /stats + /healthz + /debug/bundle
   (+ /fleet + /events + /traces) HTTP endpoint
   (:class:`MetricsHTTPServer`) behind ``rlt serve --serve.metrics_port``.
+- :mod:`obs.journal` — deterministic capture & replay
+  (:class:`WorkloadJournal`, :func:`load_journal`,
+  :func:`replay_journal`): the serve session's externally-sourced
+  request stream journaled into a bounded ring (+ optional JSONL
+  spill), re-drivable bit-exactly via ``rlt replay`` — every incident
+  a local repro, every captured trace a benchmark.
 - :mod:`obs.fleet` — the fleet aggregator (:class:`FleetPoller`,
   :class:`FleetSnapshot`): a driver-side puller condensing every
   replica's stats/health into one bounded-history snapshot stream —
@@ -68,6 +74,11 @@ from ray_lightning_tpu.obs.health import (
 )
 from ray_lightning_tpu.obs.httpd import MetricsHTTPServer
 from ray_lightning_tpu.obs.jaxmon import compile_stats, install_compile_listener
+from ray_lightning_tpu.obs.journal import (
+    WorkloadJournal,
+    load_journal,
+    replay_journal,
+)
 from ray_lightning_tpu.obs.profiling import capture_profile, profiler_available
 from ray_lightning_tpu.obs.registry import (
     Counter,
@@ -103,6 +114,7 @@ __all__ = [
     "SLORule",
     "TrainTelemetry",
     "Watchdog",
+    "WorkloadJournal",
     "aggregate_fleet",
     "capture_profile",
     "compile_stats",
@@ -111,11 +123,13 @@ __all__ = [
     "get_registry",
     "heartbeats_to_registry",
     "install_compile_listener",
+    "load_journal",
     "merge_chrome_trace",
     "parse_prometheus_text",
     "parse_slo_rules",
     "profiler_available",
     "read_bundle",
+    "replay_journal",
     "summarize_replica",
     "to_chrome_trace",
 ]

@@ -9,6 +9,9 @@ bundle directory containing:
 - ``metrics.prom``   — the registry rendered in Prometheus text format
 - ``events.jsonl``   — the structured event-log tail (obs.events)
 - ``trace.json``     — recent request traces as Chrome trace JSON
+- ``journal.jsonl``  — the workload journal (obs.journal): the recorded
+                       request stream + outcomes, replayable via
+                       ``rlt replay``
 - ``health.json``    — the health report at dump time (obs.health)
 - ``heartbeats.json``— the fabric heartbeat snapshot (driver-side)
 - ``config.json``    — the serve/train config the process ran with
@@ -81,6 +84,7 @@ def dump_bundle(
     registry: Optional[MetricsRegistry] = None,
     events: Optional[EventLog] = None,
     tracer: Optional[Any] = None,
+    journal: Optional[Any] = None,
     health: Optional[Any] = None,
     heartbeats: Optional[Dict[str, Any]] = None,
     config: Optional[Dict[str, Any]] = None,
@@ -126,6 +130,11 @@ def dump_bundle(
                 to_chrome_trace({r: e for r, e in traces.items() if e})
             )
         write("trace.json", _trace)
+    if journal is not None:
+        # The workload journal (obs.journal) makes the bundle
+        # REPLAYABLE: `rlt replay <bundle>/journal.jsonl` re-drives the
+        # recorded request stream bit-exactly.
+        write("journal.jsonl", journal.to_jsonl)
     if health is not None:
         write("health.json", lambda: json.dumps(
             health.to_dict() if hasattr(health, "to_dict") else health,
@@ -190,6 +199,7 @@ class FlightRecorder:
         registry: Optional[MetricsRegistry] = None,
         events: Optional[EventLog] = None,
         tracer: Optional[Any] = None,
+        journal: Optional[Any] = None,
         health_fn: Optional[Callable[[], Any]] = None,
         heartbeats_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         config: Optional[Dict[str, Any]] = None,
@@ -200,6 +210,7 @@ class FlightRecorder:
         self._registry = registry
         self._events = events
         self._tracer = tracer
+        self._journal = journal
         self._health_fn = health_fn
         self._heartbeats_fn = heartbeats_fn
         self._config = config
@@ -226,6 +237,7 @@ class FlightRecorder:
             registry=self._registry,
             events=self._events,
             tracer=self._tracer,
+            journal=self._journal,
             health=self._health_fn() if self._health_fn else None,
             heartbeats=self._heartbeats_fn() if self._heartbeats_fn else None,
             config=self._config,

@@ -1,5 +1,5 @@
 """Tiny obs HTTP endpoint: /metrics, /stats, /healthz, /debug/bundle,
-/fleet, /events, /traces.
+/fleet, /events, /traces, /journal.
 
 Standard-library only (http.server in a daemon thread). The handler
 calls the collector functions PER REQUEST, so a scrape always sees
@@ -28,19 +28,53 @@ transport behind ``rlt doctor --doctor.bundle``.
 The fleet routes (PR 8): ``/fleet`` serves ``collect_fleet`` (the
 latest :class:`obs.fleet.FleetSnapshot` + history ring — ``rlt top``'s
 feed), ``/events`` serves ``collect_events`` as JSONL (the merged
-structured event rings), and ``/traces`` serves ``collect_traces``
-(the stitched cross-process Chrome trace — save it and open in
-Perfetto). All three are collector-gated exactly like the others: an
-endpoint without the collector 404s.
+structured event rings — ``?level=``, ``?subsystem=``, and ``?n=``
+query filters apply server-side via :func:`filter_events_jsonl`), and
+``/traces`` serves ``collect_traces`` (the stitched cross-process
+Chrome trace — save it and open in Perfetto). ``/journal`` serves
+``collect_journal`` as JSONL — the workload journal (obs.journal),
+directly consumable by ``rlt replay``. All are collector-gated exactly
+like the others: an endpoint without the collector 404s.
 """
 from __future__ import annotations
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def filter_events_jsonl(text: str, query: Dict[str, List[str]]) -> str:
+    """Apply ``/events`` query filters to a JSONL body: ``level=`` and
+    ``subsystem=`` keep matching rows (repeatable — values OR), ``n=``
+    keeps the newest n AFTER filtering. Unparseable lines are dropped
+    rather than crashing a scrape; no recognized params = passthrough."""
+    levels = set(query.get("level") or [])
+    subsystems = set(query.get("subsystem") or [])
+    n = None
+    if query.get("n"):
+        n = int(query["n"][0])
+    if not levels and not subsystems and n is None:
+        return text
+    kept: List[str] = []
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        try:
+            row = json.loads(ln)
+        except ValueError:
+            continue
+        if levels and row.get("level") not in levels:
+            continue
+        if subsystems and row.get("subsystem") not in subsystems:
+            continue
+        kept.append(ln)
+    if n is not None:
+        kept = kept[-n:]
+    return "\n".join(kept) + ("\n" if kept else "")
 
 
 class MetricsHTTPServer:
@@ -55,6 +89,7 @@ class MetricsHTTPServer:
         collect_fleet: Optional[Callable[[], Dict[str, Any]]] = None,
         collect_events: Optional[Callable[[], str]] = None,
         collect_traces: Optional[Callable[[], Dict[str, Any]]] = None,
+        collect_journal: Optional[Callable[[], str]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ) -> None:
@@ -65,6 +100,7 @@ class MetricsHTTPServer:
         self._collect_fleet = collect_fleet
         self._collect_events = collect_events
         self._collect_traces = collect_traces
+        self._collect_journal = collect_journal
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -72,7 +108,7 @@ class MetricsHTTPServer:
                 pass  # scrapes must not spam stderr
 
             def do_GET(self) -> None:  # noqa: N802 - http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 code = 200
                 try:
                     if path in ("/metrics", "/"):
@@ -109,7 +145,15 @@ class MetricsHTTPServer:
                         path == "/events"
                         and outer._collect_events is not None
                     ):
-                        body = outer._collect_events().encode()
+                        body = filter_events_jsonl(
+                            outer._collect_events(), parse_qs(query)
+                        ).encode()
+                        ctype = "application/x-ndjson"
+                    elif (
+                        path == "/journal"
+                        and outer._collect_journal is not None
+                    ):
+                        body = outer._collect_journal().encode()
                         ctype = "application/x-ndjson"
                     elif (
                         path == "/traces"

@@ -228,6 +228,32 @@ class ServeClient:
             json.dumps(r, default=str) for r in rows
         ) + ("\n" if rows else "")
 
+    def journal_dumps(
+        self, n: Optional[int] = None
+    ) -> List[Dict[str, Any]]:
+        """Every replica's workload journal in the wire form (header +
+        entries), index-aligned with the replica list — the replay
+        substrate (obs.journal)."""
+        return fabric.get(
+            [r.journal_dump.remote(n) for r in self._replicas]
+        )
+
+    def journal_jsonl(self, n: Optional[int] = None) -> str:
+        """The fleet's journals as JSONL (the ``/journal`` route body).
+        A single replica's journal comes back verbatim (directly
+        replayable); multi-replica output tags every line with its
+        replica index — ``rlt replay --replay.replica i`` (or
+        ``obs.journal.load_journal(path, replica=i)``) filters one
+        replica's stream back out."""
+        from ray_lightning_tpu.obs.journal import dump_to_jsonl
+
+        dumps = self.journal_dumps(n)
+        if len(dumps) == 1:
+            return dump_to_jsonl(dumps[0])
+        return "".join(
+            dump_to_jsonl(d, replica=i) for i, d in enumerate(dumps)
+        )
+
     def health(self) -> List[Dict[str, Any]]:
         """Per-replica health reports (obs.health), index-aligned with
         the replica list — the driver aggregates them replica-labelled

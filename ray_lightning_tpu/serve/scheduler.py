@@ -50,6 +50,7 @@ from ray_lightning_tpu.serve.metrics import ServeMetrics
 
 if TYPE_CHECKING:  # engine pulls jax; keep the package import light
     from ray_lightning_tpu.obs.events import EventLog
+    from ray_lightning_tpu.obs.journal import WorkloadJournal
     from ray_lightning_tpu.obs.trace import RequestTracer
     from ray_lightning_tpu.serve.engine import DecodeEngine
 
@@ -113,6 +114,7 @@ class Scheduler:
         priority_age_s: Optional[float] = None,
         tracer: Optional["RequestTracer"] = None,
         events: Optional["EventLog"] = None,
+        journal: Optional["WorkloadJournal"] = None,
     ) -> None:
         self.engine = engine
         self.metrics = metrics or ServeMetrics(engine.num_slots)
@@ -129,6 +131,15 @@ class Scheduler:
         self.events = events
         if events is not None and getattr(engine, "events", None) is None:
             engine.events = events
+        #: Workload journal (obs.journal): the deterministic capture of
+        #: every externally-sourced input (submits with full sampling
+        #: params, cancels) plus per-request emitted-token outcomes —
+        #: the replay substrate. None = off (zero cost). Token values
+        #: accumulate inline in step()'s existing loops (one list append
+        #: per emission, no extra pass) and flush at the ledger close.
+        self.journal = journal
+        self._jr_tokens: Dict[str, List[int]] = {}
+        self._jr_ttft: Dict[str, float] = {}
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
         #: Chunk-vs-fold interleave budget: prefill chunks advanced per
         #: step (chunked engines only; sits next to the admission budget).
@@ -206,6 +217,16 @@ class Scheduler:
             device_s=rec["device_s"],
             queue_s=rec["queue_s"],
         )
+        if self.journal is not None:
+            # The outcome entry rides the ledger close: the emitted
+            # token values (accumulated inline as they were harvested)
+            # + this cost record — the recorded truth a replay asserts
+            # bit-exactness against.
+            self.journal.record_outcome(
+                rid, outcome, cost=rec,
+                tokens=self._jr_tokens.pop(rid, None),
+                ttft_s=self._jr_ttft.pop(rid, None),
+            )
 
     def _trace(
         self, rid: str, span: str, t: Optional[float] = None, **attrs: Any
@@ -259,6 +280,24 @@ class Scheduler:
             depth = len(self._pending)
             self.metrics.record_submit(depth)
             self._acct_open(req)
+        if self.journal is not None:
+            s = req.sampling
+            self.journal.record_submit(
+                request_id=req.request_id,
+                prompt=req.prompt,
+                sampling={
+                    "max_new_tokens": s.max_new_tokens,
+                    "temperature": s.temperature,
+                    "top_k": s.top_k,
+                    "top_p": s.top_p,
+                    "seed": s.seed,
+                    "eos_token": s.eos_token,
+                },
+                priority=req.priority,
+                deadline_s=req.deadline_s,
+                tenant=req.tenant,
+                t_mono=req.submitted_at,
+            )
         if self.tracer is not None:
             self.tracer.event(
                 req.request_id, _trace.SPAN_SUBMIT, t=req.submitted_at,
@@ -287,7 +326,9 @@ class Scheduler:
             )
             if known:
                 self._cancelled.add(request_id)
-            return known
+        if self.journal is not None:
+            self.journal.record_cancel(request_id, known)
+        return known
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -466,6 +507,11 @@ class Scheduler:
                 )
                 if acct is not None:
                     acct["emitted_tokens"] += 1
+                if self.journal is not None:
+                    self._jr_tokens[req.request_id] = [int(first_tok)]
+                    self._jr_ttft[req.request_id] = (
+                        now - req.submitted_at
+                    )
                 events.append(
                     TokenEvent(
                         req.request_id, first_tok, done,
@@ -509,6 +555,14 @@ class Scheduler:
                 acct["prefill_chunks"] = task.chunks
                 acct["prefix_hit_tokens"] = task.matched_tokens
                 acct["emitted_tokens"] += 1
+            if self.journal is not None and tok is not None:
+                self._jr_tokens.setdefault(
+                    task.request_id, []
+                ).append(int(tok))
+                if req is not None:
+                    self._jr_ttft.setdefault(
+                        task.request_id, now - req.submitted_at
+                    )
             events.append(
                 TokenEvent(
                     task.request_id, tok, done,
@@ -581,8 +635,11 @@ class Scheduler:
                 self.tracer.event(
                     rid, _trace.SPAN_DECODE_FOLD, attrs={"tokens": n}
                 )
+        jr_on = self.journal is not None
         for slot, rid, tok, done in fold_results:
             emitted += 1
+            if jr_on:
+                self._jr_tokens.setdefault(rid, []).append(int(tok))
             events.append(
                 TokenEvent(rid, tok, done, "finished" if done else "token")
             )

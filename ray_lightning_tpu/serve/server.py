@@ -358,6 +358,9 @@ class ServeReplica:
         tick_s: float = 0.002,
         tracing: bool = True,
         trace_capacity: int = 8192,
+        journal: bool = True,
+        journal_dir: Optional[str] = None,
+        journal_capacity: int = 4096,
         watchdog: bool = True,
         watchdog_interval_s: float = 1.0,
         stall_s: float = 10.0,
@@ -442,6 +445,32 @@ class ServeReplica:
             capacity=trace_capacity, enabled=bool(tracing)
         )
         self.events = get_event_log()
+        # Workload journal: the deterministic capture of this replica's
+        # externally-sourced request stream (ring always on by default —
+        # the hot-path cost is one dict append per lifecycle event;
+        # journal_dir adds the streaming JSONL spill). The header pins
+        # the config/checkpoint identity a replay rebuilds from.
+        self.journal = None
+        if journal:
+            from ray_lightning_tpu.obs.journal import (
+                WorkloadJournal,
+                engine_header,
+            )
+
+            self.journal = WorkloadJournal(
+                capacity=int(journal_capacity), spill_dir=journal_dir
+            )
+            self.journal.set_header(engine_header(
+                self.engine,
+                ckpt_path=ckpt_path,
+                int8=self.int8,
+                spec_draft_ckpt=spec_draft_ckpt,
+                spec_draft_config=spec_draft_config,
+                spec_draft_int8=spec_draft_int8,
+                max_prefills_per_step=max_prefills_per_step,
+                max_prefill_chunks_per_step=max_prefill_chunks_per_step,
+                priority_age_s=priority_age_s,
+            ))
         self.scheduler = Scheduler(
             self._sched_engine,
             metrics=self.metrics,
@@ -450,6 +479,7 @@ class ServeReplica:
             priority_age_s=priority_age_s,
             tracer=self.tracer,
             events=self.events,
+            journal=self.journal,
         )
         self._serve_config: Dict[str, Any] = {
             "num_slots": self.engine.num_slots,
@@ -466,6 +496,7 @@ class ServeReplica:
             "watchdog": bool(watchdog),
             "stall_s": float(stall_s),
             "slo": dict(slo or {}),
+            "journal": self.journal is not None,
         }
         self.events.record(
             "serve", "replica_init",
@@ -479,6 +510,7 @@ class ServeReplica:
             registry=self._registry,
             events=self.events,
             tracer=self.tracer,
+            journal=self.journal,
             # The LAST report, not a fresh evaluation: a dump triggered
             # from inside evaluate() (on_unhealthy) must capture the
             # verdict that fired it, and must not recurse.
@@ -716,6 +748,15 @@ class ServeReplica:
         """Tail of this process's structured event log (obs.events)."""
         return self.events.tail(n)
 
+    def journal_dump(self, n: Optional[int] = None) -> Dict[str, Any]:
+        """This replica's workload journal in the wire form (header +
+        newest ``n`` entries; all when None) — the replay substrate
+        behind ``/journal``, ``journal.jsonl`` bundles, and
+        ``rlt replay``. Empty when journaling is off."""
+        if self.journal is None:
+            return {"header": None, "entries": []}
+        return self.journal.dump(n)
+
     # -- observability RPCs ----------------------------------------------
     def trace(self, request_id: str) -> list:
         """One request's recorded spans (oldest first); [] when unknown
@@ -764,6 +805,8 @@ class ServeReplica:
     def stop(self) -> None:
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.journal is not None:
+            self.journal.close()  # flush/close any open spill file
         if isinstance(self._sched_engine, _GangLeaderEngine):
             self._sched_engine.close()  # followers drain and exit
         self._stop.set()

@@ -134,6 +134,22 @@ def test_bench_smoke_cpu():
     }
     assert fl_modes == {"fleet_off", "fleet_on"}, out["extra"]
     assert out["extra"]["fleet_overhead"] < 1.05, out["extra"]
+    # And for CAPTURE: the default-on workload journal (the bounded
+    # ring) must also cost < 5% tokens/s on the decode hot loop — a
+    # journal you can't afford to leave on never captures the incident.
+    # The opt-in JSONL spill is recorded as a third row
+    # (journal_on_spill / journal_spill_overhead) but not gated: its
+    # flush cost is a knowing trade the --serve.journal operator makes.
+    jr_modes = {
+        r["mode"]
+        for r in out["extra"]["serve_rows"]
+        if r["workload"] == "journal_overhead"
+    }
+    assert jr_modes == {
+        "journal_off", "journal_on", "journal_on_spill",
+    }, out["extra"]
+    assert out["extra"]["journal_overhead"] < 1.05, out["extra"]
+    assert out["extra"]["journal_spill_overhead"] > 0, out["extra"]
     # Mesh-sharded decode sweep: a 1x1 control plus >= 1 model-axis
     # mesh over the forced host devices, per-device KV bytes shrinking
     # ~linearly in the model axis (the tp=N footprint story, measured).

@@ -463,6 +463,13 @@ class _StubClient:
     def export_stitched_trace(self, n=16):
         return obs.merge_chrome_trace(self.trace_dumps(n))
 
+    def journal_jsonl(self, n=None):
+        return (
+            '{"kind": "header", "version": 1}\n'
+            '{"kind": "submit", "request_id": "r1", "prompt": [1],'
+            ' "sampling": {"seed": 0}}\n'
+        )
+
     def debug_dump(self, reason="rpc", pull=True):
         return {
             "reason": reason, "dir": "/tmp/stub-bundle",
@@ -516,6 +523,12 @@ def test_serve_obs_server_routes_over_real_http(start_fabric, tmp_path):
             base + "/metrics", timeout=10
         ).read().decode()
         assert "rlt_fleet_replicas" in scrape
+        jlines = urllib.request.urlopen(
+            base + "/journal", timeout=10
+        ).read().decode().splitlines()
+        jrows = [json.loads(ln) for ln in jlines if ln]
+        assert jrows[0]["kind"] == "header"
+        assert any(r.get("kind") == "submit" for r in jrows)
         # Doctor pull: the driver augments the replica bundle with the
         # fleet snapshot + stitched trace before shipping it.
         out = run_doctor({

@@ -1,0 +1,470 @@
+"""Deterministic capture & replay tests: the workload journal ring +
+JSONL spill, bit-exact replay of a recorded serve session (greedy +
+seeded sampling + mid-flight cancel + an expired deadline), the
+first-divergence report (and `rlt replay`'s nonzero exit) on injected
+token mismatches, the doctor-bundle journal path end to end, the
+`/events` query filters, the `/journal` route, and `rlt top
+--top.once --top.json`.
+
+The load-bearing property: the serving engine is deterministic given
+its inputs (frozen compiles, bit-exact greedy, per-seed rng chains), so
+journaling ONLY the externally-sourced request stream is sufficient for
+a bit-exact replay — asserted here by replaying recorded sessions on a
+freshly built engine and comparing token-for-token.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import obs
+from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+from ray_lightning_tpu.obs.journal import (
+    WorkloadJournal,
+    engine_header,
+    load_journal,
+    replay_journal,
+)
+
+#: One layer is enough: replay exactness is about the REQUEST STREAM
+#: round trip, not model depth — and every test here pays an engine
+#: compile, so the config is as small as the serve path allows.
+JR_CFG = GPTConfig(
+    vocab_size=97,
+    n_layer=1,
+    n_head=4,
+    n_kv_head=2,
+    d_model=32,
+    max_seq=64,
+    attn_impl="reference",
+    compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def jr_params():
+    import jax
+
+    return init_gpt_params(jax.random.PRNGKey(0), JR_CFG)
+
+
+# ---------------------------------------------------------------------------
+# Ring bounding + spill rotation (pure)
+# ---------------------------------------------------------------------------
+def test_journal_ring_bounds_and_spill_rotation(tmp_path):
+    """The ring drops oldest entries at capacity; the spill rotates at
+    spill_max_bytes keeping spill_keep files, each re-writing the
+    header line so every kept file is independently loadable."""
+    spill = str(tmp_path / "spill")
+    jr = WorkloadJournal(
+        capacity=8, spill_dir=spill, spill_max_bytes=600, spill_keep=3
+    )
+    jr.set_header({"version": 1, "model_config": {"d_model": 32}})
+    for i in range(40):
+        jr.record_submit(
+            request_id=f"r{i:03d}", prompt=[1, 2, 3],
+            sampling={"max_new_tokens": 4, "seed": i},
+        )
+    jr.close()
+    # Ring: bounded, newest kept.
+    d = jr.dump()
+    assert len(d["entries"]) == 8
+    assert d["entries"][-1]["request_id"] == "r039"
+    assert d["header"]["model_config"] == {"d_model": 32}
+    # dump(n) tails further.
+    assert len(jr.dump(3)["entries"]) == 3
+    # Spill: rotated and pruned, every file starts with a header line.
+    files = sorted(os.listdir(spill))
+    assert 1 < len(files) <= 3, files
+    for name in files:
+        with open(os.path.join(spill, name)) as f:
+            first = json.loads(f.readline())
+        assert first["kind"] == "header"
+    # A directory loads as one journal (oldest kept file first).
+    loaded = load_journal(spill)
+    assert loaded["header"]["version"] == 1
+    rids = [e["request_id"] for e in loaded["entries"]]
+    assert rids == sorted(rids)  # in record order
+    assert rids[-1] == "r039"
+    # to_jsonl round-trips through load_journal.
+    path = tmp_path / "one.jsonl"
+    path.write_text(jr.to_jsonl())
+    again = load_journal(str(path))
+    assert [e["request_id"] for e in again["entries"]] == [
+        e["request_id"] for e in d["entries"]
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Capture -> bit-exact replay (in-process scheduler)
+# ---------------------------------------------------------------------------
+def _record_session(jr_params, journal):
+    """One serve session: two greedy, one seeded-sampling, one
+    mid-flight cancel, one queued expiry — the acceptance workload."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    eng = DecodeEngine(
+        jr_params, JR_CFG, num_slots=2, max_seq=64,
+        prefill_buckets=[8], decode_fold=2,
+    )
+    journal.set_header(engine_header(eng, max_prefills_per_step=2))
+    sched = Scheduler(eng, max_prefills_per_step=2, journal=journal)
+    g = np.random.default_rng(3)
+    p = lambda n: g.integers(0, 97, size=n).tolist()  # noqa: E731
+    sched.submit(p(6), SamplingParams(max_new_tokens=8))
+    sched.submit(
+        p(7),
+        SamplingParams(
+            max_new_tokens=8, temperature=0.9, seed=11, top_k=20
+        ),
+        tenant="acme",
+    )
+    rc = sched.submit(p(6), SamplingParams(max_new_tokens=16))
+    sched.submit(
+        p(5), SamplingParams(max_new_tokens=8), deadline_s=0.0
+    )
+    got = 0
+    while sched.has_work():
+        evs = sched.step()
+        got += sum(
+            1 for e in evs if e.request_id == rc and e.token is not None
+        )
+        if got >= 3:
+            sched.cancel(rc)
+            break
+    sched.run_until_idle()
+    return rc
+
+
+def test_capture_and_replay_bit_exact(jr_params, tmp_path):
+    """The tentpole contract: a recorded session (greedy +
+    seeded-sampling + mid-flight cancel + expired deadline) replays
+    bit-exact per-request token output on a FRESH engine, in virtual
+    time; wall timing also replays exact and emits the perf comparison
+    against the recorded ledger."""
+    jr = WorkloadJournal(capacity=256, spill_dir=str(tmp_path / "s"))
+    rc = _record_session(jr_params, jr)
+    jr.close()
+    j = load_journal(str(tmp_path / "s"))
+    outcomes = {
+        e["request_id"]: e for e in j["entries"]
+        if e["kind"] == "outcome"
+    }
+    assert {o["outcome"] for o in outcomes.values()} == {
+        "finished", "cancelled", "expired",
+    }
+    assert len(outcomes[rc]["tokens"]) >= 3  # the truncated prefix
+    # Outcome entries carry the ledger record + ttft for the perf diff.
+    fin = next(
+        o for o in outcomes.values() if o["outcome"] == "finished"
+    )
+    assert fin["cost"]["emitted_tokens"] == len(fin["tokens"])
+    assert fin["ttft_s"] > 0
+    # A tenant label survives the round trip.
+    subs = {
+        e["request_id"]: e for e in j["entries"] if e["kind"] == "submit"
+    }
+    assert any(s.get("tenant") == "acme" for s in subs.values())
+    assert any(
+        s["sampling"]["seed"] == 11 and s["sampling"]["temperature"] == 0.9
+        for s in subs.values()
+    )
+
+    from ray_lightning_tpu.obs.journal import build_replay_scheduler
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    sched_v = build_replay_scheduler(j["header"], params=jr_params)
+    res = replay_journal(j, scheduler=sched_v)
+    assert res["exact"] is True and res["divergence"] is None
+    assert res["compared"] == 4 and res["open"] == 0
+    assert res["tokens_compared"] == sum(
+        len(o["tokens"]) for o in outcomes.values()
+    )
+    by_rid = {r["request_id"]: r for r in res["rows"]}
+    assert by_rid[rc]["outcome_replayed"] == "cancelled"
+    exp_rid = next(
+        r for r, o in outcomes.items() if o["outcome"] == "expired"
+    )
+    assert by_rid[exp_rid]["outcome_replayed"] == "expired"
+    assert by_rid[exp_rid]["tokens_replayed"] == 0
+
+    # Wall timing: still exact on finished requests, plus the perf
+    # comparison computed from the recorded run's own journal/ledger.
+    # (A fresh Scheduler over the drained replay engine — scheduler
+    # state is host-side, so the compiled engine is reusable.)
+    res_w = replay_journal(
+        j,
+        scheduler=Scheduler(sched_v.engine, max_prefills_per_step=2),
+        timing="wall",
+    )
+    assert res_w["exact"] is True
+    perf = res_w["perf"]
+    assert perf["recorded"]["tokens_per_sec"] > 0
+    assert perf["replayed"]["tokens_per_sec"] > 0
+    assert perf["recorded"]["ttft_p50_s"] > 0
+    assert perf["recorded"]["goodput_tokens_per_device_s"] > 0
+    assert "tokens_per_sec" in perf["replay_vs_recorded"]
+
+
+def test_replay_rejects_bad_timing_and_missing_header(jr_params):
+    with pytest.raises(ValueError, match="timing"):
+        replay_journal({"entries": []}, timing="nope")
+    with pytest.raises(ValueError, match="header"):
+        replay_journal({"header": None, "entries": []})
+
+
+# ---------------------------------------------------------------------------
+# ServeReplica end to end: ckpt header, doctor-bundle journal path,
+# injected divergence, rlt replay exit status
+# ---------------------------------------------------------------------------
+def _write_ckpt(tmp_path, params):
+    import dataclasses
+
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file,
+        to_state_stream,
+    )
+
+    path = os.path.join(str(tmp_path), "journal.ckpt")
+    state_stream_to_file(
+        to_state_stream(
+            {"params": params, "gpt_config": dataclasses.asdict(JR_CFG)}
+        ),
+        path,
+    )
+    return path
+
+
+def test_replica_bundle_journal_replay_and_divergence(
+    jr_params, tmp_path, capsys
+):
+    """The acceptance path: an in-process ServeReplica serving from a
+    real checkpoint journals greedy + seeded + a mid-flight cancel; the
+    flight-recorder bundle carries journal.jsonl; `rlt replay` of that
+    file rebuilds the engine FROM THE HEADER'S CKPT and replays
+    bit-exactly (exit 0); an injected token mismatch yields the
+    first-divergence report and a nonzero exit."""
+    from ray_lightning_tpu.cli import cli_entry, parse_args
+    from ray_lightning_tpu.serve.server import ServeReplica
+
+    ckpt = _write_ckpt(tmp_path, jr_params)
+    rep = ServeReplica(
+        ckpt_path=ckpt,
+        num_slots=2,
+        prefill_buckets=[8],
+        decode_fold=2,
+        watchdog=False,
+        blackbox_dir=str(tmp_path / "bb"),
+    )
+    try:
+        g = np.random.default_rng(5)
+        r1 = rep.submit(
+            g.integers(0, 97, size=6).tolist(), max_new_tokens=6
+        )
+        r2 = rep.submit(
+            g.integers(0, 97, size=7).tolist(), max_new_tokens=6,
+            temperature=0.8, seed=23, top_k=16,
+        )
+        rc = rep.submit(
+            g.integers(0, 97, size=6).tolist(), max_new_tokens=32
+        )
+        deadline = time.monotonic() + 120
+        while len(rep.result(rc, wait_s=0.5)["tokens"]) < 2:
+            assert time.monotonic() < deadline, "no tokens for cancel rig"
+        rep.cancel(rc)
+        for rid in (r1, r2, rc):
+            while not rep.result(rid, wait_s=0.5)["done"]:
+                assert time.monotonic() < deadline
+        manifest = rep.debug_dump(reason="test", pull=True)
+    finally:
+        rep.stop()
+    # The doctor-bundle journal path: journal.jsonl rides the bundle.
+    assert "journal.jsonl" in manifest["files"], manifest
+    journal_text = manifest["files_content"]["journal.jsonl"]
+    jpath = tmp_path / "pulled_journal.jsonl"
+    jpath.write_text(journal_text)
+    header = load_journal(str(jpath))["header"]
+    assert header["ckpt_path"] == ckpt
+    assert header["ckpt_bytes"] > 0  # checkpoint identity recorded
+    assert header["engine"]["num_slots"] == 2
+
+    # rlt replay rebuilds from the header's checkpoint: exact, exit 0.
+    sub, cfg = parse_args(["replay", str(jpath)])
+    assert sub == "replay" and cfg["replay"]["journal"] == str(jpath)
+    assert cli_entry(["replay", str(jpath)]) == 0
+    capsys.readouterr()
+
+    # Inject a token mismatch into a finished outcome: the replay must
+    # report the exact first divergence and exit nonzero.
+    lines = [json.loads(ln) for ln in journal_text.splitlines() if ln]
+    tampered_rid = None
+    for row in lines:
+        if row.get("kind") == "outcome" and row["outcome"] == "finished":
+            row["tokens"][1] = (row["tokens"][1] + 1) % 97
+            tampered_rid = row["request_id"]
+            break
+    assert tampered_rid is not None
+    tpath = tmp_path / "tampered.jsonl"
+    tpath.write_text(
+        "\n".join(json.dumps(r) for r in lines) + "\n"
+    )
+    # One CLI run covers both contracts: nonzero exit AND the
+    # first-divergence report in the verdict JSON (--replay.out).
+    rc_code = cli_entry([
+        "replay", str(tpath),
+        "--replay.out", str(tmp_path / "verdict.json"),
+    ])
+    capsys.readouterr()
+    assert rc_code == 1
+    verdict = json.loads((tmp_path / "verdict.json").read_text())
+    assert verdict["exact"] is False
+    div = verdict["divergence"]
+    assert div["request_id"] == tampered_rid
+    assert div["token_index"] == 1
+    assert div["expected"] != div["got"]
+
+
+# ---------------------------------------------------------------------------
+# /events filters + /journal route (real HTTP)
+# ---------------------------------------------------------------------------
+def test_events_route_query_filters_over_http():
+    """/events gains ?level= / ?subsystem= / ?n= server-side filters;
+    no params keeps the legacy full dump."""
+    from ray_lightning_tpu.obs.events import EventLog
+
+    log = EventLog(capacity=64)
+    log.record("scheduler", "admit_burst", n=1)
+    log.record("scheduler", "expire", level="warn", request_id="a")
+    log.record("engine", "prefix_evict", level="warn", blocks=2)
+    log.record("fabric", "actor_start")
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "", collect_events=log.to_jsonl
+    ).start()
+    try:
+        base = f"http://{srv.host}:{srv.port}/events"
+
+        def rows(q=""):
+            body = urllib.request.urlopen(base + q, timeout=10).read()
+            return [
+                json.loads(ln)
+                for ln in body.decode().splitlines() if ln
+            ]
+
+        assert len(rows()) == 4  # passthrough without params
+        warns = rows("?level=warn")
+        assert len(warns) == 2
+        assert all(r["level"] == "warn" for r in warns)
+        sched = rows("?subsystem=scheduler")
+        assert {r["name"] for r in sched} == {"admit_burst", "expire"}
+        assert [r["name"] for r in rows("?n=2")] == [
+            "prefix_evict", "actor_start",
+        ]  # newest n after filtering
+        combo = rows("?level=warn&subsystem=engine")
+        assert [r["name"] for r in combo] == ["prefix_evict"]
+        assert rows("?level=error") == []
+    finally:
+        srv.close()
+
+
+def test_journal_route_over_http_is_replayable_jsonl(jr_params):
+    """/journal serves the journal as JSONL whose bytes load straight
+    back through load_journal (the curl-and-replay path)."""
+    jr = WorkloadJournal(capacity=32)
+    jr.set_header({"version": 1, "model_config": {"d_model": 32}})
+    jr.record_submit(
+        request_id="r1", prompt=[1, 2],
+        sampling={"max_new_tokens": 2, "seed": 0},
+    )
+    jr.record_cancel("r1", True)
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "", collect_journal=jr.to_jsonl
+    ).start()
+    try:
+        resp = urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/journal", timeout=10
+        )
+        assert resp.headers["Content-Type"] == "application/x-ndjson"
+        body = resp.read().decode()
+    finally:
+        srv.close()
+    lines = [json.loads(ln) for ln in body.splitlines() if ln]
+    assert lines[0]["kind"] == "header"
+    assert [ln["kind"] for ln in lines[1:]] == ["submit", "cancel"]
+
+
+def test_client_journal_jsonl_tags_replicas_and_load_filters():
+    """Multi-replica /journal bodies are replica-tagged per line;
+    load_journal filters one replica's stream back out."""
+    from ray_lightning_tpu.obs.journal import dump_to_jsonl
+
+    a = WorkloadJournal(capacity=8)
+    a.set_header({"version": 1, "who": "a"})
+    a.record_submit(request_id="ra", prompt=[1], sampling={"seed": 0})
+    b = WorkloadJournal(capacity=8)
+    b.set_header({"version": 1, "who": "b"})
+    b.record_submit(request_id="rb", prompt=[2], sampling={"seed": 0})
+    merged = dump_to_jsonl(a.dump(), replica=0) + dump_to_jsonl(
+        b.dump(), replica=1
+    )
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".jsonl", delete=False
+    ) as f:
+        f.write(merged)
+        path = f.name
+    try:
+        j0 = load_journal(path)  # default: lowest tag
+        assert j0["header"]["who"] == "a"
+        assert [e["request_id"] for e in j0["entries"]] == ["ra"]
+        j1 = load_journal(path, replica=1)
+        assert j1["header"]["who"] == "b"
+        assert [e["request_id"] for e in j1["entries"]] == ["rb"]
+        assert all("replica" not in e for e in j1["entries"])
+    finally:
+        os.unlink(path)
+
+
+# ---------------------------------------------------------------------------
+# rlt top --top.once --top.json
+# ---------------------------------------------------------------------------
+def test_top_once_json_emits_machine_readable_snapshot(capsys):
+    from ray_lightning_tpu.cli import run_top
+    from ray_lightning_tpu.obs.fleet import FleetPoller
+
+    p = FleetPoller(
+        lambda: (
+            [{
+                "queue_depth": 1, "active_slots": 1, "num_slots": 2,
+                "tokens_per_sec": 9.5, "submitted": 3, "finished": 2,
+                "cost": {"emitted_tokens": 10, "device_seconds": 2.0,
+                         "goodput_tokens_per_device_s": 5.0},
+            }],
+            [{"verdict": "healthy"}],
+            None,
+        )
+    )
+    p.poll_now()
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "", collect_fleet=p.to_dict
+    ).start()
+    try:
+        out = run_top({
+            "top": {
+                "addr": f"{srv.host}:{srv.port}",
+                "once": True, "json": True,
+            }
+        })
+        printed = capsys.readouterr().out.strip().splitlines()
+        assert len(printed) == 1  # ONE machine-readable line
+        payload = json.loads(printed[0])
+        assert payload["latest"]["fleet"]["replicas"] == 1
+        assert payload["latest"]["replicas"][0]["tokens_per_sec"] == 9.5
+        assert "rlt top" not in printed[0]  # no tty framing
+        assert out["snapshot"]["latest"]["fleet"]["replicas"] == 1
+    finally:
+        srv.close()

@@ -12,6 +12,12 @@ prefix-cache path, then:
   the live endpoint (health report over /healthz, flight-recorder
   bundle over /debug/bundle) and leaves the pulled bundle in DIR — the
   `doctor` manifest stage's artifact;
+- with ``--out-journal PATH``: serves from a real (temp) checkpoint so
+  the workload journal carries a replayable config/checkpoint header,
+  saves the captured journal JSONL to PATH, then runs the real
+  ``rlt replay`` CLI against it and writes the exactness verdict JSON
+  to ``--out-replay`` — the `replay` manifest stage's artifact (a
+  recorded serve smoke proven bit-exactly replayable on this host);
 - prints a one-line JSON summary (span counts, prefix hit rate,
   compiles_since_init — which must be 0 — health verdict, bundle path)
   to stdout.
@@ -146,6 +152,15 @@ def main() -> None:
         "flight-recorder bundle into this directory",
     )
     p.add_argument(
+        "--out-journal", default="",
+        help="save the captured workload journal JSONL here and run the "
+        "real `rlt replay` CLI against it (bit-exactness proof)",
+    )
+    p.add_argument(
+        "--out-replay", default="/tmp/replay_verdict.json",
+        help="where the replay verdict JSON lands (with --out-journal)",
+    )
+    p.add_argument(
         "--out-fleet", default="",
         help="run the 2-replica FLEET path instead and save the /fleet "
         "snapshot JSON here",
@@ -174,9 +189,29 @@ def main() -> None:
         attn_impl="reference",
     )
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    rep_kwargs = dict(params=params, model_config=cfg)
+    if args.out_journal:
+        # The journal path serves from a REAL checkpoint so the journal
+        # header carries a checkpoint identity `rlt replay` can rebuild
+        # from — the production capture shape, not the test shortcut.
+        import dataclasses
+
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        ckpt = os.path.join(
+            tempfile.mkdtemp(prefix="rlt_replay_"), "serve.ckpt"
+        )
+        state_stream_to_file(
+            to_state_stream(
+                {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+            ),
+            ckpt,
+        )
+        rep_kwargs = dict(ckpt_path=ckpt)
     rep = ServeReplica(
-        params=params,
-        model_config=cfg,
         num_slots=4,
         prefill_chunk=16,
         prefix_blocks=16,
@@ -185,6 +220,7 @@ def main() -> None:
         max_prefills_per_step=2,
         watchdog_interval_s=0.25,
         blackbox_dir=args.out_bundle or None,
+        **rep_kwargs,
     )
     try:
         g = np.random.default_rng(0)
@@ -245,11 +281,44 @@ def main() -> None:
         with open(args.out_metrics, "wb") as f:
             f.write(body)
 
+        if args.out_journal:
+            # One mid-flight cancel rides the captured session so the
+            # replay artifact proves truncated streams replay too.
+            crid = rep.submit(
+                g.integers(0, 257, size=12).tolist(), max_new_tokens=64
+            )
+            while len(rep.result(crid, wait_s=1.0)["tokens"]) < 2:
+                if time.monotonic() > deadline:
+                    print("timeout waiting for cancel target",
+                          file=sys.stderr)
+                    sys.exit(1)
+            rep.cancel(crid)
+            while not rep.result(crid, wait_s=1.0)["done"]:
+                pass
+            with open(args.out_journal, "w") as f:
+                f.write(rep.journal.to_jsonl())
+
         chrome = rep.export_trace(n=args.requests)
         with open(args.out_trace, "w") as f:
             json.dump(chrome, f)
 
         stats = rep.stats()
+        replay = None
+        if args.out_journal:
+            # Replay AFTER stats: the replay rebuilds a second engine in
+            # this process, and its construction compiles must not bleed
+            # into the replica's compiles_since_init reading above.
+            from ray_lightning_tpu.cli import run_replay
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                replay = run_replay({
+                    "replay": {
+                        "journal": args.out_journal,
+                        "out": args.out_replay,
+                    }
+                })
+            print(buf.getvalue(), file=sys.stderr, end="")
         parsed = obs.parse_prometheus_text(body.decode())
         summary = {
             "requests": args.requests,
@@ -267,6 +336,11 @@ def main() -> None:
         if doctor is not None:
             summary["doctor_status"] = doctor["status"]
             summary["bundle"] = doctor.get("bundle")
+        if replay is not None:
+            summary["replay_exact"] = replay["exact"]
+            summary["replay_compared"] = replay["compared"]
+            summary["out_journal"] = args.out_journal
+            summary["out_replay"] = args.out_replay
         print(json.dumps(summary))
     finally:
         rep.stop()

@@ -21,6 +21,7 @@ have_spec=0
 have_obs=0
 have_doctor=0
 have_fleet=0
+have_replay=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -29,6 +30,7 @@ spec_fails=0
 obs_fails=0
 doctor_fails=0
 fleet_fails=0
+replay_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -41,6 +43,7 @@ spec_status=pending
 obs_status=pending
 doctor_status=pending
 fleet_status=pending
+replay_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -60,6 +63,7 @@ write_manifest() {
     echo "stage=obs status=$obs_status fails=$obs_fails"
     echo "stage=doctor status=$doctor_status fails=$doctor_fails"
     echo "stage=fleet status=$fleet_status fails=$fleet_fails"
+    echo "stage=replay status=$replay_status fails=$replay_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -293,6 +297,36 @@ while true; do
             have_fleet=1
             fleet_status=skipped
             echo "$(date -u +%H:%M:%S) fleet snapshot SKIPPED after $fleet_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_replay" -eq 0 ]; then
+        # Stage 7c: capture & replay artifact — record a serve smoke's
+        # workload journal (config/checkpoint header + request stream +
+        # emitted-token outcomes), `rlt replay` it on the same host, and
+        # archive the bit-exactness verdict, so each healthy window
+        # proves the incident-repro path end-to-end on-chip.
+        echo "$(date -u +%H:%M:%S) launching REPLAY snapshot" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 1200 python tools/obs_snapshot.py \
+            --out-metrics /tmp/replay_metrics.prom \
+            --out-trace /tmp/replay_trace.json \
+            --out-journal /tmp/serve_journal.jsonl \
+            --out-replay /tmp/replay_verdict.json \
+            > /tmp/replay_snapshot.json 2> /tmp/replay_snapshot.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/serve_journal.jsonl ] && \
+           grep -q '"exact": true' /tmp/replay_verdict.json 2>/dev/null; then
+          have_replay=1
+          replay_status=ok
+          echo "$(date -u +%H:%M:%S) REPLAY snapshot SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          replay_fails=$((replay_fails+1))
+          replay_status=failed
+          echo "$(date -u +%H:%M:%S) replay snapshot failed rc=$rc (fail $replay_fails)" >> /tmp/tpu_watch.log
+          if [ "$replay_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_replay=1
+            replay_status=skipped
+            echo "$(date -u +%H:%M:%S) replay snapshot SKIPPED after $replay_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       else
