@@ -684,6 +684,10 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
       cache OFF vs ON — per-row TTFT p50/p95 (host-measured submit ->
       first token), prefix hit rate, and chunk dispatches per admit. The
       graded headline is the OFF/ON TTFT ratio.
+    - ``tiered_prefix``: a working set 10x the device prefix pool,
+      tiers off vs host-RAM vs host+disk — per-row hit rate, revisit
+      TTFT p50, and refill (H2D promotion) seconds. The graded claim is
+      the host tier beating tiers-off TTFT p50 on the oversized set.
     - ``mixed_long_prompt``: one resident request decoding while long
       prompts are admitted, monolithic vs chunked prefill — per-row
       inter-token p95/max of the RESIDENT stream (its decode-stall while
@@ -788,6 +792,111 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
             )
         speedup = round(
             pct(off_ttfts, 0.50) / max(pct(on_ttfts, 0.50), 1e-9), 2
+        )
+
+        # ---- tiered prefix cache: working set 10x the device pool ------
+        # 10 distinct shared prefixes (3 pool blocks each) through a
+        # device pool sized for ONE of them, visited in two passes.
+        # Tiers off, pass 2 finds the pool long since evicted (hit rate
+        # ~0, every revisit re-prefills the whole prefix); the host tier
+        # holds the entire working set, so every revisit promotes its
+        # blocks back through the compiled H2D refill and prefills only
+        # the suffix. Rows: hit rate, pass-2 TTFT p50, refill seconds.
+        import shutil as _shutil
+        import tempfile as _tf
+
+        n_prefixes = 10
+        tier_prefixes = [
+            g.integers(0, cfg.vocab_size, size=shared).tolist()
+            for _ in range(n_prefixes)
+        ]
+        tier_sfx = [
+            g.integers(0, cfg.vocab_size, size=uniq).tolist()
+            for _ in range(n_prefixes)
+        ]
+        dev_blocks = shared // pblock  # pool = exactly one prefix
+        blk_bytes = (
+            2 * cfg.n_layer * pblock * cfg.kv_head * cfg.head_dim
+            * (2 if cfg.compute_dtype == "bfloat16" else 4)
+        )
+        ws_mb = n_prefixes * dev_blocks * blk_bytes / (1 << 20)
+
+        def tiered_run(host_mb, disk_dir, disk_mb):
+            eng = DecodeEngine(
+                params, cfg, num_slots=2, max_seq=P + n_new,
+                prefill_buckets=[P], prefill_chunk=chunk,
+                prefix_blocks=dev_blocks, prefix_block=pblock,
+                prefix_host_mb=host_mb, prefix_disk_dir=disk_dir,
+                prefix_disk_mb=disk_mb, decode_fold=4,
+            )
+            sched = Scheduler(
+                eng, max_prefills_per_step=1,
+                max_prefill_chunks_per_step=1,
+            )
+            # Pass 1: populate (cold inserts; evictions spill when
+            # tiers are on, die when off).
+            for pfx, sfx in zip(tier_prefixes, tier_sfx):
+                sched.submit(
+                    pfx + sfx, SamplingParams(max_new_tokens=n_new)
+                )
+                sched.run_until_idle()
+            # Pass 2: revisit the whole working set; TTFT per revisit.
+            ttfts = []
+            for pfx, sfx in zip(tier_prefixes, tier_sfx):
+                rid = sched.submit(
+                    pfx + sfx, SamplingParams(max_new_tokens=n_new)
+                )
+                t0 = _time.monotonic()
+                got = None
+                while got is None:
+                    for ev in sched.step():
+                        if ev.request_id == rid and ev.token is not None:
+                            got = _time.monotonic() - t0
+                            break
+                ttfts.append(got)
+                sched.run_until_idle()
+            ttfts.sort()
+            return ttfts, sched.metrics.snapshot(), eng.prefix_stats()
+
+        tier_disk_dir = _tf.mkdtemp(prefix="rlt_tier_bench_")
+        # host: the whole working set fits in RAM. host_disk: the host
+        # tier holds only ~1/3 of it (floor: 4 blocks), so most
+        # revisits cascade to — and hit — the disk tier.
+        tier_modes = (
+            ("tiers_off", 0.0, None, 0.0),
+            ("host", max(2.0, 1.5 * ws_mb), None, 0.0),
+            (
+                "host_disk",
+                max(4 * blk_bytes / (1 << 20), 0.34 * ws_mb),
+                tier_disk_dir,
+                max(4.0, 2.0 * ws_mb),
+            ),
+        )
+        tiered_rows = []
+        tier_ttft = {}
+        for mode, host_mb, disk_dir, disk_mb in tier_modes:
+            ttfts, snap, pstats = tiered_run(host_mb, disk_dir, disk_mb)
+            tier_ttft[mode] = pct(ttfts, 0.50)
+            tiers = pstats.get("tiers") or {}
+            tiered_rows.append(
+                {
+                    "workload": "tiered_prefix",
+                    "mode": mode,
+                    "working_set_x_pool": n_prefixes,
+                    "ttft_p50_s": round(pct(ttfts, 0.50), 6),
+                    "ttft_p95_s": round(pct(ttfts, 0.95), 6),
+                    "prefix_hit_rate": snap.get("prefix_hit_rate", 0.0),
+                    "refill_h2d_s": round(
+                        pstats.get("refill_s", 0.0), 6
+                    ),
+                    "host_hits": tiers.get("host", {}).get("hits", 0),
+                    "disk_hits": tiers.get("disk", {}).get("hits", 0),
+                }
+            )
+        _shutil.rmtree(tier_disk_dir, ignore_errors=True)
+        rows.extend(tiered_rows)
+        tiered_host_vs_off = round(
+            tier_ttft["tiers_off"] / max(tier_ttft["host"], 1e-9), 2
         )
 
         # ---- mixed long-prompt: decode-stall while a prefill runs ------
@@ -1162,6 +1271,8 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
         return {
             "serve_rows": rows,
             "serve_shared_prefix_ttft_speedup": speedup,
+            "tiered_prefix_rows": tiered_rows,
+            "tiered_host_vs_off_ttft": tiered_host_vs_off,
             "obs_overhead": obs_overhead,
             "watchdog_overhead": watchdog_overhead,
             "fleet_overhead": fleet_overhead,
@@ -1327,8 +1438,9 @@ def main() -> None:
     parser.add_argument(
         "--serve-only", action="store_true",
         help="run ONLY the prefill-heavy serving sweep (shared-prefix "
-        "TTFT with the prefix cache off/on + decode-stall under long-"
-        "prompt admissions, chunked vs monolithic) and emit its JSON",
+        "TTFT with the prefix cache off/on, tiered-prefix spill on a "
+        "10x working set, decode-stall under long-prompt admissions "
+        "chunked vs monolithic) and emit its JSON",
     )
     args = parser.parse_args()
 

@@ -391,6 +391,7 @@ _SERVE_KEYS = frozenset((
     "hosts_per_replica",
     "prefill_buckets", "max_prefills_per_step", "decode_fold",
     "pipeline", "prefill_chunk", "prefix_cache", "prefix_block",
+    "prefix_host_mb", "prefix_disk_dir", "prefix_disk_mb",
     "max_prefill_chunks_per_step", "priority_age_s",
     "spec", "spec_depth", "spec_draft_ckpt", "spec_draft_config",
     "spec_draft_int8", "spec_window",
@@ -559,6 +560,15 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
       prefix_cache: "off" (default), "on" (64 blocks), or a block count
         — device-resident prefix KV reuse for shared prompt prefixes
         (implies chunked prefill). prefix_block: tokens per pool block.
+      prefix_host_mb: host-RAM spill tier below the device prefix pool
+        (MiB; 0 = off): LRU-evicted pool blocks spill D2H instead of
+        dying, and a host hit promotes the block back through one
+        compiled H2D copy — cache capacity grows from spare HBM to
+        machine RAM with greedy outputs unchanged. prefix_disk_dir /
+        prefix_disk_mb: an optional disk tier below the host tier
+        (.npy block files under the directory, default budget 1024
+        MiB) absorbing host-tier evictions. Tier traffic lands in
+        rlt_serve_prefix_*_total{tier=} and stats prefix.tiers.
       priority_age_s: queued requests age toward priority 0 at this rate
         (seconds per priority level); unset = strict priority order.
       spec: speculative decoding — "off" (default), "ngram" (in-graph
@@ -762,6 +772,17 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     else:
         blocks = (64 if pc else 0) if isinstance(pc, bool) else int(pc)
     replica_kwargs["prefix_blocks"] = blocks
+    # Spill tiers below the device pool (host RAM, then disk). Budgets
+    # are MiB floats; the engine rejects tiers without a device pool.
+    replica_kwargs["prefix_host_mb"] = float(
+        serve_cfg.pop("prefix_host_mb", 0.0)
+    )
+    pdd = serve_cfg.pop("prefix_disk_dir", None)
+    if pdd is not None:
+        replica_kwargs["prefix_disk_dir"] = str(pdd)
+    replica_kwargs["prefix_disk_mb"] = float(
+        serve_cfg.pop("prefix_disk_mb", 0.0)
+    )
     pb = serve_cfg.pop("prefill_buckets", None)
     if pb is not None:
         replica_kwargs["prefill_buckets"] = [int(b) for b in pb]
@@ -1090,10 +1111,21 @@ def render_fleet(payload: Dict[str, Any]) -> str:
         (
             f"{'replica':>7} {'health':>9} {'queue':>5} {'slots':>7} "
             f"{'tok/s':>9} {'ttft_p50':>9} {'ttft_p95':>9} "
-            f"{'accept':>7} {'hit':>6} {'goodput':>9}"
+            f"{'accept':>7} {'hit':>6} {'hit d/h/k':>14} {'goodput':>9}"
         ),
     ]
     for r in rows:
+        # Tiered prefix cache: fraction of block probes each tier served
+        # (device/host/disk) — "-" when the replica runs no tiers.
+        th = r.get("prefix_tier_hit_rate") or {}
+        tier_cell = (
+            "{:.2f}/{:.2f}/{:.2f}".format(
+                th.get("device", 0.0), th.get("host", 0.0),
+                th.get("disk", 0.0),
+            )
+            if th
+            else None
+        )
         out.append(
             f"{_fmt_cell(r.get('replica'), 7)} "
             f"{_fmt_cell(r.get('health'), 9)} "
@@ -1106,6 +1138,7 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{_fmt_cell(r.get('ttft_p95_s'), 9, 4)} "
             f"{_fmt_cell(r.get('spec_accept_rate'), 7, 2)} "
             f"{_fmt_cell(r.get('prefix_hit_rate'), 6, 2)} "
+            f"{_fmt_cell(tier_cell, 14)} "
             f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)}"
         )
     if fleet:

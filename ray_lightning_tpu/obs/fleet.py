@@ -51,6 +51,15 @@ def summarize_replica(
     verdict = (health or {}).get("verdict")
     if verdict is None:
         verdict = stats.get("health", "unknown")
+    # Tiered prefix cache: fraction of block probes each tier served
+    # (device probes = the walk's total, since device is probed first).
+    tiers = dict((stats.get("prefix") or {}).get("tiers") or {})
+    dev = tiers.get("device") or {}
+    probes = int(dev.get("hits", 0)) + int(dev.get("misses", 0))
+    tier_hit = {
+        t: (round(int(r.get("hits", 0)) / probes, 4) if probes else 0.0)
+        for t, r in tiers.items()
+    } or None
     return {
         "replica": int(index),
         "health": str(verdict),
@@ -66,6 +75,7 @@ def summarize_replica(
         "ttft_p95_s": stats.get("ttft_p95_s"),
         "spec_accept_rate": stats.get("spec_accept_rate"),
         "prefix_hit_rate": stats.get("prefix_hit_rate"),
+        "prefix_tier_hit_rate": tier_hit,
         "submitted": int(stats.get("submitted", 0)),
         "finished": int(stats.get("finished", 0)),
         "compiles_since_init": int(stats.get("compiles_since_init", 0)),

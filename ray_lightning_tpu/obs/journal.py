@@ -100,6 +100,12 @@ def engine_header(
             "prefill_chunk": engine.prefill_chunk,
             "prefix_blocks": engine.prefix_blocks,
             "prefix_block": engine.prefix_block,
+            # Tiered prefix-cache knobs: a replay must rebuild the same
+            # tier config — hit/miss/spill decisions shape admission
+            # timing, and a recorded host-tier hit should hit on replay.
+            "prefix_host_mb": getattr(engine, "prefix_host_mb", 0.0),
+            "prefix_disk_dir": getattr(engine, "prefix_disk_dir", None),
+            "prefix_disk_mb": getattr(engine, "prefix_disk_mb", 0.0),
             "spec": engine.spec,
             "spec_depth": engine.spec_depth,
             "spec_window": engine.spec_window,
@@ -380,7 +386,8 @@ def load_journal(
 #: engine_header keys build_engine accepts verbatim.
 _ENGINE_REBUILD_KEYS = frozenset((
     "num_slots", "max_seq", "prefill_buckets", "decode_fold", "pipeline",
-    "prefill_chunk", "prefix_blocks", "prefix_block", "spec", "spec_depth",
+    "prefill_chunk", "prefix_blocks", "prefix_block", "prefix_host_mb",
+    "prefix_disk_dir", "prefix_disk_mb", "spec", "spec_depth",
     "spec_window", "spec_draft_ckpt", "spec_draft_config",
     "spec_draft_int8", "mesh",
 ))

@@ -87,6 +87,24 @@ prefix reuse, pool-of-blocks form):
   are ref-counted while a matching prefill is in flight and evicted LRU
   under pool pressure. K/V per position are a pure function of the token
   prefix, so a seeded slot decodes bit-identically to a cold prefill.
+- **Tiered spill (``prefix_host_mb`` / ``prefix_disk_dir``).** The pool's
+  capacity is spare HBM, so LRU eviction caps the cache at the top
+  handful of prefixes. With tiers on, an evicted block SPILLS instead of
+  dying: one compiled D2H pool read captures its K/V into a host-RAM
+  tier (byte-budgeted, its own LRU), whose own evictions fall into an
+  optional disk tier (``.npy`` files under ``prefix_disk_dir``, read
+  back memory-mapped). Both tiers reuse the same chained digests as the
+  tier-wide key; the admission walk falls through device -> host -> disk,
+  and a cold hit PROMOTES the block back into the device pool through
+  one compiled H2D pool write before the seeding copy runs. Both
+  transfer executables are lowered at construction, so steady-state
+  tier traffic never compiles; spilled bytes are bit-identical to the
+  device originals (K/V are a pure function of the token prefix), so a
+  promoted block decodes exactly like a device-resident one. Under a
+  mesh, spill captures each block's per-device SHARDS and refill
+  rebuilds the sharded array via ``make_array_from_callback`` — the
+  full block never lands on one device, and a multi-host gang member
+  only ever touches its own shards.
 
 Both paths keep the contracts above: the compile count is frozen at
 construction (chunk executables replace the per-bucket fused admits; one
@@ -146,6 +164,9 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
+import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -239,6 +260,9 @@ class DecodeEngine:
         prefill_chunk: int = 0,
         prefix_blocks: int = 0,
         prefix_block: int = 16,
+        prefix_host_mb: float = 0.0,
+        prefix_disk_dir: Optional[str] = None,
+        prefix_disk_mb: float = 0.0,
         spec: str = "off",
         spec_depth: int = 4,
         spec_params: Any = None,
@@ -301,6 +325,26 @@ class DecodeEngine:
                     f"prefix_block {self.prefix_block} must be in "
                     f"[1, max_seq={self.max_seq}]"
                 )
+        # Spill tiers below the device pool: host RAM (prefix_host_mb
+        # MiB), then an optional disk tier (prefix_disk_dir; its budget
+        # defaults to 1 GiB when only the directory is given). Validated
+        # before anything is placed or compiled.
+        self.prefix_host_mb = float(prefix_host_mb)
+        self.prefix_disk_dir = (
+            str(prefix_disk_dir) if prefix_disk_dir else None
+        )
+        self.prefix_disk_mb = float(prefix_disk_mb)
+        if self.prefix_host_mb < 0 or self.prefix_disk_mb < 0:
+            raise ValueError("prefix tier budgets must be >= 0")
+        if self.prefix_disk_dir and self.prefix_disk_mb == 0:
+            self.prefix_disk_mb = 1024.0
+        if (
+            self.prefix_host_mb > 0 or self.prefix_disk_dir
+        ) and not self.prefix_blocks:
+            raise ValueError(
+                "prefix tiers (prefix_host_mb / prefix_disk_dir) need a "
+                "device prefix pool (prefix_blocks > 0) to spill from"
+            )
         # Mesh-native serving (tensor-parallel decode): with a mesh
         # bound, every per-slot device tensor becomes a mesh-sharded
         # jax.Array — attention heads (and the Hkv-headed KV cache +
@@ -313,6 +357,7 @@ class DecodeEngine:
         self._rep_sh = None
         self._cache_sh = None
         self._pool_sh = None
+        self._blk_sh = None
         self._params_sh = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -347,6 +392,18 @@ class DecodeEngine:
                     spec_from_logical(
                         (L_, self.prefix_blocks, self.prefix_block, Hkv_,
                          hd_),
+                        DECODE_CACHE_AXES,
+                        DEFAULT_RULES,
+                        mesh,
+                    ),
+                )
+                # One pool block's sharding (same logical axes, block
+                # dim 1): the spill/refill transfer unit — captured
+                # shards and rebuilt arrays both carry it.
+                self._blk_sh = NamedSharding(
+                    mesh,
+                    spec_from_logical(
+                        (L_, 1, self.prefix_block, Hkv_, hd_),
                         DECODE_CACHE_AXES,
                         DEFAULT_RULES,
                         mesh,
@@ -440,6 +497,51 @@ class DecodeEngine:
         self.prefix_prompt_tokens = 0
         self.prefix_inserts = 0
         self.prefix_evictions = 0
+        # -- spill tiers (host RAM, then disk) ---------------------------
+        # Budgets are enforced on LOGICAL block bytes (one K + one V
+        # block), so a byte budget means the same cache capacity whether
+        # or not a mesh splits the resident shards across processes.
+        self._blk_shape = (L, 1, self.prefix_block, Hkv, hd)
+        self._blk_dtype = np.dtype(cdt)
+        self._blk_nbytes = (
+            2 * int(np.prod(self._blk_shape)) * cdt.itemsize
+        )
+        self._host_budget = int(self.prefix_host_mb * (1 << 20))
+        self._disk_budget = (
+            int(self.prefix_disk_mb * (1 << 20))
+            if self.prefix_disk_dir
+            else 0
+        )
+        self._tiered = self._host_budget > 0 or self._disk_budget > 0
+        #: digest -> (k_payload, v_payload), oldest first (the tier's
+        #: LRU). A payload is the full np block single-device, or
+        #: {shard_index: np_shard} of THIS process's shards under a mesh.
+        self._host_map: "OrderedDict[bytes, Tuple[Any, Any]]" = (
+            OrderedDict()
+        )
+        #: digest -> on-disk bytes, oldest first; files live under
+        #: ``prefix_disk_dir`` as ``<digest-hex>.{keys,k,v}.npy``.
+        self._disk_map: "OrderedDict[bytes, int]" = OrderedDict()
+        self._disk_bytes = 0
+        if self._disk_budget:
+            os.makedirs(self.prefix_disk_dir, exist_ok=True)
+            self._disk_prune_stale()
+        #: Cumulative per-tier accounting (the scheduler diffs these into
+        #: ServeMetrics): hits/misses are digest-walk probes; spills are
+        #: blocks moved one tier colder (still alive); promotions are
+        #: blocks moved back into the device pool; evictions are blocks
+        #: dropped from the tier entirely.
+        self.tier_counters: Dict[str, Dict[str, int]] = {
+            t: {
+                "hits": 0, "misses": 0, "spills": 0,
+                "promotions": 0, "evictions": 0,
+            }
+            for t in ("device", "host", "disk")
+        }
+        #: Host-side seconds spent refilling promoted blocks (payload
+        #: assembly + the compiled H2D dispatch) — the bench's
+        #: "what does a cold hit cost" column.
+        self.refill_s = 0.0
 
         # Per-slot DEVICE state (fixed shapes: one step signature forever;
         # replicated under a mesh — slot writes and the per-fold harvest
@@ -928,6 +1030,59 @@ class DecodeEngine:
                 .compile()
             )
             self.compiled_count += 1
+        if self.prefix_blocks and self._tiered:
+            blk_out = self._blk_sh  # None single-device
+
+            def pool_read_impl(pool_k, pool_v, block):
+                # The D2H half of a spill: slice one block out of the
+                # pool (no donation — the pool stays live); the host
+                # copies the result out before the block's metadata dies.
+                src_k = jax.lax.dynamic_slice(
+                    pool_k, (0, block, 0, 0, 0), (L, 1, bs, Hkv, hd)
+                )
+                src_v = jax.lax.dynamic_slice(
+                    pool_v, (0, block, 0, 0, 0), (L, 1, bs, Hkv, hd)
+                )
+                return src_k, src_v
+
+            def pool_write_impl(pool_k, pool_v, kblk, vblk, block):
+                # The H2D half of a refill: write one host-sourced block
+                # into the pool (donated) — the ONE compiled transfer a
+                # cold-tier promotion pays, lowered here so steady-state
+                # tier traffic never compiles.
+                pool_k = jax.lax.dynamic_update_slice(
+                    pool_k, kblk, (0, block, 0, 0, 0)
+                )
+                pool_v = jax.lax.dynamic_update_slice(
+                    pool_v, vblk, (0, block, 0, 0, 0)
+                )
+                return pool_k, pool_v
+
+            blk_spec = jax.ShapeDtypeStruct(
+                self._blk_shape,
+                jnp.dtype(cfg.compute_dtype),
+                sharding=blk_out if mesh_on else None,
+            )
+            self._pool_read_exec = (
+                jit_exec(
+                    pool_read_impl,
+                    (),
+                    (blk_out, blk_out) if mesh_on else None,
+                )
+                .lower(pool_spec, pool_spec, i32)
+                .compile()
+            )
+            self.compiled_count += 1
+            self._pool_write_exec = (
+                jit_exec(
+                    pool_write_impl,
+                    (0, 1),
+                    (pool_out, pool_out) if mesh_on else None,
+                )
+                .lower(pool_spec, pool_spec, blk_spec, blk_spec, i32)
+                .compile()
+            )
+            self.compiled_count += 1
         # The folded step: caches + in-graph-updated state donated; the
         # sampling knobs and eos table are read-only inputs (slot writes
         # own their updates). With spec on the token history rides the
@@ -1246,7 +1401,7 @@ class DecodeEngine:
                 key0 = np.asarray(
                     jax.random.PRNGKey(int(r.get("seed", 0))), np.uint32
                 ).reshape(2)
-                matched_idxs = self._match_prefix(prompt)
+                matched_idxs, matched_tiers = self._match_prefix(prompt)
                 matched = len(matched_idxs) * self.prefix_block
                 if self.prefix_blocks:
                     self.prefix_lookups += 1
@@ -1280,6 +1435,13 @@ class DecodeEngine:
                             "tokens": matched,
                             "blocks": len(matched_idxs),
                             "slot": slot,
+                            # Where each seeded block came from: a
+                            # host/disk count > 0 means this admission
+                            # paid a promotion (H2D refill) for it.
+                            "tiers": {
+                                t: matched_tiers.count(t)
+                                for t in ("device", "host", "disk")
+                            },
                         },
                     )
                 top_k = r.get("top_k")
@@ -1468,48 +1630,345 @@ class DecodeEngine:
             out.append(d)
         return out
 
-    def _match_prefix(self, tokens: np.ndarray) -> List[int]:
-        """Longest cached prefix walk: pool block indices of the leading
-        blocks present, capped so the final chunk always runs (the
-        first-token logits need the last prompt position's hidden state,
-        which the pool does not store)."""
+    def _match_prefix(
+        self, tokens: np.ndarray
+    ) -> Tuple[List[int], List[str]]:
+        """Longest cached prefix walk across ALL tiers: device-pool hits
+        are free; host/disk hits PROMOTE the block back into the device
+        pool (one compiled H2D pool write) before the seeding copies
+        run. Returns (pool block indices, source tier per block), capped
+        so the final chunk always runs (the first-token logits need the
+        last prompt position's hidden state, which no tier stores).
+        Blocks matched earlier in the walk are shielded from eviction by
+        a mid-walk promotion (their refs are only taken by the caller
+        after the walk returns)."""
         if not self.prefix_blocks:
-            return []
+            return [], []
         matched: List[int] = []
+        tiers: List[str] = []
+        pinned: set = set()
+        tc = self.tier_counters
         for d in self._block_digests(tokens):
             idx = self._pool_map.get(d)
-            if idx is None:
-                break
+            tier = "device"
+            if idx is not None:
+                tc["device"]["hits"] += 1
+            else:
+                tc["device"]["misses"] += 1
+                tier = None
+                if self._host_budget:
+                    if d in self._host_map:
+                        tc["host"]["hits"] += 1
+                        tier = "host"
+                    else:
+                        tc["host"]["misses"] += 1
+                if tier is None and self._disk_budget:
+                    if d in self._disk_map:
+                        tc["disk"]["hits"] += 1
+                        tier = "disk"
+                    else:
+                        tc["disk"]["misses"] += 1
+                if tier is None:
+                    break
+                idx = self._promote(d, tier, frozenset(pinned))
+                if idx is None:
+                    # No allocatable device block (everything pinned by
+                    # in-flight prefills) or an unreadable disk entry:
+                    # the walk stops and admission prefills the rest
+                    # uncached — never a deadlock, never a spurious
+                    # eviction of a referenced block.
+                    break
             matched.append(idx)
+            tiers.append(tier)
+            pinned.add(idx)
         while matched and len(matched) * self.prefix_block >= len(tokens):
             matched.pop()
+            tiers.pop()
         for idx in matched:
             self._pool_tick += 1
             self._pool_meta[idx].stamp = self._pool_tick
-        return matched
+        return matched, tiers
 
-    def _pool_alloc(self) -> Optional[int]:
+    def _pool_alloc(
+        self, avoid: frozenset = frozenset()
+    ) -> Optional[int]:
         """A free pool block, evicting the LRU unreferenced block under
-        pressure; None when every block is pinned."""
+        pressure (the victim SPILLS one tier down instead of dying when
+        tiers are on); None when every block is pinned. ``avoid``
+        shields blocks matched earlier in an in-progress digest walk,
+        whose refs are not yet taken."""
         if self._pool_free:
             return self._pool_free.pop()
         victim = None
         for i, m in enumerate(self._pool_meta):
-            if m is None or m.refs > 0:
+            if m is None or m.refs > 0 or i in avoid:
                 continue
             if victim is None or m.stamp < self._pool_meta[victim].stamp:
                 victim = i
         if victim is None:
             return None
-        del self._pool_map[self._pool_meta[victim].digest]
+        vm = self._pool_meta[victim]
+        if self._tiered:
+            self._spill_block(victim, vm.digest)
+        del self._pool_map[vm.digest]
         self._pool_meta[victim] = None
         self.prefix_evictions += 1
+        self.tier_counters["device"]["evictions"] += 1
         if self.events is not None:
             self.events.record(
                 "engine", "prefix_evict", block=victim,
-                evictions=self.prefix_evictions,
+                evictions=self.prefix_evictions, spilled=self._tiered,
             )
         return victim
+
+    # -- spill tiers (host RAM + disk) -----------------------------------
+    @staticmethod
+    def _norm_index(idx, shape) -> Tuple[Tuple[int, int], ...]:
+        """Canonical key of one shard's position: (start, stop) per dim
+        — the join between captured shards (``Shard.index``) and the
+        indices ``make_array_from_callback`` asks for at refill."""
+        return tuple(
+            sl.indices(dim)[:2] for sl, dim in zip(idx, shape)
+        )
+
+    def _capture_block(self, arr: Any) -> Any:
+        """Host payload of one pool-block array: the full np block
+        single-device, or THIS process's per-device shards under a mesh
+        (a multi-host gang member never materializes remote shards)."""
+        if self.mesh is None:
+            return np.asarray(arr)
+        return {
+            self._norm_index(s.index, self._blk_shape): np.asarray(s.data)
+            for s in arr.addressable_shards
+        }
+
+    def _device_block(self, payload: Any) -> Any:
+        """The refill direction: a host payload back to a device-placed
+        block — a plain array single-device (the compiled pool write
+        does the H2D), or a sharded jax.Array rebuilt shard-by-shard via
+        ``make_array_from_callback`` under a mesh (each device receives
+        exactly its shard; the full block never lands on one device)."""
+        if self.mesh is None:
+            return np.ascontiguousarray(payload)
+        import jax
+
+        return jax.make_array_from_callback(
+            self._blk_shape,
+            self._blk_sh,
+            lambda idx: payload[self._norm_index(idx, self._blk_shape)],
+        )
+
+    def _spill_block(self, victim: int, digest: bytes) -> None:
+        """D2H the evicted block (compiled pool read, synced here — off
+        the decode hot path; eviction only fires at admission/insert
+        time) and push it one tier down: host RAM, else disk."""
+        k, v = self._pool_read_exec(
+            self._pool_k, self._pool_v, np.int32(victim)
+        )
+        kp, vp = self._capture_block(k), self._capture_block(v)
+        self.tier_counters["device"]["spills"] += 1
+        if self._host_budget:
+            self._host_insert(digest, kp, vp)
+        else:
+            self._disk_insert(digest, kp, vp)
+
+    def _host_bytes(self) -> int:
+        return len(self._host_map) * self._blk_nbytes
+
+    def _host_insert(self, digest: bytes, kp: Any, vp: Any) -> None:
+        """Insert one spilled block into the host tier, evicting oldest
+        blocks down to disk (or dropping them) until the byte budget
+        holds — the tier is never over budget."""
+        self._host_map.pop(digest, None)
+        if self._blk_nbytes > self._host_budget:
+            # A block the tier can never hold skips straight down.
+            if self._disk_budget:
+                self.tier_counters["host"]["spills"] += 1
+                self._disk_insert(digest, kp, vp)
+            else:
+                self.tier_counters["host"]["evictions"] += 1
+            return
+        while self._host_map and (
+            self._host_bytes() + self._blk_nbytes > self._host_budget
+        ):
+            old_d, (ok, ov) = self._host_map.popitem(last=False)
+            if self._disk_budget:
+                self.tier_counters["host"]["spills"] += 1
+                self._disk_insert(old_d, ok, ov)
+            else:
+                self.tier_counters["host"]["evictions"] += 1
+        self._host_map[digest] = (kp, vp)
+
+    def _disk_paths(self, digest: bytes) -> Tuple[str, str, str]:
+        hexd = digest.hex()
+        return tuple(
+            os.path.join(self.prefix_disk_dir, f"{hexd}.{part}.npy")
+            for part in ("keys", "k", "v")
+        )
+
+    def _disk_prune_stale(self) -> None:
+        """Start the disk tier EMPTY: leftover block files from an
+        earlier engine are removed, not adopted — adoption would make
+        pool decisions depend on external disk state, breaking the
+        multi-host gang's op-stream determinism (every process must make
+        identical alloc/promote choices from the op sequence alone)."""
+        for name in os.listdir(self.prefix_disk_dir):
+            if name.endswith((".keys.npy", ".k.npy", ".v.npy")):
+                try:
+                    os.remove(os.path.join(self.prefix_disk_dir, name))
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _stack_payload(payload: Any, shape) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, stacked shards) of one payload — shards sorted by
+        index so the on-disk form is deterministic; a single-device
+        payload is one whole-block 'shard'."""
+        if isinstance(payload, dict):
+            keys = sorted(payload)
+            return (
+                np.asarray(keys, np.int64),
+                np.stack([payload[k] for k in keys]),
+            )
+        key = tuple((0, dim) for dim in shape)
+        return np.asarray([key], np.int64), payload[None]
+
+    def _disk_insert(self, digest: bytes, kp: Any, vp: Any) -> None:
+        """Write one block to the disk tier (atomic per file: tmp +
+        rename), then enforce the byte budget on MEASURED file sizes —
+        oldest entries drop first, and the tier is never over budget."""
+        if not self._disk_budget:
+            return
+        if digest in self._disk_map:
+            self._disk_map.move_to_end(digest)
+            return
+        keys, kstack = self._stack_payload(kp, self._blk_shape)
+        _, vstack = self._stack_payload(vp, self._blk_shape)
+        # Store a canonical uint8 byte view: np.save cannot round-trip
+        # extension dtypes (bfloat16 comes back as raw void); the load
+        # views the bytes back to the engine dtype, which is fixed for
+        # the engine's lifetime.
+        kstack = np.ascontiguousarray(kstack).view(np.uint8)
+        vstack = np.ascontiguousarray(vstack).view(np.uint8)
+        size = 0
+        paths = self._disk_paths(digest)
+        try:
+            for path, arr in zip(paths, (keys, kstack, vstack)):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    np.save(f, arr)
+                os.replace(tmp, path)
+                size += os.path.getsize(path)
+        except OSError:
+            # Best-effort tier: a full/failing disk drops the block.
+            for path in paths:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            self.tier_counters["disk"]["evictions"] += 1
+            return
+        while self._disk_map and (
+            self._disk_bytes + size > self._disk_budget
+        ):
+            oldest = next(iter(self._disk_map))
+            self._disk_drop(oldest)
+            self.tier_counters["disk"]["evictions"] += 1
+        if self._disk_bytes + size > self._disk_budget:
+            # One block alone exceeds the whole budget: it cannot live
+            # here.
+            for path in paths:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            self.tier_counters["disk"]["evictions"] += 1
+            return
+        self._disk_map[digest] = size
+        self._disk_bytes += size
+
+    def _disk_drop(self, digest: bytes) -> None:
+        size = self._disk_map.pop(digest, 0)
+        self._disk_bytes -= size
+        for path in self._disk_paths(digest):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _disk_load(self, digest: bytes) -> Optional[Tuple[Any, Any]]:
+        """Read one block back (memory-mapped; only the needed shards
+        are copied out); an unreadable entry is dropped and reported as
+        a promotion failure, never an exception on the admission path."""
+        kpath, kfile, vfile = self._disk_paths(digest)
+        try:
+            keys = np.load(kpath)
+            kmm = np.load(kfile, mmap_mode="r")
+            vmm = np.load(vfile, mmap_mode="r")
+
+            def shard(mm, i):
+                # uint8 on disk -> the engine dtype (last axis folds
+                # back by itemsize); only the touched rows leave the
+                # mmap.
+                return np.asarray(mm[i]).view(self._blk_dtype)
+
+            if self.mesh is None:
+                return shard(kmm, 0), shard(vmm, 0)
+            # The file holds exactly this process's shards (that is what
+            # _capture_block spilled), so every entry comes back.
+            kd: Dict[Any, np.ndarray] = {}
+            vd: Dict[Any, np.ndarray] = {}
+            for i, key in enumerate(keys):
+                nk = tuple((int(a), int(b)) for a, b in key)
+                kd[nk] = shard(kmm, i)
+                vd[nk] = shard(vmm, i)
+            return kd, vd
+        except (OSError, ValueError):
+            self._disk_drop(digest)
+            return None
+
+    def _promote(
+        self, digest: bytes, tier: str, avoid: frozenset
+    ) -> Optional[int]:
+        """Move one cold-tier block back into the device pool through
+        the compiled H2D pool write; returns the pool index, or None
+        when no device block can be allocated (every block pinned) or
+        the disk entry is unreadable — the admission then proceeds
+        uncached from this point."""
+        # Pop the payload BEFORE allocating: the alloc's spill cascade
+        # can itself evict this digest from the host map (budget
+        # pressure), so holding the payload by reference is the only
+        # safe order. On alloc failure it goes back as the tier's MRU.
+        if tier == "host":
+            payload = self._host_map.pop(digest, None)
+        else:
+            payload = self._disk_load(digest)
+        if payload is None:
+            return None
+        idx = self._pool_alloc(avoid)
+        if idx is None:
+            if tier == "host":
+                self._host_map[digest] = payload
+            elif digest in self._disk_map:
+                self._disk_map.move_to_end(digest)
+            return None
+        t0 = time.monotonic()
+        kp, vp = payload
+        self._pool_k, self._pool_v = self._pool_write_exec(
+            self._pool_k, self._pool_v,
+            self._device_block(kp), self._device_block(vp),
+            np.int32(idx),
+        )
+        if tier != "host":
+            self._disk_drop(digest)
+        self._pool_tick += 1
+        self._pool_map[digest] = idx
+        self._pool_meta[idx] = _PoolBlock(
+            digest=digest, refs=0, stamp=self._pool_tick
+        )
+        self.tier_counters[tier]["promotions"] += 1
+        self.refill_s += time.monotonic() - t0
+        return idx
 
     def _insert_prefix(self, slot: int, tokens: np.ndarray) -> None:
         """Insert the freshly-prefilled prompt's full blocks (slot rows ->
@@ -1535,6 +1994,13 @@ class DecodeEngine:
                 digest=d, refs=0, stamp=self._pool_tick
             )
             self.prefix_inserts += 1
+            # A fresh device insert supersedes any spilled copy of the
+            # same digest (identical bytes — K/V are a pure function of
+            # the token prefix); dropping it keeps tier budgets honest.
+            if self._tiered:
+                self._host_map.pop(d, None)
+                if d in self._disk_map:
+                    self._disk_drop(d)
 
     def _copy_block(self, block: int, slot: int, row: int,
                     to_slot: bool) -> None:
@@ -1551,9 +2017,11 @@ class DecodeEngine:
                 meta.refs -= 1
         task.block_refs = []
 
-    def prefix_stats(self) -> Dict[str, int]:
-        """Pool counters for the stats endpoint / bench."""
-        return {
+    def prefix_stats(self) -> Dict[str, Any]:
+        """Pool counters for the stats endpoint / bench; with tiers on,
+        a per-tier breakdown and the cumulative refill seconds ride
+        along."""
+        out: Dict[str, Any] = {
             "lookups": self.prefix_lookups,
             "hit_tokens": self.prefix_hit_tokens,
             "prompt_tokens": self.prefix_prompt_tokens,
@@ -1562,6 +2030,54 @@ class DecodeEngine:
             "blocks_used": self.prefix_blocks - len(self._pool_free),
             "blocks_total": self.prefix_blocks,
         }
+        if self.prefix_blocks:
+            out["tiers"] = self.prefix_tier_stats()
+        if self._tiered:
+            out["refill_s"] = round(self.refill_s, 6)
+        return out
+
+    def prefix_tier_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-tier cumulative counters plus resident/budget bytes
+        (device always; host/disk only when budgeted) — the stats-
+        endpoint face of the tier walk."""
+        used = self.prefix_blocks - len(self._pool_free)
+        out: Dict[str, Dict[str, int]] = {
+            "device": {
+                **self.tier_counters["device"],
+                "bytes": used * self._blk_nbytes,
+                "budget_bytes": self.prefix_blocks * self._blk_nbytes,
+            }
+        }
+        if self._host_budget:
+            out["host"] = {
+                **self.tier_counters["host"],
+                "bytes": self._host_bytes(),
+                "budget_bytes": self._host_budget,
+            }
+        if self._disk_budget:
+            out["disk"] = {
+                **self.tier_counters["disk"],
+                "bytes": self._disk_bytes,
+                "budget_bytes": self._disk_budget,
+            }
+        return out
+
+    def prefix_tier_counters(self) -> Dict[str, Dict[str, int]]:
+        """Cumulative per-tier event counters (all three tiers, zeros
+        for disabled ones) — the scheduler diffs consecutive snapshots
+        into per-step ServeMetrics deltas."""
+        return {t: dict(c) for t, c in self.tier_counters.items()}
+
+    def prefix_tier_bytes(self) -> Dict[str, int]:
+        """Resident bytes per ENABLED tier (the
+        ``rlt_serve_prefix_bytes{tier=}`` gauge values)."""
+        used = self.prefix_blocks - len(self._pool_free)
+        out = {"device": used * self._blk_nbytes}
+        if self._host_budget:
+            out["host"] = self._host_bytes()
+        if self._disk_budget:
+            out["disk"] = self._disk_bytes
+        return out
 
     def release(self, slot: int) -> None:
         """Evict a slot (cancelled, or host-observed finished); it is

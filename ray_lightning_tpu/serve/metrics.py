@@ -85,6 +85,35 @@ class ServeMetrics:
                     "rlt_serve_spec_accept_rate",
                     "Sliding-window draft-token accept rate (0-1)",
                 ),
+                # Tiered prefix cache: block-probe traffic and resident
+                # bytes per tier (device / host / disk) — the scheduler
+                # diffs the engine's cumulative tier counters into these
+                # once per step.
+                "prefix_hits": registry.counter(
+                    "rlt_serve_prefix_hits_total",
+                    "Prefix-cache block probes served, by tier",
+                ),
+                "prefix_misses": registry.counter(
+                    "rlt_serve_prefix_misses_total",
+                    "Prefix-cache block probes that missed, by tier",
+                ),
+                "prefix_evictions": registry.counter(
+                    "rlt_serve_prefix_evictions_total",
+                    "Prefix-cache blocks dropped from a tier",
+                ),
+                "prefix_spills": registry.counter(
+                    "rlt_serve_prefix_spills_total",
+                    "Prefix-cache blocks spilled one tier down",
+                ),
+                "prefix_promotions": registry.counter(
+                    "rlt_serve_prefix_promotions_total",
+                    "Cold-tier prefix blocks promoted back to the "
+                    "device pool",
+                ),
+                "prefix_bytes": registry.gauge(
+                    "rlt_serve_prefix_bytes",
+                    "Resident prefix-cache bytes by tier",
+                ),
                 "hbm": registry.gauge(
                     "rlt_serve_hbm_bytes",
                     "Per-device resident bytes of engine device state "
@@ -141,6 +170,10 @@ class ServeMetrics:
         #: Scheduler's ledger): the sliding window behind the ``cost``
         #: stats block and the goodput gauge.
         self._costs: deque = deque(maxlen=window)
+        #: Cumulative tiered prefix-cache counters (device/host/disk) —
+        #: accumulated from the scheduler's per-step deltas; feeds the
+        #: ``prefix_tiers`` stats block and its hit-rate-by-tier.
+        self._prefix_tiers: Dict[str, Dict[str, int]] = {}
         self._queue_depth = 0
         self._started = time.monotonic()
         self._last_log = 0.0
@@ -261,6 +294,40 @@ class ServeMetrics:
             self._reg["spec_drafted"].inc(int(drafted))
             self._reg["spec_accepted"].inc(int(accepted))
 
+    def record_prefix_tiers(
+        self,
+        deltas: Dict[str, Dict[str, int]],
+        bytes_by_tier: Optional[Dict[str, int]] = None,
+    ) -> None:
+        """One step's tiered prefix-cache delta (the engine's cumulative
+        counters diffed by the scheduler): accumulated for the stats
+        ``prefix_tiers`` block and mirrored into the tier-labelled
+        ``rlt_serve_prefix_*_total`` counters and the
+        ``rlt_serve_prefix_bytes`` gauge."""
+        kinds = ("hits", "misses", "spills", "promotions", "evictions")
+        with self._lock:
+            for tier, kv in deltas.items():
+                cum = self._prefix_tiers.setdefault(
+                    tier, {k: 0 for k in kinds}
+                )
+                for k in kinds:
+                    cum[k] += int(kv.get(k, 0))
+        if self._reg is None:
+            return
+        for tier, kv in deltas.items():
+            for kind, key in (
+                ("hits", "prefix_hits"),
+                ("misses", "prefix_misses"),
+                ("spills", "prefix_spills"),
+                ("promotions", "prefix_promotions"),
+                ("evictions", "prefix_evictions"),
+            ):
+                n = int(kv.get(kind, 0))
+                if n:
+                    self._reg[key].inc(n, tier=tier)
+        for tier, b in (bytes_by_tier or {}).items():
+            self._reg["prefix_bytes"].set(float(b), tier=tier)
+
     def record_cost(self, record: Dict[str, Any]) -> None:
         """One terminal request's accounting record (the scheduler's
         cost ledger emits it at finish/cancel/expire): windowed for the
@@ -367,6 +434,23 @@ class ServeMetrics:
                 out["prefix_hit_rate"] = (
                     round(hit / tot, 4) if tot else 0.0
                 )
+            # Tiered prefix cache: per-tier probe counters with a
+            # hit-rate-by-tier (fraction of ALL block probes each tier
+            # served — the tier walk probes device first, so device
+            # hits + misses is the probe total).
+            if self._prefix_tiers:
+                dev = self._prefix_tiers.get("device", {})
+                probes = int(dev.get("hits", 0)) + int(dev.get("misses", 0))
+                out["prefix_tiers"] = {
+                    tier: {
+                        **kv,
+                        "hit_rate": (
+                            round(kv.get("hits", 0) / probes, 4)
+                            if probes else 0.0
+                        ),
+                    }
+                    for tier, kv in self._prefix_tiers.items()
+                }
             # Decode-path latency: with a folded engine one step emits up
             # to decode_fold tokens per slot, so step time and per-slot
             # inter-token latency diverge — report both, plus tokens/s

@@ -162,6 +162,10 @@ class Scheduler:
         #: Last-seen engine speculative-decoding counters (cumulative);
         #: step() diffs them into per-step metrics deltas.
         self._spec_seen = (0, 0, 0)
+        #: Last-seen engine tiered prefix-cache counters (cumulative,
+        #: per tier); step() diffs them into per-step metrics deltas —
+        #: the tier-labelled rlt_serve_prefix_* series.
+        self._prefix_seen: Dict[str, Dict[str, int]] = {}
         #: Requests popped for admission but not yet registered in
         #: _slot_req (engine.admit runs OUTSIDE the lock); cancel() must
         #: still find them so a cancel racing an admission is honored at
@@ -620,6 +624,25 @@ class Scheduler:
                             },
                         )
             self._spec_seen = (v, d, a)
+        # Tiered prefix cache: diff the engine's cumulative per-tier
+        # counters into one metrics record per step that saw tier
+        # traffic (admissions walk the tiers; steady decode never does).
+        tier_fn = getattr(self.engine, "prefix_tier_counters", None)
+        if tier_fn is not None and getattr(self.engine, "prefix_blocks", 0):
+            tiers = tier_fn()
+            if tiers != self._prefix_seen:
+                seen = self._prefix_seen
+                self.metrics.record_prefix_tiers(
+                    {
+                        t: {
+                            k: n - seen.get(t, {}).get(k, 0)
+                            for k, n in kv.items()
+                        }
+                        for t, kv in tiers.items()
+                    },
+                    self.engine.prefix_tier_bytes(),
+                )
+                self._prefix_seen = tiers
         for rid, n in fold_tokens.items():
             acct = self._acct.get(rid)
             if acct is not None:

@@ -74,7 +74,8 @@ def load_serve_params(
 ENGINE_KEYS = frozenset((
     "ckpt_path", "model_config", "params", "int8", "num_slots", "max_seq",
     "prefill_buckets", "decode_fold", "pipeline", "prefill_chunk",
-    "prefix_blocks", "prefix_block", "spec", "spec_depth",
+    "prefix_blocks", "prefix_block", "prefix_host_mb", "prefix_disk_dir",
+    "prefix_disk_mb", "spec", "spec_depth",
     "spec_draft_ckpt", "spec_draft_config", "spec_draft_int8",
     "spec_window", "mesh",
 ))
@@ -93,6 +94,9 @@ def build_engine(
     prefill_chunk: int = 0,
     prefix_blocks: int = 0,
     prefix_block: int = 16,
+    prefix_host_mb: float = 0.0,
+    prefix_disk_dir: Optional[str] = None,
+    prefix_disk_mb: float = 0.0,
     spec: str = "off",
     spec_depth: int = 4,
     spec_draft_ckpt: Optional[str] = None,
@@ -161,6 +165,9 @@ def build_engine(
         prefill_chunk=prefill_chunk,
         prefix_blocks=prefix_blocks,
         prefix_block=prefix_block,
+        prefix_host_mb=prefix_host_mb,
+        prefix_disk_dir=prefix_disk_dir,
+        prefix_disk_mb=prefix_disk_mb,
         spec=spec,
         spec_depth=spec_depth,
         spec_params=spec_params,
@@ -347,6 +354,9 @@ class ServeReplica:
         prefill_chunk: int = 0,
         prefix_blocks: int = 0,
         prefix_block: int = 16,
+        prefix_host_mb: float = 0.0,
+        prefix_disk_dir: Optional[str] = None,
+        prefix_disk_mb: float = 0.0,
         max_prefill_chunks_per_step: int = 1,
         spec: str = "off",
         spec_depth: int = 4,
@@ -403,6 +413,9 @@ class ServeReplica:
             prefill_chunk=prefill_chunk,
             prefix_blocks=prefix_blocks,
             prefix_block=prefix_block,
+            prefix_host_mb=prefix_host_mb,
+            prefix_disk_dir=prefix_disk_dir,
+            prefix_disk_mb=prefix_disk_mb,
             spec=spec,
             spec_depth=spec_depth,
             spec_draft_ckpt=spec_draft_ckpt,
@@ -488,6 +501,9 @@ class ServeReplica:
             "pipeline": self.engine.pipeline,
             "prefill_chunk": self.engine.prefill_chunk,
             "prefix_blocks": self.engine.prefix_blocks,
+            "prefix_host_mb": self.engine.prefix_host_mb,
+            "prefix_disk_dir": self.engine.prefix_disk_dir,
+            "prefix_disk_mb": self.engine.prefix_disk_mb,
             "spec": self.engine.spec,
             "spec_depth": self.engine.spec_depth,
             "int8": self.int8,

@@ -103,6 +103,27 @@ def test_bench_smoke_cpu():
     # The dispatch-bound regime (fold 1) is where spec must pay for
     # itself; the n-gram drafter on a repetitive suffix clears >= 1.5x.
     assert out["extra"]["decode_spec_vs_off_best"] >= 1.5, spec_rows
+    # Tiered prefix cache: on a working set 10x the device pool, the
+    # host-RAM tier must BEAT tiers-off — higher hit rate (spilled
+    # blocks survive eviction) and a better revisit TTFT p50 (an H2D
+    # block refill is cheaper than re-prefilling the prefix) — with the
+    # host+disk cascade recording real disk hits.
+    tiered = {
+        r["mode"]: r
+        for r in out["extra"]["tiered_prefix_rows"]
+    }
+    assert set(tiered) == {"tiers_off", "host", "host_disk"}, tiered
+    assert (
+        tiered["host"]["prefix_hit_rate"]
+        > tiered["tiers_off"]["prefix_hit_rate"]
+    ), tiered
+    assert (
+        tiered["host"]["ttft_p50_s"] < tiered["tiers_off"]["ttft_p50_s"]
+    ), tiered
+    assert tiered["host"]["host_hits"] > 0, tiered
+    assert tiered["host"]["refill_h2d_s"] > 0, tiered
+    assert tiered["host_disk"]["disk_hits"] > 0, tiered
+    assert out["extra"]["tiered_host_vs_off_ttft"] > 1.0, out["extra"]
     # Observer effect: tracing on the decode hot loop must stay under 5%
     # tokens/s (the obs layer's near-zero-cost contract, measured
     # best-of-3 per mode so scheduler jitter doesn't fail the gate).

@@ -16,6 +16,7 @@ have_headline=0
 have_full=0
 have_gpt=0
 have_serve=0
+have_tiered=0
 have_sharded=0
 have_spec=0
 have_obs=0
@@ -25,6 +26,7 @@ have_replay=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
+tiered_fails=0
 sharded_fails=0
 spec_fails=0
 obs_fails=0
@@ -38,6 +40,7 @@ headline_status=pending
 full_status=pending
 gpt_status=pending
 serve_status=pending
+tiered_status=pending
 sharded_status=pending
 spec_status=pending
 obs_status=pending
@@ -58,6 +61,7 @@ write_manifest() {
     echo "stage=full status=$full_status fails=$full_fails"
     echo "stage=gpt_ab status=$gpt_status fails=$gpt_fails"
     echo "stage=serve status=$serve_status fails=$serve_fails"
+    echo "stage=tiered status=$tiered_status fails=$tiered_fails"
     echo "stage=sharded_serve status=$sharded_status fails=$sharded_fails"
     echo "stage=spec status=$spec_status fails=$spec_fails"
     echo "stage=obs status=$obs_status fails=$obs_fails"
@@ -166,6 +170,32 @@ while true; do
             have_serve=1
             serve_status=skipped
             echo "$(date -u +%H:%M:%S) serve bench SKIPPED after $serve_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_tiered" -eq 0 ]; then
+        # Stage 4a: tiered-prefix-cache artifact — the serve sweep now
+        # carries tiered_prefix_rows (a working set 10x the device pool:
+        # tiers off vs host-RAM vs host+disk, hit rate + revisit TTFT +
+        # refill seconds), so the next healthy window records the
+        # spill/promote story ON CHIP next to the CPU control.
+        echo "$(date -u +%H:%M:%S) launching TIERED serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/tiered_bench.json 2> /tmp/tiered_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/tiered_bench.json ] && \
+           grep -q tiered_prefix_rows /tmp/tiered_bench.json; then
+          have_tiered=1
+          tiered_status=ok
+          echo "$(date -u +%H:%M:%S) TIERED serve bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          tiered_fails=$((tiered_fails+1))
+          tiered_status=failed
+          echo "$(date -u +%H:%M:%S) tiered serve bench failed rc=$rc (fail $tiered_fails)" >> /tmp/tpu_watch.log
+          if [ "$tiered_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_tiered=1
+            tiered_status=skipped
+            echo "$(date -u +%H:%M:%S) tiered serve bench SKIPPED after $tiered_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_sharded" -eq 0 ]; then
