@@ -1416,6 +1416,179 @@ def bench_serve_sharded(use_tpu: bool) -> Dict[str, Any]:
     return _in_worker(run, use_tpu, timeout=2400.0, cpu_devices=8)
 
 
+def bench_failover(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
+    """``failover_blackout``: kill one of two replica actors mid-load
+    through the deterministic fault harness (serve.faults — the kill
+    lands at a fixed fold boundary, not a wall-clock instant) with the
+    FleetSupervisor running, and measure the recovery the client
+    actually delivers: requests lost (must be zero — journal-backed
+    failover resubmits every incomplete request onto the survivor),
+    whether the failed-over streams are BIT-IDENTICAL to an
+    uninterrupted run of the same prompts (seed-chained rng makes this
+    assertable, not aspirational), the post-kill token blackout
+    (first token any stream receives after the replica_lost event), and
+    the supervisor's time-to-restart. Always measured on CPU replicas
+    (``failover_cpu_control``): the row grades the recovery machinery's
+    latency, which lives in the driver/scheduler, not the device."""
+
+    def run():
+        import dataclasses
+        import os as _os
+        import tempfile as _tempfile
+        import threading as _threading
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu import fabric as _fabric
+        from ray_lightning_tpu import obs
+        from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+        from ray_lightning_tpu.serve.client import start_replicas
+        from ray_lightning_tpu.serve.supervisor import FleetSupervisor
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        # This worker hosts its own nested fabric for the replica
+        # actors; over-provision LOGICAL CPUs (like bench main does) so
+        # the two replica bundles fit on small hosts — the replicas are
+        # plain processes, the logical count is bookkeeping only.
+        _fabric.init(num_cpus=max(8.0, float(_os.cpu_count() or 1)))
+
+        cfg = GPTConfig(
+            vocab_size=256, n_layer=1, n_head=4, n_kv_head=2, d_model=32,
+            max_seq=64, attn_impl="reference", compute_dtype="float32",
+        )
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        ckpt = _os.path.join(
+            _tempfile.mkdtemp(prefix="rlt_failover_"), "m.ckpt"
+        )
+        state_stream_to_file(
+            to_state_stream(
+                {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+            ),
+            ckpt,
+        )
+        g = np.random.default_rng(0)
+        n_req, n_new = 8, 16
+        prompts = [
+            g.integers(0, cfg.vocab_size, size=8).tolist()
+            for _ in range(n_req)
+        ]
+        client = start_replicas(
+            2,
+            ckpt_path=ckpt,
+            num_slots=2,
+            prefill_buckets=[16],
+            decode_fold=2,
+            env={"JAX_PLATFORMS": "cpu"},
+        )
+        sup = FleetSupervisor(
+            client, interval_s=0.1, restart_backoff_s=0.2,
+            restart_limit=3, probe_timeout_s=60.0,
+        ).start()
+        try:
+            def drive(record_times):
+                """Submit every prompt and stream them concurrently,
+                returning ({idx: tokens}, {idx: [wall stamps]}, lost)."""
+                handles = [
+                    client.submit(p, max_new_tokens=n_new, seed=i)
+                    for i, p in enumerate(prompts)
+                ]
+                outs: Dict[int, list] = {}
+                stamps: Dict[int, list] = {i: [] for i in range(n_req)}
+                lost: list = []
+
+                def pull(i, h):
+                    try:
+                        toks = []
+                        for t in client.stream_handle(h, timeout_s=300):
+                            toks.append(t)
+                            if record_times:
+                                stamps[i].append(_time.time())
+                        outs[i] = toks
+                    except Exception:  # noqa: BLE001 - a lost stream IS
+                        lost.append(i)  # the measurement
+
+                threads = [
+                    _threading.Thread(target=pull, args=(i, h))
+                    for i, h in enumerate(handles)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                return outs, stamps, lost
+
+            # Uninterrupted control: the bit-exactness oracle.
+            base, _, base_lost = drive(record_times=False)
+            assert not base_lost, f"control run lost streams {base_lost}"
+            # Arm the kill on replica 0 (third fold boundary — mid-load,
+            # every stream part-way through) and drive the SAME prompts.
+            client.inject_fault(
+                0, [{"point": "fold_boundary", "action": "kill",
+                     "after": 3}],
+            )
+            t_round = _time.time()
+            outs, stamps, lost_streams = drive(record_times=True)
+            # Post-kill blackout: first token ANY stream received after
+            # the client declared the replica lost.
+            t_lost = None
+            for ev in obs.get_event_log().tail(512):
+                if (
+                    ev.get("name") == "replica_lost"
+                    and ev.get("ts", 0) >= t_round
+                ):
+                    t_lost = ev["ts"]
+                    break
+            blackout = None
+            if t_lost is not None:
+                after = [
+                    t for ts in stamps.values() for t in ts if t > t_lost
+                ]
+                if after:
+                    blackout = round(min(after) - t_lost, 4)
+            # Supervisor restart latency (poll granularity ~10ms).
+            restart_s = None
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                rows_now = sup.rows()
+                if rows_now and rows_now[0].get("restarts", 0) >= 1:
+                    restart_s = round(_time.time() - (t_lost or t_round), 3)
+                    break
+                _time.sleep(0.01)
+            exact = (
+                not lost_streams
+                and all(outs.get(i) == base.get(i) for i in range(n_req))
+            )
+            row = {
+                "workload": "failover_blackout",
+                "replicas": 2,
+                "requests": n_req,
+                "kill_point": "fold_boundary",
+                "requests_lost": len(lost_streams),
+                "exact_vs_uninterrupted": exact,
+                "ttft_after_kill_s": blackout,
+                "supervisor_restart_s": restart_s,
+            }
+            return {
+                "failover_blackout_rows": [row],
+                "failover_requests_lost": len(lost_streams),
+                "failover_exact": exact,
+                "failover_ttft_after_kill_s": blackout,
+                "failover_cpu_control": True,
+            }
+        finally:
+            sup.stop()
+            client.shutdown()
+
+    # Always a CPU control (see docstring): the replicas pin
+    # JAX_PLATFORMS=cpu, so the worker never needs a chip.
+    return _in_worker(run, False, timeout=1200.0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=3)
@@ -1563,6 +1736,10 @@ def main() -> None:
             extra.update(bench_serve_sharded(use_tpu))
         except Exception as exc:  # noqa: BLE001 - still emit a record
             extra["sharded_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_failover(use_tpu))
+        except Exception as exc:  # noqa: BLE001 - still emit a record
+            extra["failover_error"] = f"{type(exc).__name__}: {exc}"
         extra["bench_wall_s"] = round(time.time() - t0, 1)
         val = extra.get("serve_shared_prefix_ttft_speedup", 0.0)
         print(
@@ -1691,6 +1868,10 @@ def main() -> None:
             extra.update(bench_serve_sharded(use_tpu))
         except Exception as exc:  # noqa: BLE001
             extra["sharded_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_failover(use_tpu))
+        except Exception as exc:  # noqa: BLE001
+            extra["failover_error"] = f"{type(exc).__name__}: {exc}"
     extra["bench_wall_s"] = round(time.time() - t0, 1)
 
     print(

@@ -400,6 +400,7 @@ _SERVE_KEYS = frozenset((
     "blackbox_dir", "blackbox_keep",
     "fleet", "fleet_interval_s", "fleet_history",
     "journal", "journal_capacity",
+    "supervisor", "restart_limit", "restart_backoff_s", "rpc_timeout_s",
 ))
 
 
@@ -409,6 +410,7 @@ def _serve_obs_server(
     fleet: bool = True,
     fleet_interval_s: float = 2.0,
     fleet_history: int = 128,
+    supervisor: Any = None,
 ) -> Tuple[Any, Optional[Any]]:
     """Build (started) the driver-side obs HTTP server ``rlt serve``
     runs next to a replica gang, plus its FleetPoller (None when
@@ -417,10 +419,16 @@ def _serve_obs_server(
     - ``/metrics``: every replica's registry (replica-labelled) + the
       driver's own (fabric heartbeat gauges, ``rlt_fleet_*``);
     - ``/stats``: per-replica stats snapshots;
-    - ``/healthz``: 200/503 aggregating fabric heartbeat verdicts +
-      every replica's health() RPC;
+    - ``/healthz``: the FLEET readiness probe an external load balancer
+      points at: 503 only when NO replica can serve (every replica
+      unhealthy/unreachable — a single sick replica is the
+      supervisor's problem, not the LB's); the JSON body lists every
+      replica's verdict plus the driver's own (fabric heartbeat)
+      report, and the top-level verdict degrades while any replica is
+      out;
     - ``/fleet``: the latest FleetSnapshot + history ring (``rlt top``'s
-      feed);
+      feed), plus the supervisor's per-replica state table when a
+      :class:`serve.supervisor.FleetSupervisor` is wired;
     - ``/events``: the merged structured event rings as JSONL
       (``?level=``/``?subsystem=``/``?n=`` filter server-side);
     - ``/traces``: the stitched cross-process Chrome trace;
@@ -454,6 +462,9 @@ def _serve_obs_server(
             history=int(fleet_history),
             registry=driver_reg,
             events=obs.get_event_log(),
+            supervisor_fn=(
+                supervisor.rows if supervisor is not None else None
+            ),
         ).start()
 
     def _collect() -> str:
@@ -461,17 +472,29 @@ def _serve_obs_server(
         return client.metrics_text() + driver_reg.render()
 
     def _collect_health():
+        # FLEET readiness, not per-process health: an external LB gets
+        # ONE probe endpoint and should keep routing while ANY replica
+        # can serve — a single dead/unhealthy replica is the
+        # supervisor's job (drain, restart, fail over), and pulling the
+        # whole fleet for it would turn one replica crash into an
+        # outage. 503 only when every replica is out; the body always
+        # lists per-replica verdicts so operators see exactly who is
+        # sick, plus the driver's own (fabric heartbeat) report.
         report = driver_wd.evaluate()
         payload = report.to_dict()
-        healthy = report.healthy
         replicas = client.health()
         payload["replicas"] = replicas
-        healthy = healthy and all(
-            r.get("healthy", True) for r in replicas
-        )
+        up = sum(1 for r in replicas if r.get("healthy", True))
+        payload["replicas_total"] = len(replicas)
+        payload["replicas_healthy"] = up
+        if supervisor is not None:
+            payload["supervisor"] = supervisor.rows()
+        healthy = up > 0 if replicas else report.healthy
         payload["healthy"] = healthy
         if not healthy:
             payload["verdict"] = "unhealthy"
+        elif (replicas and up < len(replicas)) or not report.healthy:
+            payload["verdict"] = "degraded"
         return healthy, payload
 
     def _collect_events() -> str:
@@ -592,6 +615,23 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         (default on; needs metrics_port to be reachable).
         fleet_interval_s: poll cadence (default 2s); fleet_history:
         snapshots retained in the history ring (default 128).
+      supervisor: drive the driver-side FleetSupervisor (default on) —
+        the detect->decide->recover loop: unhealthy replicas drain
+        (no new submissions, in-flight work finishes), dead replicas
+        restart through the fabric from the same resolved config, and
+        their incomplete requests fail over onto survivors by
+        replaying the client journal's submit records (bit-identical
+        token streams for greedy/seeded requests; already-streamed
+        prefixes deduplicate client-side). restart_limit: consecutive
+        failed restarts before a replica is parked as failed (default
+        3); restart_backoff_s: base of the capped exponential restart
+        backoff (default 1s). Restart/failover traffic lands in
+        rlt_fleet_replica_restarts_total, rlt_serve_failover_*, and
+        replica_lost/failover/replica_restarted events.
+      rpc_timeout_s: per-RPC timeout for every client->replica call
+        (default none — block); transient failures retry with capped
+        exponential backoff + jitter before the replica is declared
+        lost.
       tracing: record request traces on the replicas (default on);
         trace_out: after serving, write the replicas' recent traces as
         Chrome trace-event JSON to this path (opens in Perfetto).
@@ -760,6 +800,14 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     fleet_enabled = bool(serve_cfg.pop("fleet", True))
     fleet_interval_s = float(serve_cfg.pop("fleet_interval_s", 2.0))
     fleet_history = int(serve_cfg.pop("fleet_history", 128))
+    # Fault tolerance: the driver-side supervisor (drain/restart/fail
+    # over) and the client's per-RPC timeout knob.
+    supervisor_enabled = bool(serve_cfg.pop("supervisor", True))
+    restart_limit = int(serve_cfg.pop("restart_limit", 3))
+    restart_backoff_s = float(serve_cfg.pop("restart_backoff_s", 1.0))
+    rpc_timeout_s = serve_cfg.pop("rpc_timeout_s", None)
+    if rpc_timeout_s is not None:
+        rpc_timeout_s = float(rpc_timeout_s)
     pc = serve_cfg.pop("prefix_cache", "off")
     if isinstance(pc, str):
         pc_norm = pc.strip().lower()
@@ -828,10 +876,24 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         replicas,
         env=env,
         hosts_per_replica=hosts_per_replica,
+        rpc_timeout_s=rpc_timeout_s,
         **replica_kwargs,
     )
     metrics_server = None
     fleet_poller = None
+    supervisor = None
+    if supervisor_enabled:
+        # Close the detect->decide->recover loop for the run's duration:
+        # unhealthy replicas drain, dead ones restart (same resolved
+        # config) within the backoff budget, and their incomplete
+        # requests fail over onto survivors bit-exactly.
+        from ray_lightning_tpu.serve.supervisor import FleetSupervisor
+
+        supervisor = FleetSupervisor(
+            client,
+            restart_limit=restart_limit,
+            restart_backoff_s=restart_backoff_s,
+        ).start()
     try:
         if metrics_port is not None:
             # Driver-side Prometheus endpoint for the run's duration:
@@ -848,7 +910,13 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
                 fleet=fleet_enabled,
                 fleet_interval_s=fleet_interval_s,
                 fleet_history=fleet_history,
+                supervisor=supervisor,
             )
+            if supervisor is not None and fleet_poller is not None:
+                # Share PR 8's pull: the supervisor reads heartbeat ages
+                # from the poller's latest snapshot instead of its own
+                # fabric read.
+                supervisor.poller = fleet_poller
             print(
                 f"serve metrics endpoint: {metrics_server.url}",
                 file=sys.stderr,
@@ -889,6 +957,8 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         print(_json.dumps({"serve_stats": stats}))
         return {"outputs": outputs, "stats": stats}
     finally:
+        if supervisor is not None:
+            supervisor.stop()  # before shutdown: no restarts mid-teardown
         if fleet_poller is not None:
             fleet_poller.stop()
         if metrics_server is not None:
@@ -1150,6 +1220,22 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"goodput={fleet.get('goodput_tokens_per_device_s', 0.0)} "
             f"ttft_p95_worst={fleet.get('ttft_p95_s_worst')}"
         )
+    # Recovery plane (when a FleetSupervisor is wired): one cell per
+    # replica — state, lifetime restarts, pending attempts.
+    sup = payload.get("supervisor") or []
+    if sup:
+        cells = []
+        for s in sup:
+            cell = f"r{s.get('replica')}={s.get('state')}"
+            extras = []
+            if s.get("restarts"):
+                extras.append(f"restarts={s['restarts']}")
+            if s.get("attempts"):
+                extras.append(f"attempts={s['attempts']}")
+            if extras:
+                cell += "(" + ",".join(extras) + ")"
+            cells.append(cell)
+        out.append("supervisor: " + " ".join(cells))
     return "\n".join(out)
 
 

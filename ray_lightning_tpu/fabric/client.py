@@ -231,7 +231,17 @@ def wait(
     return _client.request(("wait", list(refs), num_returns, timeout))
 
 
-def kill(handle: Any, no_restart: bool = True) -> None:  # noqa: ARG001
+def kill(handle: Any, no_restart: bool = True) -> None:
+    # Same contract as core.kill: the fabric never restarts actors in
+    # place, so no_restart=False must fail loudly instead of silently
+    # doing the no_restart=True thing (see serve.supervisor for the
+    # restart path).
+    if not no_restart:
+        raise ValueError(
+            "fabric.kill(no_restart=False) is unsupported: fabric "
+            "actors are never restarted in place; use "
+            "serve.supervisor.FleetSupervisor for replica restarts"
+        )
     _client.request(("kill", handle.actor_id))
 
 

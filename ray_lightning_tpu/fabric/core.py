@@ -1119,9 +1119,25 @@ def _boot_worker_process(actor_id: str, env: Dict[str, Any], node: Node):
         listener.close()
 
 
-def kill(handle: ActorHandle, no_restart: bool = True) -> None:  # noqa: ARG001
+def kill(handle: ActorHandle, no_restart: bool = True) -> None:
     """Terminate an actor and release its resources (no restart semantics,
-    matching ``ray.kill(no_restart=True)`` in ray_launcher.py:126)."""
+    matching ``ray.kill(no_restart=True)`` in ray_launcher.py:126).
+
+    ``no_restart=False`` is REJECTED loudly: fabric actors have no
+    restart machinery (no retained spawn spec, no supervision), so
+    silently accepting the flag would promise a restart that never
+    comes. Restartable serving replicas are the serve layer's job —
+    ``serve.supervisor.FleetSupervisor`` re-runs a dead replica's
+    original spawn via ``ServeClient.respawn_replica``.
+    """
+    if not no_restart:
+        raise ValueError(
+            "fabric.kill(no_restart=False) is unsupported: fabric actors "
+            "are never restarted in place. For restartable serving "
+            "replicas use serve.supervisor.FleetSupervisor (which "
+            "re-runs the original spawn), then kill with the default "
+            "no_restart=True."
+        )
     _c = _client_mode()
     if _c is not None:
         _c.kill(handle)

@@ -150,6 +150,11 @@ class FleetPoller:
     (production default seconds — the bench runs it 100x faster to
     measure the observer effect). ``to_dict()`` is the ``/fleet``
     payload: the latest snapshot plus the history ring.
+
+    ``supervisor_fn`` (optional, zero-arg -> list of rows — typically
+    ``FleetSupervisor.rows``) embeds the recovery plane's per-replica
+    state table in the ``/fleet`` payload, so ``rlt top`` and dashboards
+    show restarts/draining next to the health/throughput rows.
     """
 
     def __init__(
@@ -159,8 +164,12 @@ class FleetPoller:
         history: int = 128,
         registry: Optional[Any] = None,
         events: Optional[Any] = None,
+        supervisor_fn: Optional[
+            Callable[[], List[Dict[str, Any]]]
+        ] = None,
     ) -> None:
         self._pull = pull_fn
+        self._supervisor_fn = supervisor_fn
         self.interval_s = float(interval_s)
         self.history = max(1, int(history))
         self._events = events
@@ -260,13 +269,19 @@ class FleetPoller:
             ring = list(self._ring)
             errors = self._errors
             polls = self._polls
-        return {
+        out = {
             "latest": ring[-1] if ring else None,
             "history": ring,
             "polls": polls,
             "errors": errors,
             "interval_s": self.interval_s,
         }
+        if self._supervisor_fn is not None:
+            try:
+                out["supervisor"] = self._supervisor_fn()
+            except Exception:  # noqa: BLE001 - the fleet payload must
+                pass  # survive a supervisor mid-teardown
+        return out
 
     # -- thread lifecycle -------------------------------------------------
     def start(self) -> "FleetPoller":

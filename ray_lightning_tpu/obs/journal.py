@@ -344,7 +344,14 @@ def load_journal(
     rotated files concatenate oldest-first). Replica-tagged lines (the
     multi-replica ``/journal`` body) are filtered to ``replica``
     (default: the lowest tag present); untagged journals ignore it.
-    Returns ``{"header": ..., "entries": [...]}``."""
+    Crash consistency: a journal written by a process that died hard
+    (fault-injected kill, OOM, SIGKILL) legitimately ends in a TORN
+    line — the spill buffer was cut mid-record. Unparseable lines are
+    skipped and counted (``torn_lines`` in the result) instead of
+    failing the whole load; the replay/failover machinery must be able
+    to read exactly the journals that crashes produce.
+
+    Returns ``{"header": ..., "entries": [...], "torn_lines": n}``."""
     paths = [path]
     if os.path.isdir(path):
         paths = [
@@ -355,13 +362,22 @@ def load_journal(
         if not paths:
             raise ValueError(f"no journal-*.jsonl files in {path!r}")
     rows: List[Dict[str, Any]] = []
+    torn = 0
     for p in paths:
-        with open(p) as f:
+        with open(p, errors="replace") as f:
             for ln in f:
                 ln = ln.strip()
                 if not ln:
                     continue
-                rows.append(json.loads(ln))
+                try:
+                    row = json.loads(ln)
+                except ValueError:
+                    torn += 1
+                    continue
+                if not isinstance(row, dict):
+                    torn += 1
+                    continue
+                rows.append(row)
     tags = sorted(
         {r["replica"] for r in rows if "replica" in r}
     )
@@ -377,7 +393,30 @@ def load_journal(
             header = {k: v for k, v in r.items() if k != "kind"}
         else:
             entries.append(r)
-    return {"header": header, "entries": entries, "path": path}
+    return {
+        "header": header, "entries": entries, "path": path,
+        "torn_lines": torn,
+    }
+
+
+def incomplete_requests(journal: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The failover set: ``submit`` entries with no terminal ``outcome``
+    entry — exactly the requests a crashed replica stranded (the process
+    died before ``_acct_close`` flushed them, or the ring rotated the
+    outcome away). An outcome-less submit is DATA, not corruption: it
+    carries everything a resubmission needs (prompt, full SamplingParams
+    including the seed, priority/deadline/tenant), and seed-chained rng
+    makes the replayed request emit bit-identical tokens."""
+    entries = journal.get("entries") or []
+    done = {
+        e.get("request_id")
+        for e in entries
+        if e.get("kind") == "outcome"
+    }
+    return [
+        e for e in entries
+        if e.get("kind") == "submit" and e.get("request_id") not in done
+    ]
 
 
 # ---------------------------------------------------------------------------

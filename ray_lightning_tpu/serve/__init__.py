@@ -14,6 +14,13 @@ The L5 layer over the decode path (models/gpt.py: prefill + GQA KV cache
   (server.py, client.py); ``rlt serve`` is the CLI front end.
 - :class:`ServeMetrics` — queue depth, TTFT, occupancy, tokens/s
   (metrics.py), exposed through the replicas' ``stats()`` endpoint.
+- :class:`FleetSupervisor` — the driver-side detect->decide->recover
+  loop (supervisor.py): drains unhealthy replicas, restarts dead ones
+  through the fabric, and fails their incomplete requests over
+  (journal-backed, bit-exact) onto survivors.
+- :class:`FaultInjector` — deterministic fault injection (faults.py):
+  kill/delay/drop/wedge at named lifecycle points, driving the chaos
+  tests and the ``failover_blackout`` bench.
 
 Heavy deps load lazily: the engine (jax) and the replica/client layer
 (fabric) import on first attribute access, not at package import.
@@ -28,6 +35,8 @@ from ray_lightning_tpu.serve.scheduler import (
     TokenEvent,
 )
 
+from ray_lightning_tpu.serve.faults import FaultInjector, FaultRule
+
 __all__ = [
     "DecodeEngine",
     "ServeMetrics",
@@ -39,6 +48,9 @@ __all__ = [
     "ServeClient",
     "start_replicas",
     "load_serve_params",
+    "FleetSupervisor",
+    "FaultInjector",
+    "FaultRule",
 ]
 
 _LAZY = {
@@ -48,6 +60,7 @@ _LAZY = {
     "load_serve_params": "ray_lightning_tpu.serve.server",
     "ServeClient": "ray_lightning_tpu.serve.client",
     "start_replicas": "ray_lightning_tpu.serve.client",
+    "FleetSupervisor": "ray_lightning_tpu.serve.supervisor",
 }
 
 

@@ -12,25 +12,79 @@ before the submit RPC departs and records a ``client_submit`` span in
 its own ring, so ``export_stitched_trace()`` can merge the client,
 every replica, and every gang follower into ONE wall-clock-aligned
 Chrome trace (see obs.trace.merge_chrome_trace).
+
+Fault tolerance (the client half of the recovery loop — the driver half
+is :class:`serve.supervisor.FleetSupervisor`): every RPC takes an
+optional per-call timeout with capped exponential backoff + jitter on
+transient failures; replicas that die (``ActorDiedError``) or exhaust
+the retry budget land on an EXCLUSION list and their incomplete
+requests FAIL OVER — the client keeps a driver-side workload journal
+(obs.journal schema: one normalized ``submit`` record per request, one
+``outcome`` at terminal), so a lost replica's outcome-less submits are
+replayed verbatim (prompt + full SamplingParams incl. seed +
+priority/deadline/tenant) onto survivors. Because per-request rng is
+seed-chained and greedy decode is bit-exact, the resubmitted request
+emits the IDENTICAL token stream; ``stream_handle`` keeps its cursor
+across the failover, so callers see one uninterrupted stream with the
+already-delivered prefix deduplicated client-side.
 """
 from __future__ import annotations
 
-import itertools
-import json
+import random
+import threading
 import time
 import uuid
 from dataclasses import dataclass
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ray_lightning_tpu import fabric
 from ray_lightning_tpu.obs import trace as _trace
 from ray_lightning_tpu.serve.server import ServeReplica
 
 
+class ReplicaLostError(RuntimeError):
+    """A replica stopped answering (died, or exhausted the RPC retry
+    budget); carries the replica index so callers can fail over."""
+
+    def __init__(self, replica: int, reason: str) -> None:
+        super().__init__(f"replica {replica} lost: {reason}")
+        self.replica = int(replica)
+        self.reason = reason
+
+
+class NoReplicasError(RuntimeError):
+    """Every replica is excluded/lost — nothing can take traffic."""
+
+
 @dataclass(frozen=True)
 class RequestHandle:
+    #: The replica the request was FIRST routed to; after a failover the
+    #: client's route table (not this field) is authoritative.
     replica: int
     request_id: str
+
+
+#: ServeReplica.submit's full kwarg surface with its defaults — the
+#: normalization target for the client-side journal: a submit record
+#: always carries EVERY field explicitly, so a failover resubmission is
+#: byte-for-byte the original request regardless of which defaults the
+#: caller leaned on.
+_SUBMIT_DEFAULTS: Dict[str, Any] = {
+    "max_new_tokens": 32,
+    "temperature": 0.0,
+    "top_k": None,
+    "top_p": None,
+    "seed": 0,
+    "eos_token": None,
+    "priority": 0,
+    "deadline_s": None,
+    "tenant": None,
+}
+
+#: Exceptions that mean "this actor is gone" (fail over now) vs
+#: "this call failed" (retry with backoff first).
+_FATAL_RPC_ERRORS = (fabric.ActorDiedError,)
+_TRANSIENT_RPC_ERRORS = (TimeoutError, ConnectionError, EOFError, OSError)
 
 
 class ServeClient:
@@ -39,6 +93,15 @@ class ServeClient:
     ``followers`` are the rank>0 members of sharded gangs (see
     ``start_replicas`` ``hosts_per_replica``): they take no requests —
     the client only has to tear them down after their leaders.
+    ``follower_replica`` maps each follower to the replica index whose
+    gang it belongs to (parallel list; defaults to replica 0).
+
+    ``respawn_fn(i) -> (leader, followers)`` re-runs replica ``i``'s
+    original spawn (same resolved config, same placement-group bundle,
+    fresh processes) — the supervisor's restart path. ``rpc_timeout_s``
+    bounds every RPC (None = block, the pre-supervisor behavior);
+    ``rpc_retries`` transient failures are retried with capped
+    exponential backoff + jitter before the replica is declared lost.
     """
 
     def __init__(
@@ -47,20 +110,197 @@ class ServeClient:
         pg: Any = None,
         followers: Optional[List[Any]] = None,
         tracer: Optional[Any] = None,
+        respawn_fn: Optional[Callable[[int], Tuple[Any, List[Any]]]] = None,
+        follower_replica: Optional[List[int]] = None,
+        rpc_timeout_s: Optional[float] = None,
+        rpc_retries: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        journal_capacity: int = 8192,
+        init_timeout: float = 300.0,
+        registry: Optional[Any] = None,
+        events: Optional[Any] = None,
     ) -> None:
+        from ray_lightning_tpu.obs.events import get_event_log
+        from ray_lightning_tpu.obs.journal import WorkloadJournal
+        from ray_lightning_tpu.obs.registry import get_registry
+
         if not replicas:
             raise ValueError("need at least one replica")
         self._replicas = list(replicas)
         self._followers = list(followers or [])
+        self._follower_replica = list(
+            follower_replica
+            if follower_replica is not None
+            else [0] * len(self._followers)
+        )
         self._pg = pg
-        self._rr = itertools.cycle(range(len(self._replicas)))
+        self._respawn_fn = respawn_fn
+        self._init_timeout = float(init_timeout)
+        self.rpc_timeout_s = (
+            None if rpc_timeout_s is None else float(rpc_timeout_s)
+        )
+        self.rpc_retries = max(0, int(rpc_retries))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._lock = threading.RLock()
+        self._rr = 0
+        #: Replica indices receiving no NEW traffic: draining (supervisor
+        #: verdict) or lost (failed RPCs). ``_lost`` additionally means
+        #: "its incomplete requests were failed over".
+        self._excluded: set = set()
+        self._lost: set = set()
+        #: request_id -> current replica index (None once declared lost).
+        self._route: Dict[str, Optional[int]] = {}
+        #: request_id -> its normalized journal ``submit`` record — the
+        #: OPEN half of the driver-side journal (popped at terminal).
+        #: This is the failover set: submit without outcome == incomplete.
+        self._open: Dict[str, Dict[str, Any]] = {}
         #: Driver-side trace ring: the client records a ``client_submit``
         #: span per request (under the SAME id the replica traces carry
         #: — the client mints it), so the stitched export shows the
         #: client-observed queue time that no replica ring can see.
         self.tracer = tracer or _trace.RequestTracer(capacity=4096)
+        #: Driver-side workload journal (obs.journal schema): every
+        #: submit this client issued + every terminal outcome it
+        #: observed. Survives any replica's death by construction —
+        #: the substrate request failover replays from.
+        self.journal = WorkloadJournal(capacity=int(journal_capacity))
+        self._events = events if events is not None else get_event_log()
+        reg = registry if registry is not None else get_registry()
+        self._m_failover = reg.counter(
+            "rlt_serve_failover_requests_total",
+            "Requests moved off a lost replica (outcome label: "
+            "resubmitted onto a survivor, or lost with no survivor)",
+        )
+        self._m_rpc_retries = reg.counter(
+            "rlt_serve_failover_rpc_retries_total",
+            "Client RPCs retried after a transient failure/timeout",
+        )
+        self._m_replicas_lost = reg.counter(
+            "rlt_serve_failover_replicas_lost_total",
+            "Replicas declared lost by the serve client",
+        )
 
-    # -- request API -----------------------------------------------------
+    # -- internals --------------------------------------------------------
+    def _event(self, name: str, level: str = "info", **kv: Any) -> None:
+        try:
+            self._events.record("serve", name, level=level, **kv)
+        except Exception:  # noqa: BLE001 - forensics must never block I/O
+            pass
+
+    def _backoff(self, attempt: int) -> float:
+        """Capped exponential backoff with jitter (0.5x-1x of the
+        deterministic value, so a thundering herd of retries decorrelates)."""
+        base = min(
+            self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt)
+        )
+        return base * (0.5 + 0.5 * random.random())
+
+    def _actor(self, idx: int) -> Any:
+        with self._lock:
+            return self._replicas[idx]
+
+    def _rpc(
+        self,
+        idx: int,
+        method: str,
+        *args: Any,
+        timeout: Optional[float] = None,
+        retries: Optional[int] = None,
+        **kwargs: Any,
+    ) -> Any:
+        """One replica RPC with the client's fault policy: per-call
+        timeout, transient errors retried with capped backoff + jitter,
+        actor death (or retry exhaustion) raised as ReplicaLostError."""
+        timeout = self.rpc_timeout_s if timeout is None else timeout
+        retries = self.rpc_retries if retries is None else max(0, retries)
+        attempt = 0
+        while True:
+            actor = self._actor(idx)
+            try:
+                return fabric.get(
+                    getattr(actor, method).remote(*args, **kwargs),
+                    timeout=timeout,
+                )
+            except _FATAL_RPC_ERRORS as exc:
+                raise ReplicaLostError(
+                    idx, f"{type(exc).__name__}: {exc}"
+                ) from exc
+            except _TRANSIENT_RPC_ERRORS as exc:
+                if attempt >= retries:
+                    raise ReplicaLostError(
+                        idx,
+                        f"rpc {method!r} failed {attempt + 1}x "
+                        f"({type(exc).__name__}: {exc})",
+                    ) from exc
+                self._m_rpc_retries.inc(1)
+                time.sleep(self._backoff(attempt))
+                attempt += 1
+
+    def _alive(self, exclude: Optional[int] = None) -> List[int]:
+        with self._lock:
+            return [
+                i for i in range(len(self._replicas))
+                if i not in self._excluded and i != exclude
+            ]
+
+    def _pick(self, exclude: Optional[int] = None) -> int:
+        """Round-robin over the non-excluded replicas."""
+        with self._lock:
+            alive = self._alive(exclude)
+            if not alive:
+                raise NoReplicasError(
+                    "no live replicas to route to (all excluded/lost)"
+                )
+            idx = alive[self._rr % len(alive)]
+            self._rr += 1
+            return idx
+
+    # -- exclusion surface (the supervisor's levers) -----------------------
+    def exclude(self, idx: int) -> None:
+        """Stop routing NEW submissions to replica ``idx`` (draining:
+        in-flight requests keep streaming). Idempotent."""
+        with self._lock:
+            self._excluded.add(int(idx))
+
+    def restore(self, idx: int) -> None:
+        """Resume routing to a drained replica. Idempotent."""
+        with self._lock:
+            self._excluded.discard(int(idx))
+            self._lost.discard(int(idx))
+
+    def excluded(self) -> List[int]:
+        with self._lock:
+            return sorted(self._excluded)
+
+    # -- request API -------------------------------------------------------
+    def _record_submit(
+        self, rid: str, prompt: List[int], record: Dict[str, Any]
+    ) -> None:
+        self.journal.record_submit(
+            request_id=rid,
+            prompt=prompt,
+            sampling={
+                k: record[k]
+                for k in (
+                    "max_new_tokens", "temperature", "top_k", "top_p",
+                    "seed", "eos_token",
+                )
+            },
+            priority=record["priority"],
+            deadline_s=record["deadline_s"],
+            tenant=record["tenant"],
+        )
+
+    def _submit_rpc(
+        self, idx: int, rid: str, prompt: List[int], record: Dict[str, Any]
+    ) -> None:
+        self._rpc(
+            idx, "submit", prompt, request_id=rid,
+            **{k: record[k] for k in _SUBMIT_DEFAULTS},
+        )
+
     def submit(
         self,
         prompt: Sequence[int],
@@ -68,24 +308,59 @@ class ServeClient:
         replica: Optional[int] = None,
         **sampling: Any,
     ) -> RequestHandle:
-        """Queue a request (round-robin across replicas unless pinned);
-        sampling kwargs mirror ServeReplica.submit (including ``tenant``
-        for cost-ledger attribution)."""
-        idx = next(self._rr) if replica is None else int(replica)
-        # The client mints the id so its submit span and every remote
-        # span share it BEFORE the RPC departs (trace context carried
-        # across the process hop).
+        """Queue a request (round-robin across live replicas unless
+        pinned); sampling kwargs mirror ServeReplica.submit (including
+        ``tenant`` for cost-ledger attribution). A replica dying under
+        the submit re-routes to a survivor (pinned submits raise
+        instead — the pin was the point)."""
         rid = sampling.pop("request_id", None) or uuid.uuid4().hex[:12]
-        self.tracer.event(
-            rid, _trace.SPAN_CLIENT_SUBMIT,
-            attrs={"replica": idx, "prompt_tokens": len(prompt)},
-        )
-        rid = fabric.get(
-            self._replicas[idx].submit.remote(
-                [int(t) for t in prompt], request_id=rid, **sampling
+        unknown = set(sampling) - set(_SUBMIT_DEFAULTS)
+        if unknown:
+            raise TypeError(
+                f"unknown submit option(s) {sorted(unknown)}; valid: "
+                f"{sorted(_SUBMIT_DEFAULTS)}"
             )
-        )
-        return RequestHandle(replica=idx, request_id=rid)
+        record = dict(_SUBMIT_DEFAULTS)
+        record.update(sampling)
+        prompt = [int(t) for t in prompt]
+        record["prompt"] = prompt
+        # Journal BEFORE the RPC departs: a replica dying mid-submit must
+        # still leave the record failover resubmits from.
+        with self._lock:
+            self._open[rid] = record
+        self._record_submit(rid, prompt, record)
+        while True:
+            idx = int(replica) if replica is not None else self._pick()
+            self.tracer.event(
+                rid, _trace.SPAN_CLIENT_SUBMIT,
+                attrs={"replica": idx, "prompt_tokens": len(prompt)},
+            )
+            try:
+                self._submit_rpc(idx, rid, prompt, record)
+            except ReplicaLostError as exc:
+                self.on_replica_lost(idx, reason=str(exc))
+                if replica is not None:
+                    with self._lock:
+                        self._open.pop(rid, None)
+                    raise
+                continue
+            with self._lock:
+                self._route[rid] = idx
+            return RequestHandle(replica=idx, request_id=rid)
+
+    def _finish(self, rid: str, status: str) -> None:
+        """A request reached terminal state from this client's point of
+        view: close the driver-side journal record (it leaves the
+        failover set) and drop its route."""
+        with self._lock:
+            known = self._open.pop(rid, None)
+            self._route.pop(rid, None)
+        if known is not None:
+            self.journal.record_outcome(rid, status)
+
+    def _route_of(self, handle: RequestHandle) -> Optional[int]:
+        with self._lock:
+            return self._route.get(handle.request_id, handle.replica)
 
     def stream(
         self,
@@ -108,27 +383,53 @@ class ServeClient:
         poll_s: float = 0.05,
         timeout_s: float = 300.0,
     ) -> Iterator[int]:
-        actor = self._replicas[handle.replica]
+        """Stream a request's tokens, transparently surviving replica
+        loss: the poll follows the route table, and because a failed-over
+        request re-emits its full (bit-identical) stream on the
+        survivor, the retained ``cursor`` deduplicates the prefix the
+        caller already received — the stream just continues."""
+        rid = handle.request_id
         cursor = 0
         deadline = time.monotonic() + timeout_s
         while True:
-            res = fabric.get(
-                actor.result.remote(
-                    handle.request_id, cursor, wait_s=poll_s
+            idx = self._route_of(handle)
+            if idx is None:
+                raise ReplicaLostError(
+                    handle.replica,
+                    f"request {rid} could not be failed over "
+                    "(no surviving replicas)",
                 )
-            )
+            try:
+                res = self._rpc(
+                    idx, "result", rid, cursor, wait_s=poll_s,
+                    timeout=(
+                        None if self.rpc_timeout_s is None
+                        else self.rpc_timeout_s + poll_s
+                    ),
+                )
+            except ReplicaLostError as exc:
+                self.on_replica_lost(idx, reason=str(exc))
+                continue  # the route table now points at a survivor
+            except KeyError:
+                # The routed replica does not know the id — it was
+                # restarted under us (fresh process, empty buffers).
+                # Fail the stale route over from the journal record.
+                if not self._resubmit_from_journal(rid, exclude=idx):
+                    raise
+                continue
             for tok in res["tokens"]:
                 yield int(tok)
             cursor += len(res["tokens"])
             if res["done"]:
+                self._finish(rid, res["status"])
                 if res["status"] in ("cancelled", "expired"):
                     raise RuntimeError(
-                        f"request {handle.request_id} {res['status']}"
+                        f"request {rid} {res['status']}"
                     )
                 return
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"request {handle.request_id} streamed no completion "
+                    f"request {rid} streamed no completion "
                     f"within {timeout_s}s"
                 )
 
@@ -139,30 +440,210 @@ class ServeClient:
         return list(self.stream(prompt, timeout_s=timeout_s, **sampling))
 
     def result(self, handle: RequestHandle, cursor: int = 0) -> Dict[str, Any]:
-        return fabric.get(
-            self._replicas[handle.replica].result.remote(
-                handle.request_id, cursor
+        idx = self._route_of(handle)
+        if idx is None:
+            raise ReplicaLostError(
+                handle.replica, f"request {handle.request_id} was lost"
             )
-        )
+        res = self._rpc(idx, "result", handle.request_id, cursor)
+        if res.get("done"):
+            self._finish(handle.request_id, res["status"])
+        return res
 
     def cancel(self, handle: RequestHandle) -> bool:
-        return fabric.get(
-            self._replicas[handle.replica].cancel.remote(handle.request_id)
-        )
+        idx = self._route_of(handle)
+        if idx is None:
+            return False
+        ok = bool(self._rpc(idx, "cancel", handle.request_id))
+        self._finish(handle.request_id, "cancelled")
+        return ok
 
-    # -- ops --------------------------------------------------------------
+    # -- failover ----------------------------------------------------------
+    def _resubmit_from_journal(
+        self, rid: str, exclude: Optional[int] = None
+    ) -> bool:
+        """Replay one OPEN request's journal submit record onto a live
+        replica (same id, same prompt, same full SamplingParams — the
+        survivor's seed-chained rng reproduces the stream bit-exactly).
+        Returns False when the id has no open record or no replica can
+        take it (the request is then marked lost)."""
+        with self._lock:
+            record = self._open.get(rid)
+        if record is None:
+            return False
+        while True:
+            try:
+                idx = self._pick(exclude=exclude)
+            except NoReplicasError:
+                with self._lock:
+                    self._route[rid] = None
+                self._m_failover.inc(1, outcome="lost")
+                self._event(
+                    "failover", level="error", request_id=rid,
+                    outcome="lost",
+                )
+                self.journal.record_outcome(rid, "lost")
+                with self._lock:
+                    self._open.pop(rid, None)
+                return False
+            try:
+                self._submit_rpc(idx, rid, record["prompt"], record)
+            except ReplicaLostError as exc:
+                self.on_replica_lost(idx, reason=str(exc))
+                continue
+            with self._lock:
+                self._route[rid] = idx
+            self._m_failover.inc(1, outcome="resubmitted")
+            self._event(
+                "failover", request_id=rid, outcome="resubmitted",
+                to_replica=idx,
+            )
+            return True
+
+    def on_replica_lost(
+        self, idx: int, reason: str = ""
+    ) -> Dict[str, List[str]]:
+        """Declare replica ``idx`` lost: exclude it from routing and fail
+        its incomplete requests (driver-journal submits without
+        outcomes) over onto survivors. Idempotent — the streaming path,
+        the submit path, and the supervisor may all detect the same
+        death; only the first caller moves the requests."""
+        idx = int(idx)
+        with self._lock:
+            if idx in self._lost:
+                return {"resubmitted": [], "lost": []}
+            self._lost.add(idx)
+            self._excluded.add(idx)
+            victims = sorted(
+                rid for rid, r in self._route.items() if r == idx
+            )
+        self._m_replicas_lost.inc(1)
+        self._event(
+            "replica_lost", level="error", replica=idx,
+            reason=str(reason)[:300], incomplete=len(victims),
+        )
+        moved: List[str] = []
+        lost: List[str] = []
+        for rid in victims:
+            if self._resubmit_from_journal(rid, exclude=idx):
+                moved.append(rid)
+            else:
+                lost.append(rid)
+        return {"resubmitted": moved, "lost": lost}
+
+    # -- restart (the supervisor's recover arm) ----------------------------
+    def can_respawn(self) -> bool:
+        return self._respawn_fn is not None
+
+    def respawn_replica(self, idx: int) -> Any:
+        """Re-run replica ``idx``'s original spawn (same resolved
+        config/bundle — ``build_engine`` reconstructs a bit-identical
+        engine from the same checkpoint) and swap the fresh actor (and
+        gang followers) into the routing table. The old processes are
+        torn down best-effort first (they are typically already dead)."""
+        idx = int(idx)
+        if self._respawn_fn is None:
+            raise RuntimeError(
+                "this client has no respawn path (constructed without "
+                "respawn_fn — use serve.start_replicas)"
+            )
+        with self._lock:
+            old = self._replicas[idx]
+            old_followers = [
+                f for f, owner in zip(
+                    self._followers, self._follower_replica
+                )
+                if owner == idx
+            ]
+        for h in [old] + old_followers:
+            try:
+                fabric.kill(h)
+            except Exception:  # noqa: BLE001 - usually already dead
+                pass
+        leader, new_followers = self._respawn_fn(idx)
+        try:
+            fabric.get(
+                [h.ping.remote() for h in [leader] + list(new_followers)],
+                timeout=self._init_timeout,
+            )
+        except BaseException:
+            for h in [leader] + list(new_followers):
+                try:
+                    fabric.kill(h)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        with self._lock:
+            self._replicas[idx] = leader
+            kept = [
+                (f, owner) for f, owner in zip(
+                    self._followers, self._follower_replica
+                )
+                if owner != idx
+            ] + [(f, idx) for f in new_followers]
+            self._followers = [f for f, _ in kept]
+            self._follower_replica = [owner for _, owner in kept]
+            self._excluded.discard(idx)
+            self._lost.discard(idx)
+        self._event("replica_respawned", replica=idx)
+        return leader
+
+    # -- fault injection (chaos tests / bench) -----------------------------
+    def inject_fault(self, replica: int, plan: Any) -> list:
+        """Arm a deterministic fault plan (serve.faults) on ONE live
+        replica; returns the armed rules."""
+        return self._rpc(int(replica), "inject_fault", plan)
+
+    # -- ops ---------------------------------------------------------------
     @property
     def num_replicas(self) -> int:
-        return len(self._replicas)
+        with self._lock:
+            return len(self._replicas)
+
+    def replica_is_alive(self, idx: int) -> bool:
+        """Process-level liveness of replica ``idx``'s actor (no RPC):
+        False once the fabric observed the process exit."""
+        try:
+            return bool(self._actor(int(idx)).is_alive())
+        except Exception:  # noqa: BLE001 - a broken handle is not alive
+            return False
+
+    def replica_heartbeat_age(self, idx: int) -> Optional[float]:
+        """Age (s) of replica ``idx``'s newest fabric heartbeat push, or
+        None when unavailable (client mode, heartbeats disabled, or no
+        push yet) — a supervisor liveness signal that needs no RPC."""
+        try:
+            actor_id = getattr(self._actor(int(idx)), "actor_id", None)
+            if actor_id is None:
+                return None
+            entry = fabric.heartbeats().get(actor_id)
+            return None if entry is None else float(entry.get("age_s"))
+        except Exception:  # noqa: BLE001 - heartbeats are best-effort
+            return None
 
     def stats(self) -> List[Dict[str, Any]]:
-        """Per-replica stats-endpoint snapshots."""
-        return fabric.get([r.stats.remote() for r in self._replicas])
+        """Per-replica stats-endpoint snapshots, per-replica
+        error-isolated: a dead replica yields an ``unreachable`` row
+        instead of failing the whole pull (the fleet poller and /fleet
+        must keep reporting THROUGH a replica's death)."""
+        rows: List[Dict[str, Any]] = []
+        for i in range(self.num_replicas):
+            try:
+                rows.append(self._rpc(i, "stats", retries=0))
+            except Exception as exc:  # noqa: BLE001 - isolate per replica
+                rows.append({
+                    "unreachable": True,
+                    "health": "unreachable",
+                    "error": f"{type(exc).__name__}: {exc}"[:200],
+                })
+        return rows
 
     def trace(self, handle: RequestHandle) -> List[Dict[str, Any]]:
         """A request's recorded spans from its replica's ring buffer."""
-        return fabric.get(
-            self._replicas[handle.replica].trace.remote(handle.request_id)
+        idx = self._route_of(handle)
+        return self._rpc(
+            handle.replica if idx is None else idx, "trace",
+            handle.request_id,
         )
 
     def export_trace(
@@ -172,25 +653,30 @@ class ServeClient:
         most recent when no handle is given). Single-process view; see
         :meth:`export_stitched_trace` for the cross-process merge."""
         if handle is not None:
-            return fabric.get(
-                self._replicas[handle.replica].export_trace.remote(
-                    handle.request_id
-                )
+            idx = self._route_of(handle)
+            return self._rpc(
+                handle.replica if idx is None else idx, "export_trace",
+                handle.request_id,
             )
-        return fabric.get(self._replicas[0].export_trace.remote(None, n))
+        return self._rpc(0, "export_trace", None, n)
 
     def trace_dumps(self, n: int = 16) -> List[Dict[str, Any]]:
         """Every process's trace ring in the stitching wire form: the
         client's own, each replica's, and each gang follower's, tagged
         with display names (``client`` / ``replica{i}`` /
-        ``follower{j}``). Follower pulls are best-effort — a wedged
-        follower must not block the trace of the gang that wedged it."""
+        ``follower{j}``). Pulls are best-effort — a dead replica or a
+        wedged follower must not block the trace of the fleet that
+        outlived it."""
         dumps = [{"name": "client", **self.tracer.dump(n)}]
-        for i, d in enumerate(
-            fabric.get([r.trace_dump.remote(n) for r in self._replicas])
-        ):
+        for i in range(self.num_replicas):
+            try:
+                d = self._rpc(i, "trace_dump", n, retries=0)
+            except Exception:  # noqa: BLE001 - best-effort forensics
+                continue
             dumps.append({"name": f"replica{i}", **d})
-        for j, f in enumerate(self._followers):
+        with self._lock:
+            followers = list(self._followers)
+        for j, f in enumerate(followers):
             try:
                 d = fabric.get(f.trace_dump.remote(n), timeout=30.0)
             except Exception:  # noqa: BLE001 - best-effort forensics
@@ -210,19 +696,23 @@ class ServeClient:
 
     def recent_events(self, n: int = 256) -> List[Dict[str, Any]]:
         """The fleet's structured event rings merged on wall-clock ts,
-        each event tagged with its source replica."""
+        each event tagged with its source replica (dead replicas are
+        skipped — their last events live in the driver's own ring as
+        replica_lost/failover records)."""
         rows: List[Dict[str, Any]] = []
-        for i, evs in enumerate(
-            fabric.get(
-                [r.recent_events.remote(n) for r in self._replicas]
-            )
-        ):
+        for i in range(self.num_replicas):
+            try:
+                evs = self._rpc(i, "recent_events", n, retries=0)
+            except Exception:  # noqa: BLE001 - isolate per replica
+                continue
             rows.extend({**ev, "replica": i} for ev in evs)
         rows.sort(key=lambda e: e.get("ts", 0))
         return rows[-int(n):]
 
     def events_jsonl(self, n: int = 256) -> str:
         """The merged event tail as JSONL (the ``/events`` route body)."""
+        import json
+
         rows = self.recent_events(n)
         return "\n".join(
             json.dumps(r, default=str) for r in rows
@@ -233,10 +723,16 @@ class ServeClient:
     ) -> List[Dict[str, Any]]:
         """Every replica's workload journal in the wire form (header +
         entries), index-aligned with the replica list — the replay
-        substrate (obs.journal)."""
-        return fabric.get(
-            [r.journal_dump.remote(n) for r in self._replicas]
-        )
+        substrate (obs.journal). A dead replica contributes an empty
+        journal (its in-process ring died with it; the client-side
+        journal in ``self.journal`` still has the driver's view)."""
+        out: List[Dict[str, Any]] = []
+        for i in range(self.num_replicas):
+            try:
+                out.append(self._rpc(i, "journal_dump", n, retries=0))
+            except Exception:  # noqa: BLE001 - isolate per replica
+                out.append({"header": None, "entries": []})
+        return out
 
     def journal_jsonl(self, n: Optional[int] = None) -> str:
         """The fleet's journals as JSONL (the ``/journal`` route body).
@@ -256,9 +752,34 @@ class ServeClient:
 
     def health(self) -> List[Dict[str, Any]]:
         """Per-replica health reports (obs.health), index-aligned with
-        the replica list — the driver aggregates them replica-labelled
-        exactly like metrics_text()."""
-        return fabric.get([r.health.remote() for r in self._replicas])
+        the replica list and per-replica error-isolated: a replica that
+        cannot answer gets an ``unreachable`` verdict row — the driver's
+        /healthz must aggregate a PARTIALLY dead fleet, not 500 on it."""
+        out: List[Dict[str, Any]] = []
+        for i in range(self.num_replicas):
+            try:
+                out.append(self._rpc(i, "health", retries=0))
+            except Exception as exc:  # noqa: BLE001 - isolate per replica
+                out.append({
+                    "verdict": "unreachable",
+                    "healthy": False,
+                    "reasons": [
+                        f"health RPC failed: "
+                        f"{type(exc).__name__}: {exc}"[:200]
+                    ],
+                    "components": {},
+                    "watchdog": False,
+                })
+        return out
+
+    def health_one(
+        self, idx: int, timeout: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """One replica's health report, raising ReplicaLostError when it
+        cannot answer — the supervisor's probe primitive."""
+        return self._rpc(
+            int(idx), "health", timeout=timeout, retries=0
+        )
 
     def debug_dump(
         self, reason: str = "rpc", replica: int = 0, pull: bool = True
@@ -266,66 +787,89 @@ class ServeClient:
         """Flight-recorder bundle from one replica: the manifest plus
         (``pull``) the bundle files inline, so the driver/doctor can
         save them without a shared filesystem."""
-        return fabric.get(
-            self._replicas[int(replica)].debug_dump.remote(reason, pull),
-            timeout=120.0,
+        return self._rpc(
+            int(replica), "debug_dump", reason, pull, timeout=120.0,
         )
 
     def metrics_text(self) -> str:
         """All replicas' registries as ONE Prometheus exposition: each
         replica's series gets a ``replica="<i>"`` label so identical
-        metric names across replicas stay distinct for the scraper."""
+        metric names across replicas stay distinct for the scraper.
+        Dead replicas simply drop out of the scrape."""
         from ray_lightning_tpu.obs.registry import relabel_text
 
-        texts = fabric.get(
-            [r.metrics_text.remote() for r in self._replicas]
-        )
-        if len(texts) == 1:
-            return texts[0]
+        texts: List[Tuple[int, str]] = []
+        for i in range(self.num_replicas):
+            try:
+                t = self._rpc(i, "metrics_text", retries=0)
+            except Exception:  # noqa: BLE001 - isolate per replica
+                continue
+            if t:
+                texts.append((i, t))
+        if len(texts) == 1 and self.num_replicas == 1:
+            return texts[0][1]
         parts = [
-            relabel_text(t, replica=i).rstrip("\n")
-            for i, t in enumerate(texts)
-            if t
+            relabel_text(t, replica=i).rstrip("\n") for i, t in texts
         ]
-        return "\n".join(parts) + "\n"
+        return "\n".join(parts) + ("\n" if parts else "")
 
     def profile(
         self, duration_s: float = 1.0, replica: int = 0
     ) -> Dict[str, Any]:
         """On-demand jax.profiler capture on one replica (the replica's
         serve loop keeps running; this blocks ~duration_s)."""
-        return fabric.get(
-            self._replicas[int(replica)].profile.remote(duration_s),
+        return self._rpc(
+            int(replica), "profile", duration_s,
             timeout=duration_s + 120.0,
         )
 
     def shutdown(self) -> None:
         # Leaders first: their stop() pushes the gang sentinel, so any
-        # followers drain their op streams before being killed.
-        for r in self._replicas:
+        # followers drain their op streams before being killed. Teardown
+        # failures are CLASSIFIED, not swallowed: an already-dead actor
+        # is expected churn (info), anything else is a silent-teardown
+        # bug surfaced as a warn-level drain_failed event.
+        def _drain(kind: str, replica_idx: int, actor: Any) -> None:
             try:
-                fabric.get(r.stop.remote(), timeout=10.0)
-            except Exception:  # noqa: BLE001 - best-effort drain
-                pass
+                fabric.get(actor.stop.remote(), timeout=10.0)
+            except Exception as exc:  # noqa: BLE001 - classified below
+                already_dead = isinstance(exc, fabric.ActorDiedError)
+                self._event(
+                    "drain_failed",
+                    level="info" if already_dead else "warn",
+                    kind=kind, replica=replica_idx, stage="stop",
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
             try:
-                fabric.kill(r)
-            except Exception:  # noqa: BLE001
-                pass
-        for f in self._followers:
-            try:
-                fabric.get(f.stop.remote(), timeout=10.0)
-            except Exception:  # noqa: BLE001
-                pass
-            try:
-                fabric.kill(f)
-            except Exception:  # noqa: BLE001
-                pass
-        self._followers = []
+                fabric.kill(actor)
+            except Exception as exc:  # noqa: BLE001
+                self._event(
+                    "drain_failed", level="warn",
+                    kind=kind, replica=replica_idx, stage="kill",
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
+
+        with self._lock:
+            replicas = list(self._replicas)
+            followers = list(
+                zip(self._followers, self._follower_replica)
+            )
+        for i, r in enumerate(replicas):
+            _drain("replica", i, r)
+        for f, owner in followers:
+            _drain("follower", owner, f)
+        with self._lock:
+            self._followers = []
+            self._follower_replica = []
         if self._pg is not None:
             try:
                 fabric.remove_placement_group(self._pg)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:  # noqa: BLE001
+                self._event(
+                    "drain_failed", level="warn",
+                    kind="placement_group", replica=-1, stage="remove",
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
             self._pg = None
 
 
@@ -347,6 +891,7 @@ def start_replicas(
     init_timeout: float = 300.0,
     hosts_per_replica: int = 1,
     coordinator_host: str = "127.0.0.1",
+    rpc_timeout_s: Optional[float] = None,
     **replica_kwargs: Any,
 ) -> ServeClient:
     """Spawn a replica gang on the fabric and return a connected client.
@@ -367,6 +912,12 @@ def start_replicas(
     ``coordinator_host`` must be an address of the machine the leader
     lands on (the default suits a single-machine fabric; on a real pod
     pass the leader host's reachable IP).
+
+    The spawn recipe for each replica index is retained on the returned
+    client as its ``respawn_fn``: ``FleetSupervisor`` restarts a dead
+    replica by re-running exactly this spawn (same resolved config, same
+    placement-group bundle, fresh coordinator/queues for gangs).
+    ``rpc_timeout_s`` bounds every client RPC (see :class:`ServeClient`).
     """
     if num_replicas < 1:
         raise ValueError("num_replicas must be >= 1")
@@ -383,68 +934,80 @@ def start_replicas(
             strategy=placement_strategy,
         )
     actor_cls = fabric.remote(ServeReplica)
-    replicas = []
-    followers = []
-    try:
-        for i in range(num_replicas):
-            def opts_for(bundle_index: int) -> Dict[str, Any]:
-                o: Dict[str, Any] = {
-                    "num_cpus": num_cpus_per_replica,
-                    "env": dict(env or {}),
-                    "init_timeout": init_timeout,
-                }
-                if num_tpus_per_replica:
-                    o["num_tpus"] = num_tpus_per_replica
-                if pg is not None:
-                    o["placement_group"] = pg
-                    o["placement_group_bundle_index"] = bundle_index
-                return o
 
-            if hosts == 1:
-                replicas.append(
-                    actor_cls.options(**opts_for(i)).remote(**replica_kwargs)
-                )
-                continue
-            # One process group per mesh: leader + followers share a
-            # jax.distributed rendezvous; the op stream rides one fabric
-            # queue per follower. Spawns are async, so the whole gang is
-            # up and joining the rendezvous before anyone is pinged.
-            from ray_lightning_tpu.serve.server import (
-                ENGINE_KEYS,
-                ServeShardFollower,
+    def opts_for(bundle_index: int) -> Dict[str, Any]:
+        o: Dict[str, Any] = {
+            "num_cpus": num_cpus_per_replica,
+            "env": dict(env or {}),
+            "init_timeout": init_timeout,
+        }
+        if num_tpus_per_replica:
+            o["num_tpus"] = num_tpus_per_replica
+        if pg is not None:
+            o["placement_group"] = pg
+            o["placement_group_bundle_index"] = bundle_index
+        return o
+
+    def spawn_replica(i: int) -> Tuple[Any, List[Any]]:
+        """Spawn replica ``i``'s process (group): the leader plus any
+        gang followers, from the SAME resolved kwargs/bundles every
+        time — the initial launch and every supervisor restart run
+        exactly this."""
+        if hosts == 1:
+            return (
+                actor_cls.options(**opts_for(i)).remote(**replica_kwargs),
+                [],
             )
+        # One process group per mesh: leader + followers share a
+        # jax.distributed rendezvous; the op stream rides one fabric
+        # queue per follower. Spawns are async, so the whole gang is
+        # up and joining the rendezvous before anyone is pinged.
+        from ray_lightning_tpu.serve.server import (
+            ENGINE_KEYS,
+            ServeShardFollower,
+        )
 
-            coordinator = f"{coordinator_host}:{_find_free_port()}"
-            queues = [fabric.Queue() for _ in range(hosts - 1)]
-            engine_kwargs = {
-                k: v for k, v in replica_kwargs.items() if k in ENGINE_KEYS
-            }
-            follower_cls = fabric.remote(ServeShardFollower)
-            for rank in range(1, hosts):
-                followers.append(
-                    follower_cls.options(
-                        **opts_for(i * hosts + rank)
-                    ).remote(
-                        op_queue=queues[rank - 1],
-                        dist={
-                            "num_hosts": hosts,
-                            "host_rank": rank,
-                            "coordinator_address": coordinator,
-                        },
-                        **engine_kwargs,
-                    )
-                )
-            replicas.append(
-                actor_cls.options(**opts_for(i * hosts)).remote(
+        coordinator = f"{coordinator_host}:{_find_free_port()}"
+        queues = [fabric.Queue() for _ in range(hosts - 1)]
+        engine_kwargs = {
+            k: v for k, v in replica_kwargs.items() if k in ENGINE_KEYS
+        }
+        follower_cls = fabric.remote(ServeShardFollower)
+        gang_followers = []
+        for rank in range(1, hosts):
+            gang_followers.append(
+                follower_cls.options(
+                    **opts_for(i * hosts + rank)
+                ).remote(
+                    op_queue=queues[rank - 1],
                     dist={
                         "num_hosts": hosts,
-                        "host_rank": 0,
+                        "host_rank": rank,
                         "coordinator_address": coordinator,
                     },
-                    gang_queues=queues,
-                    **replica_kwargs,
+                    **engine_kwargs,
                 )
             )
+        leader = actor_cls.options(**opts_for(i * hosts)).remote(
+            dist={
+                "num_hosts": hosts,
+                "host_rank": 0,
+                "coordinator_address": coordinator,
+            },
+            gang_queues=queues,
+            **replica_kwargs,
+        )
+        return leader, gang_followers
+
+    replicas = []
+    followers = []
+    follower_replica: List[int] = []
+    try:
+        for i in range(num_replicas):
+            leader, gang_followers = spawn_replica(i)
+            replicas.append(leader)
+            followers.extend(gang_followers)
+            follower_replica.extend([i] * len(gang_followers))
         fabric.get(
             [r.ping.remote() for r in replicas + followers],
             timeout=init_timeout,
@@ -461,4 +1024,12 @@ def start_replicas(
             except Exception:  # noqa: BLE001
                 pass
         raise
-    return ServeClient(replicas, pg=pg, followers=followers)
+    return ServeClient(
+        replicas,
+        pg=pg,
+        followers=followers,
+        follower_replica=follower_replica,
+        respawn_fn=spawn_replica,
+        rpc_timeout_s=rpc_timeout_s,
+        init_timeout=init_timeout,
+    )

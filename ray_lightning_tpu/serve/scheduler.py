@@ -115,8 +115,14 @@ class Scheduler:
         tracer: Optional["RequestTracer"] = None,
         events: Optional["EventLog"] = None,
         journal: Optional["WorkloadJournal"] = None,
+        faults: Optional[Any] = None,
     ) -> None:
         self.engine = engine
+        #: Deterministic fault injection (serve.faults.FaultInjector):
+        #: step() reports named lifecycle points so a chaos plan can
+        #: kill/delay this process at a FIXED logical step instead of a
+        #: wall-clock instant. None = off (one attribute check).
+        self.faults = faults
         self.metrics = metrics or ServeMetrics(engine.num_slots)
         #: Request tracer (obs.trace): lifecycle events recorded from the
         #: scheduler's vantage point; the engine shares the same tracer
@@ -241,6 +247,10 @@ class Scheduler:
     def _event(self, name: str, level: str = "info", **kv: Any) -> None:
         if self.events is not None:
             self.events.record("scheduler", name, level=level, **kv)
+
+    def _fault(self, point: str) -> None:
+        if self.faults is not None:
+            self.faults.hit(point)
 
     # -- intake (thread-safe) --------------------------------------------
     def submit(
@@ -531,7 +541,16 @@ class Scheduler:
                     closed.append((req.request_id, "finished"))
                 else:
                     newly[slot] = req
+        if admits:
+            # Fault point: requests hold slots, chunked ones have no
+            # first token yet — dying here strands admitted-not-started
+            # work (the failover set's hardest case).
+            self._fault("post_admit")
         # 3) Advance chunked prefills — the chunk-vs-fold interleave.
+        # (Snapshot the in-progress count first: the fault hook below
+        # must fire on every step that ADVANCED a chunk, not only the
+        # one that completed a prefill — "mid-prefill" is the point.)
+        prefilling = getattr(self.engine, "num_prefilling", 0)
         chunk_events = self.engine.prefill_step(
             self.max_prefill_chunks_per_step
         )
@@ -579,6 +598,11 @@ class Scheduler:
                 finished_rids.append(task.request_id)
                 closed.append((task.request_id, "finished"))
                 newly.pop(slot, None)
+        if chunk_events or prefilling:
+            # Fault point: a multi-chunk prompt is part-way through its
+            # prefill (device KV holds a partial range nobody can read
+            # back — the request MUST be replayed from its submit).
+            self._fault("mid_prefill_chunk")
         # 4) One engine fold for everything resident (up to decode_fold
         # tokens per slot fan out of a single dispatch+harvest).
         active = self.engine.num_active
@@ -672,6 +696,11 @@ class Scheduler:
                 finished_slots.append(slot)
                 finished_rids.append(rid)
                 closed.append((rid, "finished"))
+        if fold_results:
+            # Fault point: a decode fold's tokens are harvested (and
+            # journaled below) but the step has not returned — mid-decode
+            # death with partially-streamed outputs.
+            self._fault("fold_boundary")
         with self._lock:
             self._slot_req.update(newly)
             for req in admits:
@@ -703,6 +732,13 @@ class Scheduler:
                     acct["device_s"] += share
         for rid, outcome in closed:
             self._acct_close(rid, outcome)
+        if any(outcome == "finished" for _, outcome in closed):
+            # Fault point: the terminal ledger/journal flush happened but
+            # the finish events never reach the replica's buffers — the
+            # replica RECORDED an outcome the client never saw, so the
+            # client-side journal must still classify it incomplete and
+            # resubmit (dedup keeps the stream exact).
+            self._fault("post_finish_pre_ack")
         # Token accounting must be EXACT (the ledger balances against
         # it): count only admissions that really emitted a first token —
         # chunked admissions return None and their token is counted at

@@ -268,10 +268,17 @@ class ServeShardFollower:
         self,
         op_queue: Any,
         dist: Optional[Dict[str, Any]] = None,
+        faults: Any = None,
         **engine_kwargs: Any,
     ) -> None:
         from ray_lightning_tpu.obs.trace import RequestTracer
+        from ray_lightning_tpu.serve.faults import FaultInjector
 
+        # Fault injection (chaos tests): explicit plan or the RLT_FAULTS
+        # env gate — the `follower_op` point wedges this op loop.
+        self.faults = (
+            FaultInjector.parse(faults) or FaultInjector.from_env()
+        )
         _setup_gang_rendezvous(dict(dist or {}))
         self.engine = build_engine(
             **{k: v for k, v in engine_kwargs.items() if k in ENGINE_KEYS}
@@ -302,6 +309,12 @@ class ServeShardFollower:
             if op is None:
                 break
             name, args, kwargs = op
+            if self.faults is not None:
+                # Named wedge point: a chaos plan can hang this follower
+                # mid-stream (the gang's collectives stop completing)
+                # without killing its process — the failure mode a
+                # watchdog must distinguish from a clean death.
+                self.faults.hit("follower_op")
             try:
                 getattr(self.engine, name)(*args, **kwargs)
             except Exception as exc:  # noqa: BLE001 - gang is broken
@@ -380,6 +393,7 @@ class ServeReplica:
         mesh: Optional[str] = None,
         dist: Optional[Dict[str, Any]] = None,
         gang_queues: Optional[Sequence[Any]] = None,
+        faults: Any = None,
     ) -> None:
         from ray_lightning_tpu.obs import blackbox as obs_blackbox
         from ray_lightning_tpu.obs import health as obs_health
@@ -484,6 +498,16 @@ class ServeReplica:
                 max_prefill_chunks_per_step=max_prefill_chunks_per_step,
                 priority_age_s=priority_age_s,
             ))
+        # Deterministic fault injection (serve.faults): an explicit plan
+        # beats the RLT_FAULTS env gate; armed rules fire at named
+        # lifecycle points in the scheduler loop and this RPC surface.
+        # A live replica can be (re)armed via the inject_fault RPC —
+        # how a chaos test targets ONE replica of a fleet.
+        from ray_lightning_tpu.serve.faults import FaultInjector
+
+        self.faults = FaultInjector.parse(
+            faults, events=self.events
+        ) or FaultInjector.from_env(events=self.events)
         self.scheduler = Scheduler(
             self._sched_engine,
             metrics=self.metrics,
@@ -493,6 +517,7 @@ class ServeReplica:
             tracer=self.tracer,
             events=self.events,
             journal=self.journal,
+            faults=self.faults,
         )
         self._serve_config: Dict[str, Any] = {
             "num_slots": self.engine.num_slots,
@@ -638,6 +663,8 @@ class ServeReplica:
         ``tenant`` labels the request's cost-ledger record."""
         from ray_lightning_tpu.serve.scheduler import SamplingParams
 
+        if self.faults is not None:
+            self.faults.hit("rpc_submit")
         rid = self.scheduler.submit(
             prompt,
             SamplingParams(
@@ -668,6 +695,8 @@ class ServeReplica:
         completion, which keeps streaming polls cheap."""
         import time as _time
 
+        if self.faults is not None:
+            self.faults.hit("rpc_result")
         deadline = _time.monotonic() + max(0.0, wait_s)
         with self._cond:
             while True:
@@ -763,6 +792,18 @@ class ServeReplica:
     def recent_events(self, n: int = 64) -> list:
         """Tail of this process's structured event log (obs.events)."""
         return self.events.tail(n)
+
+    def inject_fault(self, plan: Any) -> list:
+        """Arm (or disarm with None) a deterministic fault plan on this
+        LIVE replica (serve.faults) — the chaos tests' and the
+        ``failover_blackout`` bench's way of targeting one replica of a
+        fleet; returns the armed rules. Replaces any previous plan."""
+        from ray_lightning_tpu.serve.faults import FaultInjector
+
+        inj = FaultInjector.parse(plan, events=self.events)
+        self.faults = inj
+        self.scheduler.faults = inj
+        return [] if inj is None else inj.describe()
 
     def journal_dump(self, n: Optional[int] = None) -> Dict[str, Any]:
         """This replica's workload journal in the wire form (header +

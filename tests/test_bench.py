@@ -184,6 +184,17 @@ def test_bench_smoke_cpu():
             == r["kv_bytes_total"] // r["model_axis"]
         ), r
     assert out["extra"]["sharded_cpu_control"] is True
+    # Failover blackout: a fault-injected kill of one of two replicas
+    # mid-load must lose ZERO requests — the supervisor restarts it and
+    # journal-backed failover resubmits every incomplete request onto
+    # the survivor, bit-identical to the uninterrupted control run.
+    (fo_row,) = out["extra"]["failover_blackout_rows"]
+    assert fo_row["workload"] == "failover_blackout", fo_row
+    assert fo_row["requests_lost"] == 0, fo_row
+    assert fo_row["exact_vs_uninterrupted"] is True, fo_row
+    assert out["extra"]["failover_requests_lost"] == 0, out["extra"]
+    assert out["extra"]["failover_exact"] is True, out["extra"]
+    assert out["extra"]["failover_cpu_control"] is True
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

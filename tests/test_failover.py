@@ -1,0 +1,868 @@
+"""Fault-tolerant serving fleet tests: the deterministic fault harness,
+the supervisor's detect->decide->recover state machine, journal-backed
+request failover with client-side prefix dedup, the drain_failed
+teardown events, the fleet-level /healthz aggregation, and the
+end-to-end chaos path (slow tier: real actors fault-killed mid-prefill /
+mid-decode / post-finish-pre-ack, restarted by the supervisor, every
+stream completing bit-identical to an uninterrupted run).
+
+The load-bearing property: the engine is deterministic given its inputs
+(frozen compiles, bit-exact greedy, seed-chained per-request rng), so a
+lost replica's incomplete requests — replayed from their journal submit
+records onto a survivor — emit the IDENTICAL token stream, and the
+client's retained cursor turns a replica crash into an invisible hiccup
+instead of a corrupted or truncated response.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import fabric, obs
+from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+from ray_lightning_tpu.serve.faults import (
+    FAULT_POINTS,
+    FaultDropError,
+    FaultInjector,
+)
+from ray_lightning_tpu.serve.supervisor import FleetSupervisor
+
+FT_CFG = GPTConfig(
+    vocab_size=97,
+    n_layer=1,
+    n_head=4,
+    n_kv_head=2,
+    d_model=32,
+    max_seq=64,
+    attn_impl="reference",
+    compute_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def ft_params():
+    import jax
+
+    return init_gpt_params(jax.random.PRNGKey(0), FT_CFG)
+
+
+# ---------------------------------------------------------------------------
+# Fault injector (pure)
+# ---------------------------------------------------------------------------
+def test_fault_injector_fires_on_nth_hit_then_disarms():
+    inj = FaultInjector.parse(
+        [{"point": "rpc_result", "action": "drop", "after": 3}]
+    )
+    inj.hit("rpc_result")
+    inj.hit("rpc_result")
+    inj.hit("fold_boundary")  # unarmed point: free
+    with pytest.raises(FaultDropError):
+        inj.hit("rpc_result")
+    # One-shot: the fired rule stays disarmed.
+    inj.hit("rpc_result")
+    (rule,) = inj.describe()
+    assert rule["fired"] is True and rule["hits"] == 3
+
+
+def test_fault_injector_rejects_unknown_points_and_actions():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultInjector.parse([{"point": "nope"}])
+    with pytest.raises(ValueError, match="unknown fault action"):
+        FaultInjector.parse([{"point": "fold_boundary", "action": "x"}])
+    assert FaultInjector.parse(None) is None
+    assert FaultInjector.parse([]) is None
+
+
+def test_fault_injector_env_gate(monkeypatch):
+    monkeypatch.setenv(
+        "RLT_FAULTS",
+        json.dumps({"point": "post_admit", "action": "delay",
+                    "seconds": 0.0}),
+    )
+    inj = FaultInjector.from_env()
+    assert inj is not None
+    assert inj.describe()[0]["point"] == "post_admit"
+    monkeypatch.delenv("RLT_FAULTS")
+    assert FaultInjector.from_env() is None
+
+
+class _RecordingFaults:
+    """Stand-in injector: records hit order instead of acting."""
+
+    def __init__(self):
+        self.hits = []
+
+    def hit(self, point):
+        assert point in FAULT_POINTS, point
+        self.hits.append(point)
+
+
+def test_scheduler_hook_points_fire_in_lifecycle_order(ft_params):
+    """The scheduler reports post_admit -> fold_boundary ->
+    post_finish_pre_ack for a plain request, and mid_prefill_chunk for
+    a chunked one — the fixed logical steps chaos plans key on."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    rng = np.random.default_rng(0)
+    eng = DecodeEngine(
+        ft_params, FT_CFG, num_slots=2, max_seq=64, prefill_buckets=[16],
+        decode_fold=2,
+    )
+    rec = _RecordingFaults()
+    sched = Scheduler(eng, faults=rec)
+    sched.submit(
+        rng.integers(0, 97, size=8).tolist(),
+        SamplingParams(max_new_tokens=4),
+    )
+    sched.run_until_idle()
+    assert rec.hits.count("post_admit") == 1
+    assert rec.hits.count("post_finish_pre_ack") == 1
+    assert rec.hits.count("fold_boundary") >= 1
+    assert rec.hits.index("post_admit") < rec.hits.index("fold_boundary")
+    assert rec.hits[-1] == "post_finish_pre_ack"
+
+    chunked = DecodeEngine(
+        ft_params, FT_CFG, num_slots=2, max_seq=64, prefill_chunk=8,
+    )
+    rec2 = _RecordingFaults()
+    s2 = Scheduler(chunked, faults=rec2)
+    s2.submit(
+        rng.integers(0, 97, size=20).tolist(),
+        SamplingParams(max_new_tokens=4),
+    )
+    s2.run_until_idle()
+    assert rec2.hits.count("mid_prefill_chunk") >= 2  # 20 tokens / 8
+
+
+def test_replica_inject_fault_rpc_drops_then_disarms(ft_params):
+    """A live replica armed over the inject_fault RPC drops the faulted
+    RPC (ConnectionError to the caller, process alive), and None
+    disarms."""
+    from ray_lightning_tpu.serve.server import ServeReplica
+
+    rep = ServeReplica(
+        params=ft_params, model_config=FT_CFG, num_slots=2, max_seq=48,
+        prefill_buckets=[16], watchdog=False,
+    )
+    try:
+        rules = rep.inject_fault(
+            [{"point": "rpc_result", "action": "drop"}]
+        )
+        assert rules[0]["point"] == "rpc_result"
+        rid = rep.submit(list(range(1, 7)), max_new_tokens=2)
+        with pytest.raises(ConnectionError):
+            rep.result(rid)
+        assert rep.inject_fault(None) == []
+        deadline = time.monotonic() + 60
+        while not rep.result(rid, wait_s=0.5)["done"]:
+            assert time.monotonic() < deadline
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# ServeClient failover (fake actors — no fabric processes)
+# ---------------------------------------------------------------------------
+class _RemoteShim:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def remote(self, *args, **kwargs):
+        # fabric.get passes plain values through, so returning the
+        # result directly makes this a complete fake actor handle.
+        return self._fn(*args, **kwargs)
+
+
+class _FakeReplica:
+    """In-memory 'replica' with a deterministic token function: the
+    exact surface the client's fault policy touches, dying on command
+    exactly like a fabric actor (ActorDiedError from every call)."""
+
+    def __init__(self, burst=2, die_after_results=None):
+        self.dead = False
+        self.burst = burst
+        self.die_after_results = die_after_results
+        self.result_calls = 0
+        self.submits = []  # (rid, kwargs) log — failover exactness proof
+        self.requests = {}
+        self.stop_raises = None
+
+    @staticmethod
+    def tokens_for(prompt, seed, n):
+        return [(sum(prompt) + 7 * seed + i) % 97 for i in range(n)]
+
+    def _check(self):
+        if self.dead:
+            raise fabric.ActorDiedError("fake replica dead")
+
+    # -- RPC surface ------------------------------------------------------
+    def _rpc_submit(self, prompt, request_id=None, **kw):
+        self._check()
+        self.submits.append((request_id, dict(kw)))
+        self.requests[request_id] = self.tokens_for(
+            prompt, kw.get("seed", 0), kw.get("max_new_tokens", 32)
+        )
+        return request_id
+
+    def _rpc_result(self, rid, cursor, wait_s=0.0):
+        self._check()
+        self.result_calls += 1
+        if (
+            self.die_after_results is not None
+            and self.result_calls > self.die_after_results
+        ):
+            self.dead = True
+            raise fabric.ActorDiedError("fake replica crashed mid-stream")
+        toks = self.requests[rid]
+        out = toks[cursor: cursor + self.burst]
+        return {
+            "tokens": out,
+            "done": cursor + len(out) >= len(toks),
+            "status": "finished",
+        }
+
+    def _rpc_health(self):
+        self._check()
+        return {"verdict": "healthy", "healthy": True}
+
+    def _rpc_stop(self):
+        if self.stop_raises is not None:
+            raise self.stop_raises
+        self._check()
+
+    def _rpc_ping(self):
+        self._check()
+        return "ok"
+
+    def __getattr__(self, name):
+        fn = object.__getattribute__(self, "__dict__").get(name)
+        if fn is not None:
+            return fn
+        try:
+            return _RemoteShim(
+                object.__getattribute__(self, f"_rpc_{name}")
+            )
+        except AttributeError:
+            raise AttributeError(name) from None
+
+
+def _client(replicas, **kw):
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+    from ray_lightning_tpu.serve.client import ServeClient
+
+    events = obs.EventLog()
+    reg = MetricsRegistry()
+    return (
+        ServeClient(replicas, registry=reg, events=events, **kw),
+        reg,
+        events,
+    )
+
+
+def test_client_failover_dedups_streamed_prefix_bit_exact(start_fabric):
+    """A replica dying mid-stream: the client fails the request over by
+    replaying its journal submit record (same id, same full sampling
+    incl. seed) onto the survivor, and the caller's stream continues
+    seamlessly — full output identical to an undisturbed run, no token
+    repeated, no token lost."""
+    start_fabric(num_cpus=1)
+    r0 = _FakeReplica(burst=2, die_after_results=2)  # dies after 4 tokens
+    r1 = _FakeReplica(burst=4)
+    client, reg, events = _client([r0, r1])
+    prompt = [3, 1, 4, 1, 5]
+    h = client.submit(prompt, max_new_tokens=10, seed=9, replica=0)
+    got = list(client.stream_handle(h))
+    assert got == _FakeReplica.tokens_for(prompt, 9, 10)
+    # The survivor got the journal record verbatim: full sampling params
+    # with the seed, under the SAME request id.
+    (rid1, kw1) = r1.submits[0]
+    assert rid1 == h.request_id
+    assert kw1["seed"] == 9 and kw1["max_new_tokens"] == 10
+    assert kw1["temperature"] == 0.0 and kw1["tenant"] is None
+    # The dead replica is excluded; new traffic routes around it.
+    assert client.excluded() == [0]
+    h2 = client.submit(prompt, max_new_tokens=3)
+    assert h2.replica == 1
+    # Observability: replica_lost + failover events, failover counter.
+    names = [e["name"] for e in events.tail(32)]
+    assert "replica_lost" in names and "failover" in names
+    assert reg.counter(
+        "rlt_serve_failover_requests_total"
+    ).value(outcome="resubmitted") == 1
+    # Terminal outcome landed in the driver-side journal: the request
+    # left the failover set.
+    entries = client.journal.dump()["entries"]
+    kinds = [
+        (e["kind"], e["request_id"]) for e in entries
+        if e["request_id"] == h.request_id
+    ]
+    assert ("outcome", h.request_id) in kinds
+
+
+def test_client_submit_reroutes_off_dead_replica(start_fabric):
+    start_fabric(num_cpus=1)
+    r0 = _FakeReplica()
+    r0.dead = True
+    r1 = _FakeReplica(burst=8)
+    client, _, _ = _client([r0, r1])
+    h = client.submit([1, 2], max_new_tokens=4)
+    assert h.replica == 1
+    assert list(client.stream_handle(h)) == _FakeReplica.tokens_for(
+        [1, 2], 0, 4
+    )
+    assert client.excluded() == [0]
+
+
+def test_client_marks_requests_lost_with_no_survivors(start_fabric):
+    start_fabric(num_cpus=1)
+    r0 = _FakeReplica(burst=1, die_after_results=1)
+    client, reg, _ = _client([r0])
+    h = client.submit([2, 2], max_new_tokens=6)
+    from ray_lightning_tpu.serve.client import ReplicaLostError
+
+    with pytest.raises(ReplicaLostError):
+        list(client.stream_handle(h))
+    assert reg.counter(
+        "rlt_serve_failover_requests_total"
+    ).value(outcome="lost") == 1
+    # The journal records the loss (submit + outcome=lost).
+    outcomes = [
+        e["outcome"] for e in client.journal.dump()["entries"]
+        if e["kind"] == "outcome"
+    ]
+    assert outcomes == ["lost"]
+
+
+def test_client_rpc_retries_transient_then_declares_lost(start_fabric):
+    """Transient failures (timeouts/conn errors) retry with backoff and
+    count in rlt_serve_failover_rpc_retries_total; exhaustion declares
+    the replica lost."""
+    start_fabric(num_cpus=1)
+
+    class _Flaky(_FakeReplica):
+        def __init__(self):
+            super().__init__(burst=8)
+            self.failures = 2
+
+        def _rpc_result(self, rid, cursor, wait_s=0.0):
+            if self.failures > 0:
+                self.failures -= 1
+                raise ConnectionError("transient blip")
+            return super()._rpc_result(rid, cursor, wait_s)
+
+    flaky = _Flaky()
+    client, reg, _ = _client(
+        [flaky], rpc_retries=3, backoff_base_s=0.001
+    )
+    h = client.submit([5], max_new_tokens=4)
+    assert list(client.stream_handle(h)) == _FakeReplica.tokens_for(
+        [5], 0, 4
+    )
+    assert reg.counter(
+        "rlt_serve_failover_rpc_retries_total"
+    ).value() == 2
+    # Exhaustion: a permanently failing replica is declared lost.
+    always = _Flaky()
+    always.failures = 10 ** 9
+    client2, _, events2 = _client(
+        [always], rpc_retries=1, backoff_base_s=0.001
+    )
+    from ray_lightning_tpu.serve.client import ReplicaLostError
+
+    h2 = client2.submit([5], max_new_tokens=4)  # submit is clean
+    with pytest.raises(ReplicaLostError):
+        list(client2.stream_handle(h2))  # polls exhaust the budget
+    assert "replica_lost" in [e["name"] for e in events2.tail(16)]
+
+
+def test_client_submit_rejects_unknown_sampling_keys(start_fabric):
+    start_fabric(num_cpus=1)
+    client, _, _ = _client([_FakeReplica()])
+    with pytest.raises(TypeError, match="unknown submit option"):
+        client.submit([1], max_new_tokns=4)  # the typo the test is about
+
+
+def test_shutdown_classifies_drain_failures_with_replica_id(start_fabric):
+    """The drain-swallowing satellite: a replica/follower whose stop()
+    raises produces a typed drain_failed event carrying the replica id
+    and error class — silent teardown bugs become visible. An
+    already-dead actor classifies as expected churn (info level)."""
+    start_fabric(num_cpus=1)
+    r0 = _FakeReplica()
+    r0.stop_raises = RuntimeError("stop exploded")
+    r1 = _FakeReplica()
+    r1.dead = True  # already gone: info-level classification
+    follower = _FakeReplica()
+    follower.stop_raises = ValueError("follower wedge")
+    client, _, events = _client(
+        [r0, r1], followers=[follower], follower_replica=[0]
+    )
+    client.shutdown()
+    drains = [
+        e for e in events.tail(64) if e["name"] == "drain_failed"
+    ]
+    stops = {
+        (e["kind"], e["replica"]): e
+        for e in drains
+        if e["stage"] == "stop"
+    }
+    assert ("replica", 0) in stops and ("follower", 0) in stops
+    assert stops[("replica", 0)]["level"] == "warn"
+    assert "RuntimeError" in stops[("replica", 0)]["error"]
+    assert "ValueError" in stops[("follower", 0)]["error"]
+    # Already-dead replica 1: expected churn, not a warning.
+    assert stops[("replica", 1)]["level"] == "info"
+
+
+# ---------------------------------------------------------------------------
+# Supervisor state machine (fake client, injectable clock — no sleeps)
+# ---------------------------------------------------------------------------
+class _FakeClient:
+    """Scripted ServeClient surface for the supervisor state machine."""
+
+    def __init__(self, n=2):
+        self.n = n
+        self.verdicts = {i: "healthy" for i in range(n)}
+        self.alive = {i: True for i in range(n)}
+        self.excluded = set()
+        self.lost_calls = []
+        self.respawn_calls = []
+        self.respawn_fail = 0  # next N respawns raise
+
+    @property
+    def num_replicas(self):
+        return self.n
+
+    def _actor(self, idx):
+        return None
+
+    def replica_is_alive(self, idx):
+        return self.alive[idx]
+
+    def replica_heartbeat_age(self, idx):
+        return None
+
+    def health_one(self, idx, timeout=None):
+        if not self.alive[idx]:
+            raise fabric.ActorDiedError("dead")
+        return {"verdict": self.verdicts[idx],
+                "healthy": self.verdicts[idx] == "healthy"}
+
+    def exclude(self, idx):
+        self.excluded.add(idx)
+
+    def restore(self, idx):
+        self.excluded.discard(idx)
+
+    def on_replica_lost(self, idx, reason=""):
+        self.lost_calls.append((idx, reason))
+        self.excluded.add(idx)
+        return {"resubmitted": [], "lost": []}
+
+    def can_respawn(self):
+        return True
+
+    def respawn_replica(self, idx):
+        self.respawn_calls.append(idx)
+        if self.respawn_fail > 0:
+            self.respawn_fail -= 1
+            raise RuntimeError("respawn failed")
+        self.alive[idx] = True
+        self.verdicts[idx] = "healthy"
+        self.excluded.discard(idx)
+
+
+def _supervisor(fake, clock, **kw):
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    events = obs.EventLog()
+    reg = MetricsRegistry()
+    kw.setdefault("restart_backoff_s", 1.0)
+    kw.setdefault("restart_limit", 3)
+    sup = FleetSupervisor(
+        fake, registry=reg, events=events, clock=clock, **kw
+    )
+    return sup, reg, events
+
+
+def test_supervisor_drains_unhealthy_and_restores_on_recovery():
+    fake = _FakeClient()
+    now = {"t": 0.0}
+    sup, _, events = _supervisor(fake, lambda: now["t"])
+    fake.verdicts[1] = "unhealthy"
+    sup.tick()
+    assert fake.excluded == {1}
+    assert sup.rows()[1]["state"] == "draining"
+    assert "replica_draining" in [e["name"] for e in events.tail(8)]
+    # Verdict recovers -> re-included.
+    fake.verdicts[1] = "healthy"
+    sup.tick()
+    assert fake.excluded == set()
+    assert sup.rows()[1]["state"] == "healthy"
+    assert "replica_recovered" in [e["name"] for e in events.tail(8)]
+
+
+def test_supervisor_restarts_dead_replica_with_capped_backoff():
+    """Death -> immediate failover, restart only after the backoff
+    elapses; failed restarts double the backoff (capped); success
+    resets and counts in rlt_fleet_replica_restarts_total."""
+    fake = _FakeClient()
+    now = {"t": 0.0}
+    sup, reg, events = _supervisor(
+        fake, lambda: now["t"], restart_backoff_s=2.0,
+        restart_backoff_cap_s=5.0,
+    )
+    fake.alive[0] = False
+    sup.tick()  # detect death: failover fires NOW, restart is scheduled
+    assert fake.lost_calls and fake.lost_calls[0][0] == 0
+    assert sup.rows()[0]["state"] == "dead"
+    assert fake.respawn_calls == []
+    now["t"] = 1.0
+    sup.tick()  # backoff (2s) not elapsed
+    assert fake.respawn_calls == []
+    # First restart attempt fails -> re-scheduled with doubled backoff.
+    fake.respawn_fail = 1
+    now["t"] = 2.5
+    sup.tick()
+    assert fake.respawn_calls == [0]
+    assert sup.rows()[0]["state"] == "dead"
+    assert "replica_restart_failed" in [
+        e["name"] for e in events.tail(8)
+    ]
+    now["t"] = 4.0  # 2.5 + 4.0s backoff not elapsed yet
+    sup.tick()
+    assert fake.respawn_calls == [0]
+    now["t"] = 7.0
+    sup.tick()  # second attempt succeeds
+    assert fake.respawn_calls == [0, 0]
+    row = sup.rows()[0]
+    assert row["state"] == "healthy" and row["restarts"] == 1
+    assert reg.counter(
+        "rlt_fleet_replica_restarts_total"
+    ).value(replica=0) == 1
+    assert "replica_restarted" in [e["name"] for e in events.tail(8)]
+    # The state gauge published every transition.
+    assert reg.gauge("rlt_fleet_replica_state").value(replica=0) == 0.0
+
+
+def test_supervisor_respects_restart_limit_then_gives_up():
+    fake = _FakeClient(n=1)
+    fake.respawn_fail = 10
+    now = {"t": 0.0}
+    sup, _, events = _supervisor(
+        fake, lambda: now["t"], restart_limit=2,
+        restart_backoff_s=0.1, restart_backoff_cap_s=0.1,
+    )
+    fake.alive[0] = False
+    for _ in range(10):
+        now["t"] += 1.0
+        sup.tick()
+    assert len(fake.respawn_calls) == 2  # the budget, not forever
+    assert sup.rows()[0]["state"] == "failed"
+    assert "replica_restart_giveup" in [
+        e["name"] for e in events.tail(16)
+    ]
+
+
+def test_supervisor_heartbeat_flatline_is_a_death_verdict():
+    """A stale fabric heartbeat (older than heartbeat_dead_s) declares
+    the replica dead even while its RPC surface might still answer —
+    the PR 8 signal consumed, not just displayed."""
+    fake = _FakeClient(n=1)
+    fake.replica_heartbeat_age = lambda idx: 999.0
+    now = {"t": 0.0}
+    sup, _, _ = _supervisor(
+        fake, lambda: now["t"], heartbeat_dead_s=60.0,
+    )
+    sup.tick()
+    assert fake.lost_calls, "stale heartbeat did not trigger failover"
+    assert sup.rows()[0]["state"] == "dead"
+    assert "no fabric heartbeat" in sup.rows()[0]["last_error"]
+
+
+def test_supervisor_reads_heartbeat_age_from_poller_snapshot():
+    """With a FleetPoller wired, the supervisor consumes heartbeat ages
+    from the poller's latest snapshot (one fabric read for the whole
+    fleet) instead of pulling its own."""
+
+    class _Actor:
+        actor_id = "actor-x"
+
+    class _Poller:
+        def latest(self):
+            return {"heartbeats": {"actor-x": {"age_s": 500.0}}}
+
+    fake = _FakeClient(n=1)
+    fake._actor = lambda idx: _Actor()
+    now = {"t": 0.0}
+    sup, _, _ = _supervisor(
+        fake, lambda: now["t"], heartbeat_dead_s=60.0, poller=_Poller(),
+    )
+    sup.tick()
+    assert sup.rows()[0]["state"] == "dead"
+    assert fake.lost_calls
+
+
+# ---------------------------------------------------------------------------
+# Fleet-level /healthz aggregation + supervisor rows in /fleet + rlt top
+# ---------------------------------------------------------------------------
+class _HealthStub:
+    """ServeClient stand-in for the obs endpoint: scripted health."""
+
+    def __init__(self, verdicts):
+        self._verdicts = verdicts
+
+    def stats(self):
+        return [{"health": v} for v in self._verdicts]
+
+    def health(self):
+        return [
+            {"verdict": v, "healthy": v in ("healthy", "degraded")}
+            for v in self._verdicts
+        ]
+
+    def metrics_text(self):
+        return ""
+
+    def recent_events(self, n):
+        return []
+
+    def export_stitched_trace(self, n=16):
+        return {"traceEvents": []}
+
+    def journal_jsonl(self, n=None):
+        return ""
+
+    def debug_dump(self, reason="rpc", pull=True):
+        return {"dir": "x", "files": [], "files_content": {}}
+
+
+def _healthz(client, supervisor=None):
+    from ray_lightning_tpu.cli import _serve_obs_server
+
+    server, poller = _serve_obs_server(
+        client, 0, fleet=True, fleet_interval_s=60.0,
+        supervisor=supervisor,
+    )
+    try:
+        poller.poll_now()
+        base = f"http://{server.host}:{server.port}"
+        try:
+            resp = urllib.request.urlopen(base + "/healthz", timeout=10)
+            status, body = resp.status, resp.read()
+        except urllib.error.HTTPError as exc:
+            status, body = exc.code, exc.read()
+        fleet = json.loads(
+            urllib.request.urlopen(base + "/fleet", timeout=10).read()
+        )
+        return status, json.loads(body), fleet
+    finally:
+        poller.stop()
+        server.close()
+
+
+def test_driver_healthz_503_only_when_all_replicas_unhealthy(start_fabric):
+    """One probe endpoint for an external LB: a single sick replica
+    degrades the fleet (200 — survivors still serve; the supervisor owns
+    the sick one), every replica down flips 503; the body lists
+    per-replica verdicts either way."""
+    start_fabric(num_cpus=1)
+    status, report, _ = _healthz(_HealthStub(["healthy", "unhealthy"]))
+    assert status == 200
+    assert report["verdict"] == "degraded"
+    assert report["replicas_healthy"] == 1
+    assert [r["verdict"] for r in report["replicas"]] == [
+        "healthy", "unhealthy",
+    ]
+    status, report, _ = _healthz(
+        _HealthStub(["unhealthy", "unreachable"])
+    )
+    assert status == 503
+    assert report["verdict"] == "unhealthy"
+    assert report["replicas_healthy"] == 0
+    status, report, _ = _healthz(_HealthStub(["healthy", "healthy"]))
+    assert status == 200 and report["replicas_healthy"] == 2
+
+
+def test_fleet_payload_and_top_render_supervisor_rows(start_fabric):
+    """/fleet embeds the supervisor table and rlt top renders it."""
+    from ray_lightning_tpu.cli import render_fleet
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    start_fabric(num_cpus=1)
+    fake = _FakeClient(n=2)
+    sup = FleetSupervisor(
+        fake, registry=MetricsRegistry(), events=obs.EventLog(),
+    )
+    fake.alive[1] = False
+    sup.tick()
+    status, report, fleet = _healthz(
+        _HealthStub(["healthy", "unreachable"]), supervisor=sup
+    )
+    assert status == 200  # one survivor keeps the fleet serving
+    rows = fleet["supervisor"]
+    assert rows[1]["state"] == "dead"
+    assert report["supervisor"][1]["state"] == "dead"
+    frame = render_fleet(fleet)
+    assert "supervisor:" in frame and "r1=dead" in frame
+
+
+def test_serve_cli_knows_the_failover_knobs():
+    from ray_lightning_tpu.cli import _SERVE_KEYS
+
+    assert {
+        "supervisor", "restart_limit", "restart_backoff_s",
+        "rpc_timeout_s",
+    } <= _SERVE_KEYS
+
+
+def test_fabric_kill_rejects_no_restart_false():
+    """The kill(no_restart) satellite: the flag is honored by rejection
+    — fabric actors never restart in place, and silently accepting
+    no_restart=False would promise otherwise (core AND client mode)."""
+    from ray_lightning_tpu.fabric import client as fabric_client
+    from ray_lightning_tpu.fabric import core as fabric_core
+
+    with pytest.raises(ValueError, match="no_restart=False"):
+        fabric_core.kill(object(), no_restart=False)
+    with pytest.raises(ValueError, match="no_restart=False"):
+        fabric_client.kill(object(), no_restart=False)
+
+
+# ---------------------------------------------------------------------------
+# End to end: chaos kill -> supervisor restart -> bit-exact failover
+# ---------------------------------------------------------------------------
+def _write_ckpt(tmp_path, params):
+    import dataclasses
+    import os
+
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file,
+        to_state_stream,
+    )
+
+    path = os.path.join(tmp_path, "ft.ckpt")
+    state_stream_to_file(
+        to_state_stream(
+            {"params": params, "gpt_config": dataclasses.asdict(FT_CFG)}
+        ),
+        path,
+    )
+    return path
+
+
+def _baseline(params, engine_kw, jobs):
+    """The uninterrupted oracle: the same engine config in-process,
+    one request at a time (exactness under batching is already
+    contract-tested; sequential keeps this oracle trivially right)."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    eng = DecodeEngine(params, FT_CFG, **engine_kw)
+    sched = Scheduler(eng)
+    out = []
+    for prompt, sampling in jobs:
+        rid = sched.submit(prompt, SamplingParams(**sampling))
+        toks = [
+            e.token for e in sched.run_until_idle()
+            if e.request_id == rid and e.token is not None
+        ]
+        out.append(toks)
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "kill_point,after,engine_kw",
+    [
+        ("fold_boundary", 2, {"decode_fold": 2}),
+        ("mid_prefill_chunk", 2, {"prefill_chunk": 8}),
+        ("post_finish_pre_ack", 1, {"decode_fold": 2}),
+    ],
+)
+def test_chaos_kill_supervisor_restart_bit_exact_failover(
+    start_fabric, tmp_path, ft_params, kill_point, after, engine_kw
+):
+    """The acceptance path: 2 replicas under load, a fault-injected kill
+    of one at a deterministic lifecycle point (mid-decode, mid-prefill,
+    or after a finish was journaled but never acked) ->
+
+    - every in-flight request completes on the survivor with token
+      output BIT-IDENTICAL to an uninterrupted run (greedy AND seeded),
+      zero requests lost;
+    - the supervisor detects the death, restarts the replica from the
+      same resolved config within the backoff budget, and the restarted
+      replica serves traffic (itself bit-exact).
+    """
+    start_fabric(num_cpus=4)
+    ckpt = _write_ckpt(tmp_path, ft_params)
+    rng = np.random.default_rng(3)
+    plen = 12 if kill_point == "mid_prefill_chunk" else 8
+    jobs = []
+    for i in range(6):
+        prompt = rng.integers(0, 97, size=plen).tolist()
+        sampling = {"max_new_tokens": 8, "seed": i}
+        if i == 3:
+            sampling["temperature"] = 0.8  # one seeded-sampled rider
+        jobs.append((prompt, sampling))
+    base_kw = dict(
+        num_slots=2, max_seq=64, prefill_buckets=[16], **engine_kw
+    )
+    expected = _baseline(ft_params, base_kw, jobs)
+
+    from ray_lightning_tpu.serve.client import start_replicas
+
+    client = start_replicas(
+        2,
+        ckpt_path=ckpt,
+        env={"JAX_PLATFORMS": "cpu"},
+        **base_kw,
+    )
+    sup = FleetSupervisor(
+        client, interval_s=0.2, restart_backoff_s=0.2,
+        restart_limit=3, probe_timeout_s=60.0,
+    ).start()
+    try:
+        client.inject_fault(
+            0,
+            [{"point": kill_point, "action": "kill", "after": after}],
+        )
+        handles = [
+            client.submit(p, **s) for p, s in jobs
+        ]  # round-robin: half land on the doomed replica
+        outs = [
+            list(client.stream_handle(h, timeout_s=180)) for h in handles
+        ]
+        # Zero lost, every stream bit-identical to the oracle — the
+        # failed-over ones included (the streams' retained cursors
+        # deduplicated whatever the dead replica already delivered).
+        assert outs == expected
+        assert any(h.replica == 0 for h in handles)
+        # The supervisor restarted replica 0 within the backoff budget.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            row = sup.rows()[0] if sup.rows() else {}
+            if row.get("restarts", 0) >= 1 and row.get(
+                "state"
+            ) == "healthy":
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail(f"supervisor never restarted: {sup.rows()}")
+        # The restarted replica (same resolved config) serves bit-exact.
+        h = client.submit(jobs[0][0], replica=0, **jobs[0][1])
+        assert list(
+            client.stream_handle(h, timeout_s=180)
+        ) == expected[0]
+        # Forensics: the whole story is in the driver's event ring.
+        names = [e["name"] for e in obs.get_event_log().tail(256)]
+        assert "replica_lost" in names
+        assert "failover" in names
+        assert "replica_restarted" in names
+    finally:
+        sup.stop()
+        client.shutdown()

@@ -98,6 +98,77 @@ def test_journal_ring_bounds_and_spill_rotation(tmp_path):
     ]
 
 
+def test_load_journal_crash_consistency_torn_tail_and_incomplete(tmp_path):
+    """Crash consistency: a journal cut mid-write by a hard kill — a
+    torn (half-written) JSONL tail and an ``outcome``-less submit entry
+    — must LOAD (torn lines counted, not fatal) and classify the
+    outcome-less submit as incomplete for failover selection, instead
+    of crashing the replay parser."""
+    from ray_lightning_tpu.obs.journal import incomplete_requests
+
+    path = tmp_path / "torn.jsonl"
+    path.write_text(
+        json.dumps({"kind": "header", "version": 1}) + "\n"
+        + json.dumps({
+            "kind": "submit", "request_id": "done-1", "prompt": [1, 2],
+            "sampling": {"max_new_tokens": 4, "seed": 0},
+        }) + "\n"
+        + json.dumps({
+            "kind": "outcome", "request_id": "done-1",
+            "outcome": "finished", "tokens": [5, 6, 7, 8],
+        }) + "\n"
+        + json.dumps({
+            "kind": "submit", "request_id": "stranded-2",
+            "prompt": [3, 4], "priority": 1, "tenant": "acme",
+            "sampling": {"max_new_tokens": 8, "seed": 7},
+        }) + "\n"
+        # The process died mid-flush: a half-written final record.
+        + '{"kind": "outcome", "request_id": "stranded-2", "outc'
+    )
+    loaded = load_journal(str(path))
+    assert loaded["torn_lines"] == 1
+    assert loaded["header"]["version"] == 1
+    assert [(e["kind"], e["request_id"]) for e in loaded["entries"]] == [
+        ("submit", "done-1"), ("outcome", "done-1"),
+        ("submit", "stranded-2"),
+    ]
+    # Failover selection: the outcome-less submit (and ONLY it) —
+    # with everything a resubmission needs intact.
+    (inc,) = incomplete_requests(loaded)
+    assert inc["request_id"] == "stranded-2"
+    assert inc["sampling"]["seed"] == 7 and inc["tenant"] == "acme"
+
+
+def test_truncated_ring_classifies_open_submits_incomplete():
+    """A bounded ring that rotated outcomes away (or never got them —
+    process died before _acct_close) yields submits that classify as
+    incomplete; a rotated-out submit whose outcome survived must NOT
+    resurface as failover work."""
+    from ray_lightning_tpu.obs.journal import incomplete_requests
+
+    jr = WorkloadJournal(capacity=3)
+    jr.record_submit(
+        request_id="old", prompt=[1],
+        sampling={"max_new_tokens": 2, "seed": 0},
+    )
+    jr.record_outcome("old", "finished", tokens=[9, 9])
+    jr.record_submit(
+        request_id="open-a", prompt=[2],
+        sampling={"max_new_tokens": 2, "seed": 1},
+    )
+    jr.record_submit(
+        request_id="open-b", prompt=[3],
+        sampling={"max_new_tokens": 2, "seed": 2},
+    )
+    # Capacity 3: the "old" submit rotated out, its outcome survived.
+    dump = jr.dump()
+    assert [e["request_id"] for e in dump["entries"]] == [
+        "old", "open-a", "open-b",
+    ]
+    rids = {e["request_id"] for e in incomplete_requests(dump)}
+    assert rids == {"open-a", "open-b"}
+
+
 # ---------------------------------------------------------------------------
 # Capture -> bit-exact replay (in-process scheduler)
 # ---------------------------------------------------------------------------

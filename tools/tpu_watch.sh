@@ -23,6 +23,7 @@ have_obs=0
 have_doctor=0
 have_fleet=0
 have_replay=0
+have_failover=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -33,6 +34,7 @@ obs_fails=0
 doctor_fails=0
 fleet_fails=0
 replay_fails=0
+failover_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -47,6 +49,7 @@ obs_status=pending
 doctor_status=pending
 fleet_status=pending
 replay_status=pending
+failover_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -68,6 +71,7 @@ write_manifest() {
     echo "stage=doctor status=$doctor_status fails=$doctor_fails"
     echo "stage=fleet status=$fleet_status fails=$fleet_fails"
     echo "stage=replay status=$replay_status fails=$replay_fails"
+    echo "stage=failover status=$failover_status fails=$failover_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -357,6 +361,34 @@ while true; do
             have_replay=1
             replay_status=skipped
             echo "$(date -u +%H:%M:%S) replay snapshot SKIPPED after $replay_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_failover" -eq 0 ]; then
+        # Stage 7d: fault-tolerance artifact — the serve sweep now
+        # carries failover_blackout (kill one of 2 replica actors
+        # mid-load through the deterministic fault harness with the
+        # FleetSupervisor running: requests lost must be 0, streams
+        # bit-identical to the uninterrupted control, post-kill token
+        # blackout + supervisor restart latency recorded), so each
+        # healthy window proves the recovery loop end-to-end.
+        echo "$(date -u +%H:%M:%S) launching FAILOVER serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/failover_bench.json 2> /tmp/failover_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/failover_bench.json ] && \
+           grep -q failover_blackout /tmp/failover_bench.json; then
+          have_failover=1
+          failover_status=ok
+          echo "$(date -u +%H:%M:%S) FAILOVER bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          failover_fails=$((failover_fails+1))
+          failover_status=failed
+          echo "$(date -u +%H:%M:%S) failover bench failed rc=$rc (fail $failover_fails)" >> /tmp/tpu_watch.log
+          if [ "$failover_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_failover=1
+            failover_status=skipped
+            echo "$(date -u +%H:%M:%S) failover bench SKIPPED after $failover_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       else
