@@ -1589,6 +1589,272 @@ def bench_failover(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
     return _in_worker(run, False, timeout=1200.0)
 
 
+def bench_preempt(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
+    """``preempt_drain``: the same 2-replica fleet hit by a NOTICED kill
+    (the ``preempt`` fault action: preemption notice + grace window +
+    hard kill at the deadline — the spot-reclamation shape) vs the same
+    kill landing as a crash (``failover_blackout``'s shape), measured
+    back to back on one fleet. The graceful drain must deliver: zero
+    requests lost, streams bit-identical to an in-process oracle, a
+    token blackout strictly below the crash baseline (the grace window,
+    consumed), and a warm KV handoff — migrated requests land prefix
+    hits on the survivor from the dying replica's exported blocks. Per-
+    fold delay faults on the doomed replica make its in-flight work
+    provably unable to finish in grace, so the drain must migrate.
+    Always a CPU control (the machinery under test is driver/scheduler
+    side)."""
+
+    def run():
+        import dataclasses
+        import os as _os
+        import tempfile as _tempfile
+        import threading as _threading
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu import fabric as _fabric
+        from ray_lightning_tpu import obs
+        from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+        from ray_lightning_tpu.serve.client import start_replicas
+        from ray_lightning_tpu.serve.engine import DecodeEngine
+        from ray_lightning_tpu.serve.scheduler import (
+            SamplingParams,
+            Scheduler,
+        )
+        from ray_lightning_tpu.serve.supervisor import FleetSupervisor
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        _fabric.init(num_cpus=max(8.0, float(_os.cpu_count() or 1)))
+
+        cfg = GPTConfig(
+            vocab_size=256, n_layer=1, n_head=4, n_kv_head=2, d_model=32,
+            max_seq=64, attn_impl="reference", compute_dtype="float32",
+        )
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        ckpt = _os.path.join(
+            _tempfile.mkdtemp(prefix="rlt_preempt_"), "m.ckpt"
+        )
+        state_stream_to_file(
+            to_state_stream(
+                {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+            ),
+            ckpt,
+        )
+        eng_kw = dict(
+            num_slots=2, max_seq=64, decode_fold=2, prefill_chunk=8,
+            prefix_blocks=8, prefix_block=8,
+        )
+        g = np.random.default_rng(0)
+        n_req, n_new = 8, 40
+
+        def make_jobs(seed0):
+            return [
+                (g.integers(0, cfg.vocab_size, size=12).tolist(),
+                 {"max_new_tokens": n_new, "seed": seed0 + i})
+                for i in range(n_req)
+            ]
+
+        def oracle(jobs):
+            # In-process sequential oracle (exactness under batching is
+            # contract-tested elsewhere) — deliberately NOT a fleet run,
+            # which would pre-warm the survivor's prefix cache and
+            # contaminate the warm-handoff measurement.
+            eng = DecodeEngine(params, cfg, **eng_kw)
+            sched = Scheduler(eng)
+            out = []
+            for prompt, sampling in jobs:
+                rid = sched.submit(prompt, SamplingParams(**sampling))
+                out.append([
+                    e.token for e in sched.run_until_idle()
+                    if e.request_id == rid and e.token is not None
+                ])
+            return out
+
+        client = start_replicas(
+            2, ckpt_path=ckpt, env={"JAX_PLATFORMS": "cpu"}, **eng_kw
+        )
+        sup = FleetSupervisor(
+            client, interval_s=0.1, restart_backoff_s=0.2,
+            restart_limit=3, probe_timeout_s=60.0,
+        ).start()
+        try:
+            def drive(jobs, death_marker):
+                """Arm already done by the caller; submit + stream all
+                jobs concurrently. Returns (outs, post-death blackout,
+                lost): blackout is measured over the streams ROUTED TO
+                the doomed replica, from the moment it actually stopped
+                existing for them (``death_marker`` event) to each
+                stream's next token — the make-before-break metric. A
+                stream that migrated/finished BEFORE the death
+                contributes 0 (the kill interrupted nobody); a crash's
+                streams are mid-flight at death by construction, so its
+                blackout is the full detect->resubmit->re-decode gap."""
+                t0 = _time.time()
+                handles = [client.submit(p, **s) for p, s in jobs]
+                affected = [
+                    i for i, h in enumerate(handles) if h.replica == 0
+                ]
+                stamps: Dict[int, list] = {i: [] for i in range(n_req)}
+                outs: Dict[int, list] = {}
+                lost: list = []
+
+                def pull(i, h):
+                    try:
+                        toks = []
+                        for t in client.stream_handle(h, timeout_s=300):
+                            toks.append(t)
+                            stamps[i].append(_time.time())
+                        outs[i] = toks
+                    except Exception:  # noqa: BLE001 - a lost stream
+                        lost.append(i)  # IS the measurement
+                threads = [
+                    _threading.Thread(target=pull, args=(i, h))
+                    for i, h in enumerate(handles)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                # The death marker may land after the streams finished
+                # (the drain's whole point): wait for it briefly.
+                t_death = None
+                wait_until = _time.monotonic() + 90
+                while t_death is None and _time.monotonic() < wait_until:
+                    for ev in obs.get_event_log().tail(2048):
+                        if (
+                            ev.get("name") == death_marker
+                            and ev.get("ts", 0) >= t0
+                        ):
+                            t_death = ev["ts"]
+                            break
+                    if t_death is None:
+                        _time.sleep(0.05)
+                blackout = None
+                if t_death is not None:
+                    blackout = 0.0
+                    for i in affected:
+                        after = [t for t in stamps[i] if t > t_death]
+                        if after:
+                            blackout = max(
+                                blackout, round(after[0] - t_death, 4)
+                            )
+                return outs, blackout, lost
+
+            # The doomed replica decodes with a 0.25s/fold delay fault
+            # in BOTH rounds — the stand-in for a big model whose folds
+            # take real time (the tiny CPU control would otherwise
+            # finish everything before any recovery machinery matters).
+            slow_folds = [
+                {"point": "fold_boundary", "action": "delay",
+                 "seconds": 0.4, "after": k}
+                for k in range(3, 40)
+            ]
+
+            # Round 1 — the crash baseline (PR 11 failover): the kill
+            # lands mid-load with every affected stream mid-flight.
+            jobs_crash = make_jobs(0)
+            want_crash = oracle(jobs_crash)
+            client.inject_fault(
+                0,
+                [{"point": "fold_boundary", "action": "kill",
+                  "after": 8}] + slow_folds,
+            )
+            outs_c, crash_blackout, lost_c = drive(
+                jobs_crash, "replica_lost"
+            )
+            exact_crash = not lost_c and all(
+                outs_c.get(i) == want_crash[i] for i in range(n_req)
+            )
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                rows_now = sup.rows()
+                if rows_now and rows_now[0].get("restarts", 0) >= 1:
+                    break
+                _time.sleep(0.05)
+
+            # Round 2 — the same slow folds, but the kill is NOTICED
+            # (grace window): the drain live-migrates the affected
+            # streams long before the deadline, so the death itself
+            # interrupts nobody. Fresh prompts so any survivor prefix
+            # hit is attributable to the KV handoff.
+            jobs_drain = make_jobs(100)
+            want_drain = oracle(jobs_drain)
+
+            def hit_tokens_total():
+                return sum(
+                    s.get("prefix", {}).get("hit_tokens", 0)
+                    for s in client.stats() if not s.get("unreachable")
+                )
+
+            hits_before = hit_tokens_total()
+            client.inject_fault(
+                0,
+                # Grace sized so the residents' completion estimate
+                # (remaining tokens at the delayed fold rate) can NOT
+                # fit half the window: the drain must live-migrate
+                # them, KV handoff included — the path under test.
+                [{"point": "fold_boundary", "action": "preempt",
+                  "after": 2, "seconds": 2.5}] + slow_folds,
+            )
+            outs_d, drain_blackout, lost_d = drive(
+                jobs_drain, "replica_preempt_replaced"
+            )
+            exact_drain = not lost_d and all(
+                outs_d.get(i) == want_drain[i] for i in range(n_req)
+            )
+            warm_hit_tokens = hit_tokens_total() - hits_before
+            drained = {}
+            for ev in obs.get_event_log().tail(2048):
+                if ev.get("name") == "replica_preempt_drained":
+                    drained = ev  # newest wins
+            kv = obs.get_registry().counter(
+                "rlt_serve_preempt_kv_blocks_total"
+            ).value()
+            row = {
+                "workload": "preempt_drain",
+                "replicas": 2,
+                "requests": n_req,
+                "grace_s": 2.5,
+                "requests_lost": len(lost_d),
+                "exact_vs_uninterrupted": exact_drain,
+                # Post-death blackout over the doomed replica's streams
+                # (0 = the kill interrupted nobody: everything migrated
+                # or finished inside the grace window) vs the same kill
+                # landing unannounced.
+                "post_death_blackout_s": drain_blackout,
+                "crash_post_death_blackout_s": crash_blackout,
+                "migrated": int(drained.get("migrated", 0)),
+                "finished_in_grace": int(
+                    drained.get("finished_in_grace", 0)
+                ),
+                "kv_blocks_handed_off": int(kv),
+                "warm_hit_tokens": int(warm_hit_tokens),
+            }
+            if crash_blackout:
+                row["drain_vs_crash_blackout"] = round(
+                    (drain_blackout or 0.0) / crash_blackout, 4
+                )
+            return {
+                "preempt_drain_rows": [row],
+                "preempt_requests_lost": len(lost_d),
+                "preempt_exact": exact_drain,
+                "preempt_crash_exact": exact_crash,
+                "preempt_blackout_s": drain_blackout,
+                "preempt_crash_blackout_s": crash_blackout,
+                "preempt_cpu_control": True,
+            }
+        finally:
+            sup.stop()
+            client.shutdown()
+
+    return _in_worker(run, False, timeout=1200.0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=3)
@@ -1740,6 +2006,10 @@ def main() -> None:
             extra.update(bench_failover(use_tpu))
         except Exception as exc:  # noqa: BLE001 - still emit a record
             extra["failover_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_preempt(use_tpu))
+        except Exception as exc:  # noqa: BLE001 - still emit a record
+            extra["preempt_error"] = f"{type(exc).__name__}: {exc}"
         extra["bench_wall_s"] = round(time.time() - t0, 1)
         val = extra.get("serve_shared_prefix_ttft_speedup", 0.0)
         print(
@@ -1872,6 +2142,10 @@ def main() -> None:
             extra.update(bench_failover(use_tpu))
         except Exception as exc:  # noqa: BLE001
             extra["failover_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_preempt(use_tpu))
+        except Exception as exc:  # noqa: BLE001
+            extra["preempt_error"] = f"{type(exc).__name__}: {exc}"
     extra["bench_wall_s"] = round(time.time() - t0, 1)
 
     print(

@@ -401,6 +401,7 @@ _SERVE_KEYS = frozenset((
     "fleet", "fleet_interval_s", "fleet_history",
     "journal", "journal_capacity",
     "supervisor", "restart_limit", "restart_backoff_s", "rpc_timeout_s",
+    "preempt_grace_s", "preempt_sigterm", "preempt_metadata",
 ))
 
 
@@ -779,6 +780,13 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         ("stall_s", float),
         ("blackbox_dir", str),
         ("blackbox_keep", int),
+        # Preemption signal plane: grace window for the drain,
+        # SIGTERM-as-notice (on by default), and the GCE-shaped
+        # maintenance-event metadata poller (off by default — only
+        # meaningful on metadata-served hosts).
+        ("preempt_grace_s", float),
+        ("preempt_sigterm", bool),
+        ("preempt_metadata", bool),
     ):
         val = serve_cfg.pop(knob, None)
         if val is not None:

@@ -825,6 +825,15 @@ class ActorHandle:
                 # stored on the handle, surfaced via heartbeats() and the
                 # obs registry — no call_id, nothing blocks on it.
                 self._last_heartbeat = (time.monotonic(), msg[1])
+                if msg[1].get("terminating"):
+                    # The worker's SIGTERM handler ran: a CLEAN
+                    # terminate, distinguishable from a heartbeat
+                    # flatline (crash/SIGKILL) in the event log.
+                    _record_event(
+                        "worker_terminating",
+                        actor=self.actor_id,
+                        reason=str(msg[1].get("reason", "")),
+                    )
         # Pipe closed: mark actor dead so blocked getters wake up, and release
         # its node resources so a relaunch after a crash can be placed.
         self._alive = False
@@ -989,6 +998,20 @@ def _spawn_actor(
     # Ship the class + ctor args (after env application in the child).
     blob = cloudpickle.dumps((cls, args, kwargs), protocol=5)
     handle._send(("init", blob))
+    if opts.get("lazy_init"):
+        # Deferred construction: return the handle NOW and let the
+        # caller barrier on readiness itself (a ping). Required for
+        # gang spawns whose __init__s rendezvous with EACH OTHER
+        # (jax.distributed.initialize blocks until every member
+        # registers) — waiting for member 1's ctor before spawning
+        # member 2 deadlocks by construction. A failed ctor still
+        # surfaces: the worker answers every later call with
+        # "actor not initialized", so the readiness ping raises.
+        _record_event(
+            "actor_start", actor=actor_id, node=node.node_id,
+            cls=cls.__name__, lazy=True,
+        )
+        return handle
     # Wait for construction so init errors surface eagerly on the driver.
     try:
         get(TaskRef(actor_id=actor_id, call_id=-1), timeout=opts.get("init_timeout", 300.0))

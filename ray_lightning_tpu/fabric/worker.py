@@ -32,12 +32,24 @@ import traceback
 #: further SIGTERMs are no-ops.
 _EXITING = False
 
+#: Set by _worker_main: pushes one final ("heartbeat", {...,
+#: "terminating": True}) frame so the driver can tell a CLEAN terminate
+#: (this handler ran) from a heartbeat flatline (the process just
+#: vanished). Best-effort: bounded lock wait, every failure swallowed —
+#: a wedged connection must not stall the exit the signal asked for.
+_TERM_NOTIFY = None
+
 
 def _on_sigterm(*_):
     global _EXITING
     if _EXITING or sys.is_finalizing():
         return
     _EXITING = True
+    if _TERM_NOTIFY is not None:
+        try:
+            _TERM_NOTIFY()
+        except Exception:  # noqa: BLE001 - exit anyway
+            pass
     sys.exit(0)
 
 
@@ -107,6 +119,18 @@ def _heartbeat_loop(send, state, interval_s):
                 else round(now - state["last_end"], 3)
             ),
         }
+        # Preemption notice piggybacks on the heartbeat: processes with
+        # no RPC surface (gang followers) still reach the supervisor.
+        # peek_state never CREATES a monitor — an unarmed process pays
+        # one None check.
+        try:
+            from ray_lightning_tpu.serve.preempt import peek_state
+
+            p = peek_state()
+            if p and p.get("pending"):
+                stats["preempt"] = p
+        except Exception:  # noqa: BLE001 - heartbeats must keep flowing
+            pass
         try:
             send(cloudpickle.dumps(("heartbeat", stats)))
         except (OSError, ValueError):
@@ -143,6 +167,35 @@ def _worker_main(conn):
             conn.send_bytes(payload)
 
     hb_state = {"calls": 0, "busy": 0, "last_end": None, "t0": time.monotonic()}
+
+    def _term_notify():
+        """The final heartbeat a SIGTERM'd worker pushes before exiting:
+        the driver reads ``terminating`` and classifies this death as a
+        clean terminate, not a flatline. Lock wait is bounded — the
+        heartbeat thread may be mid-send."""
+        rss, cpu_s = _proc_stats()
+        payload = cloudpickle.dumps((
+            "heartbeat",
+            {
+                "pid": os.getpid(),
+                "rss_bytes": rss,
+                "cpu_s": round(cpu_s, 3),
+                "uptime_s": round(time.monotonic() - hb_state["t0"], 3),
+                "calls_handled": hb_state["calls"],
+                "calls_in_flight": hb_state["busy"],
+                "last_call_age_s": None,
+                "terminating": True,
+                "reason": "sigterm",
+            },
+        ))
+        if send_lock.acquire(timeout=0.5):
+            try:
+                conn.send_bytes(payload)
+            finally:
+                send_lock.release()
+
+    global _TERM_NOTIFY
+    _TERM_NOTIFY = _term_notify
     try:
         hb_interval = float(os.environ.get("RLT_HEARTBEAT_S", "10"))
     except ValueError:

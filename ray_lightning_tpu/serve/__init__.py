@@ -19,8 +19,14 @@ The L5 layer over the decode path (models/gpt.py: prefill + GQA KV cache
   through the fabric, and fails their incomplete requests over
   (journal-backed, bit-exact) onto survivors.
 - :class:`FaultInjector` — deterministic fault injection (faults.py):
-  kill/delay/drop/wedge at named lifecycle points, driving the chaos
-  tests and the ``failover_blackout`` bench.
+  kill/delay/drop/wedge/preempt at named lifecycle points, driving the
+  chaos tests and the ``failover_blackout``/``preempt_drain`` benches.
+- :class:`PreemptionMonitor` (preempt.py) — the per-process preemption
+  signal plane: SIGTERM, a metadata poller, and the ``preempt`` fault
+  action funnel into one ``preemption_pending(deadline)`` state the
+  supervisor drains gracefully (finish-in-grace + live-migration with
+  cross-replica KV handoff) and the trainer answers with
+  checkpoint-on-notice.
 
 Heavy deps load lazily: the engine (jax) and the replica/client layer
 (fabric) import on first attribute access, not at package import.
@@ -36,6 +42,11 @@ from ray_lightning_tpu.serve.scheduler import (
 )
 
 from ray_lightning_tpu.serve.faults import FaultInjector, FaultRule
+from ray_lightning_tpu.serve.preempt import (
+    PreemptionMonitor,
+    get_monitor,
+    reset_monitor,
+)
 
 __all__ = [
     "DecodeEngine",
@@ -51,6 +62,9 @@ __all__ = [
     "FleetSupervisor",
     "FaultInjector",
     "FaultRule",
+    "PreemptionMonitor",
+    "get_monitor",
+    "reset_monitor",
 ]
 
 _LAZY = {

@@ -181,6 +181,28 @@ class ServeClient:
             "rlt_serve_failover_replicas_lost_total",
             "Replicas declared lost by the serve client",
         )
+        # Preemption drain: graceful-drain outcomes (scheduled kills,
+        # consumed instead of crashed through) next to the failover
+        # (crash) counters above.
+        self._m_preempt_drains = reg.counter(
+            "rlt_serve_preempt_drains_total",
+            "Graceful drains run against preempting replicas",
+        )
+        self._m_preempt_requests = reg.counter(
+            "rlt_serve_preempt_requests_total",
+            "Requests handled by a preemption drain (outcome label: "
+            "finished in the grace window, migrated to a survivor, or "
+            "lost with no survivor)",
+        )
+        self._m_preempt_kv_blocks = reg.counter(
+            "rlt_serve_preempt_kv_blocks_total",
+            "Prefix KV blocks handed off replica-to-replica during "
+            "preemption drains",
+        )
+        #: Replacement actors spawned DURING a grace window (capacity
+        #: never dips below N): idx -> (leader, followers), consumed by
+        #: respawn_replica.
+        self._prespawned: Dict[int, Tuple[Any, List[Any]]] = {}
 
     # -- internals --------------------------------------------------------
     def _event(self, name: str, level: str = "info", **kv: Any) -> None:
@@ -421,6 +443,20 @@ class ServeClient:
                 yield int(tok)
             cursor += len(res["tokens"])
             if res["done"]:
+                if res["status"] == "migrated":
+                    # Terminal on THAT replica only: a preemption drain
+                    # evicted the request for resubmission elsewhere.
+                    # Follow the route table — once the drain re-routes
+                    # it, the survivor re-emits the full (bit-identical)
+                    # stream and the cursor dedups; until then, wait.
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"request {rid} was migrated but never "
+                            f"re-routed within {timeout_s}s"
+                        )
+                    if self._route_of(handle) == idx:
+                        time.sleep(poll_s)
+                    continue
                 self._finish(rid, res["status"])
                 if res["status"] in ("cancelled", "expired"):
                     raise RuntimeError(
@@ -446,7 +482,9 @@ class ServeClient:
                 handle.replica, f"request {handle.request_id} was lost"
             )
         res = self._rpc(idx, "result", handle.request_id, cursor)
-        if res.get("done"):
+        if res.get("done") and res.get("status") != "migrated":
+            # "migrated" is terminal on that replica, not for the
+            # request — the drain's resubmission keeps it open.
             self._finish(handle.request_id, res["status"])
         return res
 
@@ -460,13 +498,19 @@ class ServeClient:
 
     # -- failover ----------------------------------------------------------
     def _resubmit_from_journal(
-        self, rid: str, exclude: Optional[int] = None
+        self,
+        rid: str,
+        exclude: Optional[int] = None,
+        blocks: Optional[list] = None,
     ) -> bool:
         """Replay one OPEN request's journal submit record onto a live
         replica (same id, same prompt, same full SamplingParams — the
         survivor's seed-chained rng reproduces the stream bit-exactly).
-        Returns False when the id has no open record or no replica can
-        take it (the request is then marked lost)."""
+        ``blocks`` (preemption drain) is the dying replica's exported
+        prefix KV, pushed to the chosen survivor BEFORE the resubmit so
+        its admission walk hits warm. Returns False when the id has no
+        open record or no replica can take it (the request is then
+        marked lost)."""
         with self._lock:
             record = self._open.get(rid)
         if record is None:
@@ -486,6 +530,17 @@ class ServeClient:
                 with self._lock:
                     self._open.pop(rid, None)
                 return False
+            if blocks:
+                # Best-effort warmth: a failed handoff only costs the
+                # survivor a cold re-prefill, never the request.
+                try:
+                    n = self._rpc(
+                        idx, "import_prefix_blocks", blocks, retries=0
+                    )
+                    self._m_preempt_kv_blocks.inc(int(n))
+                except Exception:  # noqa: BLE001 - see above
+                    pass
+                blocks = None  # one survivor gets them; don't re-ship
             try:
                 self._submit_rpc(idx, rid, record["prompt"], record)
             except ReplicaLostError as exc:
@@ -560,19 +615,29 @@ class ServeClient:
                 fabric.kill(h)
             except Exception:  # noqa: BLE001 - usually already dead
                 pass
-        leader, new_followers = self._respawn_fn(idx)
-        try:
-            fabric.get(
-                [h.ping.remote() for h in [leader] + list(new_followers)],
-                timeout=self._init_timeout,
-            )
-        except BaseException:
-            for h in [leader] + list(new_followers):
-                try:
-                    fabric.kill(h)
-                except Exception:  # noqa: BLE001
-                    pass
-            raise
+        with self._lock:
+            pre = self._prespawned.pop(idx, None)
+        if pre is not None:
+            # A replacement spawned during the grace window (already
+            # pinged healthy): swap it in — zero spawn latency here.
+            leader, new_followers = pre
+        else:
+            leader, new_followers = self._respawn_fn(idx)
+            try:
+                fabric.get(
+                    [
+                        h.ping.remote()
+                        for h in [leader] + list(new_followers)
+                    ],
+                    timeout=self._init_timeout,
+                )
+            except BaseException:
+                for h in [leader] + list(new_followers):
+                    try:
+                        fabric.kill(h)
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
         with self._lock:
             self._replicas[idx] = leader
             kept = [
@@ -588,11 +653,166 @@ class ServeClient:
         self._event("replica_respawned", replica=idx)
         return leader
 
+    # -- preemption drain (the supervisor's graceful-kill arm) -------------
+    def prespawn_replacement(self, idx: int) -> bool:
+        """Spawn replica ``idx``'s replacement NOW (same recipe as
+        respawn) without touching the live one — the grace-window move
+        that keeps fleet capacity at N through a preemption. The
+        replacement is held (pinged healthy) until ``respawn_replica``
+        swaps it in. Returns False when this client has no respawn path
+        or a replacement is already held."""
+        idx = int(idx)
+        if self._respawn_fn is None:
+            return False
+        with self._lock:
+            if idx in self._prespawned:
+                return True
+        try:
+            # Fresh node capacity, NOT the replica's placement-group
+            # bundle: the dying replica still occupies that until the
+            # swap — capacity-at-N through the grace window needs
+            # headroom outside the reservation.
+            leader, followers = self._respawn_fn(
+                idx, fresh_capacity=True
+            )
+        except TypeError:
+            # A respawn_fn without the knob (tests, custom wiring).
+            leader, followers = self._respawn_fn(idx)
+        try:
+            fabric.get(
+                [h.ping.remote() for h in [leader] + list(followers)],
+                timeout=self._init_timeout,
+            )
+        except BaseException:
+            for h in [leader] + list(followers):
+                try:
+                    fabric.kill(h)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        with self._lock:
+            self._prespawned[idx] = (leader, list(followers))
+        self._event("replica_prespawned", replica=idx)
+        return True
+
+    def preempt_drain(
+        self, idx: int, budget_s: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Drive a preempting replica's graceful drain: exclude it from
+        new traffic, ask it for the drain plan (finish-in-grace vs
+        migrate, with exported prefix KV per migrating request), then
+        live-migrate the migrate set — each request's blocks imported
+        into a survivor and its journal submit replayed there under the
+        SAME id/seed, so the stream continues bit-exactly with the
+        delivered prefix deduplicated client-side. Requests in the
+        finish set keep streaming from the dying replica until done."""
+        idx = int(idx)
+        self.exclude(idx)
+        wait_s = 15.0
+        timeout = (
+            None if self.rpc_timeout_s is None
+            else max(self.rpc_timeout_s, wait_s + 5.0)
+        )
+        plan = self._rpc(
+            idx, "begin_drain", budget_s, wait_s=wait_s, timeout=timeout,
+        )
+        moved: List[str] = []
+        lost: List[str] = []
+        already_done = 0
+        kv_blocks = 0
+        for item in plan.get("migrate", []):
+            rid = item["request_id"]
+            with self._lock:
+                known = rid in self._open
+            if not known:
+                # Terminal before the drain reached it (the client saw
+                # the finish): nothing to migrate.
+                already_done += 1
+                continue
+            blocks = item.get("blocks") or []
+            kv_blocks += len(blocks)
+            if self._resubmit_from_journal(rid, exclude=idx, blocks=blocks):
+                moved.append(rid)
+            else:
+                lost.append(rid)
+        self._m_preempt_drains.inc(1)
+        finish = list(plan.get("finish", []))
+        if finish:
+            self._m_preempt_requests.inc(
+                len(finish), outcome="finished_in_grace"
+            )
+        if moved:
+            self._m_preempt_requests.inc(len(moved), outcome="migrated")
+        if lost:
+            self._m_preempt_requests.inc(len(lost), outcome="lost")
+        self._event(
+            "preempt_drain", level="warn", replica=idx,
+            finish=len(finish), migrated=len(moved), lost=len(lost),
+            kv_blocks=kv_blocks, already_done=already_done,
+        )
+        return {
+            "finish": finish,
+            "migrated": moved,
+            "lost": lost,
+            "kv_blocks": kv_blocks,
+        }
+
+    def requests_on(self, idx: int) -> int:
+        """Open requests currently routed to replica ``idx`` (the
+        supervisor's drained-yet signal)."""
+        idx = int(idx)
+        with self._lock:
+            return sum(1 for r in self._route.values() if r == idx)
+
+    def gang_preempt_state(self, idx: int) -> Optional[Dict[str, Any]]:
+        """A pending preemption on any of replica ``idx``'s gang
+        FOLLOWERS, read from their fabric heartbeats (followers have no
+        client-facing RPC surface — the heartbeat is their signal path).
+        None when no follower reports one."""
+        idx = int(idx)
+        try:
+            beats = fabric.heartbeats()
+        except Exception:  # noqa: BLE001 - heartbeats are best-effort
+            return None
+        with self._lock:
+            followers = [
+                f for f, owner in zip(
+                    self._followers, self._follower_replica
+                )
+                if owner == idx
+            ]
+        for f in followers:
+            actor_id = getattr(f, "actor_id", None)
+            if actor_id is None:
+                continue
+            p = (beats.get(actor_id) or {}).get("preempt")
+            if isinstance(p, dict) and p.get("pending"):
+                return p
+        return None
+
     # -- fault injection (chaos tests / bench) -----------------------------
     def inject_fault(self, replica: int, plan: Any) -> list:
         """Arm a deterministic fault plan (serve.faults) on ONE live
         replica; returns the armed rules."""
         return self._rpc(int(replica), "inject_fault", plan)
+
+    def inject_follower_fault(
+        self, idx: int, follower: int, plan: Any
+    ) -> list:
+        """Arm a fault plan on the ``follower``-th gang member of
+        replica ``idx`` (chaos tests target ONE follower of a live
+        gang; the env gate would arm every process identically)."""
+        with self._lock:
+            followers = [
+                f for f, owner in zip(
+                    self._followers, self._follower_replica
+                )
+                if owner == int(idx)
+            ]
+        return fabric.get(
+            followers[int(follower)].inject_fault.remote(plan),
+            timeout=30.0,
+        )
 
     # -- ops ---------------------------------------------------------------
     @property
@@ -854,10 +1074,17 @@ class ServeClient:
             followers = list(
                 zip(self._followers, self._follower_replica)
             )
+            prespawned = list(self._prespawned.items())
+            self._prespawned = {}
         for i, r in enumerate(replicas):
             _drain("replica", i, r)
         for f, owner in followers:
             _drain("follower", owner, f)
+        # Unconsumed grace-window replacements die with the fleet.
+        for i, (leader, pre_followers) in prespawned:
+            _drain("replica", i, leader)
+            for f in pre_followers:
+                _drain("follower", i, f)
         with self._lock:
             self._followers = []
             self._follower_replica = []
@@ -935,7 +1162,9 @@ def start_replicas(
         )
     actor_cls = fabric.remote(ServeReplica)
 
-    def opts_for(bundle_index: int) -> Dict[str, Any]:
+    def opts_for(
+        bundle_index: int, fresh_capacity: bool = False
+    ) -> Dict[str, Any]:
         o: Dict[str, Any] = {
             "num_cpus": num_cpus_per_replica,
             "env": dict(env or {}),
@@ -943,25 +1172,37 @@ def start_replicas(
         }
         if num_tpus_per_replica:
             o["num_tpus"] = num_tpus_per_replica
-        if pg is not None:
+        if pg is not None and not fresh_capacity:
             o["placement_group"] = pg
             o["placement_group_bundle_index"] = bundle_index
         return o
 
-    def spawn_replica(i: int) -> Tuple[Any, List[Any]]:
+    def spawn_replica(
+        i: int, fresh_capacity: bool = False
+    ) -> Tuple[Any, List[Any]]:
         """Spawn replica ``i``'s process (group): the leader plus any
         gang followers, from the SAME resolved kwargs/bundles every
         time — the initial launch and every supervisor restart run
-        exactly this."""
+        exactly this. ``fresh_capacity`` draws free node capacity
+        instead of the replica's placement-group bundle: a preemption
+        PRE-spawn runs while the dying replica still occupies its
+        bundle, so keeping capacity at N through the grace window
+        requires headroom outside the reservation (no headroom fails
+        fast — the normal in-bundle respawn still runs at drain end)."""
         if hosts == 1:
             return (
-                actor_cls.options(**opts_for(i)).remote(**replica_kwargs),
+                actor_cls.options(
+                    **opts_for(i, fresh_capacity)
+                ).remote(**replica_kwargs),
                 [],
             )
         # One process group per mesh: leader + followers share a
         # jax.distributed rendezvous; the op stream rides one fabric
-        # queue per follower. Spawns are async, so the whole gang is
-        # up and joining the rendezvous before anyone is pinged.
+        # queue per follower. Spawns MUST be lazy (deferred init):
+        # every gang member's ctor blocks in the rendezvous until ALL
+        # members registered, so waiting for one ctor before spawning
+        # the next would deadlock — the whole gang goes up first, and
+        # the ping barrier below is the readiness check.
         from ray_lightning_tpu.serve.server import (
             ENGINE_KEYS,
             ServeShardFollower,
@@ -977,7 +1218,8 @@ def start_replicas(
         for rank in range(1, hosts):
             gang_followers.append(
                 follower_cls.options(
-                    **opts_for(i * hosts + rank)
+                    lazy_init=True,
+                    **opts_for(i * hosts + rank, fresh_capacity),
                 ).remote(
                     op_queue=queues[rank - 1],
                     dist={
@@ -988,15 +1230,28 @@ def start_replicas(
                     **engine_kwargs,
                 )
             )
-        leader = actor_cls.options(**opts_for(i * hosts)).remote(
-            dist={
-                "num_hosts": hosts,
-                "host_rank": 0,
-                "coordinator_address": coordinator,
-            },
-            gang_queues=queues,
-            **replica_kwargs,
-        )
+        try:
+            leader = actor_cls.options(
+                lazy_init=True, **opts_for(i * hosts, fresh_capacity)
+            ).remote(
+                dist={
+                    "num_hosts": hosts,
+                    "host_rank": 0,
+                    "coordinator_address": coordinator,
+                },
+                gang_queues=queues,
+                **replica_kwargs,
+            )
+        except BaseException:
+            # A half-spawned gang must not leak followers blocked in a
+            # rendezvous their coordinator will never join (each would
+            # hold a bundle/CPU until its register timeout).
+            for f in gang_followers:
+                try:
+                    fabric.kill(f)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
         return leader, gang_followers
 
     replicas = []

@@ -542,6 +542,12 @@ class DecodeEngine:
         #: assembly + the compiled H2D dispatch) — the bench's
         #: "what does a cold hit cost" column.
         self.refill_s = 0.0
+        #: Cross-replica KV handoff accounting: blocks this engine
+        #: serialized out for a migrating request (export) and blocks it
+        #: accepted from a dying peer (import) — the warm-handoff rate's
+        #: numerator in the preempt bench.
+        self.prefix_handoff_exports = 0
+        self.prefix_handoff_imports = 0
 
         # Per-slot DEVICE state (fixed shapes: one step signature forever;
         # replicated under a mesh — slot writes and the per-fold harvest
@@ -1030,7 +1036,11 @@ class DecodeEngine:
                 .compile()
             )
             self.compiled_count += 1
-        if self.prefix_blocks and self._tiered:
+        if self.prefix_blocks:
+            # Compiled whenever a pool exists (not just with spill tiers
+            # on): the same two transfers also serve the cross-replica
+            # KV handoff — a preempting replica pool-reads a request's
+            # prefix blocks out, the survivor pool-writes them in.
             blk_out = self._blk_sh  # None single-device
 
             def pool_read_impl(pool_k, pool_v, block):
@@ -1969,6 +1979,101 @@ class DecodeEngine:
         self.tier_counters[tier]["promotions"] += 1
         self.refill_s += time.monotonic() - t0
         return idx
+
+    # -- cross-replica KV handoff (preemption drain) ----------------------
+    def export_prefix_blocks(
+        self, tokens: Sequence[int]
+    ) -> List[Tuple[str, Any, Any]]:
+        """Serialize the cached prefix of ``tokens`` for a peer engine:
+        ``[(digest_hex, k_payload, v_payload), ...]`` in chain order,
+        stopping at the first block no tier holds (a later block without
+        its ancestors can never be matched). Payloads are the same host
+        form the spill tiers keep (full np block single-device, shard
+        dict under a mesh), so a same-config peer's
+        :meth:`import_prefix_blocks` rebuilds them verbatim. Read-only
+        (tiers keep their copies) but it runs the compiled pool read —
+        call it from the engine's driving thread only, like every other
+        engine method."""
+        if not self.prefix_blocks:
+            return []
+        out: List[Tuple[str, Any, Any]] = []
+        for d in self._block_digests(np.asarray(tokens, np.int32)):
+            idx = self._pool_map.get(d)
+            if idx is not None:
+                k, v = self._pool_read_exec(
+                    self._pool_k, self._pool_v, np.int32(idx)
+                )
+                kp, vp = self._capture_block(k), self._capture_block(v)
+            elif d in self._host_map:
+                kp, vp = self._host_map[d]
+            elif d in self._disk_map:
+                payload = self._disk_load(d)
+                if payload is None:
+                    break
+                kp, vp = payload
+            else:
+                break
+            out.append((d.hex(), kp, vp))
+            self.prefix_handoff_exports += 1
+        return out
+
+    def import_prefix_blocks(
+        self, blocks: Sequence[Tuple[str, Any, Any]]
+    ) -> int:
+        """Accept a dying peer's serialized prefix blocks (chain order,
+        :meth:`export_prefix_blocks` wire form) into the device pool via
+        the compiled H2D pool write, so a migrated request's admission
+        walk gets a warm hit instead of a cold re-prefill. Blocks the
+        pool already holds are touched (LRU), not rewritten (K/V are a
+        pure function of the token prefix, so the bytes are identical);
+        when no device block can be allocated the block lands in the
+        host tier instead (still one promotion away from warm), and
+        with no host tier the chain stops — descendants without this
+        ancestor could never match. Returns blocks accepted. Mutates
+        pool state: must run on the engine's driving thread (the
+        scheduler applies queued imports inside ``step()``)."""
+        if not self.prefix_blocks:
+            return 0
+        accepted = 0
+        for hexd, kp, vp in blocks:
+            d = bytes.fromhex(hexd)
+            idx = self._pool_map.get(d)
+            if idx is not None:
+                self._pool_tick += 1
+                self._pool_meta[idx].stamp = self._pool_tick
+                accepted += 1
+                continue
+            idx = self._pool_alloc()
+            if idx is None:
+                if self._host_budget:
+                    self._host_insert(d, kp, vp)
+                    accepted += 1
+                    self.prefix_handoff_imports += 1
+                    continue
+                break
+            self._pool_k, self._pool_v = self._pool_write_exec(
+                self._pool_k, self._pool_v,
+                self._device_block(kp), self._device_block(vp),
+                np.int32(idx),
+            )
+            self._pool_tick += 1
+            self._pool_map[d] = idx
+            self._pool_meta[idx] = _PoolBlock(
+                digest=d, refs=0, stamp=self._pool_tick
+            )
+            # An imported device copy supersedes any colder local copy
+            # (same reasoning as _insert_prefix's dedup).
+            if self._tiered:
+                self._host_map.pop(d, None)
+                if d in self._disk_map:
+                    self._disk_drop(d)
+            accepted += 1
+            self.prefix_handoff_imports += 1
+        if accepted and self.events is not None:
+            self.events.record(
+                "engine", "prefix_handoff_import", blocks=accepted,
+            )
+        return accepted
 
     def _insert_prefix(self, slot: int, tokens: np.ndarray) -> None:
         """Insert the freshly-prefilled prompt's full blocks (slot rows ->

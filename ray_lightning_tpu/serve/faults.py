@@ -33,7 +33,11 @@ Actions: ``kill`` (``os._exit`` — a hard crash, no flushes, exactly
 what a torn JSONL tail looks like), ``delay`` (sleep ``seconds``),
 ``drop`` (raise ``ConnectionError`` — the RPC fails, the process
 lives), ``wedge`` (block ``seconds``, default effectively forever —
-a hung thread).
+a hung thread), ``preempt`` (a SCHEDULED kill: the process's
+:mod:`serve.preempt` monitor records a notice with a ``seconds`` grace
+window NOW, and the hard ``os._exit`` lands at the deadline — the spot
+reclamation shape the graceful-drain path exists for: drain in time or
+die like a crash).
 
 Gating: everything is off unless a plan is supplied — via the
 ``faults=`` kwarg on ``ServeReplica``/``Scheduler``, the
@@ -64,7 +68,10 @@ FAULT_POINTS = frozenset((
     "follower_op",
 ))
 
-FAULT_ACTIONS = frozenset(("kill", "delay", "drop", "wedge"))
+FAULT_ACTIONS = frozenset(("kill", "delay", "drop", "wedge", "preempt"))
+
+#: Grace window (s) a ``preempt`` rule uses when ``seconds`` is 0.
+PREEMPT_DEFAULT_GRACE_S = 30.0
 
 #: Exit code a fault-injected kill dies with (distinguishable from a
 #: real crash in the fabric's actor_death event / exitcode).
@@ -228,3 +235,22 @@ class FaultInjector:
             # call path stops. Bounded so an orphaned wedge cannot
             # outlive a long test session's process reuse.
             threading.Event().wait(rule.seconds or 3600.0)
+        elif rule.action == "preempt":
+            # A reclamation, not a crash: the notice lands now (the
+            # monitor flips preemption_pending, health/heartbeats carry
+            # it, the drain machinery gets the grace window) and the
+            # kill honors its own deadline — an undrained process dies
+            # exactly like a ``kill`` at grace end. The calling thread
+            # continues immediately: the whole point is that serving
+            # keeps running through the window.
+            from ray_lightning_tpu.serve.preempt import get_monitor
+
+            grace = rule.seconds or PREEMPT_DEFAULT_GRACE_S
+            get_monitor(events=self._events).notice(
+                grace_s=grace, source="fault"
+            )
+            timer = threading.Timer(
+                grace, os._exit, args=(KILL_EXIT_CODE,)
+            )
+            timer.daemon = True
+            timer.start()

@@ -100,7 +100,10 @@ class TokenEvent:
     request_id: str
     token: Optional[int]  # None for lifecycle-only events
     done: bool
-    #: "token" | "finished" | "cancelled" | "expired"
+    #: "token" | "finished" | "cancelled" | "expired" | "migrated"
+    #: ("migrated": evicted by a preemption drain FOR resubmission on a
+    #: survivor — terminal on THIS engine, not for the request; the
+    #: client follows its route table instead of failing the stream).
     reason: str = "token"
 
 
@@ -164,6 +167,10 @@ class Scheduler:
         #: (priority, seq, Request) min-heap: FIFO within a priority.
         self._pending: List[Any] = []
         self._cancelled: set = set()
+        #: Subset of _cancelled evicted BY a preemption drain: their
+        #: terminal events read "migrated" so the client keeps the
+        #: stream open across the re-route instead of failing it.
+        self._migrating: set = set()
         self._slot_req: Dict[int, Request] = {}
         #: Last-seen engine speculative-decoding counters (cumulative);
         #: step() diffs them into per-step metrics deltas.
@@ -182,6 +189,19 @@ class Scheduler:
         #: estimated device-seconds share) and emitted as ONE record at
         #: finish/cancel/expire via metrics.record_cost + a typed event.
         self._acct: Dict[str, Dict[str, Any]] = {}
+        #: Preemption drain: a pending ``request_drain`` budget (s) the
+        #: next step() consumes, and the plan it produced — engine work
+        #: (prefix-block export) must run on the loop thread, so the RPC
+        #: surface arms the drain and waits on the condition instead of
+        #: touching the engine itself.
+        self._drain_req: Optional[float] = None
+        self._drain_result: Optional[Dict[str, Any]] = None
+        self._drain_cv = threading.Condition()
+        #: Prefix-block payloads handed off by a dying peer, queued here
+        #: (RPC thread) and imported into the engine pool at the top of
+        #: the next step() (loop thread) — engine state never mutates
+        #: off the driving thread.
+        self._pending_imports: List[Any] = []
 
     # -- cost ledger ------------------------------------------------------
     def _acct_open(self, req: Request) -> None:
@@ -350,7 +370,125 @@ class Scheduler:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._pending) or self.engine.num_active > 0
+            return (
+                bool(self._pending)
+                or self.engine.num_active > 0
+                or self._drain_req is not None
+                or bool(self._pending_imports)
+            )
+
+    # -- preemption drain (thread-safe arm/wait; work runs in step()) -----
+    def request_drain(self, budget_s: float) -> None:
+        """Arm a graceful drain: the next step() classifies in-flight
+        work into finish-in-grace vs migrate (cancelling + exporting the
+        migrate set) and publishes the plan for :meth:`drain_result`."""
+        with self._lock:
+            self._drain_req = float(budget_s)
+
+    def drain_result(
+        self, timeout: Optional[float] = 10.0
+    ) -> Optional[Dict[str, Any]]:
+        """Block until the armed drain's plan is ready (None on
+        timeout); consumes the plan."""
+        with self._drain_cv:
+            if self._drain_result is None:
+                self._drain_cv.wait(timeout)
+            plan, self._drain_result = self._drain_result, None
+            return plan
+
+    def enqueue_prefix_import(self, blocks: Any) -> int:
+        """Queue a dying peer's exported prefix blocks for import at the
+        top of the next step() (engine mutations stay on the loop
+        thread). Returns the number of blocks queued."""
+        with self._lock:
+            self._pending_imports.append(blocks)
+        return len(blocks)
+
+    def _apply_drain(self, events: List[TokenEvent]) -> None:
+        """Consume a pending drain request (inside step(), loop thread).
+
+        Policy: a resident request whose estimated completion fits in
+        half the grace window (the other half is the respawn/failover
+        margin) runs to completion; everything else — the rest of the
+        residents and the whole queue — is cancelled here and listed as
+        the MIGRATE set, each with its prompt's cached prefix blocks
+        serialized for the survivor (the cross-replica KV handoff). The
+        estimate is conservative: with no recent decode-rate sample,
+        everything migrates — better a warm replay on a survivor than a
+        stream the deadline truncates.
+        """
+        with self._lock:
+            budget = self._drain_req
+            if budget is None:
+                return
+            self._drain_req = None
+            rate = float(
+                self.metrics.snapshot().get("decode_tokens_per_sec") or 0.0
+            )
+            resident = list(self._slot_req.values())
+            n_res = max(1, len(resident))
+            finish: List[str] = []
+            migrate: List[Any] = []
+            for req in resident:
+                acct = self._acct.get(req.request_id) or {}
+                left = max(
+                    0,
+                    req.sampling.max_new_tokens
+                    - int(acct.get("emitted_tokens", 0)),
+                )
+                est = (left * n_res / rate) if rate > 0 else None
+                if est is not None and est <= 0.5 * budget:
+                    finish.append(req.request_id)
+                else:
+                    migrate.append(req)
+                    # The boundary eviction scan below this call picks
+                    # it up in the SAME step; _migrating makes its
+                    # terminal events read "migrated" (the client keeps
+                    # the stream open across the re-route).
+                    self._cancelled.add(req.request_id)
+                    self._migrating.add(req.request_id)
+            queued = [r for _, _, r in self._pending]
+            self._pending = []
+            for req in queued:
+                self._cancelled.discard(req.request_id)
+                migrate.append(req)
+                self.metrics.record_cancel(queue_depth=0)
+                self._trace(req.request_id, _trace.SPAN_CANCEL)
+                self._acct_close(req.request_id, "migrated")
+                events.append(
+                    TokenEvent(req.request_id, None, True, "migrated")
+                )
+        if self.journal is not None:
+            # A drain-induced cancel must look like any other cancel to
+            # a replay of this journal (the client-side journal, not
+            # this one, is what resubmits the migrated request).
+            for req in migrate:
+                self.journal.record_cancel(req.request_id, True)
+        # Engine work outside the lock: serialize each migrating
+        # request's cached prefix so the survivor's admission walk hits
+        # warm instead of re-prefilling cold.
+        plan = {
+            "budget_s": budget,
+            "finish": finish,
+            "migrate": [
+                {
+                    "request_id": req.request_id,
+                    "blocks": self.engine.export_prefix_blocks(req.prompt)
+                    if getattr(self.engine, "prefix_blocks", 0)
+                    else [],
+                }
+                for req in migrate
+            ],
+        }
+        self._event(
+            "drain_plan", level="warn",
+            budget_s=round(budget, 3), finish=len(finish),
+            migrate=len(migrate),
+            kv_blocks=sum(len(m["blocks"]) for m in plan["migrate"]),
+        )
+        with self._drain_cv:
+            self._drain_result = plan
+            self._drain_cv.notify_all()
 
     # -- the loop body (single driver thread) -----------------------------
     def step(self) -> List[TokenEvent]:
@@ -360,6 +498,16 @@ class Scheduler:
         it, so submit()/cancel() never wait on device compute."""
         events: List[TokenEvent] = []
         t0 = time.monotonic()
+        # Peer KV handoff + preemption drain ride the loop thread:
+        # apply queued block imports first, then consume any armed drain
+        # request so its cancellations land in THIS step's boundary
+        # scan (engine state never mutates off the driving thread).
+        with self._lock:
+            imports, self._pending_imports = self._pending_imports, []
+        for blocks in imports:
+            self.engine.import_prefix_blocks(blocks)
+        if self._drain_req is not None:
+            self._apply_drain(events)
         to_evict: List[Any] = []
         admits: List[Request] = []
         #: (rid, outcome) terminals from ENGINE work this step; their
@@ -393,11 +541,17 @@ class Scheduler:
             # (mid-prefill requests included — release drops their state
             # machine and unpins their prefix blocks).
             for slot, req in list(self._slot_req.items()):
-                cancelled = req.request_id in self._cancelled
+                rid = req.request_id
+                cancelled = rid in self._cancelled
                 if cancelled or req.expired(t0):
                     del self._slot_req[slot]
-                    self._cancelled.discard(req.request_id)
-                    to_evict.append((slot, req, cancelled))
+                    self._cancelled.discard(rid)
+                    if rid in self._migrating:
+                        self._migrating.discard(rid)
+                        kind = "migrated"
+                    else:
+                        kind = "cancelled" if cancelled else "expired"
+                    to_evict.append((slot, req, kind))
             # 2) Pop admission candidates: bounded prefills per step,
             # sized to the slots that are (or are about to be) free.
             budget = min(
@@ -434,31 +588,26 @@ class Scheduler:
                 admits.append(req)
                 self._admitting.add(req.request_id)
         # -- engine work, lock NOT held --------------------------------
-        for slot, req, cancelled in to_evict:
+        for slot, req, kind in to_evict:
             self.engine.release(slot)
-            (self.metrics.record_cancel if cancelled
-             else self.metrics.record_expire)(
+            (self.metrics.record_expire if kind == "expired"
+             else self.metrics.record_cancel)(
                 queue_depth=self.queue_depth()
             )
             self._trace(
                 req.request_id,
-                _trace.SPAN_CANCEL if cancelled else _trace.SPAN_EXPIRE,
+                _trace.SPAN_EXPIRE if kind == "expired"
+                else _trace.SPAN_CANCEL,
                 slot=slot,
             )
             self._event(
-                "cancel" if cancelled else "expire",
-                level="info" if cancelled else "warn",
+                "expire" if kind == "expired" else "cancel",
+                level="warn" if kind == "expired" else "info",
                 request_id=req.request_id, where="slot", slot=slot,
+                migrated=kind == "migrated",
             )
-            closed.append(
-                (req.request_id, "cancelled" if cancelled else "expired")
-            )
-            events.append(
-                TokenEvent(
-                    req.request_id, None, True,
-                    "cancelled" if cancelled else "expired",
-                )
-            )
+            closed.append((req.request_id, kind))
+            events.append(TokenEvent(req.request_id, None, True, kind))
         newly: Dict[int, Request] = {}
         finished_rids: List[str] = []
         if admits:
@@ -713,6 +862,7 @@ class Scheduler:
             # engine section ran would pin the id in _cancelled forever
             # and spuriously evict a later request reusing it.
             self._cancelled.difference_update(finished_rids)
+            self._migrating.difference_update(finished_rids)
         # Device-seconds attribution: this step's wall time split evenly
         # over the requests that held engine state through it (resident
         # slots + this step's admissions). An estimate by construction —

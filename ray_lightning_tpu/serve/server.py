@@ -243,6 +243,24 @@ class _GangLeaderEngine:
         self._broadcast("release", args, kwargs)
         return self._engine.release(*args, **kwargs)
 
+    def import_prefix_blocks(self, *args: Any, **kwargs: Any) -> Any:
+        # Pool mutation: followers must apply the identical import so
+        # later alloc/promote choices stay in lockstep.
+        self._broadcast("import_prefix_blocks", args, kwargs)
+        return self._engine.import_prefix_blocks(*args, **kwargs)
+
+    def export_prefix_blocks(self, *args: Any, **kwargs: Any) -> Any:
+        # A read, but it RUNS the compiled pool read — under a real
+        # multi-host mesh every process must issue the same dispatch
+        # sequence, so the export is broadcast too (followers discard
+        # the result). Each process serializes only its own shards;
+        # cross-gang KV handoff therefore ships the LEADER's view — a
+        # complete block single-host, leader-shards-only on a true
+        # multi-host gang (documented caveat; the migration itself
+        # stays correct either way).
+        self._broadcast("export_prefix_blocks", args, kwargs)
+        return self._engine.export_prefix_blocks(*args, **kwargs)
+
     def close(self) -> None:
         """End-of-life sentinel: followers drain and exit their loops."""
         for q in self._queues:
@@ -335,6 +353,24 @@ class ServeShardFollower:
         """This follower's trace ring in the stitching wire form."""
         return self.tracer.dump(n)
 
+    def inject_fault(self, plan: Any) -> list:
+        """Arm (or disarm with None) a fault plan on this LIVE follower
+        — how a chaos test preempts/wedges ONE gang member of a fleet
+        (the env gate arms every process identically). Replaces any
+        previous plan; returns the armed rules."""
+        from ray_lightning_tpu.serve.faults import FaultInjector
+
+        inj = FaultInjector.parse(plan)
+        self.faults = inj
+        return [] if inj is None else inj.describe()
+
+    def preempt_state(self) -> Dict[str, Any]:
+        """This follower's preemption-monitor state (the RPC mirror of
+        what its fabric heartbeats carry)."""
+        from ray_lightning_tpu.serve.preempt import peek_state
+
+        return peek_state() or {"pending": False}
+
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=10.0)
@@ -394,6 +430,9 @@ class ServeReplica:
         dist: Optional[Dict[str, Any]] = None,
         gang_queues: Optional[Sequence[Any]] = None,
         faults: Any = None,
+        preempt_grace_s: float = 30.0,
+        preempt_sigterm: bool = True,
+        preempt_metadata: bool = False,
     ) -> None:
         from ray_lightning_tpu.obs import blackbox as obs_blackbox
         from ray_lightning_tpu.obs import health as obs_health
@@ -472,6 +511,25 @@ class ServeReplica:
             capacity=trace_capacity, enabled=bool(tracing)
         )
         self.events = get_event_log()
+        # Preemption signal plane (serve.preempt): SIGTERM, the optional
+        # metadata poller, and the `preempt` fault action all funnel
+        # into one process monitor; health()/stats() ship its state so
+        # the supervisor can flip this replica to PREEMPTING and drive
+        # the graceful drain inside the grace window. SIGTERM records
+        # the notice WITHOUT exiting (the drain is the response; fabric
+        # kill()'s shutdown message / SIGKILL escalation still end the
+        # process), and the notice wakes the loop thread so a drain on
+        # an idle replica starts immediately.
+        from ray_lightning_tpu.serve.preempt import get_monitor
+
+        self.preempt = get_monitor(
+            grace_s=float(preempt_grace_s), events=self.events
+        )
+        self.preempt.add_callback(lambda _m: self._work.set())
+        if preempt_sigterm:
+            self.preempt.install_sigterm()
+        if preempt_metadata:
+            self.preempt.start_metadata_poller()
         # Workload journal: the deterministic capture of this replica's
         # externally-sourced request stream (ring always on by default —
         # the hot-path cost is one dict append per lifecycle event;
@@ -538,6 +596,7 @@ class ServeReplica:
             "stall_s": float(stall_s),
             "slo": dict(slo or {}),
             "journal": self.journal is not None,
+            "preempt_grace_s": float(preempt_grace_s),
         }
         self.events.record(
             "serve", "replica_init",
@@ -757,6 +816,7 @@ class ServeReplica:
         if self.engine.spec != "off":
             snap["spec_stats"] = self.engine.spec_stats()
         snap["health"] = self.health()["verdict"]
+        snap["preempt"] = self.preempt.state()
         return snap
 
     # -- health / forensics RPCs ------------------------------------------
@@ -766,12 +826,17 @@ class ServeReplica:
         aggregation surface the driver's /healthz pulls, so it must not
         serve a stale verdict at a recovery boundary."""
         if self.watchdog is None:
-            return {
+            out = {
                 "verdict": "healthy", "healthy": True, "reasons": [],
                 "components": {}, "watchdog": False,
             }
-        out = self.watchdog.evaluate().to_dict()
-        out["watchdog"] = True
+        else:
+            out = self.watchdog.evaluate().to_dict()
+            out["watchdog"] = True
+        # Preemption is NOT unhealthiness (the process still serves) —
+        # it rides the report as its own field so the supervisor can
+        # flip to PREEMPTING and start the deadline-driven drain.
+        out["preempt"] = self.preempt.state()
         return out
 
     def debug_dump(
@@ -804,6 +869,49 @@ class ServeReplica:
         self.faults = inj
         self.scheduler.faults = inj
         return [] if inj is None else inj.describe()
+
+    # -- preemption drain RPCs --------------------------------------------
+    def preempt_now(self, grace_s: Optional[float] = None) -> float:
+        """Record a preemption notice on this replica (tests, manual
+        drills, an external node-drainer); returns the deadline's
+        remaining seconds. The supervisor picks the state up on its next
+        probe and drives the drain."""
+        self.preempt.notice(grace_s=grace_s, source="rpc")
+        return float(self.preempt.remaining() or 0.0)
+
+    def begin_drain(
+        self,
+        budget_s: Optional[float] = None,
+        wait_s: float = 15.0,
+    ) -> Dict[str, Any]:
+        """Run the graceful-drain classification: requests that can
+        finish inside ``budget_s`` (default: the monitor's remaining
+        grace) keep running; the rest are cancelled at the next step
+        boundary and returned as the MIGRATE set, each with its cached
+        prefix blocks serialized for the survivor. Blocks until the loop
+        thread publishes the plan (it does engine work)."""
+        if budget_s is None:
+            budget_s = self.preempt.remaining()
+        if budget_s is None:
+            budget_s = self.preempt.grace_s
+        self.scheduler.request_drain(float(budget_s))
+        self._work.set()  # an idle loop must still produce the plan
+        plan = self.scheduler.drain_result(timeout=float(wait_s))
+        if plan is None:
+            raise TimeoutError(
+                f"drain plan not produced within {wait_s}s (loop thread "
+                "wedged?)"
+            )
+        return plan
+
+    def import_prefix_blocks(self, blocks: Any) -> int:
+        """Accept a dying peer's exported prefix blocks (the
+        cross-replica KV handoff): queued here, imported into the engine
+        pool at the top of the next scheduler step (engine mutations
+        stay on the loop thread). Returns blocks queued."""
+        n = self.scheduler.enqueue_prefix_import(blocks)
+        self._work.set()
+        return n
 
     def journal_dump(self, n: Optional[int] = None) -> Dict[str, Any]:
         """This replica's workload journal in the wire form (header +

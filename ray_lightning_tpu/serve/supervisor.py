@@ -24,6 +24,17 @@ unit-testable without sleeping):
   incomplete requests over (``client.on_replica_lost`` — journal-backed
   resubmission onto survivors, bit-exact by the seed-chain contract)
   and schedules a restart.
+- **preempting**: the probe (or a gang follower's heartbeat) carries a
+  pending preemption notice (serve.preempt) — a SCHEDULED kill with a
+  grace window, not a crash. The supervisor consumes the warning:
+  traffic is excluded immediately, a replacement is PRE-SPAWNED during
+  the grace window (fleet capacity never dips below N), and the replica
+  drains — requests that can finish inside the window run to
+  completion; the rest live-migrate (``client.preempt_drain``: the
+  dying replica's exported prefix KV lands on a survivor, the journal
+  submit replays there under the same id/seed, the stream cursor dedups
+  — bit-exact, warm). When the routed requests hit zero (or the
+  deadline), the replacement swaps in.
 - **restarting**: after a capped exponential backoff
   (``restart_backoff_s * 2^attempt``, capped), the replica's original
   spawn recipe is re-run (``client.respawn_replica`` — same resolved
@@ -53,10 +64,12 @@ DRAINING = "draining"
 DEAD = "dead"
 RESTARTING = "restarting"
 FAILED = "failed"
+PREEMPTING = "preempting"
 
 #: rlt_fleet_replica_state gauge values (renders in dashboards).
 _STATE_SCORE = {
     HEALTHY: 0.0, DRAINING: 1.0, DEAD: 2.0, RESTARTING: 3.0, FAILED: 4.0,
+    PREEMPTING: 5.0,
 }
 
 
@@ -123,7 +136,12 @@ class FleetSupervisor:
         self._m_state = reg.gauge(
             "rlt_fleet_replica_state",
             "Supervisor replica state (0 healthy, 1 draining, 2 dead, "
-            "3 restarting, 4 failed)",
+            "3 restarting, 4 failed, 5 preempting)",
+        )
+        self._m_preempts = reg.counter(
+            "rlt_fleet_replica_preemptions_total",
+            "Preemption notices the supervisor consumed with a "
+            "graceful drain",
         )
         self._lock = threading.RLock()
         #: replica idx -> state record (see _fresh()).
@@ -141,6 +159,8 @@ class FleetSupervisor:
             "attempts": 0,        # consecutive failed/pending attempts
             "next_restart_t": 0.0,
             "last_error": None,
+            "preempt_deadline": None,   # monotonic; PREEMPTING only
+            "preemptions": 0,           # notices consumed, lifetime
         }
 
     def _event(self, name: str, level: str = "info", **kv: Any) -> None:
@@ -177,27 +197,41 @@ class FleetSupervisor:
         return age
 
     def _probe(self, idx: int) -> Any:
-        """One replica's liveness + verdict: the health() RPC (fresh
-        watchdog evaluation) gated by process liveness and heartbeat
-        age. Returns a verdict string, or None == dead (with the reason
-        in the state record)."""
+        """One replica's liveness + verdict + preemption notice: the
+        health() RPC (fresh watchdog evaluation) gated by process
+        liveness and heartbeat age, plus any pending preemption — the
+        replica's own (health report) or a gang follower's (fabric
+        heartbeat: followers have no RPC surface, and one preempted
+        member dooms the whole gang). Returns
+        ``(verdict, death_reason, preempt_info)``; verdict None == dead."""
         alive_fn = getattr(self.client, "replica_is_alive", None)
         if alive_fn is not None and not alive_fn(idx):
-            return None, "actor process is not alive"
+            return None, "actor process is not alive", None
         age = self._heartbeat_age(idx)
         if age is not None and age > self.heartbeat_dead_s:
             return None, (
                 f"no fabric heartbeat for {age:.1f}s "
                 f"(> {self.heartbeat_dead_s:g}s)"
-            )
+            ), None
         try:
             rep = self.client.health_one(
                 idx, timeout=self.probe_timeout_s
             )
         except Exception as exc:  # noqa: BLE001 - any probe failure is
             # a death verdict; the restart path owns recovery.
-            return None, f"{type(exc).__name__}: {exc}"[:300]
-        return str(rep.get("verdict", HEALTHY)), None
+            return None, f"{type(exc).__name__}: {exc}"[:300], None
+        preempt = rep.get("preempt") if isinstance(rep, dict) else None
+        if not (isinstance(preempt, dict) and preempt.get("pending")):
+            preempt = None
+            gang_fn = getattr(self.client, "gang_preempt_state", None)
+            if gang_fn is not None:
+                try:
+                    p = gang_fn(idx)
+                except Exception:  # noqa: BLE001 - advisory signal
+                    p = None
+                if isinstance(p, dict) and p.get("pending"):
+                    preempt = dict(p, member="follower")
+        return str(rep.get("verdict", HEALTHY)), None, preempt
 
     # -- the loop body -----------------------------------------------------
     def tick(self) -> Dict[str, Any]:
@@ -207,7 +241,7 @@ class FleetSupervisor:
         now = self._clock()
         summary: Dict[str, Any] = {
             "probed": 0, "failed_over": 0, "restarted": 0,
-            "restart_failures": 0,
+            "restart_failures": 0, "preempting": 0,
         }
         for idx in range(int(self.client.num_replicas)):
             with self._lock:
@@ -218,11 +252,19 @@ class FleetSupervisor:
                 continue
             if state == FAILED:
                 continue
-            verdict, err = self._probe(idx)
+            if state == PREEMPTING:
+                self._continue_preempt(idx, now, summary)
+                continue
+            verdict, err, preempt = self._probe(idx)
             summary["probed"] += 1
             if verdict is None:
                 self._on_dead(idx, err, now)
                 summary["failed_over"] += 1
+            elif preempt is not None:
+                # A scheduled kill outranks an unhealthy verdict: the
+                # drain consumes the grace window either way.
+                self._begin_preempt(idx, preempt, now)
+                summary["preempting"] += 1
             elif verdict == "unhealthy":
                 with self._lock:
                     st["verdict"] = verdict
@@ -263,6 +305,138 @@ class FleetSupervisor:
                 "failover_error", level="error", replica=idx,
                 error=f"{type(exc).__name__}: {exc}"[:300],
             )
+
+    # -- preemption: consume the warning ----------------------------------
+    def _begin_preempt(
+        self, idx: int, info: Dict[str, Any], now: float
+    ) -> None:
+        """A preemption notice landed: exclude the replica, pre-spawn
+        its replacement, and run the graceful drain (finish-in-grace +
+        live-migrate) — all inside the grace window."""
+        remaining = float(info.get("remaining_s") or 0.0)
+        with self._lock:
+            st = self._replicas[idx]
+            st["state"] = PREEMPTING
+            st["verdict"] = PREEMPTING
+            st["preempt_deadline"] = now + remaining
+            st["preemptions"] += 1
+        self._m_preempts.inc(1, replica=idx)
+        self._event(
+            "replica_preempting", level="warn", replica=idx,
+            remaining_s=round(remaining, 3),
+            source=str(info.get("source", "")),
+            member=str(info.get("member", "replica")),
+        )
+        try:
+            self.client.exclude(idx)
+        except Exception:  # noqa: BLE001 - routing is advisory here;
+            pass  # the drain below excludes again
+        # Drain FIRST (one RPC + one scheduler step: the cheap, urgent
+        # move — migrated requests are safe on survivors within
+        # milliseconds of the notice), THEN pre-spawn the replacement
+        # (slow: a fresh engine build) with the rest of the window —
+        # the in-grace finishers keep streaming off the dying replica
+        # throughout, and the swap at drain end is instant.
+        drain = getattr(self.client, "preempt_drain", None)
+        if drain is not None:
+            try:
+                res = drain(idx, budget_s=remaining)
+            except Exception as exc:  # noqa: BLE001 - a failed drain
+                # degrades to crash semantics at the deadline, never
+                # worse.
+                self._event(
+                    "preempt_drain_error", level="error", replica=idx,
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                )
+            else:
+                self._event(
+                    "replica_preempt_drained", replica=idx,
+                    finished_in_grace=len(res.get("finish", [])),
+                    migrated=len(res.get("migrated", [])),
+                    lost=len(res.get("lost", [])),
+                    kv_blocks=int(res.get("kv_blocks", 0)),
+                )
+        # Pre-spawn DURING the grace window so fleet capacity never
+        # dips below N. Failure only costs the pre-spawn (a normal
+        # respawn still runs at finalize).
+        prespawn = getattr(self.client, "prespawn_replacement", None)
+        can = getattr(self.client, "can_respawn", lambda: False)()
+        if prespawn is not None and can:
+            try:
+                prespawn(idx)
+            except Exception as exc:  # noqa: BLE001 - see above
+                self._event(
+                    "replica_prespawn_failed", level="warn", replica=idx,
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                )
+
+    def _continue_preempt(
+        self, idx: int, now: float, summary: Dict[str, Any]
+    ) -> None:
+        """PREEMPTING follow-up ticks: wait while in-grace requests
+        stream off the dying replica, then swap the replacement in (at
+        zero routed requests, early death, or the deadline — whichever
+        comes first)."""
+        alive_fn = getattr(self.client, "replica_is_alive", None)
+        alive = bool(alive_fn(idx)) if alive_fn is not None else True
+        open_fn = getattr(self.client, "requests_on", None)
+        open_count = int(open_fn(idx)) if open_fn is not None else 0
+        with self._lock:
+            st = self._replicas[idx]
+            deadline = float(st["preempt_deadline"] or 0.0)
+        if alive and open_count > 0 and now < deadline:
+            return  # still finishing in-grace work
+        if not alive or open_count > 0:
+            # Died early, or the deadline caught unfinished work: those
+            # requests fail over NOW (idempotent — the streaming path
+            # may already have moved them).
+            try:
+                self.client.on_replica_lost(
+                    idx, reason="preempted (grace expired)"
+                    if alive else "preempted (died in grace window)"
+                )
+            except Exception as exc:  # noqa: BLE001 - keep replacing
+                self._event(
+                    "failover_error", level="error", replica=idx,
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                )
+            summary["failed_over"] += 1
+        if not getattr(self.client, "can_respawn", lambda: False)():
+            with self._lock:
+                st["state"] = FAILED
+                st["preempt_deadline"] = None
+            self._event(
+                "replica_restart_giveup", level="error", replica=idx,
+                attempts=0,
+            )
+            return
+        try:
+            self.client.respawn_replica(idx)
+        except Exception as exc:  # noqa: BLE001 - fall back to the
+            # normal dead/backoff machinery.
+            with self._lock:
+                st["state"] = DEAD
+                st["verdict"] = DEAD
+                st["last_error"] = f"{type(exc).__name__}: {exc}"[:300]
+                st["attempts"] = 0
+                st["next_restart_t"] = now + self._backoff(0)
+                st["preempt_deadline"] = None
+            summary["restart_failures"] += 1
+            self._event(
+                "replica_restart_failed", level="warn", replica=idx,
+                attempt=0, error=str(exc)[:300],
+            )
+            return
+        with self._lock:
+            st["state"] = HEALTHY
+            st["verdict"] = HEALTHY
+            st["restarts"] += 1
+            st["attempts"] = 0
+            st["last_error"] = None
+            st["preempt_deadline"] = None
+        summary["restarted"] += 1
+        self._m_restarts.inc(1, replica=idx)
+        self._event("replica_preempt_replaced", replica=idx)
 
     def _try_restart(
         self, idx: int, now: float, summary: Dict[str, Any]
@@ -330,6 +504,7 @@ class FleetSupervisor:
                     "verdict": st["verdict"],
                     "restarts": st["restarts"],
                     "attempts": st["attempts"],
+                    "preemptions": st["preemptions"],
                     "last_error": st["last_error"],
                 }
                 for idx, st in sorted(self._replicas.items())

@@ -25,7 +25,11 @@ from ray_lightning_tpu.trainer.data import (
     TokenBinDataset,
     write_token_bin,
 )
-from ray_lightning_tpu.trainer.loop import TrainerSpec, TrainingLoop
+from ray_lightning_tpu.trainer.loop import (
+    TrainerSpec,
+    TrainingLoop,
+    TrainingPreempted,
+)
 from ray_lightning_tpu.trainer.module import DataModule, TPUModule
 from ray_lightning_tpu.trainer.trainer import Trainer
 
@@ -35,6 +39,7 @@ __all__ = [
     "DataModule",
     "TrainerSpec",
     "TrainingLoop",
+    "TrainingPreempted",
     "Callback",
     "ModelCheckpoint",
     "CSVLogger",

@@ -102,6 +102,28 @@ class TrainerSpec:
     callbacks: List[Any] = field(default_factory=list)
 
 
+class TrainingPreempted(RuntimeError):
+    """The fit answered a preemption notice (serve.preempt) with
+    checkpoint-on-notice: a validated checkpoint was written at the
+    step boundary the notice caught, and the loop exited cleanly.
+    ``Trainer.fit``'s ``max_restarts`` loop catches this and resumes
+    from ``ckpt_path`` bit-exactly, losing at most the one step that
+    was in flight — instead of everything since the last periodic
+    checkpoint. Picklable across the fabric (a worker-side preemption
+    reaches the driver's retry loop as this same type)."""
+
+    def __init__(self, ckpt_path: str, global_step: int = 0) -> None:
+        super().__init__(
+            f"fit preempted: checkpoint-on-notice written to {ckpt_path} "
+            f"at step {global_step}"
+        )
+        self.ckpt_path = ckpt_path
+        self.global_step = int(global_step)
+
+    def __reduce__(self):  # keep attrs across cloudpickle round trips
+        return (type(self), (self.ckpt_path, self.global_step))
+
+
 def _limit(n_batches: Optional[int], limit: Any) -> Optional[int]:
     """None n_batches = a streaming loader (unknown length): int limits
     bound it, fractional limits have nothing to take a fraction OF."""
@@ -476,6 +498,17 @@ class TrainingLoop:
         # re-trained batches beat silently skipping the epoch's remainder.
         bump = 0 if state.get("mid_epoch") else 1
         self._resumed_mid_epoch = bool(state.get("mid_epoch"))
+        rb = int(state.get("resume_batch") or 0)
+        self._resume_batch = 0
+        if rb and state.get("mid_epoch"):
+            # Checkpoint-on-notice (preemption): continue the SAME epoch
+            # at the exact next batch — the loader stream is
+            # deterministic given set_epoch + the sampler seed, so
+            # skipping the trained prefix reproduces the uninterrupted
+            # run bit-for-bit. The partial grad-accumulation window is
+            # KEPT (no MultiSteps reset: no batch is re-accumulated).
+            self._resume_batch = rb
+            self._resumed_mid_epoch = False
         self.current_epoch = int(state.get("epoch", -1)) + bump
         self.global_step = int(state.get("global_step", 0))
         for cb in self.callbacks:
@@ -511,6 +544,9 @@ class TrainingLoop:
                     type(cb).__name__: cb.state_dict() for cb in self.callbacks
                 },
             }
+            rb = getattr(self, "_preempt_resume_batch", None)
+            if rb:
+                meta["resume_batch"] = int(rb)
             if getattr(self, "_sharded_io", None) is None:
                 from ray_lightning_tpu.trainer.checkpoint_io import (
                     AsyncOrbaxCheckpointIO,
@@ -560,7 +596,7 @@ class TrainingLoop:
             self.strategy.barrier("finalize_checkpoints")
 
     def checkpoint_state(self) -> Dict[str, Any]:
-        return {
+        state = {
             "params": self.strategy.gather_state(self.params),
             "opt_state": self.strategy.gather_state(self.opt_state),
             "epoch": self.current_epoch,
@@ -570,6 +606,85 @@ class TrainingLoop:
                 type(cb).__name__: cb.state_dict() for cb in self.callbacks
             },
         }
+        rb = getattr(self, "_preempt_resume_batch", None)
+        if rb:
+            # Checkpoint-on-notice only: the exact epoch position for a
+            # continue-the-epoch resume (see _restore_progress).
+            state["resume_batch"] = int(rb)
+        return state
+
+    # ------------------------------------------------------------------
+    def _preempt_pending(self, synced: bool) -> bool:
+        """Has a preemption notice landed on this process
+        (serve.preempt)? ``synced=True`` reaches a cross-rank consensus
+        (any preempted rank stops everyone — the gang checkpoints and
+        exits as a unit) and is a collective, like
+        :meth:`_out_of_time`."""
+        from ray_lightning_tpu.serve.preempt import peek_state
+
+        st = peek_state()
+        local = bool(st and st.get("pending"))
+        if not synced:
+            return local
+        import jax
+
+        if jax.process_count() == 1:
+            return local
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(np.asarray(local))
+        return bool(np.any(flags))
+
+    def _preempt_exit(self, resume_batch: Optional[int]) -> None:
+        """Checkpoint-on-notice: write a VALIDATED resume checkpoint at
+        this step boundary, then exit the fit cleanly via
+        :class:`TrainingPreempted` (which ``Trainer.fit``'s
+        ``max_restarts`` loop catches and resumes from bit-exactly).
+
+        ``resume_batch`` — batches of the current epoch already trained
+        — rides the checkpoint so the resume continues the epoch at the
+        exact next batch (the loader stream is deterministic given
+        ``set_epoch`` + the sampler seed) instead of the re-run-the-epoch
+        semantics periodic mid-epoch checkpoints use; any partial
+        grad-accumulation window is likewise kept, not reset. None =
+        the epoch just completed (resume starts the next one). The
+        checkpoint name sorts into the ``last*`` resume group, so the
+        restart scan picks it over older rolling checkpoints.
+        """
+        cb = next(
+            (c for c in self.callbacks if hasattr(c, "best_model_path")),
+            None,
+        )
+        d = getattr(cb, "dirpath", None) if cb is not None else None
+        if not d:
+            d = os.path.join(self.spec.default_root_dir, "checkpoints")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"last-preempt-step{self.global_step:08d}.ckpt"
+        )
+        self._events.record(
+            "trainer", "fit_preempt_checkpoint", level="warn",
+            path=path, step=self.global_step, epoch=self.current_epoch,
+            resume_batch=int(resume_batch or 0),
+        )
+        self._preempt_resume_batch = (
+            int(resume_batch) if resume_batch else None
+        )
+        try:
+            self.save_checkpoint(path)
+        finally:
+            self._preempt_resume_batch = None
+        if self.global_rank == 0:
+            # VALIDATED: an unreadable file must raise here (crash
+            # semantics, resume from an older checkpoint) — never hand
+            # the retry loop a checkpoint that cannot load.
+            with open(path, "rb") as f:
+                load_state_stream(f.read())
+        tel = getattr(self, "telemetry", None)
+        if tel is not None:
+            tel.fit_done = True  # the fit-stall watchdog stands down
+        self.state = {"status": "preempted", "stage": "fit"}
+        raise TrainingPreempted(path, self.global_step)
 
     # ------------------------------------------------------------------
     def _out_of_time(self, synced: bool) -> bool:
@@ -630,6 +745,11 @@ class TrainingLoop:
             try:
                 return self._run_fit_impl(ckpt_stream)
             except (SystemExit, KeyboardInterrupt):
+                raise
+            except TrainingPreempted:
+                # Not a crash: the checkpoint-on-notice already ran and
+                # its own typed event fired — no fit_exception, no
+                # flight-recorder bundle.
                 raise
             except BaseException as exc:
                 # Forensics BEFORE the raise unwinds: a structured event
@@ -710,6 +830,12 @@ class TrainingLoop:
         self._time_check_per_step = (
             self._fit_deadline is not None and jax.process_count() == 1
         )
+        # Preemption checkpoint-on-notice (serve.preempt): single-process
+        # fits answer the notice at the very next chunk boundary;
+        # multi-process fits at the same consensus boundaries max_time
+        # uses (mid-epoch val, epoch end), so every rank writes the same
+        # checkpoint and takes the same exit.
+        self._preempt_per_step = jax.process_count() == 1
         self._setup_common()
         if self._train_loader is None:
             raise RuntimeError("fit requires train_dataloader()")
@@ -900,10 +1026,18 @@ class TrainingLoop:
             val_epoch = (epoch + 1) % self.spec.check_val_every_n_epoch == 0
             last_val_step = -1
 
+            # Exact-batch resume after checkpoint-on-notice: skip the
+            # batches the preempted attempt already trained and continue
+            # the epoch where it stopped (batch_idx stays epoch-absolute
+            # so val cadences and epoch-end checks are unchanged).
+            skip = 0
+            if epoch == start_epoch:
+                skip = int(getattr(self, "_resume_batch", 0) or 0)
+                self._resume_batch = 0
             # Bound the epoch's batch pull by the step budget so the
             # stacked staging below is budget-exact: a folded chunk can
             # never overshoot max_steps (the tail arrives as singles).
-            n_iter = n_batches
+            n_iter = None if n_batches is None else max(0, n_batches - skip)
             if self.spec.max_steps is not None:
                 remaining = max(0, self.spec.max_steps - self.global_step)
                 n_iter = (
@@ -912,7 +1046,11 @@ class TrainingLoop:
                 if remaining == 0:
                     stop = True
             staged = self.strategy.stage_batches(
-                itertools.islice(self._train_loader.iter_batches(mult), n_iter),
+                itertools.islice(
+                    self._train_loader.iter_batches(mult),
+                    skip,
+                    None if n_iter is None else skip + n_iter,
+                ),
                 # Depth counts STAGING UNITS (a whole stacked chunk when
                 # folding): 3 keeps one executing + two in flight without
                 # multiplying in-flight buffers by the fold.
@@ -922,7 +1060,7 @@ class TrainingLoop:
                 # arrive as singles for the single-step executable.
                 stack=fold if fold > 1 else 0,
             )
-            batch_idx = -1
+            batch_idx = skip - 1
             # Explicit iterator so each chunk's wall time splits into the
             # three host-observable segments (obs.telemetry): data wait
             # (blocking on the staged pipeline — where device compute
@@ -996,15 +1134,27 @@ class TrainingLoop:
                         self._call_callbacks("on_validation_end")
                         last_val_step = self.global_step
                         # Every rank just finished the same val epoch: a
-                        # safe point for the max_time consensus check.
+                        # safe point for the max_time consensus check
+                        # (and the multi-process preemption consensus).
                         if self._out_of_time(synced=True):
                             self.should_stop = True
+                        if not self._preempt_per_step and (
+                            self._preempt_pending(synced=True)
+                        ):
+                            self._preempt_exit(batch_idx + 1)
                     self.telemetry.record_chunk(
                         n_chunk,
                         data_wait=t_fetch - t_pull,
                         step=t_dispatch - t_fetch,
                         drain=_time.monotonic() - t_dispatch,
                     )
+                    if self._preempt_per_step and self._preempt_pending(
+                        synced=False
+                    ):
+                        # Consume the notice NOW: a validated checkpoint
+                        # at this exact step boundary, then a clean exit
+                        # the max_restarts loop resumes from bit-exactly.
+                        self._preempt_exit(batch_idx + 1)
                     if (
                         (
                             self.spec.max_steps is not None
@@ -1075,6 +1225,11 @@ class TrainingLoop:
             # budget expiry during the val epoch in any topology).
             if self._out_of_time(synced=True):
                 self.should_stop = True
+            if not self._preempt_per_step and self._preempt_pending(
+                synced=True
+            ):
+                # Epoch-complete exit: resume starts the NEXT epoch.
+                self._preempt_exit(None)
 
         self._record_fit_throughput(mult)
         self.telemetry.fit_done = True  # the fit-stall watchdog stands down

@@ -433,12 +433,20 @@ class Trainer:
         """Run the fit stage; with ``max_restarts > 0``, worker-group
         failures (a dead actor mid-fit) relaunch the group and resume from
         the newest on-disk checkpoint (or the original ``ckpt_path``/scratch
-        when none was written yet). Checkpoints must be reachable from the
+        when none was written yet), and a PREEMPTED fit (the loop's
+        checkpoint-on-notice wrote a validated checkpoint at the step
+        boundary the notice caught, then exited cleanly) resumes from
+        exactly that checkpoint — bit-exact, losing at most the one step
+        that was in flight. Checkpoints must be reachable from the
         driver — true on single-host fits and shared filesystems; the
         reference gets the same property from Ray Tune's trial-level
         restore rather than the trainer (SURVEY.md §5 failure detection).
+        Every restart is observable: ``fit_restarting`` / ``fit_resume``
+        typed events and the ``rlt_train_fit_restarts_total{cause=}``
+        counter, next to the serving plane's recovery events.
         """
         from ray_lightning_tpu.fabric.core import ActorDiedError
+        from ray_lightning_tpu.trainer.loop import TrainingPreempted
 
         fit_started = time.time()
         attempts = self.max_restarts
@@ -447,23 +455,70 @@ class Trainer:
             try:
                 self._run("fit", module, datamodule, ckpt_path, ckpt_data)
                 return self
-            except ActorDiedError as exc:
+            except (ActorDiedError, TrainingPreempted) as exc:
                 if attempts <= 0:
                     raise
                 attempts -= 1
+                preempted = isinstance(exc, TrainingPreempted)
+                cause = "preempted" if preempted else "actor_died"
+                self._record_fit_restart(cause, exc, attempts)
                 resume, resume_data = self._restart_checkpoint(fit_started)
                 warnings.warn(
-                    f"worker died mid-fit ({exc}); restarting "
-                    f"({attempts} restart(s) left) from "
+                    (
+                        "fit preempted (checkpoint-on-notice saved); "
+                        if preempted
+                        else f"worker died mid-fit ({exc}); "
+                    )
+                    + f"restarting ({attempts} restart(s) left) from "
                     f"{resume or ckpt_path or 'scratch'}",
                     RuntimeWarning,
                     stacklevel=2,
                 )
+                if preempted:
+                    # The notice is consumed: this retry stands in for
+                    # the replacement process (a real reclamation kills
+                    # this one regardless — then the NEXT fit, in the
+                    # fresh process, resumes from the same checkpoint).
+                    from ray_lightning_tpu.serve.preempt import (
+                        reset_monitor,
+                    )
+
+                    reset_monitor()
                 if resume is not None:
                     # Reuse the validation read — no second read+unpickle.
                     ckpt_path, ckpt_data = resume, resume_data
                 else:
                     ckpt_data = None  # fall back to original ckpt_path
+                self._record_fit_resume(
+                    cause, resume or ckpt_path or "scratch"
+                )
+
+    def _record_fit_restart(
+        self, cause: str, exc: BaseException, restarts_left: int
+    ) -> None:
+        """Typed observability for the fit retry loop: training
+        recoveries must show up in /events and doctor bundles exactly
+        like serving recoveries do (not just a warnings.warn)."""
+        from ray_lightning_tpu.obs.events import get_event_log
+        from ray_lightning_tpu.obs.registry import get_registry
+
+        get_registry().counter(
+            "rlt_train_fit_restarts_total",
+            "Mid-fit restarts performed by the Trainer.fit retry loop",
+        ).inc(1, cause=cause)
+        get_event_log().record(
+            "trainer", "fit_restarting", level="warn", cause=cause,
+            error=f"{type(exc).__name__}: {exc}"[:300],
+            restarts_left=restarts_left,
+        )
+
+    @staticmethod
+    def _record_fit_resume(cause: str, ckpt: str) -> None:
+        from ray_lightning_tpu.obs.events import get_event_log
+
+        get_event_log().record(
+            "trainer", "fit_resume", cause=cause, ckpt=str(ckpt),
+        )
 
     def _ckpt_search_dirs(self) -> List[str]:
         cb = self.checkpoint_callback

@@ -195,6 +195,24 @@ def test_bench_smoke_cpu():
     assert out["extra"]["failover_requests_lost"] == 0, out["extra"]
     assert out["extra"]["failover_exact"] is True, out["extra"]
     assert out["extra"]["failover_cpu_control"] is True
+    # Preempt drain: the same kill, NOTICED — zero lost, bit-exact,
+    # requests really migrated with a warm KV handoff (survivor prefix
+    # hits from the dying replica's exported blocks), and a blackout
+    # strictly below the crash baseline (the grace window, consumed).
+    (pd_row,) = out["extra"]["preempt_drain_rows"]
+    assert pd_row["workload"] == "preempt_drain", pd_row
+    assert pd_row["requests_lost"] == 0, pd_row
+    assert pd_row["exact_vs_uninterrupted"] is True, pd_row
+    assert pd_row["migrated"] >= 1, pd_row
+    assert pd_row["kv_blocks_handed_off"] >= 1, pd_row
+    assert pd_row["warm_hit_tokens"] >= 8, pd_row
+    assert (
+        pd_row["post_death_blackout_s"]
+        < pd_row["crash_post_death_blackout_s"]
+    ), pd_row
+    assert out["extra"]["preempt_requests_lost"] == 0, out["extra"]
+    assert out["extra"]["preempt_exact"] is True, out["extra"]
+    assert out["extra"]["preempt_cpu_control"] is True
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

@@ -24,6 +24,7 @@ have_doctor=0
 have_fleet=0
 have_replay=0
 have_failover=0
+have_preempt=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -35,6 +36,7 @@ doctor_fails=0
 fleet_fails=0
 replay_fails=0
 failover_fails=0
+preempt_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -50,6 +52,7 @@ doctor_status=pending
 fleet_status=pending
 replay_status=pending
 failover_status=pending
+preempt_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -72,6 +75,7 @@ write_manifest() {
     echo "stage=fleet status=$fleet_status fails=$fleet_fails"
     echo "stage=replay status=$replay_status fails=$replay_fails"
     echo "stage=failover status=$failover_status fails=$failover_fails"
+    echo "stage=preempt status=$preempt_status fails=$preempt_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -389,6 +393,34 @@ while true; do
             have_failover=1
             failover_status=skipped
             echo "$(date -u +%H:%M:%S) failover bench SKIPPED after $failover_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_preempt" -eq 0 ]; then
+        # Stage 7e: preemption artifact — the serve sweep also carries
+        # preempt_drain (the same kill, NOTICED: preempt fault with a
+        # grace window on one of 2 replicas -> graceful drain, live
+        # migration with cross-replica KV handoff, pre-spawned
+        # replacement; zero lost, bit-exact, blackout strictly below
+        # the crash baseline), so each healthy window proves the
+        # scheduled-failure path next to the crash path.
+        echo "$(date -u +%H:%M:%S) launching PREEMPT serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/preempt_bench.json 2> /tmp/preempt_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/preempt_bench.json ] && \
+           grep -q preempt_drain /tmp/preempt_bench.json; then
+          have_preempt=1
+          preempt_status=ok
+          echo "$(date -u +%H:%M:%S) PREEMPT bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          preempt_fails=$((preempt_fails+1))
+          preempt_status=failed
+          echo "$(date -u +%H:%M:%S) preempt bench failed rc=$rc (fail $preempt_fails)" >> /tmp/tpu_watch.log
+          if [ "$preempt_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_preempt=1
+            preempt_status=skipped
+            echo "$(date -u +%H:%M:%S) preempt bench SKIPPED after $preempt_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       else
