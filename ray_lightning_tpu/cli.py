@@ -392,6 +392,7 @@ _SERVE_KEYS = frozenset((
     "prefill_buckets", "max_prefills_per_step", "decode_fold",
     "pipeline", "prefill_chunk", "prefix_cache", "prefix_block",
     "prefix_host_mb", "prefix_disk_dir", "prefix_disk_mb",
+    "kv_page", "kv_pages",
     "max_prefill_chunks_per_step", "priority_age_s",
     "spec", "spec_depth", "spec_draft_ckpt", "spec_draft_config",
     "spec_draft_int8", "spec_window",
@@ -593,6 +594,18 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         (.npy block files under the directory, default budget 1024
         MiB) absorbing host-tier evictions. Tier traffic lands in
         rlt_serve_prefix_*_total{tier=} and stats prefix.tiers.
+      kv_pages / kv_page: paged KV (block-table attention) — kv_pages
+        arms it and sets the page budget, kv_page the tokens per page
+        (default 16; must divide max_seq). KV capacity becomes the
+        token budget kv_pages x kv_page instead of slots x max_seq, a
+        prefix hit aliases cached pages copy-free (refcounted; the
+        prefix cache and slot KV share ONE allocator, so
+        prefix_cache must stay off), spill tiers and preemption
+        handoff operate on the same pages, and admission parks when
+        pages run out instead of deadlocking. Greedy output stays
+        bit-identical to the dense engine; pool state lands in
+        rlt_serve_kv_pages{state=} and stats kv_pages. Leave unset
+        for the dense cache.
       priority_age_s: queued requests age toward priority 0 at this rate
         (seconds per priority level); unset = strict priority order.
       spec: speculative decoding — "off" (default), "ngram" (in-graph
@@ -839,6 +852,46 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     replica_kwargs["prefix_disk_mb"] = float(
         serve_cfg.pop("prefix_disk_mb", 0.0)
     )
+    # Paged KV: --serve.kv_pages arms block-table attention (capacity =
+    # kv_pages * kv_page tokens instead of slots * max_seq);
+    # --serve.kv_page sets the page size (default 16). Validated up
+    # front: the page budget must be real, the page size must be a
+    # token count, and the DENSE prefix cache cannot ride along — the
+    # paged allocator IS the prefix cache (copy-free aliasing), so a
+    # combined config would silently double-provision; reject it loudly
+    # instead.
+    kv_pages = serve_cfg.pop("kv_pages", None)
+    kv_page = serve_cfg.pop("kv_page", None)
+    if kv_pages is not None:
+        kv_pages = int(kv_pages)
+        if kv_pages < 2:
+            raise ValueError(
+                f"--serve.kv_pages {kv_pages} is not a usable page "
+                "budget: need >= 2 (one scratch page + at least one "
+                "real page; the engine additionally requires the "
+                "budget to hold one max_seq-length request)"
+            )
+        replica_kwargs["kv_pages"] = kv_pages
+    if kv_page is not None:
+        kv_page = int(kv_page)
+        if kv_page < 1:
+            raise ValueError(
+                f"--serve.kv_page {kv_page} must be >= 1 (tokens per "
+                "KV page; it must also divide the engine's max_seq)"
+            )
+        if kv_pages is None:
+            raise ValueError(
+                "--serve.kv_page needs --serve.kv_pages (the paged-KV "
+                "page budget); dense mode takes neither"
+            )
+        replica_kwargs["kv_page"] = kv_page
+    if kv_pages and replica_kwargs.get("prefix_blocks"):
+        raise ValueError(
+            "--serve.kv_pages (paged KV) unifies the prefix pool into "
+            "the page allocator — prefix sharing is built in and "
+            "copy-free; drop --serve.prefix_cache/--serve.prefix_block "
+            "(tune the page size with --serve.kv_page instead)"
+        )
     pb = serve_cfg.pop("prefill_buckets", None)
     if pb is not None:
         replica_kwargs["prefill_buckets"] = [int(b) for b in pb]
@@ -1189,7 +1242,8 @@ def render_fleet(payload: Dict[str, Any]) -> str:
         (
             f"{'replica':>7} {'health':>9} {'queue':>5} {'slots':>7} "
             f"{'tok/s':>9} {'ttft_p50':>9} {'ttft_p95':>9} "
-            f"{'accept':>7} {'hit':>6} {'hit d/h/k':>14} {'goodput':>9}"
+            f"{'accept':>7} {'hit':>6} {'hit d/h/k':>14} "
+            f"{'pages f/r/a':>12} {'goodput':>9}"
         ),
     ]
     for r in rows:
@@ -1202,6 +1256,17 @@ def render_fleet(payload: Dict[str, Any]) -> str:
                 th.get("disk", 0.0),
             )
             if th
+            else None
+        )
+        # Paged KV pool: free/resident/aliased pages — "-" on dense
+        # replicas.
+        kvp = r.get("kv_pages") or {}
+        page_cell = (
+            "{}/{}/{}".format(
+                kvp.get("free", 0), kvp.get("resident", 0),
+                kvp.get("aliased", 0),
+            )
+            if kvp
             else None
         )
         out.append(
@@ -1217,6 +1282,7 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{_fmt_cell(r.get('spec_accept_rate'), 7, 2)} "
             f"{_fmt_cell(r.get('prefix_hit_rate'), 6, 2)} "
             f"{_fmt_cell(tier_cell, 14)} "
+            f"{_fmt_cell(page_cell, 12)} "
             f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)}"
         )
     if fleet:

@@ -1472,10 +1472,18 @@ def gpt_decode_fold(
     v_cache: jax.Array,
     *,
     fold: int,
+    page_table: Optional[jax.Array] = None,
+    page_size: int = 0,
 ) -> Tuple[jax.Array, ...]:
     """``fold`` decode+sample iterations in ONE traced program (a
     ``lax.scan`` over :func:`gpt_decode_step`) with per-slot in-graph
     termination — the serving engine's folded hot loop.
+
+    With ``page_table`` set, ``k_cache``/``v_cache`` are the PAGE POOLS
+    (L, P, page_size, Hkv, hd) and each iteration runs
+    :func:`gpt_decode_step_paged` instead — gather, identical dense
+    math, scatter — so the paged fold is bit-identical to the dense one
+    whenever the pages hold what the dense rows would.
 
     Per-slot state: ``cur``/``pos`` (B,) int32, ``keys`` (B, 2) uint32,
     sampling knobs as in :func:`sample_logits_batched`, ``active`` (B,)
@@ -1497,9 +1505,15 @@ def gpt_decode_fold(
 
     def body(carry, _):
         cur, pos, keys, active, remaining, k_cache, v_cache = carry
-        logits, k_cache, v_cache = gpt_decode_step(
-            params, cfg, cur, pos, k_cache, v_cache
-        )
+        if page_table is None:
+            logits, k_cache, v_cache = gpt_decode_step(
+                params, cfg, cur, pos, k_cache, v_cache
+            )
+        else:
+            logits, k_cache, v_cache = gpt_decode_step_paged(
+                params, cfg, cur, pos, k_cache, v_cache, page_table,
+                page_size,
+            )
         split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
         new_keys, subs = split[:, 0], split[:, 1]
         toks = sample_logits_batched(subs, logits, temps, top_ks, top_ps)
@@ -1695,6 +1709,158 @@ def gpt_decode_verify(
     return logits, k_cache, v_cache
 
 
+# ---------------------------------------------------------------------------
+# Paged KV: block-table attention over a shared page pool
+# ---------------------------------------------------------------------------
+# The serving engine's paged mode replaces each slot's dense (S, Hkv, hd)
+# cache strip with a PAGE TABLE: ``table[b, i]`` names the pool page that
+# holds positions ``[i * page, (i + 1) * page)`` of slot ``b``. Attention
+# gathers the slot's pages back into the dense layout IN-GRAPH and runs
+# the exact same math — a gather is a copy, so the paged paths are
+# bit-identical to the dense ones by construction — and writes scatter
+# back through the table. Pool page 0 is a reserved SCRATCH page: table
+# entries of released/unallocated ranges point there, so the dense
+# paths' harmless garbage writes (frozen slots, padded rows) land in a
+# page nobody ever reads instead of corrupting a reused page.
+
+
+def paged_gather(
+    pool: jax.Array, table: jax.Array, page: int
+) -> jax.Array:
+    """Dense view of each slot's paged cache: ``pool`` (L, P, page, Hkv,
+    hd) gathered through ``table`` (B, n) into (L, B, n * page, Hkv,
+    hd). A pure gather — the view's bytes equal the dense cache's bytes
+    whenever the pages hold what the dense rows would, which is the
+    paged engine's core invariant."""
+    L, _, pg, Hkv, hd = pool.shape
+    B, n = table.shape
+    v = jnp.take(pool, table.reshape(-1), axis=1)
+    return v.reshape(L, B, n * pg, Hkv, hd)
+
+
+def paged_put_rows(
+    pool: jax.Array,
+    table: jax.Array,
+    rows: jax.Array,
+    vals: jax.Array,
+    valid: jax.Array,
+    page: int,
+) -> jax.Array:
+    """Scatter per-slot cache rows back into the pool: ``rows`` (B, R)
+    absolute positions, ``vals`` (L, B, R, Hkv, hd), ``valid`` (B, R).
+    Invalid rows (padding, positions past the view) are redirected to
+    the scratch page (pool index 0) — written but never read, matching
+    the dense paths where such rows are either unwritten or invisible
+    behind the position masks."""
+    n = table.shape[1]
+    rows_cl = jnp.clip(rows, 0, n * page - 1)
+    pidx = jnp.take_along_axis(table, rows_cl // page, axis=1)
+    pidx = jnp.where(valid, pidx, 0)
+    off = jnp.where(valid, rows_cl % page, 0)
+    return pool.at[:, pidx, off].set(vals)
+
+
+def gpt_decode_step_paged(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    cur: jax.Array,
+    pos: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    table: jax.Array,
+    page: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`gpt_decode_step` over a paged cache: gather each slot's
+    pages into the dense (L, B, S, Hkv, hd) layout, run the UNCHANGED
+    dense step (bit-identical logits), and scatter the one written row
+    per slot (position ``clip(pos, S-1)`` — the same clamp the dense
+    ``dynamic_update_slice`` applies) back to its page."""
+    S = table.shape[1] * int(page)
+    k_view = paged_gather(pool_k, table, page)
+    v_view = paged_gather(pool_v, table, page)
+    logits, k_view, v_view = gpt_decode_step(
+        params, cfg, cur, pos, k_view, v_view
+    )
+    p = jnp.clip(pos, 0, S - 1)
+    idx = p[None, :, None, None, None]
+    kvals = jnp.take_along_axis(k_view, idx, axis=2)
+    vvals = jnp.take_along_axis(v_view, idx, axis=2)
+    rows = p[:, None]
+    valid = jnp.ones_like(rows, jnp.bool_)
+    pool_k = paged_put_rows(pool_k, table, rows, kvals, valid, page)
+    pool_v = paged_put_rows(pool_v, table, rows, vvals, valid, page)
+    return logits, pool_k, pool_v
+
+
+def gpt_decode_verify_paged(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    toks: jax.Array,
+    pos: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    table: jax.Array,
+    page: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`gpt_decode_verify` over a paged cache: gather, run the
+    unchanged dense verify (its own masked writes into the view make the
+    within-verify attention exact), and scatter rows ``[pos, pos + Q)``
+    back — rows past the view end are dropped exactly like the dense
+    masked row-gather drops them."""
+    Q = toks.shape[1]
+    S = table.shape[1] * int(page)
+    k_view = paged_gather(pool_k, table, page)
+    v_view = paged_gather(pool_v, table, page)
+    logits, k_view, v_view = gpt_decode_verify(
+        params, cfg, toks, pos, k_view, v_view
+    )
+    rows = pos[:, None] + jnp.arange(Q, dtype=jnp.int32)[None]  # (B, Q)
+    valid = rows < S
+    cl = jnp.clip(rows, 0, S - 1)
+    idx = cl[None, :, :, None, None]
+    kvals = jnp.take_along_axis(k_view, idx, axis=2)
+    vvals = jnp.take_along_axis(v_view, idx, axis=2)
+    pool_k = paged_put_rows(pool_k, table, rows, kvals, valid, page)
+    pool_v = paged_put_rows(pool_v, table, rows, vvals, valid, page)
+    return logits, pool_k, pool_v
+
+
+def gpt_prefill_chunk_paged(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    chunk: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    table_row: jax.Array,
+    start_pos: jax.Array,
+    true_len: jax.Array,
+    *,
+    page: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`gpt_prefill_chunk` for one paged slot: ``table_row``
+    (1, n) is that slot's page table. Gather the slot's view, run the
+    unchanged dense chunk, scatter rows ``[start_pos, start_pos +
+    true_len)`` back (padded rows redirect to scratch — the dense path
+    never writes them)."""
+    C = chunk.shape[1]
+    S = table_row.shape[1] * int(page)
+    k_view = paged_gather(pool_k, table_row, page)
+    v_view = paged_gather(pool_v, table_row, page)
+    h, k_view, v_view = gpt_prefill_chunk(
+        params, cfg, chunk, k_view, v_view, start_pos, true_len
+    )
+    offs = jnp.arange(C, dtype=jnp.int32)
+    rows = (jnp.asarray(start_pos, jnp.int32) + offs)[None]  # (1, C)
+    valid = (offs < jnp.asarray(true_len, jnp.int32))[None] & (rows < S)
+    cl = jnp.clip(rows, 0, S - 1)
+    idx = cl[None, :, :, None, None]
+    kvals = jnp.take_along_axis(k_view, idx, axis=2)
+    vvals = jnp.take_along_axis(v_view, idx, axis=2)
+    pool_k = paged_put_rows(pool_k, table_row, rows, kvals, valid, page)
+    pool_v = paged_put_rows(pool_v, table_row, rows, vvals, valid, page)
+    return h, pool_k, pool_v
+
+
 def ngram_propose(
     hist: jax.Array,
     pos: jax.Array,
@@ -1810,6 +1976,8 @@ def gpt_decode_fold_spec(
     fold: int,
     depth: int,
     draft_fn: Any,
+    page_table: Optional[jax.Array] = None,
+    page_size: int = 0,
 ) -> Tuple[jax.Array, ...]:
     """Speculative :func:`gpt_decode_fold`: each of the ``fold``
     iterations proposes up to ``depth`` tokens per slot (``draft_fn``),
@@ -1847,9 +2015,15 @@ def gpt_decode_fold_spec(
         hist = _hist_write_at(hist, pos, cur)
         drafts = draft_fn(hist, pos, cur)  # (B, D)
         toks_in = jnp.concatenate([cur[:, None], drafts], axis=1)
-        logits, k_cache, v_cache = gpt_decode_verify(
-            params, cfg, toks_in, pos, k_cache, v_cache
-        )
+        if page_table is None:
+            logits, k_cache, v_cache = gpt_decode_verify(
+                params, cfg, toks_in, pos, k_cache, v_cache
+            )
+        else:
+            logits, k_cache, v_cache = gpt_decode_verify_paged(
+                params, cfg, toks_in, pos, k_cache, v_cache, page_table,
+                page_size,
+            )
         pos0 = pos
         # Drafts padded with a -1 sentinel at the bonus index: the last
         # sampled token has no draft to match, so the chain always stops

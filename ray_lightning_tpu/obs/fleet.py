@@ -76,6 +76,19 @@ def summarize_replica(
         "spec_accept_rate": stats.get("spec_accept_rate"),
         "prefix_hit_rate": stats.get("prefix_hit_rate"),
         "prefix_tier_hit_rate": tier_hit,
+        # Paged KV: pool state + occupancy (None on dense replicas) —
+        # the capacity signal a page-aware router/autoscaler reads.
+        "kv_pages": (
+            {
+                k: kv[k]
+                for k in (
+                    "free", "resident", "aliased", "occupancy",
+                    "fragmentation_tokens",
+                )
+            }
+            if isinstance(kv := stats.get("kv_pages"), dict)
+            else None
+        ),
         "submitted": int(stats.get("submitted", 0)),
         "finished": int(stats.get("finished", 0)),
         "compiles_since_init": int(stats.get("compiles_since_init", 0)),

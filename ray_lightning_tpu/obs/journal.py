@@ -98,8 +98,20 @@ def engine_header(
             "decode_fold": engine.decode_fold,
             "pipeline": engine.pipeline,
             "prefill_chunk": engine.prefill_chunk,
-            "prefix_blocks": engine.prefix_blocks,
-            "prefix_block": engine.prefix_block,
+            # Paged engines fold the prefix pool into the page allocator:
+            # record the PAGED knobs and zero the prefix ones (the engine
+            # rejects the combination, and a replay must rebuild the
+            # same paged config — page size shapes alias/evict behavior).
+            "prefix_blocks": (
+                0 if getattr(engine, "paged", False)
+                else engine.prefix_blocks
+            ),
+            "prefix_block": (
+                16 if getattr(engine, "paged", False)
+                else engine.prefix_block
+            ),
+            "kv_page": getattr(engine, "kv_page", 0),
+            "kv_pages": getattr(engine, "kv_pages", 0),
             # Tiered prefix-cache knobs: a replay must rebuild the same
             # tier config — hit/miss/spill decisions shape admission
             # timing, and a recorded host-tier hit should hit on replay.
@@ -426,7 +438,8 @@ def incomplete_requests(journal: Dict[str, Any]) -> List[Dict[str, Any]]:
 _ENGINE_REBUILD_KEYS = frozenset((
     "num_slots", "max_seq", "prefill_buckets", "decode_fold", "pipeline",
     "prefill_chunk", "prefix_blocks", "prefix_block", "prefix_host_mb",
-    "prefix_disk_dir", "prefix_disk_mb", "spec", "spec_depth",
+    "prefix_disk_dir", "prefix_disk_mb", "kv_page", "kv_pages",
+    "spec", "spec_depth",
     "spec_window", "spec_draft_ckpt", "spec_draft_config",
     "spec_draft_int8", "mesh",
 ))

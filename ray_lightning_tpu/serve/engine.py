@@ -263,6 +263,8 @@ class DecodeEngine:
         prefix_host_mb: float = 0.0,
         prefix_disk_dir: Optional[str] = None,
         prefix_disk_mb: float = 0.0,
+        kv_page: int = 0,
+        kv_pages: int = 0,
         spec: str = "off",
         spec_depth: int = 4,
         spec_params: Any = None,
@@ -299,11 +301,60 @@ class DecodeEngine:
                 f"max_seq {self.max_seq}"
             )
         self.prefill_buckets = buckets
-        # Chunked-prefill mode: prefill_chunk > 0 (or any prefix pool —
-        # suffix-only prefill needs the cache-seeded chunk path). Chunk
-        # lengths are bucketed like prompts, so compiles stay per-bucket.
-        self.prefix_blocks = int(prefix_blocks)
-        self.prefix_block = int(prefix_block)
+        # Paged KV (kv_pages > 0): the dense per-slot KV strips and the
+        # prefix pool UNIFY into one refcounted page pool — slots hold
+        # page-index tables into it, attention gathers pages in-graph,
+        # a prefix hit is a table alias (refcount bump, zero copy), and
+        # capacity becomes the token budget kv_pages * kv_page instead
+        # of slots * max_seq. Pool page 0 is the reserved scratch page
+        # (released slots' tables point there, absorbing the dense
+        # paths' harmless garbage writes). Validated before anything is
+        # placed or compiled, with errors naming the valid ranges.
+        self.kv_pages = int(kv_pages)
+        self.kv_page = int(kv_page) if kv_page else (16 if kv_pages else 0)
+        self.paged = self.kv_pages > 0
+        if kv_page and not self.paged:
+            raise ValueError(
+                "kv_page needs kv_pages > 0 (the paged-KV page budget); "
+                "the dense engine takes neither"
+            )
+        if self.paged:
+            if prefix_blocks:
+                raise ValueError(
+                    "paged KV (kv_pages > 0) unifies the prefix pool "
+                    "into the page allocator — prefix sharing is built "
+                    "in and keyed per kv_page-sized page; drop "
+                    "prefix_blocks/prefix_block"
+                )
+            if not 1 <= self.kv_page <= self.max_seq or (
+                self.max_seq % self.kv_page
+            ):
+                raise ValueError(
+                    f"kv_page {self.kv_page} must divide the bucket "
+                    f"sizes: a divisor of max_seq {self.max_seq} in "
+                    f"[1, {self.max_seq}]"
+                )
+            min_pages = self.max_seq // self.kv_page + 1
+            if self.kv_pages < min_pages:
+                raise ValueError(
+                    f"kv_pages {self.kv_pages} cannot hold one "
+                    f"max-length request: need >= {min_pages} "
+                    f"(max_seq {self.max_seq} / kv_page {self.kv_page} "
+                    "+ the reserved scratch page)"
+                )
+        # Chunked-prefill mode: prefill_chunk > 0 (or any prefix pool /
+        # paged KV — suffix-only prefill needs the cache-seeded chunk
+        # path). Chunk lengths are bucketed like prompts, so compiles
+        # stay per-bucket.
+        if self.paged:
+            # The unified pool rides the existing prefix-pool machinery:
+            # the digest map, LRU, refcounts, spill tiers, and handoff
+            # all operate on kv_page-sized pages.
+            self.prefix_blocks = self.kv_pages
+            self.prefix_block = self.kv_page
+        else:
+            self.prefix_blocks = int(prefix_blocks)
+            self.prefix_block = int(prefix_block)
         if self.prefix_blocks and not prefill_chunk:
             prefill_chunk = buckets[-1]
         self.prefill_chunk = int(prefill_chunk)
@@ -476,8 +527,19 @@ class DecodeEngine:
         cdt = jnp.dtype(config.compute_dtype)
         L, Hkv, hd = config.n_layer, config.kv_head, config.head_dim
         B, S = self.num_slots, self.max_seq
-        self._k = self._dfull((L, B, S, Hkv, hd), cdt, self._cache_sh)
-        self._v = self._dfull((L, B, S, Hkv, hd), cdt, self._cache_sh)
+        if self.paged:
+            # No dense slot strips: the page pool below IS the KV cache,
+            # and each slot's view of it is its page table row — zeros
+            # (the scratch page) until admission allocates real pages.
+            self._k = None
+            self._v = None
+            self._table = self._dfull(
+                (B, S // self.kv_page), jnp.int32, self._rep_sh
+            )
+        else:
+            self._k = self._dfull((L, B, S, Hkv, hd), cdt, self._cache_sh)
+            self._v = self._dfull((L, B, S, Hkv, hd), cdt, self._cache_sh)
+            self._table = None
         # Prefix pool: device-resident K/V blocks + host digest map/LRU.
         if self.prefix_blocks:
             self._pool_k = self._dfull(
@@ -490,8 +552,25 @@ class DecodeEngine:
             )
         self._pool_map: Dict[bytes, int] = {}
         self._pool_meta: List[Optional[_PoolBlock]] = [None] * self.prefix_blocks
-        self._pool_free: List[int] = list(range(self.prefix_blocks))
+        # Paged mode reserves pool page 0 as the scratch sink — never
+        # allocated, never read; its meta stays None forever.
+        self._pool_free: List[int] = list(
+            range(1 if self.paged else 0, self.prefix_blocks)
+        )
         self._pool_tick = 0
+        #: Paged bookkeeping: per-slot page lists (table entries that
+        #: are real, aliased prefix pages first), the token span each
+        #: slot's allocation must cover (min(P + new, S - 1) + 1 — the
+        #: fragmentation stat's denominator), and the QUARANTINE of
+        #: freed private pages that the one in-flight fold (dispatched
+        #: before their slot's table reset) may still scribble —
+        #: recycled only after that fold's harvest has synced.
+        self._slot_pages: List[List[int]] = [[] for _ in range(self.num_slots)]
+        self._slot_span: List[int] = [0] * self.num_slots
+        self._quarantine: List[int] = []
+        self.page_allocs = 0
+        self.page_frees = 0
+        self.page_alias_hits = 0
         self.prefix_lookups = 0
         self.prefix_hit_tokens = 0
         self.prefix_prompt_tokens = 0
@@ -783,7 +862,7 @@ class DecodeEngine:
                 upd(eos_toks, eos_v),
             )
 
-        cache_spec = spec(self._k)
+        cache_spec = spec(self._k) if self._k is not None else None
         state_specs = (
             spec(self._cur),
             spec(self._pos),
@@ -923,8 +1002,130 @@ class DecodeEngine:
             )
             return pool_k, pool_v, k_cache, v_cache
 
+        # -- paged-KV impls: block-table attention over the page pool ----
+        # The chunk/step bodies run the UNCHANGED dense math over an
+        # in-graph page gather (models/gpt.py paged primitives), so the
+        # paged engine is bit-identical to the dense one by construction;
+        # only the cache plumbing (pool + table instead of slot strips)
+        # differs. The table is a read-only input here — it mutates only
+        # through the tiny table-write executable below.
+        page = self.kv_page
+
+        def chunk_paged_impl(
+            params, pool_k, pool_v, table, cur, pos, temps, top_ks,
+            top_ps, keys, active, remaining, eos_toks, chunk, start,
+            true_len, slot, key0, temp, tk, tp, n_new, eos, is_final,
+        ):
+            from ray_lightning_tpu.models.gpt import gpt_prefill_chunk_paged
+
+            trow = jax.lax.dynamic_slice(
+                table, (slot, 0), (1, table.shape[1])
+            )
+            h, pool_k, pool_v = gpt_prefill_chunk_paged(
+                params, cfg, chunk, pool_k, pool_v, trow, start,
+                true_len, page=page,
+            )
+            h_last = jax.lax.dynamic_slice_in_dim(h, true_len - 1, 1, axis=1)
+            h_last = norm_fn(h_last, params["lnf_g"], params["lnf_b"])[:, 0]
+            logits = _lm_head(h_last, _head_weight(params, cfg))
+            key, sub = jax.random.split(key0)
+            tok = sample_logits_batched(
+                sub[None], logits, temp[None], tk[None], tp[None]
+            )[0]
+            live = is_final & (n_new > 1) & (tok != eos)
+            end = start + true_len
+
+            def upd(arr, v):
+                return jax.lax.dynamic_update_index_in_dim(arr, v, slot, 0)
+
+            return (
+                pool_k,
+                pool_v,
+                upd(cur, jnp.where(is_final, tok, 0)),
+                upd(pos, end),
+                upd(temps, temp),
+                upd(top_ks, tk),
+                upd(top_ps, tp),
+                upd(keys, jnp.where(is_final, key, key0)),
+                upd(active, live),
+                upd(remaining, jnp.where(is_final, n_new - 1, 0)),
+                upd(eos_toks, eos),
+                tok,
+            )
+
+        def chunk_paged_spec_impl(
+            params, pool_k, pool_v, table, cur, pos, temps, top_ks,
+            top_ps, keys, active, remaining, eos_toks, hist, chunk,
+            start, true_len, slot, key0, temp, tk, tp, n_new, eos,
+            is_final,
+        ):
+            # chunk_paged_impl plus the token-history heal (identical to
+            # chunk_spec_impl's — the history stays dense either way).
+            out = chunk_paged_impl(
+                params, pool_k, pool_v, table, cur, pos, temps, top_ks,
+                top_ps, keys, active, remaining, eos_toks, chunk, start,
+                true_len, slot, key0, temp, tk, tp, n_new, eos, is_final,
+            )
+            S_ = hist.shape[1]
+            rows_ = jnp.arange(S_, dtype=jnp.int32)
+            hidx = rows_ - start
+            hvalid = (hidx >= 0) & (hidx < true_len)
+            vals = chunk[0][jnp.clip(hidx, 0, chunk.shape[1] - 1)]
+            old = jax.lax.dynamic_slice(hist, (slot, 0), (1, S_))
+            new = jnp.where(hvalid[None], vals[None], old)
+            hist = jax.lax.dynamic_update_slice(hist, new, (slot, 0))
+            return out + (hist,)
+
+        def step_paged_impl(
+            params, pool_k, pool_v, table, cur, pos, temps, top_ks,
+            top_ps, keys, active, remaining, eos_toks,
+        ):
+            return gpt_decode_fold(
+                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                active, remaining, eos_toks, pool_k, pool_v,
+                fold=self.decode_fold, page_table=table, page_size=page,
+            )
+
+        def step_paged_spec_impl(
+            params, pool_k, pool_v, table, cur, pos, temps, top_ks,
+            top_ps, keys, active, remaining, eos_toks, hist,
+        ):
+            return gpt_decode_fold_spec(
+                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                active, remaining, eos_toks, hist, pool_k, pool_v,
+                fold=self.decode_fold, depth=self.spec_depth,
+                draft_fn=lambda h, p, c: ngram_propose(
+                    h, p, c, depth=self.spec_depth
+                ),
+                page_table=table, page_size=page,
+            )
+
+        def step_paged_spec_model_impl(
+            params, dparams, pool_k, pool_v, table, cur, pos, temps,
+            top_ks, top_ps, keys, active, remaining, eos_toks, hist,
+        ):
+            return gpt_decode_fold_spec(
+                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                active, remaining, eos_toks, hist, pool_k, pool_v,
+                fold=self.decode_fold, depth=self.spec_depth,
+                draft_fn=lambda h, p, c: model_propose(
+                    dparams, self._spec_cfg, h, p, c,
+                    depth=self.spec_depth, window=self.spec_window,
+                ),
+                page_table=table, page_size=page,
+            )
+
+        def table_write_impl(table, slot, row):
+            # One slot's whole page-table row in one tiny executable —
+            # admission (real pages) and release (all-scratch) share it,
+            # so table changes never recompile and always queue in
+            # donation order behind any in-flight fold.
+            return jax.lax.dynamic_update_slice(table, row, (slot, 0))
+
         spec_on = self.spec != "off"
         hist_spec = spec(self._hist) if spec_on else None
+        paged = self.paged
+        table_spec = spec(self._table) if paged else None
         self._admit_exec: Dict[int, Any] = {}
         self._chunk_exec: Dict[int, Any] = {}
         if self.chunked:
@@ -932,65 +1133,111 @@ class DecodeEngine:
             # machine exclusively — one executable per CHUNK bucket
             # replaces the per-prompt-bucket fused admits. With spec on
             # the chunk executable also heals its token-history range.
-            admit_out = None
-            if mesh_on:
-                admit_out = (cache_out, cache_out) + state_out + (rep_sh,)
-            for cb in self.chunk_buckets:
-                chunk_tok_spec = jax.ShapeDtypeStruct(
-                    (1, cb), np.int32, sharding=sc_sh
+            if paged:
+                pool_spec = spec(self._pool_k)
+                admit_out = None
+                if mesh_on:
+                    admit_out = (
+                        (pool_out, pool_out) + state_out + (rep_sh,)
+                    )
+                scalar_tail = (
+                    i32, i32, i32, key_spec, f32, i32, f32, i32, i32, b1,
                 )
-                if spec_on:
-                    self._chunk_exec[cb] = (
-                        jit_exec(
-                            chunk_spec_impl,
-                            tuple(range(1, 13)),
-                            admit_out + (rep_sh,) if mesh_on else None,
-                        )
-                        .lower(
-                            p_spec,
-                            cache_spec,
-                            cache_spec,
-                            *state_specs,
-                            hist_spec,
-                            chunk_tok_spec,
-                            i32,
-                            i32,
-                            i32,
-                            key_spec,
-                            f32,
-                            i32,
-                            f32,
-                            i32,
-                            i32,
-                            b1,
-                        )
-                        .compile()
+                for cb in self.chunk_buckets:
+                    chunk_tok_spec = jax.ShapeDtypeStruct(
+                        (1, cb), np.int32, sharding=sc_sh
                     )
-                else:
-                    self._chunk_exec[cb] = (
-                        jit_exec(
-                            chunk_impl, tuple(range(1, 12)), admit_out
+                    if spec_on:
+                        self._chunk_exec[cb] = (
+                            jit_exec(
+                                chunk_paged_spec_impl,
+                                (1, 2) + tuple(range(4, 14)),
+                                admit_out + (rep_sh,) if mesh_on else None,
+                            )
+                            .lower(
+                                p_spec, pool_spec, pool_spec, table_spec,
+                                *state_specs, hist_spec, chunk_tok_spec,
+                                *scalar_tail,
+                            )
+                            .compile()
                         )
-                        .lower(
-                            p_spec,
-                            cache_spec,
-                            cache_spec,
-                            *state_specs,
-                            chunk_tok_spec,
-                            i32,
-                            i32,
-                            i32,
-                            key_spec,
-                            f32,
-                            i32,
-                            f32,
-                            i32,
-                            i32,
-                            b1,
+                    else:
+                        self._chunk_exec[cb] = (
+                            jit_exec(
+                                chunk_paged_impl,
+                                (1, 2) + tuple(range(4, 13)),
+                                admit_out,
+                            )
+                            .lower(
+                                p_spec, pool_spec, pool_spec, table_spec,
+                                *state_specs, chunk_tok_spec,
+                                *scalar_tail,
+                            )
+                            .compile()
                         )
-                        .compile()
+                    self.compiled_count += 1
+            else:
+                admit_out = None
+                if mesh_on:
+                    admit_out = (
+                        (cache_out, cache_out) + state_out + (rep_sh,)
                     )
-                self.compiled_count += 1
+                for cb in self.chunk_buckets:
+                    chunk_tok_spec = jax.ShapeDtypeStruct(
+                        (1, cb), np.int32, sharding=sc_sh
+                    )
+                    if spec_on:
+                        self._chunk_exec[cb] = (
+                            jit_exec(
+                                chunk_spec_impl,
+                                tuple(range(1, 13)),
+                                admit_out + (rep_sh,) if mesh_on else None,
+                            )
+                            .lower(
+                                p_spec,
+                                cache_spec,
+                                cache_spec,
+                                *state_specs,
+                                hist_spec,
+                                chunk_tok_spec,
+                                i32,
+                                i32,
+                                i32,
+                                key_spec,
+                                f32,
+                                i32,
+                                f32,
+                                i32,
+                                i32,
+                                b1,
+                            )
+                            .compile()
+                        )
+                    else:
+                        self._chunk_exec[cb] = (
+                            jit_exec(
+                                chunk_impl, tuple(range(1, 12)), admit_out
+                            )
+                            .lower(
+                                p_spec,
+                                cache_spec,
+                                cache_spec,
+                                *state_specs,
+                                chunk_tok_spec,
+                                i32,
+                                i32,
+                                i32,
+                                key_spec,
+                                f32,
+                                i32,
+                                f32,
+                                i32,
+                                i32,
+                                b1,
+                            )
+                            .compile()
+                        )
+                    self.compiled_count += 1
         else:
             admit_out = None
             if mesh_on:
@@ -1021,6 +1268,10 @@ class DecodeEngine:
                 self.compiled_count += 1
         if self.prefix_blocks:
             pool_spec = spec(self._pool_k)
+        if self.prefix_blocks and not paged:
+            # Paged mode has no pool->slot copy at all: a prefix hit is
+            # a table alias (refcount bump), the copy-free path this
+            # executable existed to approximate.
             self._copy_exec = (
                 jit_exec(
                     copy_impl,
@@ -1101,9 +1352,52 @@ class DecodeEngine:
         step_out = None
         step_spec_out = None
         if mesh_on:
-            step_out = (rep_sh,) * 7 + (cache_out, cache_out)
-            step_spec_out = (rep_sh,) * 8 + (cache_out, cache_out)
-        if not spec_on:
+            tail = (pool_out, pool_out) if paged else (cache_out, cache_out)
+            step_out = (rep_sh,) * 7 + tail
+            step_spec_out = (rep_sh,) * 8 + tail
+        if paged:
+            # Paged fold: the pools + the (read-only) page table replace
+            # the dense caches; donation covers pools + in-graph state.
+            if not spec_on:
+                self._step_exec = (
+                    jit_exec(
+                        step_paged_impl, (1, 2, 4, 5, 9, 10, 11), step_out
+                    )
+                    .lower(p_spec, pool_spec, pool_spec, table_spec,
+                           *state_specs)
+                    .compile()
+                )
+            elif self.spec == "ngram":
+                self._step_exec = (
+                    jit_exec(
+                        step_paged_spec_impl,
+                        (1, 2, 4, 5, 9, 10, 11, 13),
+                        step_spec_out,
+                    )
+                    .lower(p_spec, pool_spec, pool_spec, table_spec,
+                           *state_specs, hist_spec)
+                    .compile()
+                )
+            else:
+                dp_spec = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        a.shape,
+                        a.dtype,
+                        sharding=a.sharding if mesh_on else None,
+                    ),
+                    self._spec_params,
+                )
+                self._step_exec = (
+                    jit_exec(
+                        step_paged_spec_model_impl,
+                        (2, 3, 5, 6, 10, 11, 12, 14),
+                        step_spec_out,
+                    )
+                    .lower(p_spec, dp_spec, pool_spec, pool_spec,
+                           table_spec, *state_specs, hist_spec)
+                    .compile()
+                )
+        elif not spec_on:
             self._step_exec = (
                 jit_exec(step_impl, (1, 2, 3, 4, 8, 9, 10), step_out)
                 .lower(p_spec, cache_spec, cache_spec, *state_specs)
@@ -1140,6 +1434,19 @@ class DecodeEngine:
                 .compile()
             )
         self.compiled_count += 1
+        if paged:
+            self._table_write_exec = (
+                jit_exec(table_write_impl, (0,), rep_sh if mesh_on else None)
+                .lower(
+                    table_spec,
+                    i32,
+                    jax.ShapeDtypeStruct(
+                        (1, self._table.shape[1]), np.int32, sharding=sc_sh
+                    ),
+                )
+                .compile()
+            )
+            self.compiled_count += 1
         if spec_on:
             self._hist_write_exec = (
                 jit_exec(hist_write_impl, (0,), rep_sh if mesh_on else None)
@@ -1203,6 +1510,119 @@ class DecodeEngine:
             self._hist, np.int32(slot), row, np.int32(len(prompt))
         )
 
+    # -- paged-KV plumbing -------------------------------------------------
+    def _table_write(self, slot: int, pages: Sequence[int]) -> None:
+        """Rewrite one slot's page-table row: ``pages`` fill the leading
+        entries, the rest point at the scratch page (0). One compiled
+        dispatch, queued after any in-flight fold (donation order)."""
+        row = np.zeros((1, self._table.shape[1]), np.int32)
+        row[0, : len(pages)] = pages
+        self._table = self._table_write_exec(
+            self._table, np.int32(slot), row
+        )
+
+    def pages_for(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Pages one request needs for its WHOLE life: prompt + every
+        generated token + the frozen slot's final (masked) write at
+        position ``min(P + new, S - 1)`` — the admission budget's unit
+        (prompt + decode reserve, reserved up front so decode can never
+        run out of pages mid-request)."""
+        last = min(prompt_len + max_new_tokens, self.max_seq - 1)
+        return last // self.kv_page + 1
+
+    def free_pages(self) -> int:
+        """Immediately-allocatable pages (free list only)."""
+        return len(self._pool_free)
+
+    def pages_available(self) -> int:
+        """Allocatable pages: the free list plus evictable cache pages
+        (digest-keyed, unreferenced — the LRU victims an allocation may
+        spill/drop). Quarantined pages are excluded (they free at the
+        next harvest), so the scheduler's admission check is
+        conservative and parks for at most one step on their account."""
+        evictable = sum(
+            1
+            for m in self._pool_meta
+            if m is not None and m.refs == 0 and m.digest is not None
+        )
+        return len(self._pool_free) + evictable
+
+    def _flush_quarantine(self) -> None:
+        """Recycle quarantined private pages. Only call when every fold
+        dispatched BEFORE their slots' table resets has completed (at
+        release time with no fold in flight, or at the top of a harvest
+        after its sync) — the in-flight fold is the one writer that can
+        still scribble them."""
+        if self._quarantine:
+            self._pool_free.extend(self._quarantine)
+            self._quarantine = []
+
+    def _release_pages(self, slot: int) -> None:
+        """Drop one slot's claim on its pages (paged mode): every page's
+        refcount falls; private (digestless) pages that hit zero die
+        into the quarantine, digest-keyed pages stay resident as
+        evictable cache — the copy-free afterlife of a completed
+        prompt's prefix. The slot's table row is reset to scratch so no
+        LATER-dispatched fold can write its old pages."""
+        if not self.paged:
+            return
+        pages = self._slot_pages[slot]
+        self._slot_pages[slot] = []
+        self._slot_span[slot] = 0
+        for pg in pages:
+            m = self._pool_meta[pg]
+            if m is None:
+                continue
+            m.refs -= 1
+            if m.refs <= 0 and m.digest is None:
+                self._pool_meta[pg] = None
+                self._quarantine.append(pg)
+                self.page_frees += 1
+        self._table_write(slot, ())
+        if self._inflight is None:
+            self._flush_quarantine()
+
+    def kv_page_counters(self) -> Dict[str, int]:
+        """Cumulative page-allocator event counters — the scheduler
+        diffs consecutive snapshots into per-step ServeMetrics deltas
+        (the ``rlt_serve_kv_page_*_total`` series)."""
+        return {
+            "allocs": self.page_allocs,
+            "frees": self.page_frees,
+            "alias_hits": self.page_alias_hits,
+        }
+
+    def kv_page_stats(self) -> Dict[str, Any]:
+        """The ``kv_pages`` stats block: pool occupancy by state (free /
+        resident / aliased), the token budget, and fragmentation —
+        tokens inside allocated pages no position of their slot's span
+        can ever use (partial-page tails; the capacity paging cannot
+        reclaim)."""
+        usable = self.kv_pages - 1  # minus the scratch page
+        aliased = sum(
+            1 for m in self._pool_meta if m is not None and m.refs > 1
+        )
+        allocated = sum(1 for m in self._pool_meta if m is not None)
+        free = len(self._pool_free) + len(self._quarantine)
+        frag = 0
+        for slot in range(self.num_slots):
+            span = self._slot_span[slot]
+            if span:
+                frag += len(self._slot_pages[slot]) * self.kv_page - span
+        return {
+            "page_size": self.kv_page,
+            "pages_total": usable,
+            "token_budget": usable * self.kv_page,
+            "free": free,
+            "resident": allocated - aliased,
+            "aliased": aliased,
+            "occupancy": round(allocated / usable, 4) if usable else 0.0,
+            "fragmentation_tokens": frag,
+            "allocs": self.page_allocs,
+            "frees": self.page_frees,
+            "alias_hits": self.page_alias_hits,
+        }
+
     def device_state(self) -> Dict[str, np.ndarray]:
         """Host snapshot of the device-resident per-slot state. This is a
         SYNC POINT: it blocks on any in-flight fold (debug/tests only —
@@ -1259,12 +1679,17 @@ class DecodeEngine:
             return {"bytes": total, "per_device_bytes": int(per)}
 
         out = {
+            # Paged mode: the page pool IS the KV cache (kv_cache reads
+            # 0 — there are no dense slot strips) and the unified pool
+            # reports under prefix_pool; the table rides its own row.
             "kv_cache": row(self._k, self._v),
             "prefix_pool": row(
                 getattr(self, "_pool_k", None), getattr(self, "_pool_v", None)
             ),
             "token_history": row(self._hist),
         }
+        if self.paged:
+            out["page_table"] = row(self._table)
         out["total"] = {
             "bytes": sum(r["bytes"] for r in out.values()),
             "per_device_bytes": sum(
@@ -1417,8 +1842,50 @@ class DecodeEngine:
                     self.prefix_lookups += 1
                     self.prefix_hit_tokens += matched
                     self.prefix_prompt_tokens += P
-                for b in matched_idxs:
-                    self._pool_meta[b].refs += 1  # pinned until done/cancel
+                if self.paged:
+                    # Copy-free prefix hit: the matched pages are ALIASED
+                    # into this slot's table (refcount bump below covers
+                    # the slot's whole lifetime), and only the private
+                    # remainder — suffix prompt pages + the decode
+                    # reserve — is allocated. The scheduler admits only
+                    # when pages_available() covers pages_for(), so the
+                    # allocation loop cannot come up short mid-burst.
+                    total = self.pages_for(P, n_new)
+                    avoid = set(matched_idxs)
+                    private: List[int] = []
+                    for _ in range(total - len(matched_idxs)):
+                        pg = self._pool_alloc(frozenset(avoid))
+                        if pg is None:
+                            break
+                        avoid.add(pg)
+                        private.append(pg)
+                    if len(matched_idxs) + len(private) < total:
+                        self._pool_free.extend(private)
+                        self.page_frees += len(private)
+                        raise RuntimeError(
+                            f"out of KV pages: request needs {total}, "
+                            f"only {len(matched_idxs) + len(private)} "
+                            "allocatable (check pages_available() "
+                            "before admitting)"
+                        )
+                    for b in matched_idxs:
+                        self._pool_meta[b].refs += 1
+                        self.page_alias_hits += 1
+                    for pg in private:
+                        self._pool_tick += 1
+                        self._pool_meta[pg] = _PoolBlock(
+                            digest=None, refs=1, stamp=self._pool_tick
+                        )
+                    pages = list(matched_idxs) + private
+                    self._slot_pages[slot] = pages
+                    self._slot_span[slot] = (
+                        min(P + n_new, self.max_seq - 1) + 1
+                    )
+                    self._table_write(slot, pages)
+                else:
+                    for b in matched_idxs:
+                        # pinned until done/cancel
+                        self._pool_meta[b].refs += 1
                 # Park the slot: inactive, pos at the first unseeded row
                 # (the only row interleaved folds can scribble on; the
                 # first chunk rewrites it before reading).
@@ -1428,14 +1895,15 @@ class DecodeEngine:
                 )
                 if self.spec != "off":
                     # The whole prompt (matched prefix included — the
-                    # KV copy below carries no tokens) enters the
+                    # KV copy/alias carries no tokens) enters the
                     # drafters' history up front; chunk executables
                     # re-heal their own ranges against fold scribbles.
                     self._hist_seed(slot, prompt)
-                for j, b in enumerate(matched_idxs):
-                    self._copy_block(
-                        b, slot, j * self.prefix_block, to_slot=True
-                    )
+                if not self.paged:
+                    for j, b in enumerate(matched_idxs):
+                        self._copy_block(
+                            b, slot, j * self.prefix_block, to_slot=True
+                        )
                 if self.tracer is not None and matched:
                     from ray_lightning_tpu.obs.trace import SPAN_PREFIX_SEED
 
@@ -1467,7 +1935,10 @@ class DecodeEngine:
                     top_p=1.0 if top_p is None else float(top_p),
                     key0=key0,
                     matched_tokens=matched,
-                    block_refs=list(matched_idxs),
+                    # Paged: the slot's page list (not the prefill task)
+                    # owns the alias refcounts — they persist until
+                    # release, not merely until the prefill completes.
+                    block_refs=[] if self.paged else list(matched_idxs),
                 )
                 out.append((slot, None, False))
             return out
@@ -1561,7 +2032,26 @@ class DecodeEngine:
                     np.float32(task.top_p), np.int32(task.max_new_tokens),
                     np.int32(task.eos_token), np.bool_(is_final),
                 )
-                if self.spec != "off":
+                spec_on = self.spec != "off"
+                if self.paged:
+                    args = [
+                        self.params, self._pool_k, self._pool_v,
+                        self._table, self._cur, self._pos, self._temps,
+                        self._top_ks, self._top_ps, self._keys,
+                        self._active, self._remaining, self._eos,
+                    ]
+                    if spec_on:
+                        args.append(self._hist)
+                    res = self._chunk_exec[cb](*args, *scalars)
+                    (
+                        self._pool_k, self._pool_v, self._cur, self._pos,
+                        self._temps, self._top_ks, self._top_ps,
+                        self._keys, self._active, self._remaining,
+                        self._eos, tok,
+                    ) = res[:12]
+                    if spec_on:
+                        self._hist = res[12]
+                elif spec_on:
                     (
                         self._k, self._v, self._cur, self._pos,
                         self._temps, self._top_ks, self._top_ps,
@@ -1708,6 +2198,7 @@ class DecodeEngine:
         shields blocks matched earlier in an in-progress digest walk,
         whose refs are not yet taken."""
         if self._pool_free:
+            self.page_allocs += 1
             return self._pool_free.pop()
         victim = None
         for i, m in enumerate(self._pool_meta):
@@ -1729,6 +2220,10 @@ class DecodeEngine:
                 "engine", "prefix_evict", block=victim,
                 evictions=self.prefix_evictions, spilled=self._tiered,
             )
+        # An evicted-and-reused page is one free plus one alloc in the
+        # page ledger (allocs - frees = live pages stays an invariant).
+        self.page_frees += 1
+        self.page_allocs += 1
         return victim
 
     # -- spill tiers (host RAM + disk) -----------------------------------
@@ -2079,8 +2574,42 @@ class DecodeEngine:
         """Insert the freshly-prefilled prompt's full blocks (slot rows ->
         pool, compiled copy). Chain-ordered: stop at the first block that
         cannot be allocated — a later block without its ancestors can
-        never be matched."""
+        never be matched.
+
+        Paged mode: ZERO copies — the slot's own prompt pages simply
+        gain digests in the pool map (they hold exactly the bytes a
+        pool insert would have copied), becoming shareable immediately
+        and surviving the slot's release as evictable cache pages."""
         if not self.prefix_blocks:
+            return
+        if self.paged:
+            pages = self._slot_pages[slot]
+            for i, d in enumerate(self._block_digests(tokens)):
+                existing = self._pool_map.get(d)
+                if existing is not None:
+                    # Already registered: the alias this slot admitted
+                    # with, or a concurrent identical prefill that
+                    # finished first (its page wins; ours stays a
+                    # private twin and dies at release).
+                    self._pool_tick += 1
+                    self._pool_meta[existing].stamp = self._pool_tick
+                    continue
+                pg = pages[i]
+                meta = self._pool_meta[pg]
+                if meta is None or meta.digest is not None:
+                    continue
+                self._pool_tick += 1
+                meta.digest = d
+                meta.stamp = self._pool_tick
+                self._pool_map[d] = pg
+                self.prefix_inserts += 1
+                # A fresh device page supersedes any spilled copy of the
+                # same digest (identical bytes); dropping it keeps tier
+                # budgets honest.
+                if self._tiered:
+                    self._host_map.pop(d, None)
+                    if d in self._disk_map:
+                        self._disk_drop(d)
             return
         bs = self.prefix_block
         for i, d in enumerate(self._block_digests(tokens)):
@@ -2122,6 +2651,14 @@ class DecodeEngine:
                 meta.refs -= 1
         task.block_refs = []
 
+    def _pool_used(self) -> int:
+        """Occupied pool blocks/pages (paged mode excludes the scratch
+        page and the quarantine - neither holds live data)."""
+        used = self.prefix_blocks - len(self._pool_free)
+        if self.paged:
+            used -= 1 + len(self._quarantine)
+        return max(0, used)
+
     def prefix_stats(self) -> Dict[str, Any]:
         """Pool counters for the stats endpoint / bench; with tiers on,
         a per-tier breakdown and the cumulative refill seconds ride
@@ -2132,7 +2669,7 @@ class DecodeEngine:
             "prompt_tokens": self.prefix_prompt_tokens,
             "inserts": self.prefix_inserts,
             "evictions": self.prefix_evictions,
-            "blocks_used": self.prefix_blocks - len(self._pool_free),
+            "blocks_used": self._pool_used(),
             "blocks_total": self.prefix_blocks,
         }
         if self.prefix_blocks:
@@ -2145,7 +2682,7 @@ class DecodeEngine:
         """Per-tier cumulative counters plus resident/budget bytes
         (device always; host/disk only when budgeted) — the stats-
         endpoint face of the tier walk."""
-        used = self.prefix_blocks - len(self._pool_free)
+        used = self._pool_used()
         out: Dict[str, Dict[str, int]] = {
             "device": {
                 **self.tier_counters["device"],
@@ -2176,7 +2713,7 @@ class DecodeEngine:
     def prefix_tier_bytes(self) -> Dict[str, int]:
         """Resident bytes per ENABLED tier (the
         ``rlt_serve_prefix_bytes{tier=}`` gauge values)."""
-        used = self.prefix_blocks - len(self._pool_free)
+        used = self._pool_used()
         out = {"device": used * self._blk_nbytes}
         if self._host_budget:
             out["host"] = self._host_bytes()
@@ -2197,6 +2734,7 @@ class DecodeEngine:
         task = self._prefills.pop(slot, None)
         if task is not None:
             self._unref_blocks(task)
+            self._release_pages(slot)
             self._deactivate(slot)
             return
         info = self._slots[slot]
@@ -2204,6 +2742,7 @@ class DecodeEngine:
             return
         info.released = True
         self._slots[slot] = None
+        self._release_pages(slot)
         self._deactivate(slot)
 
     def _deactivate(self, slot: int) -> None:
@@ -2215,9 +2754,13 @@ class DecodeEngine:
     def _release_synced(self, slot: int, info: SlotInfo) -> None:
         # Device-detected completion: the fold already froze the slot
         # in-graph at exactly this token, so no deactivate write is
-        # needed — host bookkeeping only.
+        # needed — host bookkeeping only. Paged mode still resets the
+        # page table (frozen slots keep issuing masked garbage writes at
+        # their final position; pointing them at scratch lets the pages
+        # recycle safely).
         info.released = True
         self._slots[slot] = None
+        self._release_pages(slot)
 
     # -- the hot loop ----------------------------------------------------
     def _dispatch(self) -> Tuple[Tuple[Any, Any], List[Optional[SlotInfo]]]:
@@ -2226,6 +2769,36 @@ class DecodeEngine:
         subsequent writes (admission, eviction) queue after it. With
         spec on the fold is propose-then-verify: the token block grows to
         ``fold * (spec_depth + 1)`` rows, most of them non-emitted."""
+        if self.paged:
+            # Same shapes of state in and out; the pools + the read-only
+            # page table stand in for the dense caches.
+            args = [self.params]
+            if self.spec == "model":
+                args.append(self._spec_params)
+            args += [self._pool_k, self._pool_v, self._table]
+            if self.spec == "off":
+                args += [
+                    self._cur, self._pos, self._temps, self._top_ks,
+                    self._top_ps, self._keys, self._active,
+                    self._remaining, self._eos,
+                ]
+                (
+                    tok_block, emit_block, self._cur, self._pos,
+                    self._keys, self._active, self._remaining,
+                    self._pool_k, self._pool_v,
+                ) = self._step_exec(*args)
+            else:
+                args += [
+                    self._cur, self._pos, self._temps, self._top_ks,
+                    self._top_ps, self._keys, self._active,
+                    self._remaining, self._eos, self._hist,
+                ]
+                (
+                    tok_block, emit_block, self._cur, self._pos,
+                    self._keys, self._active, self._remaining,
+                    self._hist, self._pool_k, self._pool_v,
+                ) = self._step_exec(*args)
+            return (tok_block, emit_block), list(self._slots)
         if self.spec == "off":
             (
                 tok_block, emit_block, self._cur, self._pos, self._keys,
@@ -2307,6 +2880,12 @@ class DecodeEngine:
         # (K = fold * (spec_depth + 1) with spec on).
         toks = np.asarray(outs[0])
         emits = np.asarray(outs[1])
+        # The sync above proves every fold dispatched up to this one has
+        # finished on device — pages quarantined BEFORE this harvest can
+        # no longer be scribbled and recycle now. Pages quarantined
+        # DURING it (_release_synced below) wait for the next harvest:
+        # the already-dispatched next fold may still write them.
+        self._flush_quarantine()
         out: List[Tuple[int, str, int, bool]] = []
         spec_on = self.spec != "off"
         group = self.spec_depth + 1 if spec_on else 1

@@ -119,6 +119,30 @@ class ServeMetrics:
                     "Per-device resident bytes of engine device state "
                     "by component",
                 ),
+                # Paged KV: page-pool occupancy by state and the
+                # allocator's event counters — the scheduler diffs the
+                # engine's cumulative counters into these once per step
+                # that saw page traffic.
+                "kv_pages": registry.gauge(
+                    "rlt_serve_kv_pages",
+                    "KV page-pool pages by state "
+                    "(free / resident / aliased)",
+                ),
+                "kv_page_allocs": registry.counter(
+                    "rlt_serve_kv_page_allocs_total",
+                    "KV pages allocated (private slot pages, "
+                    "promotions, imports)",
+                ),
+                "kv_page_frees": registry.counter(
+                    "rlt_serve_kv_page_frees_total",
+                    "KV pages freed (released private pages, evicted "
+                    "cache pages)",
+                ),
+                "kv_page_alias_hits": registry.counter(
+                    "rlt_serve_kv_page_alias_hits_total",
+                    "Prefix pages aliased copy-free into an admitted "
+                    "slot's page table",
+                ),
                 # Cost ledger: one record per terminal request
                 # (finish/cancel/expire), tenant-labelled so a
                 # multi-tenant deployment can bill/attribute per key.
@@ -174,6 +198,10 @@ class ServeMetrics:
         #: accumulated from the scheduler's per-step deltas; feeds the
         #: ``prefix_tiers`` stats block and its hit-rate-by-tier.
         self._prefix_tiers: Dict[str, Dict[str, int]] = {}
+        #: Latest paged-KV allocator stats block (engine.kv_page_stats,
+        #: refreshed by the scheduler) — the snapshot's ``kv_pages``
+        #: block; None until a paged engine reports.
+        self._kv_pages: Optional[Dict[str, Any]] = None
         self._queue_depth = 0
         self._started = time.monotonic()
         self._last_log = 0.0
@@ -328,6 +356,32 @@ class ServeMetrics:
         for tier, b in (bytes_by_tier or {}).items():
             self._reg["prefix_bytes"].set(float(b), tier=tier)
 
+    def record_kv_pages(
+        self, deltas: Dict[str, int], stats: Dict[str, Any]
+    ) -> None:
+        """One step's paged-KV allocator delta (the engine's cumulative
+        alloc/free/alias counters diffed by the scheduler) plus the
+        current pool state block: mirrored into the
+        ``rlt_serve_kv_page_*_total`` counters and the state-labelled
+        ``rlt_serve_kv_pages`` gauge, and kept for the snapshot's
+        ``kv_pages`` block (occupancy, fragmentation)."""
+        with self._lock:
+            self._kv_pages = dict(stats)
+        if self._reg is None:
+            return
+        for kind, key in (
+            ("allocs", "kv_page_allocs"),
+            ("frees", "kv_page_frees"),
+            ("alias_hits", "kv_page_alias_hits"),
+        ):
+            n = int(deltas.get(kind, 0))
+            if n:
+                self._reg[key].inc(n)
+        for state in ("free", "resident", "aliased"):
+            self._reg["kv_pages"].set(
+                float(stats.get(state, 0)), state=state
+            )
+
     def record_cost(self, record: Dict[str, Any]) -> None:
         """One terminal request's accounting record (the scheduler's
         cost ledger emits it at finish/cancel/expire): windowed for the
@@ -451,6 +505,12 @@ class ServeMetrics:
                     }
                     for tier, kv in self._prefix_tiers.items()
                 }
+            # Paged KV: the allocator's latest state block (occupancy,
+            # fragmentation = allocated-but-unusable tokens, and the
+            # cumulative alloc/free/alias counters) — absent on dense
+            # engines.
+            if self._kv_pages is not None:
+                out["kv_pages"] = dict(self._kv_pages)
             # Decode-path latency: with a folded engine one step emits up
             # to decode_fold tokens per slot, so step time and per-slot
             # inter-token latency diverge — report both, plus tokens/s

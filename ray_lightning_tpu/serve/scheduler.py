@@ -179,6 +179,14 @@ class Scheduler:
         #: per tier); step() diffs them into per-step metrics deltas —
         #: the tier-labelled rlt_serve_prefix_* series.
         self._prefix_seen: Dict[str, Dict[str, int]] = {}
+        #: Last-seen engine KV page-allocator counters (paged engines);
+        #: step() diffs them into per-step metrics deltas — the
+        #: rlt_serve_kv_page_*_total series and the kv_pages gauges.
+        self._kv_seen: Dict[str, int] = {}
+        #: Out-of-pages backpressure latch: set while the queue head is
+        #: parked waiting for pages, so the warn event fires once per
+        #: park episode, not once per step.
+        self._kv_parked = False
         #: Requests popped for admission but not yet registered in
         #: _slot_req (engine.admit runs OUTSIDE the lock); cancel() must
         #: still find them so a cancel racing an admission is honored at
@@ -554,13 +562,24 @@ class Scheduler:
                     to_evict.append((slot, req, kind))
             # 2) Pop admission candidates: bounded prefills per step,
             # sized to the slots that are (or are about to be) free.
+            # Paged engines add a PAGE budget: a candidate is admitted
+            # only while the allocatable pages cover its whole life
+            # (prompt + decode reserve — engine.pages_for); otherwise
+            # the queue head PARKS in place (no pop, priority order
+            # kept) until residents finish and free pages — out of
+            # pages backpressures, it never deadlocks and never lets
+            # an admission fail inside the engine.
             budget = min(
                 self.max_prefills_per_step,
                 len(self.engine.free_slots()) + len(to_evict),
             )
+            paged = getattr(self.engine, "paged", False)
+            pages_left = self.engine.pages_available() if paged else 0
+            parked = False
             while len(admits) < budget and self._pending:
-                _, _, req = heapq.heappop(self._pending)
+                _, _, req = self._pending[0]
                 if req.request_id in self._cancelled:
+                    heapq.heappop(self._pending)
                     self._cancelled.discard(req.request_id)
                     self.metrics.record_cancel(
                         queue_depth=len(self._pending)
@@ -574,6 +593,7 @@ class Scheduler:
                     )
                     continue
                 if req.expired(t0):
+                    heapq.heappop(self._pending)
                     self.metrics.record_expire(
                         queue_depth=len(self._pending)
                     )
@@ -585,8 +605,24 @@ class Scheduler:
                         TokenEvent(req.request_id, None, True, "expired")
                     )
                     continue
+                if paged:
+                    need = self.engine.pages_for(
+                        len(req.prompt), req.sampling.max_new_tokens
+                    )
+                    if need > pages_left:
+                        parked = True
+                        break
+                    pages_left -= need
+                heapq.heappop(self._pending)
                 admits.append(req)
                 self._admitting.add(req.request_id)
+            if parked and not self._kv_parked:
+                self._event(
+                    "kv_pages_backpressure", level="warn",
+                    queue_depth=len(self._pending),
+                    pages_available=pages_left,
+                )
+            self._kv_parked = parked
         # -- engine work, lock NOT held --------------------------------
         for slot, req, kind in to_evict:
             self.engine.release(slot)
@@ -816,6 +852,20 @@ class Scheduler:
                     self.engine.prefix_tier_bytes(),
                 )
                 self._prefix_seen = tiers
+        # Paged KV: diff the engine's cumulative page-allocator counters
+        # into one metrics record per step that saw page traffic, and
+        # refresh the state gauges (free/resident/aliased) alongside.
+        if getattr(self.engine, "paged", False):
+            kv = self.engine.kv_page_counters()
+            if kv != self._kv_seen:
+                self.metrics.record_kv_pages(
+                    {
+                        k: n - self._kv_seen.get(k, 0)
+                        for k, n in kv.items()
+                    },
+                    self.engine.kv_page_stats(),
+                )
+                self._kv_seen = kv
         for rid, n in fold_tokens.items():
             acct = self._acct.get(rid)
             if acct is not None:

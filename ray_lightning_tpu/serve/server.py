@@ -75,7 +75,7 @@ ENGINE_KEYS = frozenset((
     "ckpt_path", "model_config", "params", "int8", "num_slots", "max_seq",
     "prefill_buckets", "decode_fold", "pipeline", "prefill_chunk",
     "prefix_blocks", "prefix_block", "prefix_host_mb", "prefix_disk_dir",
-    "prefix_disk_mb", "spec", "spec_depth",
+    "prefix_disk_mb", "kv_page", "kv_pages", "spec", "spec_depth",
     "spec_draft_ckpt", "spec_draft_config", "spec_draft_int8",
     "spec_window", "mesh",
 ))
@@ -97,6 +97,8 @@ def build_engine(
     prefix_host_mb: float = 0.0,
     prefix_disk_dir: Optional[str] = None,
     prefix_disk_mb: float = 0.0,
+    kv_page: int = 0,
+    kv_pages: int = 0,
     spec: str = "off",
     spec_depth: int = 4,
     spec_draft_ckpt: Optional[str] = None,
@@ -168,6 +170,8 @@ def build_engine(
         prefix_host_mb=prefix_host_mb,
         prefix_disk_dir=prefix_disk_dir,
         prefix_disk_mb=prefix_disk_mb,
+        kv_page=kv_page,
+        kv_pages=kv_pages,
         spec=spec,
         spec_depth=spec_depth,
         spec_params=spec_params,
@@ -406,6 +410,8 @@ class ServeReplica:
         prefix_host_mb: float = 0.0,
         prefix_disk_dir: Optional[str] = None,
         prefix_disk_mb: float = 0.0,
+        kv_page: int = 0,
+        kv_pages: int = 0,
         max_prefill_chunks_per_step: int = 1,
         spec: str = "off",
         spec_depth: int = 4,
@@ -469,6 +475,8 @@ class ServeReplica:
             prefix_host_mb=prefix_host_mb,
             prefix_disk_dir=prefix_disk_dir,
             prefix_disk_mb=prefix_disk_mb,
+            kv_page=kv_page,
+            kv_pages=kv_pages,
             spec=spec,
             spec_depth=spec_depth,
             spec_draft_ckpt=spec_draft_ckpt,
@@ -584,6 +592,8 @@ class ServeReplica:
             "pipeline": self.engine.pipeline,
             "prefill_chunk": self.engine.prefill_chunk,
             "prefix_blocks": self.engine.prefix_blocks,
+            "kv_page": self.engine.kv_page,
+            "kv_pages": self.engine.kv_pages,
             "prefix_host_mb": self.engine.prefix_host_mb,
             "prefix_disk_dir": self.engine.prefix_disk_dir,
             "prefix_disk_mb": self.engine.prefix_disk_mb,
@@ -800,6 +810,11 @@ class ServeReplica:
                 "pipeline": self.engine.pipeline,
                 "prefill_chunk": self.engine.prefill_chunk,
                 "prefix_cache": self.engine.prefix_blocks > 0,
+                # Resolved paged-KV config (the kv_pages STATS BLOCK —
+                # a dict — is set separately below on paged engines).
+                "paged": self.engine.paged,
+                "kv_page": self.engine.kv_page,
+                "kv_pages_total": self.engine.kv_pages,
                 "int8": self.int8,
                 "mesh": self.engine.mesh_desc,
                 # Per-component resident bytes (total + per-device after
@@ -812,6 +827,11 @@ class ServeReplica:
         )
         if self.engine.prefix_blocks:
             snap["prefix"] = self.engine.prefix_stats()
+        if self.engine.paged:
+            # The allocator's live state (the scheduler-refreshed metrics
+            # copy can lag a step; this one is read straight off the
+            # engine for the stats RPC).
+            snap["kv_pages"] = self.engine.kv_page_stats()
         snap["spec"] = self.engine.spec
         if self.engine.spec != "off":
             snap["spec_stats"] = self.engine.spec_stats()

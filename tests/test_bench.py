@@ -124,6 +124,23 @@ def test_bench_smoke_cpu():
     assert tiered["host"]["refill_h2d_s"] > 0, tiered
     assert tiered["host_disk"]["disk_hits"] > 0, tiered
     assert out["extra"]["tiered_host_vs_off_ttft"] > 1.0, out["extra"]
+    # Paged KV: at the SAME KV token budget the page allocator must
+    # admit >= 1.5x the dense engine's residents (short requests stop
+    # paying max_seq HBM each), with prefix hits riding the copy-free
+    # alias path and greedy output bit-identical to dense.
+    paged = {
+        (r["workload"], r["mode"]): r
+        for r in out["extra"]["paged_kv_rows"]
+    }
+    res_d = paged[("paged_kv_residency", "dense")]
+    res_p = paged[("paged_kv_residency", "paged")]
+    assert res_d["kv_budget_tokens"] == res_p["kv_budget_tokens"]
+    assert out["extra"]["paged_vs_dense_residents"] >= 1.5, paged
+    assert res_p["alias_hits"] > 0, res_p
+    assert res_p["exact_vs_dense"] is True, res_p
+    assert paged[("paged_kv_long_context", "paged")][
+        "decode_tokens_per_sec"
+    ] > 0, paged
     # Observer effect: tracing on the decode hot loop must stay under 5%
     # tokens/s (the obs layer's near-zero-cost contract, measured
     # best-of-3 per mode so scheduler jitter doesn't fail the gate).

@@ -25,6 +25,7 @@ have_fleet=0
 have_replay=0
 have_failover=0
 have_preempt=0
+have_paged=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -37,6 +38,7 @@ fleet_fails=0
 replay_fails=0
 failover_fails=0
 preempt_fails=0
+paged_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -53,6 +55,7 @@ fleet_status=pending
 replay_status=pending
 failover_status=pending
 preempt_status=pending
+paged_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -76,6 +79,7 @@ write_manifest() {
     echo "stage=replay status=$replay_status fails=$replay_fails"
     echo "stage=failover status=$failover_status fails=$failover_fails"
     echo "stage=preempt status=$preempt_status fails=$preempt_fails"
+    echo "stage=paged status=$paged_status fails=$paged_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -204,6 +208,32 @@ while true; do
             have_tiered=1
             tiered_status=skipped
             echo "$(date -u +%H:%M:%S) tiered serve bench SKIPPED after $tiered_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_paged" -eq 0 ]; then
+        # Stage 4a': paged-KV artifact — the serve sweep now carries
+        # paged_kv_rows (max resident requests at a fixed HBM token
+        # budget, dense vs paged, + long-context tokens/s + copy-free
+        # alias hits), so the next healthy window records the
+        # block-table residency story ON CHIP next to the CPU control.
+        echo "$(date -u +%H:%M:%S) launching PAGED serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/paged_bench.json 2> /tmp/paged_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/paged_bench.json ] && \
+           grep -q paged_kv_rows /tmp/paged_bench.json; then
+          have_paged=1
+          paged_status=ok
+          echo "$(date -u +%H:%M:%S) PAGED serve bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          paged_fails=$((paged_fails+1))
+          paged_status=failed
+          echo "$(date -u +%H:%M:%S) paged serve bench failed rc=$rc (fail $paged_fails)" >> /tmp/tpu_watch.log
+          if [ "$paged_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_paged=1
+            paged_status=skipped
+            echo "$(date -u +%H:%M:%S) paged serve bench SKIPPED after $paged_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_sharded" -eq 0 ]; then
