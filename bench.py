@@ -2004,6 +2004,311 @@ def bench_preempt(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
     return _in_worker(run, False, timeout=1200.0)
 
 
+def bench_router(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
+    """``router_rows``: the front-door router measured on a 2-replica
+    CPU fleet (the machinery under test is driver-side policy, so this
+    is always a CPU control):
+
+    - ``router_affinity``: skewed shared-prefix traffic, random
+      (round-robin, router off) vs prefix-affinity routing — fleet
+      aggregate prefix hit rate, TTFT p50/p95, tokens/s. Affinity keeps
+      each shared prefix on ONE replica, so the fleet pays one cold
+      prefill per prefix instead of one per (prefix, replica) pair.
+    - ``router_overload``: a 3x-overload burst (priority-0 paid traffic
+      + a priority-1 best-effort flood with deadlines), shed off vs on.
+      Shed off, everything queues: the flood expires server-side after
+      burning queue time and admitted-work TTFT p95 breaches the SLO.
+      Shed on, the router rejects the flood at the front door
+      (saturated) with retry-after hints: zero admitted requests
+      expire and admitted-work TTFT p95 holds the SLO. Rows record
+      both TTFT p95s, expiry/rejection counts, and admitted-work
+      goodput (delivered tokens per wall second).
+    """
+
+    def run():
+        import dataclasses
+        import os as _os
+        import tempfile as _tempfile
+        import threading as _threading
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu import fabric as _fabric
+        from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+        from ray_lightning_tpu.serve.client import start_replicas
+        from ray_lightning_tpu.serve.router import (
+            RequestRejectedError,
+            Router,
+        )
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        _fabric.init(num_cpus=max(8.0, float(_os.cpu_count() or 1)))
+
+        cfg = GPTConfig(
+            vocab_size=256, n_layer=1, n_head=4, n_kv_head=2, d_model=32,
+            max_seq=128, attn_impl="reference", compute_dtype="float32",
+        )
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        ckpt = _os.path.join(
+            _tempfile.mkdtemp(prefix="rlt_router_"), "m.ckpt"
+        )
+        state_stream_to_file(
+            to_state_stream(
+                {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+            ),
+            ckpt,
+        )
+        g = np.random.default_rng(0)
+        rows = []
+
+        def pct(vals, q):
+            vals = sorted(vals)
+            idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+            return vals[idx]
+
+        # ---- affinity: skewed shared-prefix load, random vs affinity --
+        shared, uniq, n_new = 64, 8, 8
+        prefixes = [
+            g.integers(0, cfg.vocab_size, size=shared).tolist()
+            for _ in range(4)
+        ]
+        # Skewed visit order: prefix 0 is hottest, every prefix visited
+        # 4x, interleaved so round-robin alternates replicas per prefix.
+        visit_order = [0, 1, 0, 2, 0, 3, 1, 0, 2, 1, 3, 2, 0, 1, 3, 2]
+        jobs_aff = [
+            (
+                prefixes[p]
+                + g.integers(0, cfg.vocab_size, size=uniq).tolist(),
+                {"max_new_tokens": n_new, "seed": i},
+            )
+            for i, p in enumerate(visit_order)
+        ]
+        eng_kw = dict(
+            num_slots=2, max_seq=shared + uniq + n_new,
+            prefill_buckets=[shared + uniq], prefill_chunk=16,
+            prefix_blocks=3 * (shared // 16) + 2, prefix_block=16,
+            decode_fold=2,
+        )
+
+        def affinity_run(use_router):
+            client = start_replicas(
+                2, ckpt_path=ckpt, env={"JAX_PLATFORMS": "cpu"},
+                **eng_kw,
+            )
+            if use_router:
+                client.router = Router(
+                    client=client, refresh_s=0.0, prefix_block=16,
+                    shed=False,
+                )
+            try:
+                ttfts = []
+                t_run = _time.monotonic()
+                tokens = 0
+                for prompt, sampling in jobs_aff:
+                    t0 = _time.monotonic()
+                    h = client.submit(prompt, **sampling)
+                    first = None
+                    for _tok in client.stream_handle(h, timeout_s=120):
+                        if first is None:
+                            first = _time.monotonic() - t0
+                        tokens += 1
+                    ttfts.append(first)
+                wall = _time.monotonic() - t_run
+                hit = tot = 0
+                for s in client.stats():
+                    p = s.get("prefix") or {}
+                    hit += int(p.get("hit_tokens", 0))
+                    tot += int(p.get("prompt_tokens", 0))
+                return {
+                    "ttft_p50_s": round(pct(ttfts, 0.50), 6),
+                    "ttft_p95_s": round(pct(ttfts, 0.95), 6),
+                    "tokens_per_sec": round(tokens / wall, 2),
+                    "prefix_hit_rate": (
+                        round(hit / tot, 4) if tot else 0.0
+                    ),
+                }
+            finally:
+                client.shutdown()
+
+        rand = affinity_run(use_router=False)
+        aff = affinity_run(use_router=True)
+        rows.append({
+            "workload": "router_affinity", "mode": "random", **rand,
+        })
+        rows.append({
+            "workload": "router_affinity", "mode": "affinity", **aff,
+        })
+        affinity_vs_random_hit = round(
+            aff["prefix_hit_rate"] / max(rand["prefix_hit_rate"], 1e-9), 3
+        )
+
+        # ---- overload: 3x the fleet's capacity, shed off vs on ---------
+        # Delay faults slow decode to a known rate (the stand-in for a
+        # big model), so the burst is a REAL 3x overload on CPU.
+        slo_s = 2.0
+        n_paid, n_flood, o_new = 8, 24, 16
+        flood_deadline_s = 3.0
+
+        def overload_run(shed):
+            o_kw = dict(
+                num_slots=2, max_seq=64, prefill_buckets=[8],
+                decode_fold=2,
+            )
+            client = start_replicas(
+                2, ckpt_path=ckpt, env={"JAX_PLATFORMS": "cpu"}, **o_kw
+            )
+            router = Router(
+                client=client, refresh_s=0.0, affinity=False,
+                shed=shed, shed_queue_factor=1.0,
+            )
+            client.router = router
+            slow = [
+                {"point": "fold_boundary", "action": "delay",
+                 "seconds": 0.08, "after": k}
+                for k in range(1, 400)
+            ]
+            try:
+                for i in (0, 1):
+                    client.inject_fault(i, slow)
+                # Warm the decode-rate window (the router's feasibility
+                # estimates read it) and the compiled paths.
+                for h in [
+                    client.submit(
+                        g.integers(0, 256, size=6).tolist(),
+                        max_new_tokens=4, seed=99,
+                    )
+                    for _ in range(2)
+                ]:
+                    list(client.stream_handle(h, timeout_s=120))
+                # The burst: paid priority-0 work + a best-effort flood
+                # at priority 1 with a deadline.
+                burst = [
+                    (g.integers(0, 256, size=6).tolist(),
+                     {"max_new_tokens": o_new, "seed": i, "priority": 0})
+                    for i in range(n_paid)
+                ] + [
+                    (g.integers(0, 256, size=6).tolist(),
+                     {"max_new_tokens": o_new, "seed": 100 + i,
+                      "priority": 1,
+                      "deadline_s": flood_deadline_s})
+                    for i in range(n_flood)
+                ]
+                t_run = _time.monotonic()
+                handles = []
+                rejected = 0
+                for prompt, sampling in burst:
+                    try:
+                        handles.append(
+                            (client.submit(prompt, **sampling),
+                             _time.monotonic())
+                        )
+                    except RequestRejectedError:
+                        rejected += 1
+                ttfts = []
+                finished = expired = 0
+                tokens_done = [0]
+                lock = _threading.Lock()
+
+                def pull(h, t0):
+                    toks = []
+                    first = [None]
+                    try:
+                        for t in client.stream_handle(h, timeout_s=180):
+                            if first[0] is None:
+                                first[0] = _time.monotonic() - t0
+                            toks.append(t)
+                        with lock:
+                            tokens_done[0] += len(toks)
+                        return "finished", first[0]
+                    except Exception as exc:  # noqa: BLE001 - expiry is
+                        # the collapse being measured
+                        kind = (
+                            "expired" if "expired" in str(exc)
+                            else "error"
+                        )
+                        return kind, first[0]
+
+                results = [None] * len(handles)
+
+                def worker(i, h, t0):
+                    results[i] = pull(h, t0)
+
+                threads = [
+                    _threading.Thread(target=worker, args=(i, h, t0))
+                    for i, (h, t0) in enumerate(handles)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=240)
+                wall = _time.monotonic() - t_run
+                for res in results:
+                    if res is None:
+                        continue
+                    kind, first = res
+                    if kind == "finished":
+                        finished += 1
+                    elif kind == "expired":
+                        expired += 1
+                    if first is not None:
+                        ttfts.append(first)
+                return {
+                    "admitted": len(handles),
+                    "rejected": rejected,
+                    "finished": finished,
+                    "expired": expired,
+                    "ttft_p95_s": (
+                        round(pct(ttfts, 0.95), 4) if ttfts else None
+                    ),
+                    "admitted_goodput_tokens_per_s": round(
+                        tokens_done[0] / wall, 2
+                    ),
+                    "shed_total": router.shed_count,
+                }
+            finally:
+                client.shutdown()
+
+        shed_off = overload_run(shed=False)
+        shed_on = overload_run(shed=True)
+        rows.append({
+            "workload": "router_overload", "mode": "shed_off",
+            "offered": n_paid + n_flood, "slo_ttft_p95_s": slo_s,
+            **shed_off,
+        })
+        rows.append({
+            "workload": "router_overload", "mode": "shed_on",
+            "offered": n_paid + n_flood, "slo_ttft_p95_s": slo_s,
+            **shed_on,
+        })
+        shed_holds_slo = bool(
+            shed_on["ttft_p95_s"] is not None
+            and shed_on["ttft_p95_s"] <= slo_s
+            and shed_on["expired"] == 0
+            and shed_on["rejected"] > 0
+        )
+        shed_off_collapses = bool(
+            shed_off["expired"] > 0
+            or (
+                shed_off["ttft_p95_s"] is not None
+                and shed_off["ttft_p95_s"] > slo_s
+            )
+        )
+        return {
+            "router_rows": rows,
+            "router_affinity_vs_random_hit": affinity_vs_random_hit,
+            "router_shed_holds_slo": shed_holds_slo,
+            "router_shed_off_collapses": shed_off_collapses,
+            "router_cpu_control": True,
+        }
+
+    return _in_worker(run, False, timeout=1200.0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=3)
@@ -2159,6 +2464,10 @@ def main() -> None:
             extra.update(bench_preempt(use_tpu))
         except Exception as exc:  # noqa: BLE001 - still emit a record
             extra["preempt_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_router(use_tpu))
+        except Exception as exc:  # noqa: BLE001 - still emit a record
+            extra["router_error"] = f"{type(exc).__name__}: {exc}"
         extra["bench_wall_s"] = round(time.time() - t0, 1)
         val = extra.get("serve_shared_prefix_ttft_speedup", 0.0)
         print(
@@ -2295,6 +2604,10 @@ def main() -> None:
             extra.update(bench_preempt(use_tpu))
         except Exception as exc:  # noqa: BLE001
             extra["preempt_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_router(use_tpu))
+        except Exception as exc:  # noqa: BLE001
+            extra["router_error"] = f"{type(exc).__name__}: {exc}"
     extra["bench_wall_s"] = round(time.time() - t0, 1)
 
     print(

@@ -403,6 +403,9 @@ _SERVE_KEYS = frozenset((
     "journal", "journal_capacity",
     "supervisor", "restart_limit", "restart_backoff_s", "rpc_timeout_s",
     "preempt_grace_s", "preempt_sigterm", "preempt_metadata",
+    "router", "router_refresh_s", "router_affinity", "router_shed",
+    "shed_queue_factor", "retry_budget", "hedge_after_s",
+    "autoscale_min", "autoscale_max", "autoscale_interval_s",
 ))
 
 
@@ -413,6 +416,7 @@ def _serve_obs_server(
     fleet_interval_s: float = 2.0,
     fleet_history: int = 128,
     supervisor: Any = None,
+    router: Any = None,
 ) -> Tuple[Any, Optional[Any]]:
     """Build (started) the driver-side obs HTTP server ``rlt serve``
     runs next to a replica gang, plus its FleetPoller (None when
@@ -467,6 +471,7 @@ def _serve_obs_server(
             supervisor_fn=(
                 supervisor.rows if supervisor is not None else None
             ),
+            router_fn=(router.rows if router is not None else None),
         ).start()
 
     def _collect() -> str:
@@ -486,16 +491,22 @@ def _serve_obs_server(
         payload = report.to_dict()
         replicas = client.health()
         payload["replicas"] = replicas
-        up = sum(1 for r in replicas if r.get("healthy", True))
-        payload["replicas_total"] = len(replicas)
+        # Retired replicas are deliberate scale-downs, not failures:
+        # they stay visible in the body but never count against the
+        # fleet's readiness.
+        live = [r for r in replicas if not r.get("retired")]
+        up = sum(1 for r in live if r.get("healthy", True))
+        payload["replicas_total"] = len(live)
         payload["replicas_healthy"] = up
         if supervisor is not None:
             payload["supervisor"] = supervisor.rows()
-        healthy = up > 0 if replicas else report.healthy
+        if router is not None:
+            payload["router"] = router.rows()
+        healthy = up > 0 if live else report.healthy
         payload["healthy"] = healthy
         if not healthy:
             payload["verdict"] = "unhealthy"
-        elif (replicas and up < len(replicas)) or not report.healthy:
+        elif (live and up < len(live)) or not report.healthy:
             payload["verdict"] = "degraded"
         return healthy, payload
 
@@ -646,6 +657,35 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         (default none — block); transient failures retry with capped
         exponential backoff + jitter before the replica is declared
         lost.
+      router: the front-door routing policy (default on) — submit
+        consults serve.router.Router instead of round-robin:
+        supervisor states (draining/preempting/dead) and health
+        verdicts demote or exclude replicas, shared-prefix traffic
+        lands on the replica holding the warm blocks/pages
+        (router_affinity, default on — digests match the engines'
+        prefix_block/kv_page), and admission control sheds work at the
+        door (router_shed, default on): a deadline the fleet's
+        windowed decode rate cannot meet, or lowest-priority work on a
+        saturated fleet (every routable queue >= shed_queue_factor x
+        its slots, default 4.0), is rejected with a typed outcome and
+        a retry-after hint instead of queueing to collapse.
+        router_refresh_s: replica-view staleness bound (default 1s).
+      retry_budget: aggregate client retry cap — transient-RPC retries
+        across ALL calls are limited to this fraction of recent
+        submits (default 0.5; false disables), so a sick fleet gets
+        backpressure instead of a retry storm; exhaustion counts in
+        rlt_serve_retry_budget_exhausted_total.
+      hedge_after_s: hedged streaming reads — a stream with no new
+        token for this long (while its replica still answers) is
+        re-driven on a peer under the same id/seed, bit-exact with the
+        delivered prefix deduplicated (default off; covers gray
+        failures liveness probes cannot see).
+      autoscale_min / autoscale_max / autoscale_interval_s: queue-
+        driven replica autoscaling within [min, max] (autoscale_max
+        arms it; min defaults to the initial replica count): sustained
+        queue depth or shedding spawns replicas through the retained
+        spawn recipes; a sustained-idle fleet retires them gracefully
+        (drained + leftovers migrated — no request lost at retire).
       tracing: record request traces on the replicas (default on);
         trace_out: after serving, write the replicas' recent traces as
         Chrome trace-event JSON to this path (opens in Perfetto).
@@ -829,6 +869,33 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     rpc_timeout_s = serve_cfg.pop("rpc_timeout_s", None)
     if rpc_timeout_s is not None:
         rpc_timeout_s = float(rpc_timeout_s)
+    # Front-door router (default on): health/state-aware + prefix-
+    # affinity routing with admission control; the autoscaler arms when
+    # autoscale_max is set. retry_budget caps the client's aggregate
+    # transient-RPC retries as a fraction of recent submits (false
+    # disables the cap); hedge_after_s arms hedged streaming reads.
+    router_enabled = bool(serve_cfg.pop("router", True))
+    router_refresh_s = float(serve_cfg.pop("router_refresh_s", 1.0))
+    router_affinity = bool(serve_cfg.pop("router_affinity", True))
+    router_shed = bool(serve_cfg.pop("router_shed", True))
+    shed_queue_factor = float(serve_cfg.pop("shed_queue_factor", 4.0))
+    retry_budget = serve_cfg.pop("retry_budget", 0.5)
+    retry_budget = (
+        None if retry_budget in (False, None) else float(retry_budget)
+    )
+    hedge_after_s = serve_cfg.pop("hedge_after_s", None)
+    if hedge_after_s is not None:
+        hedge_after_s = float(hedge_after_s)
+    autoscale_min = serve_cfg.pop("autoscale_min", None)
+    autoscale_max = serve_cfg.pop("autoscale_max", None)
+    autoscale_interval_s = float(
+        serve_cfg.pop("autoscale_interval_s", 2.0)
+    )
+    if autoscale_max is not None and int(autoscale_max) < replicas:
+        raise ValueError(
+            f"--serve.autoscale_max {autoscale_max} is below the "
+            f"initial replica count {replicas}"
+        )
     pc = serve_cfg.pop("prefix_cache", "off")
     if isinstance(pc, str):
         pc_norm = pc.strip().lower()
@@ -895,6 +962,31 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     pb = serve_cfg.pop("prefill_buckets", None)
     if pb is not None:
         replica_kwargs["prefill_buckets"] = [int(b) for b in pb]
+    # Resolved router policy: built once — it constructs the Router
+    # below AND rides into every replica's journal header (provenance a
+    # replayed capture carries). Affinity digests must use the engines'
+    # block/page size, and only pay when a prefix cache exists at all.
+    router_cfg = None
+    if router_enabled:
+        aff_block = int(replica_kwargs.get("prefix_block", 16))
+        if replica_kwargs.get("kv_pages"):
+            aff_block = int(replica_kwargs.get("kv_page", 16) or 16)
+        router_cfg = {
+            "refresh_s": router_refresh_s,
+            "affinity": bool(
+                router_affinity
+                and (blocks > 0 or replica_kwargs.get("kv_pages"))
+            ),
+            "prefix_block": aff_block,
+            "shed": router_shed,
+            "shed_queue_factor": shed_queue_factor,
+            "retry_budget_ratio": retry_budget,
+            "hedge_after_s": hedge_after_s,
+            "autoscale_min": autoscale_min,
+            "autoscale_max": autoscale_max,
+            "autoscale_interval_s": autoscale_interval_s,
+        }
+        replica_kwargs["router_config"] = router_cfg
     if serve_cfg:
         # _SERVE_KEYS said these were valid but nothing consumed them:
         # the vocabulary and the pops drifted apart — a bug here, not a
@@ -938,11 +1030,15 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         env=env,
         hosts_per_replica=hosts_per_replica,
         rpc_timeout_s=rpc_timeout_s,
+        retry_budget_ratio=retry_budget,
+        hedge_after_s=hedge_after_s,
         **replica_kwargs,
     )
     metrics_server = None
     fleet_poller = None
     supervisor = None
+    router = None
+    autoscaler = None
     if supervisor_enabled:
         # Close the detect->decide->recover loop for the run's duration:
         # unhealthy replicas drain, dead ones restart (same resolved
@@ -955,6 +1051,37 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             restart_limit=restart_limit,
             restart_backoff_s=restart_backoff_s,
         ).start()
+    if router_cfg is not None:
+        # The front door: submit consults this policy instead of the
+        # bare round-robin — supervisor states and health verdicts
+        # demote/exclude, shared prefixes land on the warm replica, and
+        # an overloaded fleet sheds at the door instead of collapsing
+        # its queues.
+        from ray_lightning_tpu.serve.router import (
+            Router,
+            RouterAutoscaler,
+        )
+
+        router = Router(
+            client=client,
+            state_fn=(
+                supervisor.rows if supervisor is not None else None
+            ),
+            refresh_s=router_refresh_s,
+            affinity=router_cfg["affinity"],
+            prefix_block=router_cfg["prefix_block"],
+            shed=router_shed,
+            shed_queue_factor=shed_queue_factor,
+        )
+        client.router = router
+        if autoscale_max is not None:
+            autoscaler = RouterAutoscaler(
+                client,
+                router=router,
+                min_replicas=int(autoscale_min or replicas),
+                max_replicas=int(autoscale_max),
+                interval_s=autoscale_interval_s,
+            ).start()
     try:
         if metrics_port is not None:
             # Driver-side Prometheus endpoint for the run's duration:
@@ -972,12 +1099,17 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
                 fleet_interval_s=fleet_interval_s,
                 fleet_history=fleet_history,
                 supervisor=supervisor,
+                router=router,
             )
             if supervisor is not None and fleet_poller is not None:
                 # Share PR 8's pull: the supervisor reads heartbeat ages
                 # from the poller's latest snapshot instead of its own
                 # fabric read.
                 supervisor.poller = fleet_poller
+            if router is not None and fleet_poller is not None:
+                # Same for the router: its replica views ride the
+                # poller's snapshot instead of issuing their own pulls.
+                router.poller = fleet_poller
             print(
                 f"serve metrics endpoint: {metrics_server.url}",
                 file=sys.stderr,
@@ -1018,6 +1150,8 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         print(_json.dumps({"serve_stats": stats}))
         return {"outputs": outputs, "stats": stats}
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()  # before shutdown: no scaling mid-teardown
         if supervisor is not None:
             supervisor.stop()  # before shutdown: no restarts mid-teardown
         if fleet_poller is not None:
@@ -1243,9 +1377,15 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{'replica':>7} {'health':>9} {'queue':>5} {'slots':>7} "
             f"{'tok/s':>9} {'ttft_p50':>9} {'ttft_p95':>9} "
             f"{'accept':>7} {'hit':>6} {'hit d/h/k':>14} "
-            f"{'pages f/r/a':>12} {'goodput':>9}"
+            f"{'pages f/r/a':>12} {'goodput':>9} {'weight':>7}"
         ),
     ]
+    # Router weights keyed by replica (absent without a router).
+    router_block = payload.get("router") or {}
+    weights = {
+        w.get("replica"): w.get("weight")
+        for w in router_block.get("replicas") or []
+    }
     for r in rows:
         # Tiered prefix cache: fraction of block probes each tier served
         # (device/host/disk) — "-" when the replica runs no tiers.
@@ -1283,7 +1423,8 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{_fmt_cell(r.get('prefix_hit_rate'), 6, 2)} "
             f"{_fmt_cell(tier_cell, 14)} "
             f"{_fmt_cell(page_cell, 12)} "
-            f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)}"
+            f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)} "
+            f"{_fmt_cell(weights.get(r.get('replica')), 7, 2)}"
         )
     if fleet:
         out.append(
@@ -1310,6 +1451,22 @@ def render_fleet(payload: Dict[str, Any]) -> str:
                 cell += "(" + ",".join(extras) + ")"
             cells.append(cell)
         out.append("supervisor: " + " ".join(cells))
+    # Routing plane (when a Router is wired): decision totals + any
+    # replicas currently excluded from the routable set.
+    if router_block:
+        parts = [
+            f"routed={router_block.get('routed', 0)}",
+            f"shed={router_block.get('shed', 0)}",
+            f"affinity_entries={router_block.get('affinity_entries', 0)}",
+        ]
+        out_of_rotation = [
+            f"r{w.get('replica')}"
+            for w in router_block.get("replicas") or []
+            if not w.get("routable", True)
+        ]
+        if out_of_rotation:
+            parts.append("excluded=" + ",".join(out_of_rotation))
+        out.append("router: " + " ".join(parts))
     return "\n".join(out)
 
 

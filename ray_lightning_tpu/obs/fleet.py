@@ -60,6 +60,10 @@ def summarize_replica(
         t: (round(int(r.get("hits", 0)) / probes, 4) if probes else 0.0)
         for t, r in tiers.items()
     } or None
+    # Effective cache size: resident prefix bytes summed over every
+    # enabled tier (device + host + disk) — a replica's capacity to
+    # hold warm prefixes, the router's affinity tiebreaker.
+    prefix_bytes = sum(int(r.get("bytes", 0)) for r in tiers.values())
     return {
         "replica": int(index),
         "health": str(verdict),
@@ -76,6 +80,7 @@ def summarize_replica(
         "spec_accept_rate": stats.get("spec_accept_rate"),
         "prefix_hit_rate": stats.get("prefix_hit_rate"),
         "prefix_tier_hit_rate": tier_hit,
+        "prefix_bytes": prefix_bytes,
         # Paged KV: pool state + occupancy (None on dense replicas) —
         # the capacity signal a page-aware router/autoscaler reads.
         "kv_pages": (
@@ -168,6 +173,9 @@ class FleetPoller:
     ``FleetSupervisor.rows``) embeds the recovery plane's per-replica
     state table in the ``/fleet`` payload, so ``rlt top`` and dashboards
     show restarts/draining next to the health/throughput rows.
+    ``router_fn`` (optional, zero-arg -> dict — typically
+    ``serve.router.Router.rows``) embeds the routing plane the same
+    way: per-replica weights/routability plus the routed/shed totals.
     """
 
     def __init__(
@@ -180,9 +188,11 @@ class FleetPoller:
         supervisor_fn: Optional[
             Callable[[], List[Dict[str, Any]]]
         ] = None,
+        router_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ) -> None:
         self._pull = pull_fn
         self._supervisor_fn = supervisor_fn
+        self._router_fn = router_fn
         self.interval_s = float(interval_s)
         self.history = max(1, int(history))
         self._events = events
@@ -294,6 +304,11 @@ class FleetPoller:
                 out["supervisor"] = self._supervisor_fn()
             except Exception:  # noqa: BLE001 - the fleet payload must
                 pass  # survive a supervisor mid-teardown
+        if self._router_fn is not None:
+            try:
+                out["router"] = self._router_fn()
+            except Exception:  # noqa: BLE001 - same for the router
+                pass
         return out
 
     # -- thread lifecycle -------------------------------------------------

@@ -78,6 +78,7 @@ def engine_header(
     max_prefills_per_step: int = 1,
     max_prefill_chunks_per_step: int = 1,
     priority_age_s: Optional[float] = None,
+    router: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The config/checkpoint-identity header from a live engine: the
     RESOLVED knobs (buckets expanded, chunk coerced, mesh normalized),
@@ -132,6 +133,12 @@ def engine_header(
             "priority_age_s": priority_age_s,
         },
     }
+    if router is not None:
+        # Router/autoscaler knobs (serve.router.ROUTER_HEADER_KEYS):
+        # the driver-side policy that shaped this replica's traffic —
+        # provenance a replay surfaces (the single-engine replay itself
+        # has no fleet to route over).
+        header["router"] = dict(router)
     header.update(checkpoint_identity(ckpt_path))
     return header
 
@@ -434,7 +441,12 @@ def incomplete_requests(journal: Dict[str, Any]) -> List[Dict[str, Any]]:
 # ---------------------------------------------------------------------------
 # Replay
 # ---------------------------------------------------------------------------
-#: engine_header keys build_engine accepts verbatim.
+#: engine_header keys build_engine accepts verbatim. The header's
+#: ``router`` section (driver-side policy knobs, see
+#: serve.router.ROUTER_HEADER_KEYS) rebuilds separately through
+#: ``serve.router.router_config_from_header`` — replay surfaces it as
+#: ``router_config`` so a replayed capture knows the policy that shaped
+#: its traffic.
 _ENGINE_REBUILD_KEYS = frozenset((
     "num_slots", "max_seq", "prefill_buckets", "decode_fold", "pipeline",
     "prefill_chunk", "prefix_blocks", "prefix_block", "prefix_host_mb",
@@ -742,6 +754,12 @@ def replay_journal(
         "replay_span_s": round(replay_span, 6),
         "rows": rows,
     }
+    if header and header.get("router"):
+        from ray_lightning_tpu.serve.router import (
+            router_config_from_header,
+        )
+
+        result["router_config"] = router_config_from_header(header)
     if timing == "wall":
         snap = scheduler.metrics.snapshot()
         rep_tokens = sum(len(v) for v in replayed.values())

@@ -18,6 +18,13 @@ The L5 layer over the decode path (models/gpt.py: prefill + GQA KV cache
   loop (supervisor.py): drains unhealthy replicas, restarts dead ones
   through the fabric, and fails their incomplete requests over
   (journal-backed, bit-exact) onto survivors.
+- :class:`Router` / :class:`RouterAutoscaler` (router.py) — the
+  front-door routing policy ``ServeClient.submit`` consults:
+  health/state-aware weighting, prefix-affinity (the engines' chained
+  block digests, driver-side), admission control with graceful
+  shedding (:class:`RequestRejectedError` + retry-after), a shared
+  client :class:`RetryBudget`, hedged streaming reads, and
+  queue-driven replica autoscaling within ``[min, max]`` bounds.
 - :class:`FaultInjector` — deterministic fault injection (faults.py):
   kill/delay/drop/wedge/preempt at named lifecycle points, driving the
   chaos tests and the ``failover_blackout``/``preempt_drain`` benches.
@@ -47,6 +54,12 @@ from ray_lightning_tpu.serve.preempt import (
     get_monitor,
     reset_monitor,
 )
+from ray_lightning_tpu.serve.router import (
+    RequestRejectedError,
+    RetryBudget,
+    Router,
+    RouterAutoscaler,
+)
 
 __all__ = [
     "DecodeEngine",
@@ -60,6 +73,10 @@ __all__ = [
     "start_replicas",
     "load_serve_params",
     "FleetSupervisor",
+    "Router",
+    "RouterAutoscaler",
+    "RequestRejectedError",
+    "RetryBudget",
     "FaultInjector",
     "FaultRule",
     "PreemptionMonitor",

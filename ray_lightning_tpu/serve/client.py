@@ -27,6 +27,20 @@ seed-chained and greedy decode is bit-exact, the resubmitted request
 emits the IDENTICAL token stream; ``stream_handle`` keeps its cursor
 across the failover, so callers see one uninterrupted stream with the
 already-delivered prefix deduplicated client-side.
+
+Routing: with a :class:`serve.router.Router` attached (``router=`` or
+``client.router = ...``), ``submit`` consults it instead of the bare
+round-robin — health/state-aware weighting, prefix-affinity, and
+admission control (a shed submit raises the typed
+:class:`serve.router.RequestRejectedError` with a retry-after hint and
+a journaled ``rejected`` outcome). Per-call RPC retries additionally
+share one :class:`serve.router.RetryBudget` (capped as a fraction of
+recent submits) so a sick fleet gets backpressure instead of a retry
+storm, and ``hedge_after_s`` arms hedged streaming reads: a stream
+that stalls on a slow-but-HEALTHY replica (the gray failure liveness
+probes cannot see) is re-driven on a peer under the same id/seed —
+bit-exact, cursor-deduplicated — while the slow copy is cancelled
+best-effort.
 """
 from __future__ import annotations
 
@@ -39,6 +53,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 
 from ray_lightning_tpu import fabric
 from ray_lightning_tpu.obs import trace as _trace
+from ray_lightning_tpu.serve.router import RequestRejectedError
 from ray_lightning_tpu.serve.server import ServeReplica
 
 
@@ -120,10 +135,16 @@ class ServeClient:
         init_timeout: float = 300.0,
         registry: Optional[Any] = None,
         events: Optional[Any] = None,
+        router: Optional[Any] = None,
+        retry_budget_ratio: Optional[float] = 0.5,
+        retry_budget_window_s: float = 30.0,
+        retry_budget_floor: int = 8,
+        hedge_after_s: Optional[float] = None,
     ) -> None:
         from ray_lightning_tpu.obs.events import get_event_log
         from ray_lightning_tpu.obs.journal import WorkloadJournal
         from ray_lightning_tpu.obs.registry import get_registry
+        from ray_lightning_tpu.serve.router import RetryBudget
 
         if not replicas:
             raise ValueError("need at least one replica")
@@ -150,6 +171,11 @@ class ServeClient:
         #: "its incomplete requests were failed over".
         self._excluded: set = set()
         self._lost: set = set()
+        #: Indices retired by the autoscaler: permanent tombstones (the
+        #: index table never shifts, so every id->replica mapping in the
+        #: fleet stays stable). Retired implies excluded; restore() is a
+        #: no-op on them.
+        self._retired: set = set()
         #: request_id -> current replica index (None once declared lost).
         self._route: Dict[str, Optional[int]] = {}
         #: request_id -> its normalized journal ``submit`` record — the
@@ -203,6 +229,37 @@ class ServeClient:
         #: never dips below N): idx -> (leader, followers), consumed by
         #: respawn_replica.
         self._prespawned: Dict[int, Tuple[Any, List[Any]]] = {}
+        #: Routing policy (serve.router.Router): submit consults it
+        #: instead of round-robin when set. Assignable after
+        #: construction (the CLI builds the router once the supervisor
+        #: exists, since its state feed comes from there).
+        self.router = router
+        #: Shared transient-retry budget: per-call retry caps bound ONE
+        #: RPC; this bounds the aggregate across every call — None
+        #: disables the budget (the pre-router unbounded behavior).
+        self._retry_budget = (
+            None if retry_budget_ratio is None
+            else RetryBudget(
+                ratio=float(retry_budget_ratio),
+                window_s=float(retry_budget_window_s),
+                floor=int(retry_budget_floor),
+            )
+        )
+        #: Hedged streaming reads: a stream with no new token for this
+        #: many seconds (while its replica still answers polls) is
+        #: re-driven on a peer — the gray-failure cover. None = off.
+        self.hedge_after_s = (
+            None if hedge_after_s is None else float(hedge_after_s)
+        )
+        self._m_retry_budget_exhausted = reg.counter(
+            "rlt_serve_retry_budget_exhausted_total",
+            "Transient-RPC retries refused by the shared retry budget "
+            "(the call fails over instead of retrying)",
+        )
+        self._m_hedges = reg.counter(
+            "rlt_router_hedges_total",
+            "Stalled streams re-driven on a peer replica, by reason",
+        )
 
     # -- internals --------------------------------------------------------
     def _event(self, name: str, level: str = "info", **kv: Any) -> None:
@@ -256,6 +313,25 @@ class ServeClient:
                         f"rpc {method!r} failed {attempt + 1}x "
                         f"({type(exc).__name__}: {exc})",
                     ) from exc
+                if (
+                    self._retry_budget is not None
+                    and not self._retry_budget.try_spend()
+                ):
+                    # Aggregate cap: per-call retries are bounded above,
+                    # but N concurrent streams each retrying within
+                    # budget is still a storm against a sick fleet —
+                    # once the SHARED window is spent, fail over now.
+                    self._m_retry_budget_exhausted.inc(1)
+                    self._event(
+                        "rpc_retry_budget_exhausted", level="warn",
+                        replica=idx, method=method,
+                        error=f"{type(exc).__name__}: {exc}"[:200],
+                    )
+                    raise ReplicaLostError(
+                        idx,
+                        f"rpc {method!r} retry budget exhausted "
+                        f"({type(exc).__name__}: {exc})",
+                    ) from exc
                 self._m_rpc_retries.inc(1)
                 time.sleep(self._backoff(attempt))
                 attempt += 1
@@ -264,8 +340,15 @@ class ServeClient:
         with self._lock:
             return [
                 i for i in range(len(self._replicas))
-                if i not in self._excluded and i != exclude
+                if i not in self._excluded
+                and i not in self._retired
+                and i != exclude
             ]
+
+    def alive_replicas(self) -> List[int]:
+        """Replica indices currently taking new traffic (the router's
+        and autoscaler's candidate set)."""
+        return self._alive()
 
     def _pick(self, exclude: Optional[int] = None) -> int:
         """Round-robin over the non-excluded replicas."""
@@ -287,10 +370,18 @@ class ServeClient:
             self._excluded.add(int(idx))
 
     def restore(self, idx: int) -> None:
-        """Resume routing to a drained replica. Idempotent."""
+        """Resume routing to a drained replica. Idempotent; a RETIRED
+        replica stays retired (its process is gone — re-adding capacity
+        is ``add_replica``'s job)."""
         with self._lock:
+            if int(idx) in self._retired:
+                return
             self._excluded.discard(int(idx))
             self._lost.discard(int(idx))
+
+    def is_retired(self, idx: int) -> bool:
+        with self._lock:
+            return int(idx) in self._retired
 
     def excluded(self) -> List[int]:
         with self._lock:
@@ -351,8 +442,27 @@ class ServeClient:
         with self._lock:
             self._open[rid] = record
         self._record_submit(rid, prompt, record)
+        if self._retry_budget is not None:
+            self._retry_budget.note_submit()
         while True:
-            idx = int(replica) if replica is not None else self._pick()
+            if replica is not None:
+                idx = int(replica)
+            else:
+                try:
+                    idx = self._route_pick(prompt, record)
+                except RequestRejectedError as exc:
+                    # Admission control: the typed ``rejected`` outcome —
+                    # journaled and evented; the request never left the
+                    # driver, and the caller holds a retry-after hint.
+                    with self._lock:
+                        self._open.pop(rid, None)
+                    self.journal.record_outcome(rid, "rejected")
+                    self._event(
+                        "request_rejected", level="warn",
+                        request_id=rid, reason=exc.reason,
+                        retry_after_s=exc.retry_after_s,
+                    )
+                    raise
             self.tracer.event(
                 rid, _trace.SPAN_CLIENT_SUBMIT,
                 attrs={"replica": idx, "prompt_tokens": len(prompt)},
@@ -368,7 +478,30 @@ class ServeClient:
                 continue
             with self._lock:
                 self._route[rid] = idx
+            if self.router is not None:
+                try:
+                    # The prefix chain is warm on idx now — feed the
+                    # affinity map (pinned submits included: the pin
+                    # seeded the cache all the same).
+                    self.router.observe_route(prompt, idx)
+                except Exception:  # noqa: BLE001 - routing hints must
+                    pass  # never fail a placed submit
             return RequestHandle(replica=idx, request_id=rid)
+
+    def _route_pick(self, prompt: Sequence[int], record: Dict[str, Any]) -> int:
+        """One routing decision: the attached router's policy, or the
+        round-robin fallback. May raise RequestRejectedError (router
+        admission control) or NoReplicasError."""
+        router = self.router
+        if router is None:
+            return self._pick()
+        return int(router.pick(
+            prompt,
+            max_new_tokens=record["max_new_tokens"],
+            priority=record["priority"],
+            deadline_s=record["deadline_s"],
+            alive=self._alive(),
+        ))
 
     def _finish(self, rid: str, status: str) -> None:
         """A request reached terminal state from this client's point of
@@ -413,6 +546,8 @@ class ServeClient:
         rid = handle.request_id
         cursor = 0
         deadline = time.monotonic() + timeout_s
+        last_progress = time.monotonic()
+        hedged = False
         while True:
             idx = self._route_of(handle)
             if idx is None:
@@ -442,6 +577,22 @@ class ServeClient:
             for tok in res["tokens"]:
                 yield int(tok)
             cursor += len(res["tokens"])
+            if res["tokens"]:
+                last_progress = time.monotonic()
+            elif (
+                self.hedge_after_s is not None
+                and not hedged
+                and not res["done"]
+                and time.monotonic() - last_progress > self.hedge_after_s
+            ):
+                # Gray failure: the replica answers polls but the stream
+                # has stalled past the hedge threshold — re-drive it on
+                # a peer (bit-exact by the seed-chain contract; the
+                # cursor dedups the delivered prefix). One hedge per
+                # stream: a fleet-wide slowdown must not cascade.
+                hedged = self.hedge(handle)
+                if hedged:
+                    last_progress = time.monotonic()
             if res["done"]:
                 if res["status"] == "migrated":
                     # Terminal on THAT replica only: a preemption drain
@@ -555,6 +706,47 @@ class ServeClient:
             )
             return True
 
+    def hedge(self, handle: RequestHandle) -> bool:
+        """Hedged streaming read: re-drive an OPEN request on a peer
+        replica under the same id (journal record — same prompt, same
+        full SamplingParams incl. seed, so the peer emits the identical
+        stream and the caller's cursor dedups), then cancel the slow
+        copy best-effort. The slow replica is NOT excluded — it is
+        healthy by every probe; only this stream was slow. Returns False
+        when there is nothing to hedge (request closed, no peer, or the
+        hedge submit itself failed)."""
+        rid = handle.request_id
+        with self._lock:
+            cur = self._route.get(rid)
+            record = self._open.get(rid)
+        if record is None or cur is None:
+            return False
+        alts = self._alive(exclude=cur)
+        if not alts:
+            return False
+        with self._lock:
+            idx = alts[self._rr % len(alts)]
+            self._rr += 1
+        try:
+            self._submit_rpc(idx, rid, record["prompt"], record)
+        except ReplicaLostError as exc:
+            self.on_replica_lost(idx, reason=str(exc))
+            return False
+        with self._lock:
+            self._route[rid] = idx
+        # Best-effort cancel of the slow copy (wasted decode otherwise);
+        # a failure costs nothing — the route already moved.
+        try:
+            self._rpc(cur, "cancel", rid, retries=0)
+        except Exception:  # noqa: BLE001
+            pass
+        self._m_hedges.inc(1, reason="slow_stream")
+        self._event(
+            "request_hedged", level="warn", request_id=rid,
+            from_replica=cur, to_replica=idx,
+        )
+        return True
+
     def on_replica_lost(
         self, idx: int, reason: str = ""
     ) -> Dict[str, List[str]]:
@@ -577,6 +769,13 @@ class ServeClient:
             "replica_lost", level="error", replica=idx,
             reason=str(reason)[:300], incomplete=len(victims),
         )
+        if self.router is not None:
+            try:
+                # Its warm pages died with it: shared-prefix traffic
+                # must re-learn instead of chasing a ghost.
+                self.router.forget_replica(idx)
+            except Exception:  # noqa: BLE001 - hints only
+                pass
         moved: List[str] = []
         lost: List[str] = []
         for rid in victims:
@@ -652,6 +851,127 @@ class ServeClient:
             self._lost.discard(idx)
         self._event("replica_respawned", replica=idx)
         return leader
+
+    # -- autoscaling (the router's capacity arm) ---------------------------
+    def add_replica(self) -> int:
+        """Scale UP: spawn a brand-new replica at the next index through
+        the retained spawn recipe (fresh node capacity — the original
+        placement group reserved exactly N bundles) and add it to the
+        routing table once it pings healthy. Returns the new index."""
+        if self._respawn_fn is None:
+            raise RuntimeError(
+                "this client has no spawn path (constructed without "
+                "respawn_fn — use serve.start_replicas)"
+            )
+        with self._lock:
+            idx = len(self._replicas)
+            # Reserve the slot so a concurrent add picks the next index;
+            # the placeholder is invisible to routing (excluded) until
+            # the spawn pings healthy.
+            self._replicas.append(None)
+            self._excluded.add(idx)
+        leader: Any = None
+        followers: List[Any] = []
+        try:
+            try:
+                leader, followers = self._respawn_fn(
+                    idx, fresh_capacity=True
+                )
+            except TypeError:
+                # A respawn_fn without the knob (tests, custom wiring).
+                leader, followers = self._respawn_fn(idx)
+            fabric.get(
+                [h.ping.remote() for h in [leader] + list(followers)],
+                timeout=self._init_timeout,
+            )
+        except BaseException:
+            with self._lock:
+                # The slot stays a tombstone: indices never shift.
+                self._retired.add(idx)
+            for h in ([leader] if leader is not None else []) + list(
+                followers
+            ):
+                try:
+                    fabric.kill(h)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        with self._lock:
+            self._replicas[idx] = leader
+            self._followers.extend(followers)
+            self._follower_replica.extend([idx] * len(followers))
+            self._excluded.discard(idx)
+        self._event("replica_added", replica=idx)
+        return idx
+
+    def retire_replica(
+        self,
+        idx: int,
+        drain_timeout_s: float = 30.0,
+        poll_s: float = 0.05,
+    ) -> Dict[str, Any]:
+        """Scale DOWN gracefully: exclude ``idx`` from new traffic,
+        wait (bounded) for its routed requests to finish streaming,
+        LIVE-MIGRATE any leftovers onto survivors (journal resubmission
+        under the same id/seed — bit-exact, cursor-deduplicated), then
+        stop the actor. The index remains in the table as a RETIRED
+        tombstone so every id->index mapping stays stable. No request
+        is lost at retire time unless no survivor exists."""
+        idx = int(idx)
+        with self._lock:
+            if idx in self._retired:
+                return {"migrated": [], "lost": [], "already": True}
+        self.exclude(idx)
+        deadline = time.monotonic() + max(0.0, float(drain_timeout_s))
+        while self.requests_on(idx) > 0 and time.monotonic() < deadline:
+            time.sleep(poll_s)
+        with self._lock:
+            victims = sorted(
+                rid for rid, r in self._route.items() if r == idx
+            )
+        moved: List[str] = []
+        lost: List[str] = []
+        for rid in victims:
+            if self._resubmit_from_journal(rid, exclude=idx):
+                moved.append(rid)
+            else:
+                lost.append(rid)
+        with self._lock:
+            self._retired.add(idx)
+            actor = self._replicas[idx]
+            gang = [
+                f for f, owner in zip(
+                    self._followers, self._follower_replica
+                )
+                if owner == idx
+            ]
+            kept = [
+                (f, owner) for f, owner in zip(
+                    self._followers, self._follower_replica
+                )
+                if owner != idx
+            ]
+            self._followers = [f for f, _ in kept]
+            self._follower_replica = [owner for _, owner in kept]
+        for h in ([actor] if actor is not None else []) + gang:
+            try:
+                fabric.get(h.stop.remote(), timeout=10.0)
+            except Exception:  # noqa: BLE001 - retiring anyway
+                pass
+            try:
+                fabric.kill(h)
+            except Exception:  # noqa: BLE001
+                pass
+        if self.router is not None:
+            try:
+                self.router.forget_replica(idx)
+            except Exception:  # noqa: BLE001
+                pass
+        self._event(
+            "replica_retired", replica=idx,
+            migrated=len(moved), lost=len(lost),
+        )
+        return {"migrated": moved, "lost": lost}
 
     # -- preemption drain (the supervisor's graceful-kill arm) -------------
     def prespawn_replacement(self, idx: int) -> bool:
@@ -848,6 +1168,11 @@ class ServeClient:
         must keep reporting THROUGH a replica's death)."""
         rows: List[Dict[str, Any]] = []
         for i in range(self.num_replicas):
+            if self.is_retired(i):
+                # A scale-down tombstone, not a failure: the row says so
+                # instead of masquerading as an unreachable replica.
+                rows.append({"retired": True, "health": "retired"})
+                continue
             try:
                 rows.append(self._rpc(i, "stats", retries=0))
             except Exception as exc:  # noqa: BLE001 - isolate per replica
@@ -977,6 +1302,16 @@ class ServeClient:
         /healthz must aggregate a PARTIALLY dead fleet, not 500 on it."""
         out: List[Dict[str, Any]] = []
         for i in range(self.num_replicas):
+            if self.is_retired(i):
+                out.append({
+                    "verdict": "retired",
+                    "healthy": False,
+                    "retired": True,
+                    "reasons": ["retired by scale-down"],
+                    "components": {},
+                    "watchdog": False,
+                })
+                continue
             try:
                 out.append(self._rpc(i, "health", retries=0))
             except Exception as exc:  # noqa: BLE001 - isolate per replica
@@ -1071,12 +1406,15 @@ class ServeClient:
 
         with self._lock:
             replicas = list(self._replicas)
+            retired = set(self._retired)
             followers = list(
                 zip(self._followers, self._follower_replica)
             )
             prespawned = list(self._prespawned.items())
             self._prespawned = {}
         for i, r in enumerate(replicas):
+            if r is None or i in retired:
+                continue  # scale-down tombstones are already gone
             _drain("replica", i, r)
         for f, owner in followers:
             _drain("follower", owner, f)
@@ -1119,6 +1457,8 @@ def start_replicas(
     hosts_per_replica: int = 1,
     coordinator_host: str = "127.0.0.1",
     rpc_timeout_s: Optional[float] = None,
+    retry_budget_ratio: Optional[float] = 0.5,
+    hedge_after_s: Optional[float] = None,
     **replica_kwargs: Any,
 ) -> ServeClient:
     """Spawn a replica gang on the fabric and return a connected client.
@@ -1287,4 +1627,6 @@ def start_replicas(
         respawn_fn=spawn_replica,
         rpc_timeout_s=rpc_timeout_s,
         init_timeout=init_timeout,
+        retry_budget_ratio=retry_budget_ratio,
+        hedge_after_s=hedge_after_s,
     )

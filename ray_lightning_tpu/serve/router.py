@@ -1,0 +1,868 @@
+"""Front-door router: the driver-side routing policy layer.
+
+``ServeClient._pick`` was a bare round-robin over a manually maintained
+exclusion set — nothing in the fleet consumed the supervisor's replica
+states, the watchdog's ``health()`` verdicts, or the per-replica cache
+signals the obs stack already publishes. This module closes that gap:
+:class:`Router` is the policy ``ServeClient.submit`` consults instead of
+round-robin, composing four mechanisms:
+
+1. **Health/state-aware weighting** — supervisor replica states
+   (DRAINING / DEAD / PREEMPTING / FAILED / RETIRED) and ``health()``
+   verdicts demote or exclude replicas automatically. A ``degraded``
+   replica keeps serving at reduced weight; an ``unhealthy`` or
+   state-excluded one receives no new traffic at all.
+2. **Prefix-affinity routing** — the router hashes the prompt's token
+   blocks with the SAME chained blake2 digests ``serve/engine.py``
+   computes for its prefix pool, and remembers which replica served
+   each chain (a bounded driver-side digest map). Shared-prefix traffic
+   lands on the replica holding the warm pages, weighted by each
+   replica's effective cache size (the ``rlt_serve_prefix_bytes{tier=}``
+   signal rolled up into the fleet rows) — multiplying the single-
+   replica prefix-cache and tiered-spill wins across the fleet.
+3. **Admission control + graceful shedding** — per-replica load
+   estimates (queue depth, slot occupancy, paged-KV occupancy, windowed
+   decode rate) gate routing. A submit whose ``deadline_s`` cannot be
+   met even at the target's windowed decode rate is REJECTED up front
+   (typed, with a retry-after hint) instead of queueing to expire
+   server-side; when the whole fleet is saturated, deadline-infeasible
+   and lowest-priority work is shed at the front door so admitted work
+   keeps its SLO instead of every queue collapsing together.
+4. **Queue-driven autoscaling** — :class:`RouterAutoscaler` spawns and
+   retires replicas through the client's retained spawn recipes within
+   ``[min_replicas, max_replicas]``, driven by sustained queue depth
+   and shed rate; scale-down drains gracefully (exclude → wait for zero
+   routed requests → migrate leftovers → stop), so no request is ever
+   lost at retire time.
+
+The shed contract: a rejected submit raises
+:class:`RequestRejectedError` carrying ``reason`` and ``retry_after_s``
+— backpressure the caller can act on, not a crash. Paired with the
+client-side :class:`RetryBudget` (failover/transient retries capped as
+a fraction of recent submits) a sick fleet gets backpressure, not a
+retry storm; and the client's optional hedged streaming reads
+(``hedge_after_s``) cover the gray failures liveness probes cannot see
+— a slow-but-healthy replica's stream is re-driven on a peer
+bit-exactly (seed-chained rng) with the delivered prefix deduplicated.
+
+Everything is observable: ``rlt_router_{routed,shed,hedges,
+rebalances}_total{reason=}`` counters, the ``rlt_router_replica_weight``
+gauge, router rows in the ``/fleet`` payload and ``rlt top``, and the
+journal header records the router/autoscaler knobs so a replayed
+capture knows the policy that shaped its traffic.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+#: Supervisor states that must receive no NEW traffic (the recovery
+#: plane's exclusions, consumed here instead of trusted to be manual).
+NO_TRAFFIC_STATES = frozenset(
+    ("draining", "dead", "restarting", "failed", "preempting", "retired")
+)
+
+#: Health-verdict base weights: degraded keeps serving at half weight,
+#: unknown (no verdict yet — e.g. a freshly added replica) near full.
+_VERDICT_WEIGHT = {
+    "healthy": 1.0,
+    "degraded": 0.5,
+    "unknown": 0.9,
+    "retired": 0.0,
+    "unhealthy": 0.0,
+    "unreachable": 0.0,
+}
+
+
+class RequestRejectedError(RuntimeError):
+    """The router refused the submit at the front door (admission
+    control): the typed ``rejected`` outcome. Carries why
+    (``deadline_infeasible`` | ``saturated``) and a ``retry_after_s``
+    hint, so callers back off instead of treating overload or an
+    impossible deadline like a crash."""
+
+    def __init__(
+        self, reason: str, retry_after_s: float, detail: str = ""
+    ) -> None:
+        msg = f"request rejected ({reason}); retry after {retry_after_s:g}s"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+
+def prompt_block_digests(
+    tokens: Sequence[int], block: int
+) -> List[bytes]:
+    """Chained blake2 digests of the prompt's FULL token blocks —
+    digest i commits to tokens[0:(i+1)*block], the exact chaining
+    ``DecodeEngine._block_digests`` uses, so the router's affinity map
+    and the engines' prefix pools agree on what a shared prefix is."""
+    import numpy as np
+
+    out: List[bytes] = []
+    d = b""
+    arr = np.asarray(list(tokens), np.int32)
+    for i in range(len(arr) // block):
+        d = hashlib.blake2b(
+            d + arr[i * block : (i + 1) * block].tobytes(),
+            digest_size=16,
+        ).digest()
+        out.append(d)
+    return out
+
+
+class RetryBudget:
+    """Shared client-side retry budget: transient-failure retries are
+    allowed only up to ``ratio`` of the submits seen in the sliding
+    ``window_s``, plus a ``floor`` so a quiet client can still ride out
+    a blip. Per-call retry caps bound one RPC; this bounds the
+    AGGREGATE — a sick fleet gets backpressure, not a retry storm."""
+
+    def __init__(
+        self,
+        ratio: float = 0.5,
+        window_s: float = 30.0,
+        floor: int = 8,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.ratio = float(ratio)
+        self.window_s = float(window_s)
+        self.floor = max(0, int(floor))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._submits: deque = deque()
+        self._retries: deque = deque()
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._submits and self._submits[0] < cutoff:
+            self._submits.popleft()
+        while self._retries and self._retries[0] < cutoff:
+            self._retries.popleft()
+
+    def note_submit(self) -> None:
+        now = self._clock()
+        with self._lock:
+            self._prune(now)
+            self._submits.append(now)
+
+    def allowed(self) -> int:
+        """Retries the current window permits in total."""
+        with self._lock:
+            self._prune(self._clock())
+            return self.floor + int(self.ratio * len(self._submits))
+
+    def try_spend(self) -> bool:
+        """Take one retry from the budget; False when exhausted (the
+        caller should fail over / surface the error instead of
+        retrying)."""
+        now = self._clock()
+        with self._lock:
+            self._prune(now)
+            if len(self._retries) >= (
+                self.floor + int(self.ratio * len(self._submits))
+            ):
+                return False
+            self._retries.append(now)
+            return True
+
+
+def _default_view(idx: int) -> Dict[str, Any]:
+    """A neutral view for a replica the fleet plane has not reported on
+    yet (e.g. freshly added by the autoscaler): routable, unloaded."""
+    return {
+        "replica": int(idx),
+        "health": "unknown",
+        "state": "healthy",
+        "queue_depth": 0,
+        "active_slots": 0,
+        "num_slots": 1,
+        "decode_tokens_per_sec": 0.0,
+        "prefix_bytes": 0,
+        "kv_occupancy": None,
+    }
+
+
+class Router:
+    """The front-door routing policy (see module docstring).
+
+    ``client`` supplies the live signals (``stats()`` / ``health()``
+    fleet pulls and ``requests_on``); ``poller`` (obs.fleet.FleetPoller)
+    lets the router ride PR 8's existing pull instead of issuing its
+    own; ``state_fn`` (typically ``FleetSupervisor.rows``) feeds the
+    recovery plane's per-replica states into the exclusion logic.
+    Views refresh lazily at ``refresh_s`` cadence — routing itself is
+    pure host-side math on the cached rows.
+
+    Knobs: ``affinity`` toggles prefix-affinity (``prefix_block`` must
+    match the engines' block/page size for the digests to line up;
+    ``affinity_bias`` scales how strongly a matched prefix outranks
+    load); ``shed`` arms admission control (``shed_queue_factor`` — the
+    fleet is saturated when every routable replica's queue reaches this
+    many times its slot count); ``retry_after_s`` floors the hint a
+    rejection carries.
+    """
+
+    def __init__(
+        self,
+        client: Any = None,
+        poller: Any = None,
+        state_fn: Optional[Callable[[], List[Dict[str, Any]]]] = None,
+        refresh_s: float = 1.0,
+        affinity: bool = True,
+        prefix_block: int = 16,
+        affinity_bias: float = 2.0,
+        affinity_map_size: int = 65536,
+        shed: bool = True,
+        shed_queue_factor: float = 4.0,
+        retry_after_s: float = 0.25,
+        registry: Optional[Any] = None,
+        events: Optional[Any] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        from ray_lightning_tpu.obs.events import get_event_log
+        from ray_lightning_tpu.obs.registry import get_registry
+
+        self.client = client
+        self.poller = poller
+        self.state_fn = state_fn
+        self.refresh_s = float(refresh_s)
+        self.affinity = bool(affinity)
+        self.prefix_block = max(1, int(prefix_block))
+        self.affinity_bias = float(affinity_bias)
+        self.affinity_map_size = max(16, int(affinity_map_size))
+        self.shed = bool(shed)
+        self.shed_queue_factor = float(shed_queue_factor)
+        self.retry_after_s = float(retry_after_s)
+        self._clock = clock
+        self._events = events if events is not None else get_event_log()
+        reg = registry if registry is not None else get_registry()
+        self._m_routed = reg.counter(
+            "rlt_router_routed_total",
+            "Submits the router placed, by deciding policy "
+            "(affinity / weighted / fallback)",
+        )
+        self._m_shed = reg.counter(
+            "rlt_router_shed_total",
+            "Submits rejected at the front door, by reason "
+            "(deadline_infeasible / saturated)",
+        )
+        self._m_rebalances = reg.counter(
+            "rlt_router_rebalances_total",
+            "Route-table reweights: replicas excluded from or restored "
+            "to the routable set, by reason",
+        )
+        self._m_weight = reg.gauge(
+            "rlt_router_replica_weight",
+            "Router base weight per replica (0 = excluded; health x "
+            "load, before per-request affinity)",
+        )
+        self._lock = threading.RLock()
+        #: digest -> replica index (bounded LRU): where each prefix
+        #: chain last landed — the warm-page map.
+        self._affinity_map: "OrderedDict[bytes, int]" = OrderedDict()
+        #: idx -> merged view row (fleet row + supervisor state).
+        self._views: Dict[int, Dict[str, Any]] = {}
+        self._views_t = float("-inf")
+        #: idx -> routable? from the previous refresh (rebalance diffs).
+        self._routable_prev: Dict[int, bool] = {}
+        self._rr = 0
+        # Cumulative decision counters (the /fleet router totals; the
+        # registry counters carry the labelled split).
+        self.routed = 0
+        self.shed_count = 0
+
+    # -- views -------------------------------------------------------------
+    def _event(self, name: str, level: str = "info", **kv: Any) -> None:
+        try:
+            self._events.record("router", name, level=level, **kv)
+        except Exception:  # noqa: BLE001 - observability must not route
+            pass
+
+    def _pull_rows(self) -> Optional[List[Dict[str, Any]]]:
+        """Fleet rows (obs.fleet.summarize_replica schema): the poller's
+        latest snapshot when wired (one pull for the whole control
+        plane), else a direct client stats+health pull."""
+        if self.poller is not None:
+            try:
+                snap = self.poller.latest()
+            except Exception:  # noqa: BLE001 - fall through to the pull
+                snap = None
+            if snap and snap.get("replicas"):
+                return list(snap["replicas"])
+        if self.client is None:
+            return None
+        from ray_lightning_tpu.obs.fleet import summarize_replica
+
+        try:
+            stats = self.client.stats()
+            health = self.client.health()
+        except Exception:  # noqa: BLE001 - a broken pull routes neutral
+            return None
+        return [
+            summarize_replica(
+                s, health[i] if i < len(health) else None, index=i
+            )
+            for i, s in enumerate(stats)
+        ]
+
+    def refresh(self, force: bool = False) -> None:
+        """Rebuild the cached views when stale (or ``force``): merge the
+        fleet rows with the supervisor's per-replica states, recompute
+        base weights, publish the weight gauge, and count reweights."""
+        now = self._clock()
+        with self._lock:
+            if not force and now - self._views_t < self.refresh_s:
+                return
+            self._views_t = now
+        rows = self._pull_rows() or []
+        states: Dict[int, str] = {}
+        if self.state_fn is not None:
+            try:
+                for s in self.state_fn():
+                    states[int(s["replica"])] = str(s.get("state", ""))
+            except Exception:  # noqa: BLE001 - states are advisory
+                pass
+        views: Dict[int, Dict[str, Any]] = {}
+        for row in rows:
+            idx = int(row.get("replica", len(views)))
+            tiers = row.get("prefix_tier_hit_rate")  # presence signal
+            kv = row.get("kv_pages") or {}
+            views[idx] = {
+                "replica": idx,
+                "health": str(row.get("health", "unknown")),
+                "state": states.get(idx, "healthy"),
+                "queue_depth": int(row.get("queue_depth", 0)),
+                "active_slots": int(row.get("active_slots", 0)),
+                "num_slots": max(1, int(row.get("num_slots", 1))),
+                "decode_tokens_per_sec": float(
+                    row.get("decode_tokens_per_sec", 0.0)
+                ),
+                # Effective cache: resident prefix bytes across ALL
+                # tiers (device + host + disk) — a replica's capacity to
+                # hold warm prefixes, the affinity tiebreaker.
+                "prefix_bytes": int(row.get("prefix_bytes") or 0),
+                "has_tiers": bool(tiers),
+                "kv_occupancy": (
+                    float(kv["occupancy"]) if "occupancy" in kv else None
+                ),
+            }
+        with self._lock:
+            self._views = views
+            prev = self._routable_prev
+            cur = {
+                idx: self._base_weight(v) > 0.0
+                for idx, v in views.items()
+            }
+            for idx, ok in cur.items():
+                was = prev.get(idx)
+                if was is not None and was != ok:
+                    self._m_rebalances.inc(
+                        1, reason="restored" if ok else "excluded"
+                    )
+                    self._event(
+                        "router_reweight", replica=idx,
+                        routable=ok, state=views[idx]["state"],
+                        health=views[idx]["health"],
+                    )
+                self._m_weight.set(
+                    round(self._base_weight(views[idx]), 4), replica=idx
+                )
+            self._routable_prev = cur
+
+    @staticmethod
+    def _base_weight(view: Dict[str, Any]) -> float:
+        """Health x load weight, before per-request affinity. 0 means
+        excluded (state or verdict says no new traffic)."""
+        if view.get("state") in NO_TRAFFIC_STATES:
+            return 0.0
+        w = _VERDICT_WEIGHT.get(view.get("health", "unknown"), 0.9)
+        if w <= 0.0:
+            return 0.0
+        load = (
+            view.get("queue_depth", 0) + view.get("active_slots", 0)
+        ) / max(1, view.get("num_slots", 1))
+        w /= 1.0 + load
+        occ = view.get("kv_occupancy")
+        if occ is not None and occ > 0.9:
+            # Nearly out of KV pages: admission there would park behind
+            # page backpressure — steer elsewhere while any headroom
+            # exists.
+            w *= 0.25
+        return w
+
+    def views(self) -> Dict[int, Dict[str, Any]]:
+        self.refresh()
+        with self._lock:
+            return {i: dict(v) for i, v in self._views.items()}
+
+    # -- affinity ----------------------------------------------------------
+    def observe_route(self, prompt: Sequence[int], idx: int) -> None:
+        """A request landed on ``idx``: its prefix chain is warm there
+        now — remember it (bounded LRU)."""
+        if not self.affinity:
+            return
+        digests = prompt_block_digests(prompt, self.prefix_block)
+        if not digests:
+            return
+        with self._lock:
+            for d in digests:
+                self._affinity_map[d] = int(idx)
+                self._affinity_map.move_to_end(d)
+            while len(self._affinity_map) > self.affinity_map_size:
+                self._affinity_map.popitem(last=False)
+
+    def forget_replica(self, idx: int) -> None:
+        """A replica died/retired: its warm pages are gone — drop its
+        affinity entries so shared-prefix traffic re-learns."""
+        idx = int(idx)
+        with self._lock:
+            stale = [
+                d for d, i in self._affinity_map.items() if i == idx
+            ]
+            for d in stale:
+                del self._affinity_map[d]
+
+    def _affinity_blocks(
+        self, prompt: Sequence[int]
+    ) -> Dict[int, int]:
+        """Matched WHOLE-CHAIN prefix blocks per replica: the walk stops
+        at the first block whose digest is unknown or lands elsewhere —
+        only an unbroken chain is a warm prefix."""
+        if not self.affinity:
+            return {}
+        out: Dict[int, int] = {}
+        with self._lock:
+            run_idx: Optional[int] = None
+            run = 0
+            for d in prompt_block_digests(prompt, self.prefix_block):
+                i = self._affinity_map.get(d)
+                if i is None or (run_idx is not None and i != run_idx):
+                    break
+                run_idx = i
+                run += 1
+            if run_idx is not None and run:
+                out[run_idx] = run
+        return out
+
+    def affinity_entries(self) -> int:
+        with self._lock:
+            return len(self._affinity_map)
+
+    # -- the decision ------------------------------------------------------
+    def _retry_after(
+        self, views: List[Dict[str, Any]], max_new_tokens: int
+    ) -> float:
+        """Retry-after hint: the least-loaded replica's estimated time
+        to drain one queue slot at its windowed decode rate, floored by
+        the configured minimum and capped at 30s."""
+        best = None
+        for v in views:
+            rate = v.get("decode_tokens_per_sec") or 0.0
+            if rate <= 0:
+                continue
+            est = (
+                max(1, v.get("queue_depth", 0))
+                * max(1, max_new_tokens) / rate
+            )
+            best = est if best is None else min(best, est)
+        if best is None:
+            best = self.retry_after_s
+        return round(min(30.0, max(self.retry_after_s, best)), 3)
+
+    def pick(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: int = 32,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        alive: Optional[Sequence[int]] = None,
+    ) -> int:
+        """Route one submit: returns the replica index, or raises
+        :class:`RequestRejectedError` (admission control). ``alive`` is
+        the client's own exclusion-filtered candidate list — the router
+        only ever narrows it, never resurrects an excluded replica."""
+        self.refresh()
+        with self._lock:
+            views = dict(self._views)
+            rr = self._rr
+            self._rr += 1
+        cand = list(alive) if alive is not None else sorted(views)
+        scored: List[Any] = []
+        aff = self._affinity_blocks(prompt)
+        max_bytes = max(
+            (views.get(i, {}).get("prefix_bytes", 0) for i in cand),
+            default=0,
+        )
+        n_tok = max(1, len(prompt))
+        for i in cand:
+            view = views.get(i) or _default_view(i)
+            w = self._base_weight(view)
+            if w <= 0.0:
+                continue
+            frac = aff.get(i, 0) * self.prefix_block / n_tok
+            if frac:
+                # Affinity bonus, scaled by the replica's effective
+                # cache (a replica with tiers holding 10x the bytes is
+                # likelier to still hold an old chain).
+                cache_scale = 1.0
+                if max_bytes > 0:
+                    cache_scale = 0.5 + 0.5 * (
+                        view.get("prefix_bytes", 0) / max_bytes
+                    )
+                w *= 1.0 + self.affinity_bias * frac * cache_scale
+            scored.append((w, i, view, frac > 0))
+        if not scored:
+            # Nothing routable by policy: fall back to the client's
+            # alive list round-robin — the router must never be LESS
+            # available than the dumb picker it replaced (its views can
+            # be stale through a recovery; the client's exclusions are
+            # the hard filter).
+            if not cand:
+                from ray_lightning_tpu.serve.client import NoReplicasError
+
+                raise NoReplicasError(
+                    "no live replicas to route to (all excluded/lost)"
+                )
+            idx = cand[rr % len(cand)]
+            self._m_routed.inc(1, reason="fallback")
+            with self._lock:
+                self.routed += 1
+            return idx
+        scored.sort(key=lambda s: (-s[0], s[1]))
+        # Tie spread: equal-score candidates (fresh fleet, no load, no
+        # affinity) rotate round-robin instead of hammering replica 0.
+        top_w = scored[0][0]
+        ties = [s for s in scored if s[0] >= top_w * 0.999]
+        weight, idx, view, by_affinity = ties[rr % len(ties)]
+        # -- admission control ------------------------------------------
+        rate = view.get("decode_tokens_per_sec") or 0.0
+        if deadline_s is not None and rate > 0:
+            own_s = max_new_tokens / rate
+            if own_s > deadline_s:
+                # Infeasible even with an empty queue: the decode alone
+                # cannot finish by the deadline at this fleet's measured
+                # rate — reject NOW instead of queueing it to expire.
+                hint = self._retry_after(
+                    [v for _, _, v, _ in scored], max_new_tokens
+                )
+                self.shed_count += 1
+                self._m_shed.inc(1, reason="deadline_infeasible")
+                self._event(
+                    "router_shed", level="warn",
+                    reason="deadline_infeasible",
+                    deadline_s=deadline_s,
+                    est_decode_s=round(own_s, 4),
+                    retry_after_s=hint,
+                )
+                raise RequestRejectedError(
+                    "deadline_infeasible", hint,
+                    f"max_new_tokens={max_new_tokens} needs ~{own_s:.3f}s "
+                    f"at the windowed decode rate; deadline_s="
+                    f"{deadline_s:g}",
+                )
+        if self.shed:
+            saturated = all(
+                v.get("queue_depth", 0)
+                >= self.shed_queue_factor * v.get("num_slots", 1)
+                for _, _, v, _ in scored
+            )
+            if saturated:
+                infeasible = False
+                if deadline_s is not None and rate > 0:
+                    # Queue-aware feasibility: everything already queued
+                    # ahead (estimated at this request's own length)
+                    # plus its own decode must fit the deadline.
+                    wait_s = (
+                        view.get("queue_depth", 0) * max_new_tokens / rate
+                    )
+                    infeasible = (
+                        wait_s + max_new_tokens / rate > deadline_s
+                    )
+                if priority > 0 or infeasible:
+                    hint = self._retry_after(
+                        [v for _, _, v, _ in scored], max_new_tokens
+                    )
+                    self.shed_count += 1
+                    self._m_shed.inc(1, reason="saturated")
+                    self._event(
+                        "router_shed", level="warn", reason="saturated",
+                        priority=priority,
+                        queue_depth=view.get("queue_depth", 0),
+                        retry_after_s=hint,
+                    )
+                    raise RequestRejectedError(
+                        "saturated", hint,
+                        "every routable replica's queue is at "
+                        f">= {self.shed_queue_factor:g}x its slots",
+                    )
+        self._m_routed.inc(
+            1, reason="affinity" if by_affinity else "weighted"
+        )
+        with self._lock:
+            self.routed += 1
+        return idx
+
+    # -- read side ---------------------------------------------------------
+    def rows(self) -> Dict[str, Any]:
+        """The router block for the ``/fleet`` payload and ``rlt top``:
+        one row per known replica (weight, routable, state/health) plus
+        the decision totals and the policy knobs."""
+        with self._lock:
+            views = dict(self._views)
+            routed, shed = self.routed, self.shed_count
+            entries = len(self._affinity_map)
+        return {
+            "replicas": [
+                {
+                    "replica": idx,
+                    "weight": round(self._base_weight(v), 4),
+                    "routable": self._base_weight(v) > 0.0,
+                    "state": v.get("state"),
+                    "health": v.get("health"),
+                    "queue_depth": v.get("queue_depth", 0),
+                }
+                for idx, v in sorted(views.items())
+            ],
+            "routed": routed,
+            "shed": shed,
+            "affinity_entries": entries,
+            "config": self.describe(),
+        }
+
+    def describe(self) -> Dict[str, Any]:
+        """The policy knobs (the journal header's ``router`` section —
+        provenance a replayed capture carries)."""
+        return {
+            "refresh_s": self.refresh_s,
+            "affinity": self.affinity,
+            "prefix_block": self.prefix_block,
+            "affinity_bias": self.affinity_bias,
+            "shed": self.shed,
+            "shed_queue_factor": self.shed_queue_factor,
+            "retry_after_s": self.retry_after_s,
+        }
+
+
+class RouterAutoscaler:
+    """Queue-driven replica autoscaling within ``[min_replicas,
+    max_replicas]`` bounds.
+
+    Scale UP when the fleet's mean routable queue depth sustains at
+    ``up_queue_per_replica`` (or the router shed anything) for
+    ``sustain_ticks`` consecutive ticks — a new replica spawns through
+    the client's retained spawn recipe (``ServeClient.add_replica``,
+    fresh node capacity). Scale DOWN when the fleet sustains fully idle
+    (zero queue, zero active slots, zero sheds) for
+    ``down_sustain_ticks`` — the highest-index routable replica retires
+    GRACEFULLY (``ServeClient.retire_replica``: excluded first, drained,
+    leftovers migrated — no request lost at retire time). Clock-
+    injectable and drivable by explicit :meth:`tick` calls like the
+    supervisor."""
+
+    def __init__(
+        self,
+        client: Any,
+        router: Optional[Router] = None,
+        min_replicas: int = 1,
+        max_replicas: int = 1,
+        interval_s: float = 2.0,
+        up_queue_per_replica: float = 4.0,
+        sustain_ticks: int = 3,
+        down_sustain_ticks: int = 10,
+        registry: Optional[Any] = None,
+        events: Optional[Any] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        from ray_lightning_tpu.obs.events import get_event_log
+        from ray_lightning_tpu.obs.registry import get_registry
+
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.client = client
+        self.router = router
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.interval_s = float(interval_s)
+        self.up_queue_per_replica = float(up_queue_per_replica)
+        self.sustain_ticks = max(1, int(sustain_ticks))
+        self.down_sustain_ticks = max(1, int(down_sustain_ticks))
+        self._clock = clock
+        self._events = events if events is not None else get_event_log()
+        reg = registry if registry is not None else get_registry()
+        self._m_rebalances = reg.counter(
+            "rlt_router_rebalances_total",
+            "Route-table reweights: replicas excluded from or restored "
+            "to the routable set, by reason",
+        )
+        self._m_replicas = reg.gauge(
+            "rlt_router_autoscale_replicas",
+            "Routable replicas the autoscaler currently targets",
+        )
+        self._up_streak = 0
+        self._down_streak = 0
+        self._shed_seen = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _event(self, name: str, level: str = "info", **kv: Any) -> None:
+        try:
+            self._events.record("router", name, level=level, **kv)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _signals(self) -> Dict[str, Any]:
+        """Fleet load signals for one tick: routable replica count, the
+        total queue depth and active slots over them, and the router's
+        shed delta since the previous tick."""
+        alive = list(self.client.alive_replicas())
+        views: Dict[int, Dict[str, Any]] = {}
+        if self.router is not None:
+            views = self.router.views()
+        queue = sum(
+            views.get(i, {}).get("queue_depth", 0) for i in alive
+        )
+        active = sum(
+            views.get(i, {}).get("active_slots", 0) for i in alive
+        )
+        shed_total = (
+            self.router.shed_count if self.router is not None else 0
+        )
+        shed_delta = max(0, shed_total - self._shed_seen)
+        self._shed_seen = shed_total
+        return {
+            "alive": alive,
+            "queue_depth": queue,
+            "active_slots": active,
+            "shed_delta": shed_delta,
+        }
+
+    def tick(self) -> Dict[str, Any]:
+        sig = self._signals()
+        alive = sig["alive"]
+        n = len(alive)
+        self._m_replicas.set(n)
+        out = {"replicas": n, "scaled": None, **sig}
+        if n == 0:
+            return out  # recovery plane's problem, not capacity's
+        overloaded = (
+            sig["queue_depth"] / n >= self.up_queue_per_replica
+            or sig["shed_delta"] > 0
+        )
+        idle = (
+            sig["queue_depth"] == 0
+            and sig["active_slots"] == 0
+            and sig["shed_delta"] == 0
+        )
+        self._up_streak = self._up_streak + 1 if overloaded else 0
+        self._down_streak = self._down_streak + 1 if idle else 0
+        if (
+            self._up_streak >= self.sustain_ticks
+            and n < self.max_replicas
+        ):
+            self._up_streak = 0
+            self._down_streak = 0
+            try:
+                idx = self.client.add_replica()
+            except Exception as exc:  # noqa: BLE001 - a failed spawn
+                # must not kill the controller; the pressure persists
+                # and the next sustained window retries.
+                self._event(
+                    "autoscale_up_failed", level="warn",
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                )
+                return out
+            self.scale_ups += 1
+            self._m_rebalances.inc(1, reason="scale_up")
+            self._event(
+                "autoscale_up", replica=idx,
+                queue_depth=sig["queue_depth"],
+                shed_delta=sig["shed_delta"],
+            )
+            out["scaled"] = ("up", idx)
+        elif (
+            self._down_streak >= self.down_sustain_ticks
+            and n > self.min_replicas
+        ):
+            self._down_streak = 0
+            self._up_streak = 0
+            idx = max(alive)  # LIFO: autoscaled capacity retires first
+            try:
+                res = self.client.retire_replica(idx)
+            except Exception as exc:  # noqa: BLE001 - see above
+                self._event(
+                    "autoscale_down_failed", level="warn", replica=idx,
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                )
+                return out
+            self.scale_downs += 1
+            self._m_rebalances.inc(1, reason="scale_down")
+            self._event(
+                "autoscale_down", replica=idx,
+                migrated=len(res.get("migrated", [])),
+                lost=len(res.get("lost", [])),
+            )
+            out["scaled"] = ("down", idx)
+        return out
+
+    # -- thread lifecycle --------------------------------------------------
+    def start(self) -> "RouterAutoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as exc:  # noqa: BLE001 - the capacity loop
+                # must outlive a bad tick.
+                self._event(
+                    "tick_error", level="error",
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                )
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+#: Router/autoscaler knobs a journal header's ``router`` section may
+#: carry — the policy provenance ``rlt replay`` surfaces so a replayed
+#: capture knows what shaped its traffic (the single-engine replay
+#: itself has no fleet to route over).
+ROUTER_HEADER_KEYS = frozenset((
+    "refresh_s", "affinity", "prefix_block", "affinity_bias",
+    "shed", "shed_queue_factor", "retry_after_s",
+    "hedge_after_s", "retry_budget_ratio",
+    "autoscale_min", "autoscale_max", "autoscale_interval_s",
+))
+
+
+def router_config_from_header(
+    header: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """The recorded router/autoscaler knobs from a journal header
+    (empty when the capture predates the router or ran without one)."""
+    if not header:
+        return {}
+    section = header.get("router") or {}
+    return {k: v for k, v in section.items() if k in ROUTER_HEADER_KEYS}

@@ -426,6 +426,7 @@ class ServeReplica:
         journal: bool = True,
         journal_dir: Optional[str] = None,
         journal_capacity: int = 4096,
+        router_config: Optional[Dict[str, Any]] = None,
         watchdog: bool = True,
         watchdog_interval_s: float = 1.0,
         stall_s: float = 10.0,
@@ -563,6 +564,10 @@ class ServeReplica:
                 max_prefills_per_step=max_prefills_per_step,
                 max_prefill_chunks_per_step=max_prefill_chunks_per_step,
                 priority_age_s=priority_age_s,
+                # The driver-side router/autoscaler knobs (provenance:
+                # the policy that shaped this replica's traffic rides
+                # the journal a replay rebuilds from).
+                router=router_config,
             ))
         # Deterministic fault injection (serve.faults): an explicit plan
         # beats the RLT_FAULTS env gate; armed rules fire at named

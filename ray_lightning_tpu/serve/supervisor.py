@@ -65,11 +65,12 @@ DEAD = "dead"
 RESTARTING = "restarting"
 FAILED = "failed"
 PREEMPTING = "preempting"
+RETIRED = "retired"
 
 #: rlt_fleet_replica_state gauge values (renders in dashboards).
 _STATE_SCORE = {
     HEALTHY: 0.0, DRAINING: 1.0, DEAD: 2.0, RESTARTING: 3.0, FAILED: 4.0,
-    PREEMPTING: 5.0,
+    PREEMPTING: 5.0, RETIRED: 6.0,
 }
 
 
@@ -243,10 +244,19 @@ class FleetSupervisor:
             "probed": 0, "failed_over": 0, "restarted": 0,
             "restart_failures": 0, "preempting": 0,
         }
+        retired_fn = getattr(self.client, "is_retired", None)
         for idx in range(int(self.client.num_replicas)):
             with self._lock:
                 st = self._replicas.setdefault(idx, self._fresh())
                 state = st["state"]
+            if retired_fn is not None and retired_fn(idx):
+                # A scale-down tombstone: deliberately gone — never
+                # probed, never restarted (the autoscaler owns
+                # capacity; the supervisor owns failures).
+                with self._lock:
+                    st["state"] = RETIRED
+                    st["verdict"] = RETIRED
+                continue
             if state in (DEAD, RESTARTING):
                 self._try_restart(idx, now, summary)
                 continue

@@ -230,6 +230,32 @@ def test_bench_smoke_cpu():
     assert out["extra"]["preempt_requests_lost"] == 0, out["extra"]
     assert out["extra"]["preempt_exact"] is True, out["extra"]
     assert out["extra"]["preempt_cpu_control"] is True
+    # Front-door router: prefix-affinity routing must BEAT random
+    # (round-robin) on fleet prefix hit rate — affinity keeps each
+    # shared prefix on one replica instead of paying a cold prefill per
+    # (prefix, replica) pair — and shedding must beat collapse: under a
+    # 3x-overload burst, shed-on holds the admitted-work TTFT p95 SLO
+    # with ZERO admitted expiries (the flood is rejected at the door
+    # with retry-after hints) while shed-off breaches it.
+    router = {
+        (r["workload"], r["mode"]): r
+        for r in out["extra"]["router_rows"]
+    }
+    r_rand = router[("router_affinity", "random")]
+    r_aff = router[("router_affinity", "affinity")]
+    assert r_aff["prefix_hit_rate"] > r_rand["prefix_hit_rate"], router
+    assert out["extra"]["router_affinity_vs_random_hit"] > 1.0
+    o_off = router[("router_overload", "shed_off")]
+    o_on = router[("router_overload", "shed_on")]
+    assert o_on["rejected"] > 0 and o_on["expired"] == 0, router
+    assert o_on["ttft_p95_s"] <= o_on["slo_ttft_p95_s"], router
+    assert (
+        o_off["expired"] > 0
+        or o_off["ttft_p95_s"] > o_off["slo_ttft_p95_s"]
+    ), router
+    assert out["extra"]["router_shed_holds_slo"] is True
+    assert out["extra"]["router_shed_off_collapses"] is True
+    assert out["extra"]["router_cpu_control"] is True
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

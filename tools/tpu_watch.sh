@@ -26,6 +26,7 @@ have_replay=0
 have_failover=0
 have_preempt=0
 have_paged=0
+have_router=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -39,6 +40,7 @@ replay_fails=0
 failover_fails=0
 preempt_fails=0
 paged_fails=0
+router_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -56,6 +58,7 @@ replay_status=pending
 failover_status=pending
 preempt_status=pending
 paged_status=pending
+router_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -80,6 +83,7 @@ write_manifest() {
     echo "stage=failover status=$failover_status fails=$failover_fails"
     echo "stage=preempt status=$preempt_status fails=$preempt_fails"
     echo "stage=paged status=$paged_status fails=$paged_fails"
+    echo "stage=router status=$router_status fails=$router_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -234,6 +238,33 @@ while true; do
             have_paged=1
             paged_status=skipped
             echo "$(date -u +%H:%M:%S) paged serve bench SKIPPED after $paged_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_router" -eq 0 ]; then
+        # Stage 4a'': front-door-router artifact — the serve sweep now
+        # carries router_rows (skewed shared-prefix load random vs
+        # affinity routing: fleet hit rate + TTFT; 3x overload shed off
+        # vs on: admitted-work TTFT p95 vs SLO + goodput), so the next
+        # healthy window records the routing/shedding story next to the
+        # CPU control.
+        echo "$(date -u +%H:%M:%S) launching ROUTER serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/router_bench.json 2> /tmp/router_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/router_bench.json ] && \
+           grep -q router_rows /tmp/router_bench.json; then
+          have_router=1
+          router_status=ok
+          echo "$(date -u +%H:%M:%S) ROUTER serve bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          router_fails=$((router_fails+1))
+          router_status=failed
+          echo "$(date -u +%H:%M:%S) router serve bench failed rc=$rc (fail $router_fails)" >> /tmp/tpu_watch.log
+          if [ "$router_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_router=1
+            router_status=skipped
+            echo "$(date -u +%H:%M:%S) router serve bench SKIPPED after $router_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_sharded" -eq 0 ]; then
