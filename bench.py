@@ -2309,6 +2309,334 @@ def bench_router(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
     return _in_worker(run, False, timeout=1200.0)
 
 
+def bench_disagg(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
+    """``disagg_rows``: the fleet KV plane measured on 2-replica CPU
+    fleets (driver-side + transfer-plane machinery — always a CPU
+    control):
+
+    - ``disagg_prefill``: a heavy-prefill mix (resident decoders + a
+      burst of long prompts) on 2 mixed replicas vs 1 prefill + 1
+      decode. Mixed, every long prompt's chunked prefill interleaves
+      with the resident decode folds on the same engine; disaggregated,
+      prefills run on the prefill replica and the decode replica's
+      folds stay clean — the residents' inter-token p95 must IMPROVE,
+      with every stream bit-identical across modes.
+    - ``fleet_prefix``: shared prefixes warmed on replica 0, then
+      replica 0 excluded (drain/hot-spot) so revisits land on replica
+      1 — isolated caches re-prefill cold; with the fleet plane on,
+      replica 1 FETCHES the chain from replica 0 and admits warm. The
+      fleet-aggregate prefix hit rate must beat the isolated baseline.
+    """
+
+    def run():
+        import dataclasses
+        import os as _os
+        import tempfile as _tempfile
+        import threading as _threading
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu import fabric as _fabric
+        from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+        from ray_lightning_tpu.serve.client import start_replicas
+        from ray_lightning_tpu.serve.router import (
+            Router,
+            prompt_block_digests,
+        )
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        _fabric.init(num_cpus=max(8.0, float(_os.cpu_count() or 1)))
+
+        # Big enough that a prefill CHUNK is real compute (a 64-row
+        # d=256 forward, ~5ms CPU) while a shipped-page import stays a
+        # device write (~1ms) — the asymmetry disaggregation exploits;
+        # on a dispatch-dominated toy model the two blur together.
+        cfg = GPTConfig(
+            vocab_size=256, n_layer=2, n_head=4, n_kv_head=2,
+            d_model=256, max_seq=256, attn_impl="reference",
+            compute_dtype="float32",
+        )
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        ckpt = _os.path.join(
+            _tempfile.mkdtemp(prefix="rlt_disagg_"), "m.ckpt"
+        )
+        state_stream_to_file(
+            to_state_stream(
+                {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+            ),
+            ckpt,
+        )
+        g = np.random.default_rng(0)
+        rows = []
+
+        def pct(vals, q):
+            vals = sorted(vals)
+            idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+            return vals[idx]
+
+        # ---- disagg: heavy-prefill mix, mixed vs prefill/decode ------
+        # Heavy chunks (64 tokens of a d=256 model) are the
+        # interference under test: in the mixed fleet each long
+        # prompt's ~4 chunks interleave with the resident folds on the
+        # same engine; disaggregated, the decode replica sees only one
+        # page import (a device write) and one short suffix chunk per
+        # long. Paged KV keeps the decode side's warm admissions
+        # copy-free (table aliases).
+        block = 64
+        res_prompt = [
+            g.integers(0, cfg.vocab_size, size=8).tolist()
+            for _ in range(2)
+        ]
+        res_new = 128
+        longs = [
+            g.integers(0, cfg.vocab_size, size=240).tolist()
+            for _ in range(6)
+        ]
+        eng_kw = dict(
+            num_slots=4, max_seq=256, prefill_buckets=[64],
+            prefill_chunk=64, kv_page=block, kv_pages=24,
+            decode_fold=1, max_prefill_chunks_per_step=1,
+        )
+
+        def disagg_run(roles):
+            client = start_replicas(
+                2, ckpt_path=ckpt, env={"JAX_PLATFORMS": "cpu"},
+                roles=roles, rpc_timeout_s=120.0, **eng_kw,
+            )
+            client.router = Router(
+                client=client, refresh_s=0.05, prefix_block=block,
+                shed=False,
+            )
+            try:
+                gaps, res_out, long_out = [], {}, {}
+                t_burst = [float("inf")]
+
+                def follow_resident(j, prompt):
+                    toks, last = [], None
+                    h = client.submit(
+                        prompt, max_new_tokens=res_new, seed=j,
+                    )
+                    for tok in client.stream_handle(
+                        h, poll_s=0.002, timeout_s=300,
+                    ):
+                        now = _time.monotonic()
+                        if last is not None:
+                            gaps.append((now, now - last))
+                        last = now
+                        toks.append(tok)
+                    res_out[j] = toks
+
+                threads = [
+                    _threading.Thread(
+                        target=follow_resident, args=(j, p), daemon=True
+                    )
+                    for j, p in enumerate(res_prompt)
+                ]
+                for t in threads:
+                    t.start()
+                _time.sleep(0.1)  # residents settle into steady decode
+                # The prefill burst lands while the residents decode;
+                # the graded gaps are the ones UNDER the mix (from the
+                # first long submit on — the quiet warm-up before it
+                # would only dilute both modes equally).
+                t_burst[0] = _time.monotonic()
+                hs = [
+                    client.submit(p, max_new_tokens=4, seed=100 + j)
+                    for j, p in enumerate(longs)
+                ]
+                for j, h in enumerate(hs):
+                    # Short blocking polls, like the residents': a long
+                    # 50ms result() wait would serialize behind the
+                    # replica's RPC surface and read as resident
+                    # latency in BOTH modes.
+                    long_out[j] = list(client.stream_handle(
+                        h, poll_s=0.002, timeout_s=300,
+                    ))
+                for t in threads:
+                    t.join(timeout=300)
+                stats = client.stats()
+                ships = sum(
+                    (s.get("kvfleet") or {}).get("ships", 0)
+                    for s in stats
+                )
+                # The graded number is SERVER-side: the engines' own
+                # per-step inter-token estimate on the replicas hosting
+                # resident decodes (disagg: the decode pool; a prefill
+                # replica's only "emitting" steps are chunk
+                # completions, which would read as huge inter-token
+                # without hosting any decode). Client-observed delivery
+                # gaps ride along, but they fold in result-RPC
+                # contention (the actor surface is serial), which the
+                # engines never see.
+                decode_stats = [
+                    s for s in stats if s.get("role") != "prefill"
+                ]
+                server_p95 = max(
+                    float(s.get("inter_token_p95_s") or 0.0)
+                    for s in decode_stats
+                )
+                server_p50 = max(
+                    float(s.get("inter_token_p50_s") or 0.0)
+                    for s in decode_stats
+                )
+                mix_gaps = [
+                    gap for t, gap in gaps if t >= t_burst[0]
+                ] or [gap for _, gap in gaps]
+                return {
+                    "inter_token_p95_s": round(server_p95, 6),
+                    "inter_token_p50_s": round(server_p50, 6),
+                    "delivery_p95_s": round(pct(mix_gaps, 0.95), 6),
+                    "mix_gap_samples": len(mix_gaps),
+                    "ships": ships,
+                    "outputs": (dict(res_out), dict(long_out)),
+                }
+            finally:
+                client.shutdown()
+
+        mixed = disagg_run(None)
+        split = disagg_run(["prefill", "decode"])
+        exact = (
+            mixed.pop("outputs") == split.pop("outputs")
+        )
+        rows.append({
+            "workload": "disagg_prefill", "mode": "mixed",
+            "residents": len(res_prompt), "long_prompts": len(longs),
+            **mixed,
+        })
+        rows.append({
+            "workload": "disagg_prefill", "mode": "disagg",
+            "residents": len(res_prompt), "long_prompts": len(longs),
+            "exact_vs_mixed": exact,
+            **split,
+        })
+        disagg_ratio = (
+            mixed["inter_token_p95_s"] / split["inter_token_p95_s"]
+            if split["inter_token_p95_s"] > 0 else 0.0
+        )
+
+        # ---- fleet cache: isolated vs fetch-on-miss ------------------
+        # Jobs are fixed up front: both modes must see byte-identical
+        # prompts (the exactness comparison is across modes).
+        shared, uniq, n_new, fp_block = 48, 8, 8, 16
+        prefixes = [
+            g.integers(0, cfg.vocab_size, size=shared).tolist()
+            for _ in range(3)
+        ]
+        warm_jobs = [
+            p + g.integers(0, cfg.vocab_size, size=uniq).tolist()
+            for p in prefixes
+        ]
+        revisit_jobs = [
+            p + g.integers(0, cfg.vocab_size, size=uniq).tolist()
+            for p in prefixes
+        ]
+        fp_kw = dict(
+            num_slots=2, max_seq=96, prefill_buckets=[64],
+            prefill_chunk=8, prefix_blocks=32, prefix_block=fp_block,
+            decode_fold=1,
+        )
+
+        def fleet_run(kvfleet_on):
+            client = start_replicas(
+                2, ckpt_path=ckpt, env={"JAX_PLATFORMS": "cpu"},
+                kvfleet=kvfleet_on, rpc_timeout_s=120.0, **fp_kw,
+            )
+            router = Router(
+                client=client, refresh_s=0.05, prefix_block=fp_block,
+                shed=False,
+            )
+            client.router = router
+            try:
+                # Warm every prefix on replica 0 (pinned — a fresh
+                # fleet's tie spread would otherwise scatter them; the
+                # pinned submit still feeds the shared directory).
+                outs = {}
+                for i, prompt in enumerate(warm_jobs):
+                    outs[("warm", i)] = list(client.stream(
+                        prompt, replica=0, max_new_tokens=n_new,
+                        seed=i, timeout_s=120,
+                    ))
+                assert all(
+                    router.directory.chain(
+                        prompt_block_digests(p, fp_block)
+                    )[0] == 0
+                    for p in prefixes
+                ), "warm-up did not land on replica 0"
+                # The hot-spot move: the holder drains — revisits must
+                # land on its peer (cold there; warm only via a fetch).
+                client.exclude(0)
+                t0 = _time.monotonic()
+                ttfts = []
+                for i, prompt in enumerate(revisit_jobs):
+                    t1 = _time.monotonic()
+                    first = None
+                    toks = []
+                    for tok in client.stream(
+                        prompt, max_new_tokens=n_new, seed=50 + i,
+                        timeout_s=120,
+                    ):
+                        if first is None:
+                            first = _time.monotonic() - t1
+                        toks.append(tok)
+                    ttfts.append(first)
+                    outs[("revisit", i)] = toks
+                stats = client.stats()
+                hit = sum(
+                    (s.get("prefix") or {}).get("hit_tokens", 0)
+                    for s in stats
+                )
+                looked = sum(
+                    (s.get("prefix") or {}).get("prompt_tokens", 0)
+                    for s in stats
+                )
+                fetches = sum(
+                    (s.get("kvfleet") or {}).get("fetches", 0)
+                    for s in stats
+                )
+                return {
+                    "fleet_prefix_hit_rate": round(
+                        hit / looked, 4
+                    ) if looked else 0.0,
+                    "revisit_ttft_p50_s": round(pct(ttfts, 0.5), 6),
+                    "kv_fetches": fetches,
+                    "span_s": round(_time.monotonic() - t0, 3),
+                    "outputs": outs,
+                }
+            finally:
+                client.shutdown()
+
+        isolated = fleet_run(False)
+        fleet = fleet_run(True)
+        fp_exact = isolated.pop("outputs") == fleet.pop("outputs")
+        rows.append({
+            "workload": "fleet_prefix", "mode": "isolated", **isolated,
+        })
+        rows.append({
+            "workload": "fleet_prefix", "mode": "fleet",
+            "exact_vs_isolated": fp_exact, **fleet,
+        })
+        return {
+            "disagg_rows": rows,
+            "disagg_inter_token_p95_ratio": round(disagg_ratio, 4),
+            "disagg_exact": exact,
+            "fleet_prefix_exact": fp_exact,
+            # Absolute gain (rates, not a ratio: distinct prefixes make
+            # the isolated baseline's rate exactly 0).
+            "fleet_prefix_hit_gain": round(
+                fleet["fleet_prefix_hit_rate"]
+                - isolated["fleet_prefix_hit_rate"], 4
+            ),
+            "disagg_cpu_control": True,
+        }
+
+    return _in_worker(run, False, timeout=1800.0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=3)
@@ -2468,6 +2796,10 @@ def main() -> None:
             extra.update(bench_router(use_tpu))
         except Exception as exc:  # noqa: BLE001 - still emit a record
             extra["router_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_disagg(use_tpu))
+        except Exception as exc:  # noqa: BLE001 - still emit a record
+            extra["disagg_error"] = f"{type(exc).__name__}: {exc}"
         extra["bench_wall_s"] = round(time.time() - t0, 1)
         val = extra.get("serve_shared_prefix_ttft_speedup", 0.0)
         print(
@@ -2608,6 +2940,10 @@ def main() -> None:
             extra.update(bench_router(use_tpu))
         except Exception as exc:  # noqa: BLE001
             extra["router_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_disagg(use_tpu))
+        except Exception as exc:  # noqa: BLE001
+            extra["disagg_error"] = f"{type(exc).__name__}: {exc}"
     extra["bench_wall_s"] = round(time.time() - t0, 1)
 
     print(

@@ -406,6 +406,8 @@ _SERVE_KEYS = frozenset((
     "router", "router_refresh_s", "router_affinity", "router_shed",
     "shed_queue_factor", "retry_budget", "hedge_after_s",
     "autoscale_min", "autoscale_max", "autoscale_interval_s",
+    "prefill_replicas", "kvfleet", "kvfleet_timeout_s",
+    "kvfleet_inflight_mb", "kvfleet_bandwidth_mbps",
 ))
 
 
@@ -683,9 +685,30 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
       autoscale_min / autoscale_max / autoscale_interval_s: queue-
         driven replica autoscaling within [min, max] (autoscale_max
         arms it; min defaults to the initial replica count): sustained
-        queue depth or shedding spawns replicas through the retained
-        spawn recipes; a sustained-idle fleet retires them gracefully
-        (drained + leftovers migrated — no request lost at retire).
+        queue depth, shedding, or SLO breaches spawn replicas through
+        the retained spawn recipes (role-aware — a disaggregated
+        fleet's prefill and decode pools scale independently); a
+        sustained-idle fleet retires them gracefully (drained +
+        leftovers migrated — no request lost at retire).
+      prefill_replicas: dedicate the FIRST N of `replicas` to chunked
+        prefill only (disaggregated prefill/decode; needs a prefix
+        cache or paged KV and at least one decode replica left over):
+        the router lands new prompts on the prefill pool, each
+        finished prefill's KV pages ship to a router-chosen decode
+        replica over fabric queues, and the request decodes there
+        warm — greedy output bit-identical to a fully local run.
+        Long prompts stop stealing fold time from resident decodes.
+      kvfleet: cross-replica KV sharing (default: auto — on for a
+        multi-replica fleet with a prefix cache/paged KV). When the
+        router must steer a request away from the replica holding its
+        prefix chain, the target fetches the pages from that peer
+        (digest-keyed, shard-aware) instead of re-prefilling cold —
+        N caches become one fleet cache. kvfleet_timeout_s bounds a
+        fetch (timeout/staleness degrade to cold prefill, never a
+        lost request); kvfleet_inflight_mb bounds in-flight transfer
+        bytes; kvfleet_bandwidth_mbps caps transfer throughput
+        (0 = uncapped). Traffic lands in
+        rlt_serve_kvfleet_*_total{role=} and the fleet rows.
       tracing: record request traces on the replicas (default on);
         trace_out: after serving, write the replicas' recent traces as
         Chrome trace-event JSON to this path (opens in Perfetto).
@@ -896,6 +919,26 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             f"--serve.autoscale_max {autoscale_max} is below the "
             f"initial replica count {replicas}"
         )
+    # Fleet KV plane: disaggregated prefill/decode pools + the
+    # cross-replica transfer knobs (validated below once the prefix
+    # cache / paged-KV config is resolved).
+    prefill_replicas = int(serve_cfg.pop("prefill_replicas", 0))
+    if not 0 <= prefill_replicas < replicas:
+        raise ValueError(
+            f"--serve.prefill_replicas {prefill_replicas} must leave "
+            f"at least one decode replica (0 <= N < replicas="
+            f"{replicas})"
+        )
+    kvfleet = serve_cfg.pop("kvfleet", None)
+    if kvfleet is not None:
+        kvfleet = bool(kvfleet)
+    kvfleet_timeout_s = float(serve_cfg.pop("kvfleet_timeout_s", 5.0))
+    kvfleet_inflight_mb = float(
+        serve_cfg.pop("kvfleet_inflight_mb", 64.0)
+    )
+    kvfleet_bandwidth_mbps = float(
+        serve_cfg.pop("kvfleet_bandwidth_mbps", 0.0)
+    )
     pc = serve_cfg.pop("prefix_cache", "off")
     if isinstance(pc, str):
         pc_norm = pc.strip().lower()
@@ -962,6 +1005,18 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     pb = serve_cfg.pop("prefill_buckets", None)
     if pb is not None:
         replica_kwargs["prefill_buckets"] = [int(b) for b in pb]
+    if prefill_replicas and not (blocks or kv_pages):
+        raise ValueError(
+            "--serve.prefill_replicas (disaggregated prefill) ships KV "
+            "pages through the prefix pool: set --serve.prefix_cache "
+            "(dense) or --serve.kv_pages (paged)"
+        )
+    roles = None
+    if prefill_replicas:
+        roles = (
+            ["prefill"] * prefill_replicas
+            + ["decode"] * (replicas - prefill_replicas)
+        )
     # Resolved router policy: built once — it constructs the Router
     # below AND rides into every replica's journal header (provenance a
     # replayed capture carries). Affinity digests must use the engines'
@@ -1032,6 +1087,11 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         rpc_timeout_s=rpc_timeout_s,
         retry_budget_ratio=retry_budget,
         hedge_after_s=hedge_after_s,
+        roles=roles,
+        kvfleet=kvfleet,
+        kvfleet_timeout_s=kvfleet_timeout_s,
+        kvfleet_inflight_mb=kvfleet_inflight_mb,
+        kvfleet_bandwidth_mbps=kvfleet_bandwidth_mbps,
         **replica_kwargs,
     )
     metrics_server = None
@@ -1374,10 +1434,12 @@ def render_fleet(payload: Dict[str, Any]) -> str:
         f"errors={payload.get('errors', 0)} "
         f"history={len(history)})",
         (
-            f"{'replica':>7} {'health':>9} {'queue':>5} {'slots':>7} "
+            f"{'replica':>7} {'health':>9} {'role':>7} {'queue':>5} "
+            f"{'slots':>7} "
             f"{'tok/s':>9} {'ttft_p50':>9} {'ttft_p95':>9} "
             f"{'accept':>7} {'hit':>6} {'hit d/h/k':>14} "
-            f"{'pages f/r/a':>12} {'goodput':>9} {'weight':>7}"
+            f"{'pages f/r/a':>12} {'fetch/ship':>11} {'goodput':>9} "
+            f"{'weight':>7}"
         ),
     ]
     # Router weights keyed by replica (absent without a router).
@@ -1409,9 +1471,18 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             if kvp
             else None
         )
+        # Fleet KV plane: cross-replica fetches / ships — "-" on
+        # fleets without the plane.
+        kvf = r.get("kvfleet") or {}
+        kvf_cell = (
+            "{}/{}".format(kvf.get("fetches", 0), kvf.get("ships", 0))
+            if kvf
+            else None
+        )
         out.append(
             f"{_fmt_cell(r.get('replica'), 7)} "
             f"{_fmt_cell(r.get('health'), 9)} "
+            f"{_fmt_cell(r.get('role', 'mixed'), 7)} "
             f"{_fmt_cell(r.get('queue_depth'), 5)} "
             + _fmt_cell(
                 f"{r.get('active_slots', 0)}/{r.get('num_slots', 0)}", 7
@@ -1423,6 +1494,7 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{_fmt_cell(r.get('prefix_hit_rate'), 6, 2)} "
             f"{_fmt_cell(tier_cell, 14)} "
             f"{_fmt_cell(page_cell, 12)} "
+            f"{_fmt_cell(kvf_cell, 11)} "
             f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)} "
             f"{_fmt_cell(weights.get(r.get('replica')), 7, 2)}"
         )
@@ -1435,6 +1507,14 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"goodput={fleet.get('goodput_tokens_per_device_s', 0.0)} "
             f"ttft_p95_worst={fleet.get('ttft_p95_s_worst')}"
         )
+        # Fleet KV plane roll-up: only rendered once the plane moved
+        # anything (a homogeneous isolated fleet stays clean).
+        if fleet.get("kvfleet_fetches") or fleet.get("kvfleet_ships"):
+            out.append(
+                f"kvfleet: fetches={fleet.get('kvfleet_fetches', 0)} "
+                f"timeouts={fleet.get('kvfleet_fetch_timeouts', 0)} "
+                f"ships={fleet.get('kvfleet_ships', 0)}"
+            )
     # Recovery plane (when a FleetSupervisor is wired): one cell per
     # replica — state, lifetime restarts, pending attempts.
     sup = payload.get("supervisor") or []

@@ -64,9 +64,31 @@ def summarize_replica(
     # enabled tier (device + host + disk) — a replica's capacity to
     # hold warm prefixes, the router's affinity tiebreaker.
     prefix_bytes = sum(int(r.get("bytes", 0)) for r in tiers.values())
+    kvf = stats.get("kvfleet")
     return {
         "replica": int(index),
         "health": str(verdict),
+        # Fleet KV plane: the replica's role (prefill/decode/mixed)
+        # plus a compact transfer row — what `rlt top`'s role/fetch
+        # columns and the role-aware router/autoscaler consume.
+        "role": str(stats.get("role") or "mixed"),
+        "kvfleet": (
+            {
+                k: kvf.get(k, 0)
+                for k in (
+                    "fetches", "fetch_bytes", "fetch_timeouts",
+                    "fetch_stale", "ships", "served_fetches",
+                    "pending_fetches",
+                )
+            }
+            if isinstance(kvf, dict)
+            else None
+        ),
+        # Quality signals for the router/autoscaler: cumulative
+        # SLO-breach count (PR 5's declarative rules) and the engine's
+        # dropped-digest report (the directory's eviction feed).
+        "slo_breaches": int(stats.get("slo_breaches") or 0),
+        "kv_dropped": stats.get("kv_dropped"),
         "queue_depth": int(stats.get("queue_depth", 0)),
         "active_slots": int(stats.get("active_slots", 0)),
         "num_slots": int(stats.get("num_slots", 0)),
@@ -113,9 +135,20 @@ def aggregate_fleet(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     toks = sum(r["cost_emitted_tokens"] for r in rows)
     dev = sum(r["cost_device_seconds"] for r in rows)
     p95s = [r["ttft_p95_s"] for r in rows if r.get("ttft_p95_s") is not None]
+    kvf_rows = [r.get("kvfleet") or {} for r in rows]
     return {
         "replicas": len(rows),
         "healthy": sum(1 for r in rows if r["health"] == "healthy"),
+        # Fleet KV plane roll-up: cross-replica fetch/ship traffic
+        # (zeros on fleets without the plane).
+        "kvfleet_fetches": sum(
+            int(k.get("fetches", 0)) for k in kvf_rows
+        ),
+        "kvfleet_fetch_timeouts": sum(
+            int(k.get("fetch_timeouts", 0)) + int(k.get("fetch_stale", 0))
+            for k in kvf_rows
+        ),
+        "kvfleet_ships": sum(int(k.get("ships", 0)) for k in kvf_rows),
         "queue_depth": sum(r["queue_depth"] for r in rows),
         "active_slots": sum(r["active_slots"] for r in rows),
         "num_slots": sum(r["num_slots"] for r in rows),

@@ -79,6 +79,7 @@ def engine_header(
     max_prefill_chunks_per_step: int = 1,
     priority_age_s: Optional[float] = None,
     router: Optional[Dict[str, Any]] = None,
+    kvfleet: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The config/checkpoint-identity header from a live engine: the
     RESOLVED knobs (buckets expanded, chunk coerced, mesh normalized),
@@ -139,6 +140,13 @@ def engine_header(
         # provenance a replay surfaces (the single-engine replay itself
         # has no fleet to route over).
         header["router"] = dict(router)
+    if kvfleet is not None:
+        # Fleet-KV/disagg knobs (serve.kvfleet.KVFLEET_HEADER_KEYS):
+        # role + transfer budgets. A disaggregated capture replays on
+        # one engine — shipped outcomes are recorded truncations (like
+        # PR 12's migrations), so the replay stays bit-exact while the
+        # section tells the operator what shaped the traffic.
+        header["kvfleet"] = dict(kvfleet)
     header.update(checkpoint_identity(ckpt_path))
     return header
 
@@ -760,6 +768,12 @@ def replay_journal(
         )
 
         result["router_config"] = router_config_from_header(header)
+    if header and header.get("kvfleet"):
+        from ray_lightning_tpu.serve.kvfleet import (
+            kvfleet_config_from_header,
+        )
+
+        result["kvfleet_config"] = kvfleet_config_from_header(header)
     if timing == "wall":
         snap = scheduler.metrics.snapshot()
         rep_tokens = sum(len(v) for v in replayed.values())

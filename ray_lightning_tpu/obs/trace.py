@@ -55,8 +55,15 @@ SPAN_SPEC_VERIFY = "spec_verify"
 SPAN_FINISH = "finish"
 SPAN_CANCEL = "cancel"
 SPAN_EXPIRE = "expire"
+#: Fleet KV plane: the request parked transfer-pending while its warm
+#: pages fetch from a peer (attrs: peer, blocks).
+SPAN_KV_FETCH = "kv_fetch"
+#: Disaggregated prefill: this engine finished the prefill and shipped
+#: the KV pages to a decode replica (attrs: target, blocks) — terminal
+#: HERE, the stream continues on the target.
+SPAN_SHIPPED = "shipped"
 
-TERMINAL_SPANS = (SPAN_FINISH, SPAN_CANCEL, SPAN_EXPIRE)
+TERMINAL_SPANS = (SPAN_FINISH, SPAN_CANCEL, SPAN_EXPIRE, SPAN_SHIPPED)
 
 
 class RequestTracer:

@@ -25,6 +25,14 @@ The L5 layer over the decode path (models/gpt.py: prefill + GQA KV cache
   shedding (:class:`RequestRejectedError` + retry-after), a shared
   client :class:`RetryBudget`, hedged streaming reads, and
   queue-driven replica autoscaling within ``[min, max]`` bounds.
+- :class:`FleetKVDirectory` / :class:`KVFleetPlane` (kvfleet.py) — the
+  fleet KV plane: one driver-side digest→replica directory (shared
+  with the router's prefix affinity, one invalidation path incl.
+  evicted blocks) plus per-replica transfer planes over fabric inbox
+  queues — cross-replica prefix fetches on miss, and disaggregated
+  prefill/decode (``start_replicas(roles=...)``: prefill replicas
+  ship each finished prefill's KV pages to a router-chosen decode
+  replica; bit-exact end to end).
 - :class:`FaultInjector` — deterministic fault injection (faults.py):
   kill/delay/drop/wedge/preempt at named lifecycle points, driving the
   chaos tests and the ``failover_blackout``/``preempt_drain`` benches.
@@ -54,6 +62,10 @@ from ray_lightning_tpu.serve.preempt import (
     get_monitor,
     reset_monitor,
 )
+from ray_lightning_tpu.serve.kvfleet import (
+    FleetKVDirectory,
+    KVFleetPlane,
+)
 from ray_lightning_tpu.serve.router import (
     RequestRejectedError,
     RetryBudget,
@@ -77,6 +89,8 @@ __all__ = [
     "RouterAutoscaler",
     "RequestRejectedError",
     "RetryBudget",
+    "FleetKVDirectory",
+    "KVFleetPlane",
     "FaultInjector",
     "FaultRule",
     "PreemptionMonitor",

@@ -140,6 +140,8 @@ class ServeClient:
         retry_budget_window_s: float = 30.0,
         retry_budget_floor: int = 8,
         hedge_after_s: Optional[float] = None,
+        roles: Optional[Sequence[str]] = None,
+        kv_queues: Optional[Dict[int, Any]] = None,
     ) -> None:
         from ray_lightning_tpu.obs.events import get_event_log
         from ray_lightning_tpu.obs.journal import WorkloadJournal
@@ -260,6 +262,19 @@ class ServeClient:
             "rlt_router_hedges_total",
             "Stalled streams re-driven on a peer replica, by reason",
         )
+        #: Per-index replica roles (mixed | prefill | decode) — the
+        #: disaggregated-placement table the router and the autoscaler
+        #: read; index-aligned with the replica list (tombstones keep
+        #: their last role).
+        self._roles: List[str] = [
+            str(r) for r in (roles or [])
+        ] or ["mixed"] * len(self._replicas)
+        while len(self._roles) < len(self._replicas):
+            self._roles.append("mixed")
+        #: Fleet KV transfer queues (index -> inbox), shared with the
+        #: spawn closure: add_replica broadcasts a new member's inbox
+        #: to the live fleet through register_kv_peer.
+        self._kv_queues: Dict[int, Any] = dict(kv_queues or {})
 
     # -- internals --------------------------------------------------------
     def _event(self, name: str, level: str = "info", **kv: Any) -> None:
@@ -350,6 +365,18 @@ class ServeClient:
         and autoscaler's candidate set)."""
         return self._alive()
 
+    def role_of(self, idx: int) -> str:
+        """Replica ``idx``'s role (mixed | prefill | decode)."""
+        with self._lock:
+            idx = int(idx)
+            if 0 <= idx < len(self._roles):
+                return self._roles[idx]
+        return "mixed"
+
+    def replicas_with_role(self, role: str) -> List[int]:
+        """Live replicas of one role (the autoscaler's pool view)."""
+        return [i for i in self._alive() if self.role_of(i) == str(role)]
+
     def _pick(self, exclude: Optional[int] = None) -> int:
         """Round-robin over the non-excluded replicas."""
         with self._lock:
@@ -407,12 +434,24 @@ class ServeClient:
         )
 
     def _submit_rpc(
-        self, idx: int, rid: str, prompt: List[int], record: Dict[str, Any]
+        self,
+        idx: int,
+        rid: str,
+        prompt: List[int],
+        record: Dict[str, Any],
+        extra: Optional[Dict[str, Any]] = None,
     ) -> None:
-        self._rpc(
-            idx, "submit", prompt, request_id=rid,
-            **{k: record[k] for k in _SUBMIT_DEFAULTS},
-        )
+        """``extra`` carries the fleet-KV placement hints (kv_hint /
+        ship_to) of the INITIAL placement only — failover/hedge
+        resubmissions deliberately omit them (decoding locally on the
+        survivor is always correct), so they never enter the journal
+        record this call normalizes from."""
+        kwargs = {k: record[k] for k in _SUBMIT_DEFAULTS}
+        if extra:
+            kwargs.update(
+                {k: v for k, v in extra.items() if v is not None}
+            )
+        self._rpc(idx, "submit", prompt, request_id=rid, **kwargs)
 
     def submit(
         self,
@@ -425,8 +464,15 @@ class ServeClient:
         pinned); sampling kwargs mirror ServeReplica.submit (including
         ``tenant`` for cost-ledger attribution). A replica dying under
         the submit re-routes to a survivor (pinned submits raise
-        instead — the pin was the point)."""
+        instead — the pin was the point). ``kv_hint``/``ship_to``
+        (fleet KV plane) are normally the router plan's job; passing
+        them explicitly overrides it (pinned submits included)."""
         rid = sampling.pop("request_id", None) or uuid.uuid4().hex[:12]
+        explicit_extra = {
+            k: sampling.pop(k)
+            for k in ("kv_hint", "ship_to")
+            if k in sampling
+        } or None
         unknown = set(sampling) - set(_SUBMIT_DEFAULTS)
         if unknown:
             raise TypeError(
@@ -445,11 +491,14 @@ class ServeClient:
         if self._retry_budget is not None:
             self._retry_budget.note_submit()
         while True:
+            extra: Optional[Dict[str, Any]] = explicit_extra
             if replica is not None:
                 idx = int(replica)
             else:
                 try:
-                    idx = self._route_pick(prompt, record)
+                    idx, planned = self._route_plan(prompt, record)
+                    if explicit_extra is None:
+                        extra = planned
                 except RequestRejectedError as exc:
                     # Admission control: the typed ``rejected`` outcome —
                     # journaled and evented; the request never left the
@@ -468,7 +517,7 @@ class ServeClient:
                 attrs={"replica": idx, "prompt_tokens": len(prompt)},
             )
             try:
-                self._submit_rpc(idx, rid, prompt, record)
+                self._submit_rpc(idx, rid, prompt, record, extra=extra)
             except ReplicaLostError as exc:
                 self.on_replica_lost(idx, reason=str(exc))
                 if replica is not None:
@@ -488,20 +537,34 @@ class ServeClient:
                     pass  # never fail a placed submit
             return RequestHandle(replica=idx, request_id=rid)
 
-    def _route_pick(self, prompt: Sequence[int], record: Dict[str, Any]) -> int:
-        """One routing decision: the attached router's policy, or the
-        round-robin fallback. May raise RequestRejectedError (router
-        admission control) or NoReplicasError."""
+    def _route_plan(
+        self, prompt: Sequence[int], record: Dict[str, Any]
+    ) -> Tuple[int, Optional[Dict[str, Any]]]:
+        """One routing decision: ``(replica, extra submit kwargs)`` —
+        the attached router's plan (replica + the fleet-KV placement
+        hints kv_hint/ship_to), or the round-robin fallback. May raise
+        RequestRejectedError (router admission control) or
+        NoReplicasError."""
         router = self.router
         if router is None:
-            return self._pick()
-        return int(router.pick(
-            prompt,
+            return self._pick(), None
+        kwargs = dict(
             max_new_tokens=record["max_new_tokens"],
             priority=record["priority"],
             deadline_s=record["deadline_s"],
             alive=self._alive(),
-        ))
+        )
+        plan_fn = getattr(router, "plan", None)
+        if plan_fn is None:
+            # A pick-only router (tests, custom policies): no hints.
+            return int(router.pick(prompt, **kwargs)), None
+        plan = plan_fn(prompt, **kwargs)
+        extra: Dict[str, Any] = {}
+        if getattr(plan, "kv_hint", None):
+            extra["kv_hint"] = plan.kv_hint
+        if getattr(plan, "ship_to", None) is not None:
+            extra["ship_to"] = int(plan.ship_to)
+        return int(plan.replica), (extra or None)
 
     def _finish(self, rid: str, status: str) -> None:
         """A request reached terminal state from this client's point of
@@ -594,6 +657,31 @@ class ServeClient:
                 if hedged:
                     last_progress = time.monotonic()
             if res["done"]:
+                if res["status"] == "shipped":
+                    # Disaggregated prefill: THIS replica prefilled and
+                    # shipped the KV pages to `ship_to` — resubmit there
+                    # (same id/seed; the decode replica re-emits the
+                    # identical stream and the cursor dedups the first
+                    # token already delivered). The target dying, or
+                    # the ship getting lost, degrades to journal
+                    # failover / cold prefill — never a lost request.
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"request {rid} was shipped but never "
+                            f"re-driven within {timeout_s}s"
+                        )
+                    if self._route_of(handle) == idx:
+                        if not self._follow_ship(
+                            rid, res.get("ship_to"), from_replica=idx,
+                            digests=res.get("ship_digests"),
+                        ):
+                            raise ReplicaLostError(
+                                idx,
+                                f"request {rid} was shipped but could "
+                                "not be re-driven (no surviving "
+                                "replicas)",
+                            )
+                    continue
                 if res["status"] == "migrated":
                     # Terminal on THAT replica only: a preemption drain
                     # evicted the request for resubmission elsewhere.
@@ -633,9 +721,12 @@ class ServeClient:
                 handle.replica, f"request {handle.request_id} was lost"
             )
         res = self._rpc(idx, "result", handle.request_id, cursor)
-        if res.get("done") and res.get("status") != "migrated":
-            # "migrated" is terminal on that replica, not for the
-            # request — the drain's resubmission keeps it open.
+        if res.get("done") and res.get("status") not in (
+            "migrated", "shipped"
+        ):
+            # "migrated"/"shipped" are terminal on that replica, not
+            # for the request — the drain's (or the disagg handoff's)
+            # resubmission keeps it open.
             self._finish(handle.request_id, res["status"])
         return res
 
@@ -648,27 +739,65 @@ class ServeClient:
         return ok
 
     # -- failover ----------------------------------------------------------
+    def _follow_ship(
+        self,
+        rid: str,
+        target: Optional[int],
+        from_replica: int,
+        digests: Optional[Sequence[str]] = None,
+    ) -> bool:
+        """Re-drive a SHIPPED request on its decode target (preferred —
+        the pages were pushed to its import queue) or any survivor.
+        The resubmission carries a ``kv_hint`` of the shipped digest
+        chain (the prefill replica reported it with the ship) naming
+        the prefill replica as the peer: if the ship raced admission or
+        got lost, the target fetches the chain back instead of
+        re-prefilling cold. No exclusion: if every decode-side replica
+        is gone, the prefill replica itself can decode the resubmission
+        (its pool is still warm) — availability beats disaggregation."""
+        extra = None
+        if digests:
+            extra = {"kv_hint": {
+                "peer": int(from_replica),
+                "digests": [str(d) for d in digests],
+                "blocks": len(digests),
+            }}
+        return self._resubmit_from_journal(
+            rid, target=target, extra=extra,
+        )
+
     def _resubmit_from_journal(
         self,
         rid: str,
         exclude: Optional[int] = None,
         blocks: Optional[list] = None,
+        target: Optional[int] = None,
+        extra: Optional[Dict[str, Any]] = None,
     ) -> bool:
         """Replay one OPEN request's journal submit record onto a live
         replica (same id, same prompt, same full SamplingParams — the
         survivor's seed-chained rng reproduces the stream bit-exactly).
         ``blocks`` (preemption drain) is the dying replica's exported
         prefix KV, pushed to the chosen survivor BEFORE the resubmit so
-        its admission walk hits warm. Returns False when the id has no
-        open record or no replica can take it (the request is then
-        marked lost)."""
+        its admission walk hits warm; ``target`` (disagg ship-follow)
+        pins the FIRST attempt to the decode replica holding the
+        shipped pages, falling back to the normal pick when it cannot
+        take the request; ``extra`` rides the resubmit RPC (the fetch
+        hint back to the shipping replica). Returns False when the id
+        has no open record or no replica can take it (the request is
+        then marked lost)."""
         with self._lock:
             record = self._open.get(rid)
         if record is None:
             return False
         while True:
+            idx = None
+            if target is not None:
+                if int(target) in self._alive(exclude=exclude):
+                    idx = int(target)
+                target = None  # one pinned attempt, then the pick
             try:
-                idx = self._pick(exclude=exclude)
+                idx = self._pick(exclude=exclude) if idx is None else idx
             except NoReplicasError:
                 with self._lock:
                     self._route[rid] = None
@@ -693,12 +822,21 @@ class ServeClient:
                     pass
                 blocks = None  # one survivor gets them; don't re-ship
             try:
-                self._submit_rpc(idx, rid, record["prompt"], record)
+                self._submit_rpc(
+                    idx, rid, record["prompt"], record, extra=extra,
+                )
             except ReplicaLostError as exc:
                 self.on_replica_lost(idx, reason=str(exc))
                 continue
             with self._lock:
                 self._route[rid] = idx
+            if self.router is not None:
+                try:
+                    # The chain is (or is about to be) warm on the
+                    # survivor — keep the shared directory truthful.
+                    self.router.observe_route(record["prompt"], idx)
+                except Exception:  # noqa: BLE001 - hints only
+                    pass
             self._m_failover.inc(1, outcome="resubmitted")
             self._event(
                 "failover", request_id=rid, outcome="resubmitted",
@@ -853,11 +991,14 @@ class ServeClient:
         return leader
 
     # -- autoscaling (the router's capacity arm) ---------------------------
-    def add_replica(self) -> int:
+    def add_replica(self, role: Optional[str] = None) -> int:
         """Scale UP: spawn a brand-new replica at the next index through
         the retained spawn recipe (fresh node capacity — the original
         placement group reserved exactly N bundles) and add it to the
-        routing table once it pings healthy. Returns the new index."""
+        routing table once it pings healthy. ``role`` dedicates the new
+        capacity to one disagg pool (prefill | decode; None = mixed) —
+        how the autoscaler grows the two pools independently. Returns
+        the new index."""
         if self._respawn_fn is None:
             raise RuntimeError(
                 "this client has no spawn path (constructed without "
@@ -870,16 +1011,24 @@ class ServeClient:
             # the spawn pings healthy.
             self._replicas.append(None)
             self._excluded.add(idx)
+            while len(self._roles) <= idx:
+                self._roles.append("mixed")
+            self._roles[idx] = str(role or "mixed")
         leader: Any = None
         followers: List[Any] = []
         try:
             try:
                 leader, followers = self._respawn_fn(
-                    idx, fresh_capacity=True
+                    idx, fresh_capacity=True, role=role
                 )
             except TypeError:
-                # A respawn_fn without the knob (tests, custom wiring).
-                leader, followers = self._respawn_fn(idx)
+                # A respawn_fn without the knobs (tests, custom wiring).
+                try:
+                    leader, followers = self._respawn_fn(
+                        idx, fresh_capacity=True
+                    )
+                except TypeError:
+                    leader, followers = self._respawn_fn(idx)
             fabric.get(
                 [h.ping.remote() for h in [leader] + list(followers)],
                 timeout=self._init_timeout,
@@ -901,6 +1050,17 @@ class ServeClient:
             self._followers.extend(followers)
             self._follower_replica.extend([idx] * len(followers))
             self._excluded.discard(idx)
+        # Fleet KV plane: the live fleet adopts the new member's inbox
+        # (the spawn closure created it; the new replica got the full
+        # peer map at spawn). Best-effort — a replica that misses the
+        # registration only loses fetch/ship shortcuts to the newcomer.
+        q = self._kv_queues.get(idx)
+        if q is not None:
+            for j in self._alive(exclude=idx):
+                try:
+                    self._rpc(j, "register_kv_peer", idx, q, retries=0)
+                except Exception:  # noqa: BLE001 - shortcuts only
+                    pass
         self._event("replica_added", replica=idx)
         return idx
 
@@ -1459,6 +1619,11 @@ def start_replicas(
     rpc_timeout_s: Optional[float] = None,
     retry_budget_ratio: Optional[float] = 0.5,
     hedge_after_s: Optional[float] = None,
+    roles: Any = None,
+    kvfleet: Optional[bool] = None,
+    kvfleet_timeout_s: float = 5.0,
+    kvfleet_inflight_mb: float = 64.0,
+    kvfleet_bandwidth_mbps: float = 0.0,
     **replica_kwargs: Any,
 ) -> ServeClient:
     """Spawn a replica gang on the fabric and return a connected client.
@@ -1483,14 +1648,70 @@ def start_replicas(
     The spawn recipe for each replica index is retained on the returned
     client as its ``respawn_fn``: ``FleetSupervisor`` restarts a dead
     replica by re-running exactly this spawn (same resolved config, same
-    placement-group bundle, fresh coordinator/queues for gangs).
-    ``rpc_timeout_s`` bounds every client RPC (see :class:`ServeClient`).
+    placement-group bundle, same ROLE, fresh coordinator/queues for
+    gangs). ``rpc_timeout_s`` bounds every client RPC (see
+    :class:`ServeClient`).
+
+    Fleet KV plane: ``roles`` dedicates replicas to disaggregated
+    prefill/decode pools (one role string for the whole fleet, or one
+    per index — ``["prefill", "decode", "decode"]``); ``kvfleet``
+    toggles cross-replica KV transfer (None = auto: on for a
+    multi-replica fleet with a prefix cache or paged KV). With the
+    plane on, every replica gets an inbox fabric queue plus every
+    peer's handle — prefix fetches, disagg ships, and autoscale-up
+    peer registration all ride them. ``kvfleet_timeout_s`` /
+    ``kvfleet_inflight_mb`` / ``kvfleet_bandwidth_mbps`` bound the
+    transfers (timeouts degrade to cold prefill).
     """
+    from ray_lightning_tpu.serve.kvfleet import ROLES
+
     if num_replicas < 1:
         raise ValueError("num_replicas must be >= 1")
     hosts = int(hosts_per_replica)
     if hosts < 1:
         raise ValueError("hosts_per_replica must be >= 1")
+    if roles is None:
+        roles_list = ["mixed"] * num_replicas
+    elif isinstance(roles, str):
+        roles_list = [roles] * num_replicas
+    else:
+        roles_list = [str(r) for r in roles]
+    if len(roles_list) != num_replicas:
+        raise ValueError(
+            f"roles has {len(roles_list)} entries for {num_replicas} "
+            "replicas (pass one role per replica, or one string)"
+        )
+    bad_roles = sorted(set(roles_list) - set(ROLES))
+    if bad_roles:
+        raise ValueError(
+            f"unknown role(s) {bad_roles}; valid roles: {ROLES}"
+        )
+    has_cache = bool(
+        replica_kwargs.get("prefix_blocks")
+        or replica_kwargs.get("kv_pages")
+    )
+    if "prefill" in roles_list:
+        if "decode" not in roles_list and "mixed" not in roles_list:
+            raise ValueError(
+                "a fleet of only prefill replicas can never decode — "
+                "add decode (or mixed) replicas"
+            )
+        if not has_cache:
+            raise ValueError(
+                "disaggregated prefill (role='prefill') ships KV pages "
+                "through the prefix pool: set prefix_blocks/"
+                "prefix_cache (dense) or kv_pages (paged)"
+            )
+    kvfleet_on = (
+        bool(kvfleet)
+        if kvfleet is not None
+        else (num_replicas > 1 and has_cache)
+    )
+    if "prefill" in roles_list and not kvfleet_on:
+        raise ValueError(
+            "disaggregated prefill needs the fleet KV plane "
+            "(kvfleet=False was forced off)"
+        )
     bundle: Dict[str, float] = {"CPU": float(num_cpus_per_replica)}
     if num_tpus_per_replica:
         bundle["TPU"] = float(num_tpus_per_replica)
@@ -1501,6 +1722,19 @@ def start_replicas(
             strategy=placement_strategy,
         )
     actor_cls = fabric.remote(ServeReplica)
+    # Fleet KV transfer wiring: one inbox queue per replica index,
+    # created up front for the initial fleet (every member's spawn
+    # snapshot of the peer map must include everyone) and lazily for
+    # autoscaled indices (add_replica broadcasts the newcomer's inbox
+    # to the live fleet via register_kv_peer).
+    kv_queues: Dict[int, Any] = {}
+    if kvfleet_on:
+        for i in range(num_replicas):
+            kv_queues[i] = fabric.Queue()
+    #: index -> resolved role; spawn/respawn both read it, so a
+    #: restarted prefill replica comes back a prefill replica, and an
+    #: autoscaled index keeps its role across supervisor restarts.
+    role_by_index: Dict[int, str] = dict(enumerate(roles_list))
 
     def opts_for(
         bundle_index: int, fresh_capacity: bool = False
@@ -1518,22 +1752,39 @@ def start_replicas(
         return o
 
     def spawn_replica(
-        i: int, fresh_capacity: bool = False
+        i: int, fresh_capacity: bool = False, role: Optional[str] = None
     ) -> Tuple[Any, List[Any]]:
         """Spawn replica ``i``'s process (group): the leader plus any
         gang followers, from the SAME resolved kwargs/bundles every
         time — the initial launch and every supervisor restart run
-        exactly this. ``fresh_capacity`` draws free node capacity
+        exactly this (``role`` overrides only for a brand-new
+        autoscaled index; respawns reuse the recorded role).
+        ``fresh_capacity`` draws free node capacity
         instead of the replica's placement-group bundle: a preemption
         PRE-spawn runs while the dying replica still occupies its
         bundle, so keeping capacity at N through the grace window
         requires headroom outside the reservation (no headroom fails
         fast — the normal in-bundle respawn still runs at drain end)."""
+        resolved_role = str(role or role_by_index.get(i, "mixed"))
+        role_by_index[i] = resolved_role
+        kw = dict(replica_kwargs)
+        kw["role"] = resolved_role
+        if kvfleet_on:
+            if i not in kv_queues:
+                kv_queues[i] = fabric.Queue()
+            kw.update(
+                kv_self=i,
+                kv_inbox=kv_queues[i],
+                kv_peers=dict(kv_queues),
+                kvfleet_timeout_s=float(kvfleet_timeout_s),
+                kvfleet_inflight_mb=float(kvfleet_inflight_mb),
+                kvfleet_bandwidth_mbps=float(kvfleet_bandwidth_mbps),
+            )
         if hosts == 1:
             return (
                 actor_cls.options(
                     **opts_for(i, fresh_capacity)
-                ).remote(**replica_kwargs),
+                ).remote(**kw),
                 [],
             )
         # One process group per mesh: leader + followers share a
@@ -1551,7 +1802,7 @@ def start_replicas(
         coordinator = f"{coordinator_host}:{_find_free_port()}"
         queues = [fabric.Queue() for _ in range(hosts - 1)]
         engine_kwargs = {
-            k: v for k, v in replica_kwargs.items() if k in ENGINE_KEYS
+            k: v for k, v in kw.items() if k in ENGINE_KEYS
         }
         follower_cls = fabric.remote(ServeShardFollower)
         gang_followers = []
@@ -1580,7 +1831,7 @@ def start_replicas(
                     "coordinator_address": coordinator,
                 },
                 gang_queues=queues,
-                **replica_kwargs,
+                **kw,
             )
         except BaseException:
             # A half-spawned gang must not leak followers blocked in a
@@ -1629,4 +1880,6 @@ def start_replicas(
         init_timeout=init_timeout,
         retry_budget_ratio=retry_budget_ratio,
         hedge_after_s=hedge_after_s,
+        roles=roles_list,
+        kv_queues=kv_queues,
     )

@@ -166,7 +166,7 @@ import dataclasses
 import hashlib
 import os
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -627,6 +627,13 @@ class DecodeEngine:
         #: numerator in the preempt bench.
         self.prefix_handoff_exports = 0
         self.prefix_handoff_imports = 0
+        #: Digests DROPPED from every tier (evicted with nowhere to
+        #: spill, pruned from disk, unreadable): the fleet directory's
+        #: eviction-invalidation feed. A bounded ring of recent hexes +
+        #: a lifetime count ride the stats endpoint; the driver forgets
+        #: them idempotently, so re-reporting across scrapes is safe.
+        self._dropped_ring: "deque[str]" = deque(maxlen=256)
+        self.kv_dropped_total = 0
 
         # Per-slot DEVICE state (fixed shapes: one step signature forever;
         # replicated under a mesh — slot writes and the per-fold harvest
@@ -2211,6 +2218,8 @@ class DecodeEngine:
         vm = self._pool_meta[victim]
         if self._tiered:
             self._spill_block(victim, vm.digest)
+        else:
+            self._note_dropped(vm.digest)
         del self._pool_map[vm.digest]
         self._pool_meta[victim] = None
         self.prefix_evictions += 1
@@ -2292,6 +2301,7 @@ class DecodeEngine:
                 self._disk_insert(digest, kp, vp)
             else:
                 self.tier_counters["host"]["evictions"] += 1
+                self._note_dropped(digest)
             return
         while self._host_map and (
             self._host_bytes() + self._blk_nbytes > self._host_budget
@@ -2302,6 +2312,7 @@ class DecodeEngine:
                 self._disk_insert(old_d, ok, ov)
             else:
                 self.tier_counters["host"]["evictions"] += 1
+                self._note_dropped(old_d)
         self._host_map[digest] = (kp, vp)
 
     def _disk_paths(self, digest: bytes) -> Tuple[str, str, str]:
@@ -2372,6 +2383,7 @@ class DecodeEngine:
                 except OSError:
                     pass
             self.tier_counters["disk"]["evictions"] += 1
+            self._note_dropped(digest)
             return
         while self._disk_map and (
             self._disk_bytes + size > self._disk_budget
@@ -2379,6 +2391,7 @@ class DecodeEngine:
             oldest = next(iter(self._disk_map))
             self._disk_drop(oldest)
             self.tier_counters["disk"]["evictions"] += 1
+            self._note_dropped(oldest)
         if self._disk_bytes + size > self._disk_budget:
             # One block alone exceeds the whole budget: it cannot live
             # here.
@@ -2388,6 +2401,7 @@ class DecodeEngine:
                 except OSError:
                     pass
             self.tier_counters["disk"]["evictions"] += 1
+            self._note_dropped(digest)
             return
         self._disk_map[digest] = size
         self._disk_bytes += size
@@ -2430,6 +2444,7 @@ class DecodeEngine:
             return kd, vd
         except (OSError, ValueError):
             self._disk_drop(digest)
+            self._note_dropped(digest)
             return None
 
     def _promote(
@@ -2475,7 +2490,87 @@ class DecodeEngine:
         self.refill_s += time.monotonic() - t0
         return idx
 
-    # -- cross-replica KV handoff (preemption drain) ----------------------
+    # -- cross-replica KV handoff (preempt drain + fleet KV plane) --------
+    def _note_dropped(self, digest: bytes) -> None:
+        """A digest left EVERY tier (nowhere to spill / disk pruned /
+        unreadable): record it for the fleet directory's eviction feed."""
+        self.kv_dropped_total += 1
+        self._dropped_ring.append(digest.hex())
+
+    def dropped_digests(self) -> List[str]:
+        """Recent fully-dropped digest hexes (bounded ring, NOT
+        drained): the stats row the driver-side fleet directory prunes
+        from — idempotent by construction, so multiple consumers can
+        read the same ring."""
+        return list(self._dropped_ring)
+
+    @property
+    def prefix_block_nbytes(self) -> int:
+        """Logical bytes of one pool block/page (K + V) — the fleet KV
+        plane's transfer-budget unit."""
+        return int(self._blk_nbytes) if self.prefix_blocks else 0
+
+    def cached_prefix_blocks(self, tokens: Sequence[int]) -> int:
+        """How many leading FULL blocks of ``tokens`` some local tier
+        already holds — a pure host-side probe (no promotion, no
+        refcounts, no counters): the fleet plane's is-a-fetch-worth-it
+        check, capped like the real walk so the final chunk's block
+        never counts."""
+        if not self.prefix_blocks:
+            return 0
+        tokens = np.asarray(tokens, np.int32)
+        matched = 0
+        for d in self._block_digests(tokens):
+            if (
+                d in self._pool_map
+                or d in self._host_map
+                or d in self._disk_map
+            ):
+                matched += 1
+            else:
+                break
+        while matched and matched * self.prefix_block >= len(tokens):
+            matched -= 1
+        return matched
+
+    def export_blocks_by_digest(
+        self, digests_hex: Sequence[str]
+    ) -> List[Tuple[str, Any, Any]]:
+        """Serialize a digest CHAIN for a fetching peer (the fleet KV
+        plane's fetch service): same wire form as
+        :meth:`export_prefix_blocks`, but addressed by the digests the
+        requester's hint carried instead of by tokens — the export path
+        generalized beyond the preempt drain. Chain order, stopping at
+        the first digest no tier holds (the requester learns staleness
+        from the short reply, not a timeout). Runs the compiled pool
+        read — engine driving thread only."""
+        if not self.prefix_blocks:
+            return []
+        out: List[Tuple[str, Any, Any]] = []
+        for hexd in digests_hex:
+            try:
+                d = bytes.fromhex(hexd)
+            except ValueError:
+                break
+            idx = self._pool_map.get(d)
+            if idx is not None:
+                k, v = self._pool_read_exec(
+                    self._pool_k, self._pool_v, np.int32(idx)
+                )
+                kp, vp = self._capture_block(k), self._capture_block(v)
+            elif d in self._host_map:
+                kp, vp = self._host_map[d]
+            elif d in self._disk_map:
+                payload = self._disk_load(d)
+                if payload is None:
+                    break
+                kp, vp = payload
+            else:
+                break
+            out.append((hexd, kp, vp))
+            self.prefix_handoff_exports += 1
+        return out
+
     def export_prefix_blocks(
         self, tokens: Sequence[int]
     ) -> List[Tuple[str, Any, Any]]:

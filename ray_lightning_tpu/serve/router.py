@@ -15,11 +15,20 @@ round-robin, composing four mechanisms:
 2. **Prefix-affinity routing** — the router hashes the prompt's token
    blocks with the SAME chained blake2 digests ``serve/engine.py``
    computes for its prefix pool, and remembers which replica served
-   each chain (a bounded driver-side digest map). Shared-prefix traffic
-   lands on the replica holding the warm pages, weighted by each
-   replica's effective cache size (the ``rlt_serve_prefix_bytes{tier=}``
-   signal rolled up into the fleet rows) — multiplying the single-
-   replica prefix-cache and tiered-spill wins across the fleet.
+   each chain in the shared :class:`serve.kvfleet.FleetKVDirectory`
+   (ONE digest→replica store for affinity routing AND the fleet KV
+   plane's fetch hints; invalidated on replica loss/retire and on the
+   engines' reported block evictions). Shared-prefix traffic lands on
+   the replica holding the warm pages, weighted by each replica's
+   effective cache size (the ``rlt_serve_prefix_bytes{tier=}`` signal
+   rolled up into the fleet rows) — multiplying the single-replica
+   prefix-cache and tiered-spill wins across the fleet. When the
+   decision steers a request AWAY from its chain's holder, the
+   :class:`RoutePlan` carries a ``kv_hint`` so the target fetches the
+   pages instead of re-prefilling cold; on a role-split fleet
+   (disaggregated prefill/decode) the plan lands prompts on the
+   prefill pool with a ``ship_to`` decode target, warm chains routing
+   straight to the decode side.
 3. **Admission control + graceful shedding** — per-replica load
    estimates (queue depth, slot occupancy, paged-KV occupancy, windowed
    decode rate) gate routing. A submit whose ``deadline_s`` cannot be
@@ -30,10 +39,14 @@ round-robin, composing four mechanisms:
    keeps its SLO instead of every queue collapsing together.
 4. **Queue-driven autoscaling** — :class:`RouterAutoscaler` spawns and
    retires replicas through the client's retained spawn recipes within
-   ``[min_replicas, max_replicas]``, driven by sustained queue depth
-   and shed rate; scale-down drains gracefully (exclude → wait for zero
-   routed requests → migrate leftovers → stop), so no request is ever
-   lost at retire time.
+   ``[min_replicas, max_replicas]``, driven by sustained queue depth,
+   shed rate, and the quality ledger (PR 8's goodput + PR 5's
+   SLO-breach rate — a busy-but-breaching fleet scales before its
+   queues explode; routing likewise demotes actively-breaching
+   replicas); role pools (prefill/decode) keep independent streaks and
+   scale with role-tagged ``add_replica``. Scale-down drains
+   gracefully (exclude → wait for zero routed requests → migrate
+   leftovers → stop), so no request is ever lost at retire time.
 
 The shed contract: a rejected submit raises
 :class:`RequestRejectedError` carrying ``reason`` and ``retry_after_s``
@@ -56,8 +69,11 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
-from collections import OrderedDict, deque
+from collections import deque
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_lightning_tpu.serve.kvfleet import FleetKVDirectory
 
 #: Supervisor states that must receive no NEW traffic (the recovery
 #: plane's exclusions, consumed here instead of trusted to be manual).
@@ -179,13 +195,31 @@ def _default_view(idx: int) -> Dict[str, Any]:
         "replica": int(idx),
         "health": "unknown",
         "state": "healthy",
+        "role": "mixed",
         "queue_depth": 0,
         "active_slots": 0,
         "num_slots": 1,
         "decode_tokens_per_sec": 0.0,
         "prefix_bytes": 0,
         "kv_occupancy": None,
+        "goodput": 0.0,
+        "slo_breaches": 0,
+        "slo_breach_delta": 0,
     }
+
+
+@dataclass(frozen=True)
+class RoutePlan:
+    """One routing decision: where the request goes (``replica``), and
+    — fleet KV plane — where its finished-prefill pages ship
+    (``ship_to``, disaggregated placement only) plus a warm-peer fetch
+    hint (``kv_hint = {"peer", "digests", "blocks"}``) when a DIFFERENT
+    replica holds the prompt's digest chain."""
+
+    replica: int
+    ship_to: Optional[int] = None
+    kv_hint: Optional[Dict[str, Any]] = None
+    policy: str = "weighted"
 
 
 class Router:
@@ -223,6 +257,7 @@ class Router:
         retry_after_s: float = 0.25,
         registry: Optional[Any] = None,
         events: Optional[Any] = None,
+        directory: Optional[FleetKVDirectory] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         from ray_lightning_tpu.obs.events import get_event_log
@@ -263,14 +298,27 @@ class Router:
             "load, before per-request affinity)",
         )
         self._lock = threading.RLock()
-        #: digest -> replica index (bounded LRU): where each prefix
-        #: chain last landed — the warm-page map.
-        self._affinity_map: "OrderedDict[bytes, int]" = OrderedDict()
+        #: The fleet KV directory (serve.kvfleet): digest -> replica,
+        #: ONE source of truth shared by this router's prefix-affinity
+        #: policy and the fleet KV plane's fetch hints — the two maps
+        #: PR 14 and the preempt handoff used to duplicate. One
+        #: invalidation path covers replica loss/retire
+        #: (forget_replica) AND block eviction (the engines'
+        #: dropped-digest stats rows, fed back in refresh()).
+        self.directory = (
+            directory
+            if directory is not None
+            else FleetKVDirectory(capacity=self.affinity_map_size)
+        )
         #: idx -> merged view row (fleet row + supervisor state).
         self._views: Dict[int, Dict[str, Any]] = {}
         self._views_t = float("-inf")
         #: idx -> routable? from the previous refresh (rebalance diffs).
         self._routable_prev: Dict[int, bool] = {}
+        #: idx -> last-seen cumulative SLO-breach count (refresh diffs
+        #: it into the view's slo_breach_delta — the actively-breaching
+        #: demotion signal).
+        self._breaches_prev: Dict[int, int] = {}
         self._rr = 0
         # Cumulative decision counters (the /fleet router totals; the
         # registry counters carry the labelled split).
@@ -333,10 +381,14 @@ class Router:
             idx = int(row.get("replica", len(views)))
             tiers = row.get("prefix_tier_hit_rate")  # presence signal
             kv = row.get("kv_pages") or {}
+            breaches = int(row.get("slo_breaches") or 0)
+            prev_b = self._breaches_prev.get(idx, breaches)
+            self._breaches_prev[idx] = breaches
             views[idx] = {
                 "replica": idx,
                 "health": str(row.get("health", "unknown")),
                 "state": states.get(idx, "healthy"),
+                "role": str(row.get("role") or "mixed"),
                 "queue_depth": int(row.get("queue_depth", 0)),
                 "active_slots": int(row.get("active_slots", 0)),
                 "num_slots": max(1, int(row.get("num_slots", 1))),
@@ -351,7 +403,29 @@ class Router:
                 "kv_occupancy": (
                     float(kv["occupancy"]) if "occupancy" in kv else None
                 ),
+                # PR 8's quality ledger, finally consumed: goodput
+                # (emitted tokens per device-second) and the SLO-breach
+                # rate demote replicas that are busy but not DELIVERING
+                # — signals raw queue depth cannot see.
+                "goodput": float(
+                    row.get("goodput_tokens_per_device_s") or 0.0
+                ),
+                "slo_breaches": breaches,
+                "slo_breach_delta": max(0, breaches - prev_b),
             }
+            # Eviction invalidation: digests this replica dropped from
+            # every tier leave the shared directory (idempotent — the
+            # report is a ring re-seen across refreshes; only entries
+            # pointing at THIS replica are touched).
+            dropped = (row.get("kv_dropped") or {}).get("recent") or []
+            if dropped:
+                try:
+                    self.directory.forget_digests(
+                        (bytes.fromhex(h) for h in dropped),
+                        replica=idx,
+                    )
+                except (TypeError, ValueError):
+                    pass  # malformed report; advisory only
         with self._lock:
             self._views = views
             prev = self._routable_prev
@@ -394,6 +468,11 @@ class Router:
             # page backpressure — steer elsewhere while any headroom
             # exists.
             w *= 0.25
+        if view.get("slo_breach_delta", 0) > 0:
+            # Actively breaching its SLOs since the last refresh: the
+            # goodput ledger's quality signal — the replica still
+            # serves, but new work goes to peers first.
+            w *= 0.5
         return w
 
     def views(self) -> Dict[int, Dict[str, Any]]:
@@ -401,58 +480,37 @@ class Router:
         with self._lock:
             return {i: dict(v) for i, v in self._views.items()}
 
-    # -- affinity ----------------------------------------------------------
+    # -- affinity (backed by the shared fleet KV directory) ----------------
     def observe_route(self, prompt: Sequence[int], idx: int) -> None:
         """A request landed on ``idx``: its prefix chain is warm there
-        now — remember it (bounded LRU)."""
+        now — remember it in the shared directory (bounded LRU)."""
         if not self.affinity:
             return
         digests = prompt_block_digests(prompt, self.prefix_block)
-        if not digests:
-            return
-        with self._lock:
-            for d in digests:
-                self._affinity_map[d] = int(idx)
-                self._affinity_map.move_to_end(d)
-            while len(self._affinity_map) > self.affinity_map_size:
-                self._affinity_map.popitem(last=False)
+        if digests:
+            self.directory.observe(digests, int(idx))
 
     def forget_replica(self, idx: int) -> None:
         """A replica died/retired: its warm pages are gone — drop its
-        affinity entries so shared-prefix traffic re-learns."""
-        idx = int(idx)
-        with self._lock:
-            stale = [
-                d for d, i in self._affinity_map.items() if i == idx
-            ]
-            for d in stale:
-                del self._affinity_map[d]
+        directory entries so shared-prefix traffic (and fetch hints)
+        re-learn instead of chasing a ghost."""
+        self.directory.forget_replica(int(idx))
 
     def _affinity_blocks(
         self, prompt: Sequence[int]
     ) -> Dict[int, int]:
-        """Matched WHOLE-CHAIN prefix blocks per replica: the walk stops
-        at the first block whose digest is unknown or lands elsewhere —
-        only an unbroken chain is a warm prefix."""
+        """Matched WHOLE-CHAIN prefix blocks per replica: the directory
+        walk stops at the first block whose digest is unknown or lands
+        elsewhere — only an unbroken chain is a warm prefix."""
         if not self.affinity:
             return {}
-        out: Dict[int, int] = {}
-        with self._lock:
-            run_idx: Optional[int] = None
-            run = 0
-            for d in prompt_block_digests(prompt, self.prefix_block):
-                i = self._affinity_map.get(d)
-                if i is None or (run_idx is not None and i != run_idx):
-                    break
-                run_idx = i
-                run += 1
-            if run_idx is not None and run:
-                out[run_idx] = run
-        return out
+        run_idx, run = self.directory.chain(
+            prompt_block_digests(prompt, self.prefix_block)
+        )
+        return {run_idx: run} if run_idx is not None and run else {}
 
     def affinity_entries(self) -> int:
-        with self._lock:
-            return len(self._affinity_map)
+        return len(self.directory)
 
     # -- the decision ------------------------------------------------------
     def _retry_after(
@@ -475,27 +533,16 @@ class Router:
             best = self.retry_after_s
         return round(min(30.0, max(self.retry_after_s, best)), 3)
 
-    def pick(
+    def _score(
         self,
         prompt: Sequence[int],
-        *,
-        max_new_tokens: int = 32,
-        priority: int = 0,
-        deadline_s: Optional[float] = None,
-        alive: Optional[Sequence[int]] = None,
-    ) -> int:
-        """Route one submit: returns the replica index, or raises
-        :class:`RequestRejectedError` (admission control). ``alive`` is
-        the client's own exclusion-filtered candidate list — the router
-        only ever narrows it, never resurrects an excluded replica."""
-        self.refresh()
-        with self._lock:
-            views = dict(self._views)
-            rr = self._rr
-            self._rr += 1
-        cand = list(alive) if alive is not None else sorted(views)
+        views: Dict[int, Dict[str, Any]],
+        cand: Sequence[int],
+        aff: Dict[int, int],
+    ) -> List[Any]:
+        """Score candidates (health x load x affinity): ``(weight, idx,
+        view, by_affinity)`` rows, unsorted; excluded replicas absent."""
         scored: List[Any] = []
-        aff = self._affinity_blocks(prompt)
         max_bytes = max(
             (views.get(i, {}).get("prefix_bytes", 0) for i in cand),
             default=0,
@@ -518,30 +565,30 @@ class Router:
                     )
                 w *= 1.0 + self.affinity_bias * frac * cache_scale
             scored.append((w, i, view, frac > 0))
-        if not scored:
-            # Nothing routable by policy: fall back to the client's
-            # alive list round-robin — the router must never be LESS
-            # available than the dumb picker it replaced (its views can
-            # be stale through a recovery; the client's exclusions are
-            # the hard filter).
-            if not cand:
-                from ray_lightning_tpu.serve.client import NoReplicasError
+        return scored
 
-                raise NoReplicasError(
-                    "no live replicas to route to (all excluded/lost)"
-                )
-            idx = cand[rr % len(cand)]
-            self._m_routed.inc(1, reason="fallback")
-            with self._lock:
-                self.routed += 1
-            return idx
+    @staticmethod
+    def _top(scored: List[Any], rr: int) -> Any:
+        """Best-scored row with round-robin tie spread (equal-score
+        candidates — fresh fleet, no load, no affinity — rotate instead
+        of hammering the lowest index)."""
         scored.sort(key=lambda s: (-s[0], s[1]))
-        # Tie spread: equal-score candidates (fresh fleet, no load, no
-        # affinity) rotate round-robin instead of hammering replica 0.
         top_w = scored[0][0]
         ties = [s for s in scored if s[0] >= top_w * 0.999]
-        weight, idx, view, by_affinity = ties[rr % len(ties)]
-        # -- admission control ------------------------------------------
+        return ties[rr % len(ties)]
+
+    def _admission_check(
+        self,
+        view: Dict[str, Any],
+        pool_views: List[Dict[str, Any]],
+        max_new_tokens: int,
+        priority: int,
+        deadline_s: Optional[float],
+    ) -> None:
+        """Front-door admission control against the DECODING target's
+        view (raises RequestRejectedError): an infeasible deadline
+        rejects regardless of load; a saturated pool sheds
+        lowest-priority / queue-infeasible work."""
         rate = view.get("decode_tokens_per_sec") or 0.0
         if deadline_s is not None and rate > 0:
             own_s = max_new_tokens / rate
@@ -549,9 +596,7 @@ class Router:
                 # Infeasible even with an empty queue: the decode alone
                 # cannot finish by the deadline at this fleet's measured
                 # rate — reject NOW instead of queueing it to expire.
-                hint = self._retry_after(
-                    [v for _, _, v, _ in scored], max_new_tokens
-                )
+                hint = self._retry_after(pool_views, max_new_tokens)
                 self.shed_count += 1
                 self._m_shed.inc(1, reason="deadline_infeasible")
                 self._event(
@@ -568,10 +613,10 @@ class Router:
                     f"{deadline_s:g}",
                 )
         if self.shed:
-            saturated = all(
+            saturated = bool(pool_views) and all(
                 v.get("queue_depth", 0)
                 >= self.shed_queue_factor * v.get("num_slots", 1)
-                for _, _, v, _ in scored
+                for v in pool_views
             )
             if saturated:
                 infeasible = False
@@ -586,9 +631,7 @@ class Router:
                         wait_s + max_new_tokens / rate > deadline_s
                     )
                 if priority > 0 or infeasible:
-                    hint = self._retry_after(
-                        [v for _, _, v, _ in scored], max_new_tokens
-                    )
+                    hint = self._retry_after(pool_views, max_new_tokens)
                     self.shed_count += 1
                     self._m_shed.inc(1, reason="saturated")
                     self._event(
@@ -602,12 +645,219 @@ class Router:
                         "every routable replica's queue is at "
                         f">= {self.shed_queue_factor:g}x its slots",
                     )
+
+    #: A fetch hint must not point at a CORPSE: these states/verdicts
+    #: mean the holder's process (and its pages) are gone — the fetch
+    #: would only burn the timeout. A draining/preempting/merely-loaded
+    #: holder still serves fetches: that is the exact case the fleet
+    #: cache exists for (the router steered traffic away from the warm
+    #: replica, the pages are alive there).
+    _HOLDER_GONE_STATES = frozenset(("dead", "failed", "retired"))
+
+    def _fetch_hint(
+        self,
+        digests: List[bytes],
+        idx: int,
+        cand: Sequence[int],
+        views: Dict[int, Dict[str, Any]],
+    ) -> Optional[Dict[str, Any]]:
+        """A warm-peer fetch hint for a request routed to ``idx``: when
+        a DIFFERENT live replica holds the prompt's digest chain, the
+        target can fetch the pages instead of re-prefilling cold — the
+        cross-replica sharing that fires exactly when load/health/role
+        steered the request AWAY from its warm replica."""
+        if not digests:
+            return None
+        holder, run = self.directory.chain(digests)
+        if holder is None or not run or holder == idx:
+            return None
+        view = views.get(holder)
+        if view is None:
+            if holder not in set(cand):
+                return None  # unknown AND unroutable: assume gone
+        elif (
+            view.get("state") in self._HOLDER_GONE_STATES
+            or view.get("health") in ("unreachable", "retired")
+        ):
+            return None  # its pages died with it; nothing to fetch
+        return {
+            "peer": int(holder),
+            "digests": [d.hex() for d in digests[:run]],
+            "blocks": int(run),
+        }
+
+    def _useful_blocks(self, prompt: Sequence[int]) -> int:
+        """Full prompt blocks a warm admission can actually consume —
+        the engines cap their walk so the final chunk always runs, so
+        an exact-multiple prompt's last block never counts."""
+        n = len(prompt) // self.prefix_block
+        if n and n * self.prefix_block >= len(prompt):
+            n -= 1
+        return n
+
+    def plan(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: int = 32,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        alive: Optional[Sequence[int]] = None,
+    ) -> RoutePlan:
+        """Route one submit: returns a :class:`RoutePlan` (replica +
+        fleet-KV placement hints), or raises
+        :class:`RequestRejectedError` (admission control). ``alive`` is
+        the client's own exclusion-filtered candidate list — the router
+        only ever narrows it, never resurrects an excluded replica.
+
+        With role-split replicas in the candidate set (disaggregated
+        prefill/decode), the request lands on a PREFILL replica with a
+        ``ship_to`` decode target — unless the prompt's chain is
+        already warm on a decode-side replica, which then takes it
+        directly (no prefill hop for a prefix hit).
+        """
+        self.refresh()
+        with self._lock:
+            views = dict(self._views)
+            rr = self._rr
+            self._rr += 1
+        cand = list(alive) if alive is not None else sorted(views)
+        digests = (
+            prompt_block_digests(prompt, self.prefix_block)
+            if self.affinity
+            else []
+        )
+        holder0, run0 = (
+            self.directory.chain(digests) if digests else (None, 0)
+        )
+        aff = {holder0: run0} if holder0 is not None and run0 else {}
+        roles = {
+            i: str((views.get(i) or {}).get("role") or "mixed")
+            for i in cand
+        }
+        prefill_c = [i for i in cand if roles[i] == "prefill"]
+        decode_c = [i for i in cand if roles[i] != "prefill"]
+        if prefill_c and decode_c:
+            plan = self._plan_disagg(
+                prompt, digests, views, rr, cand, prefill_c, decode_c,
+                aff, max_new_tokens, priority, deadline_s,
+            )
+            if plan is not None:
+                return plan
+        scored = self._score(prompt, views, cand, aff)
+        if not scored:
+            # Nothing routable by policy: fall back to the client's
+            # alive list round-robin — the router must never be LESS
+            # available than the dumb picker it replaced (its views can
+            # be stale through a recovery; the client's exclusions are
+            # the hard filter).
+            if not cand:
+                from ray_lightning_tpu.serve.client import NoReplicasError
+
+                raise NoReplicasError(
+                    "no live replicas to route to (all excluded/lost)"
+                )
+            idx = cand[rr % len(cand)]
+            self._m_routed.inc(1, reason="fallback")
+            with self._lock:
+                self.routed += 1
+            return RoutePlan(idx, policy="fallback")
+        weight, idx, view, by_affinity = self._top(scored, rr)
+        self._admission_check(
+            view, [v for _, _, v, _ in scored],
+            max_new_tokens, priority, deadline_s,
+        )
         self._m_routed.inc(
             1, reason="affinity" if by_affinity else "weighted"
         )
         with self._lock:
             self.routed += 1
-        return idx
+        return RoutePlan(
+            idx,
+            kv_hint=self._fetch_hint(digests, idx, cand, views),
+            policy="affinity" if by_affinity else "weighted",
+        )
+
+    def _plan_disagg(
+        self,
+        prompt: Sequence[int],
+        digests: List[bytes],
+        views: Dict[int, Dict[str, Any]],
+        rr: int,
+        cand: Sequence[int],
+        prefill_c: Sequence[int],
+        decode_c: Sequence[int],
+        aff: Dict[int, int],
+        max_new_tokens: int,
+        priority: int,
+        deadline_s: Optional[float],
+    ) -> Optional[RoutePlan]:
+        """The disaggregated decision: prefill lands on the prefill
+        pool, the finished pages ship to a decode-pool replica chosen
+        here, and admission control judges the DECODE side (that is
+        where the tokens come from). A prompt already warm on a
+        decode-pool replica skips the prefill hop entirely. Returns
+        None to fall back to the single-pool path (e.g. neither pool
+        has a routable member — availability beats disaggregation)."""
+        decode_scored = self._score(prompt, views, decode_c, aff)
+        prefill_scored = self._score(prompt, views, prefill_c, {})
+        if not decode_scored or not prefill_scored:
+            return None
+        pool_views = [v for _, _, v, _ in decode_scored]
+        # Warm shortcut: the chain's holder is on the decode side and
+        # covers every usable block — admission there is a pure alias,
+        # no prefill worth offloading.
+        useful = self._useful_blocks(prompt)
+        holder, run = self.directory.chain(digests)
+        if (
+            holder is not None
+            and useful
+            and run >= useful
+            and any(i == holder for _, i, _, _ in decode_scored)
+        ):
+            view = next(
+                v for _, i, v, _ in decode_scored if i == holder
+            )
+            self._admission_check(
+                view, pool_views, max_new_tokens, priority, deadline_s,
+            )
+            self._m_routed.inc(1, reason="warm_direct")
+            with self._lock:
+                self.routed += 1
+            return RoutePlan(holder, policy="warm_direct")
+        _, d_idx, d_view, _ = self._top(decode_scored, rr)
+        self._admission_check(
+            d_view, pool_views, max_new_tokens, priority, deadline_s,
+        )
+        _, p_idx, _, _ = self._top(prefill_scored, rr)
+        self._m_routed.inc(1, reason="disagg")
+        with self._lock:
+            self.routed += 1
+        return RoutePlan(
+            p_idx,
+            ship_to=d_idx,
+            kv_hint=self._fetch_hint(digests, p_idx, cand, views),
+            policy="disagg",
+        )
+
+    def pick(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: int = 32,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        alive: Optional[Sequence[int]] = None,
+    ) -> int:
+        """Route one submit to a replica index (the pre-fleet-KV
+        surface; :meth:`plan` carries the placement hints)."""
+        return self.plan(
+            prompt,
+            max_new_tokens=max_new_tokens,
+            priority=priority,
+            deadline_s=deadline_s,
+            alive=alive,
+        ).replica
 
     # -- read side ---------------------------------------------------------
     def rows(self) -> Dict[str, Any]:
@@ -617,7 +867,7 @@ class Router:
         with self._lock:
             views = dict(self._views)
             routed, shed = self.routed, self.shed_count
-            entries = len(self._affinity_map)
+        entries = len(self.directory)
         return {
             "replicas": [
                 {
@@ -626,6 +876,7 @@ class Router:
                     "routable": self._base_weight(v) > 0.0,
                     "state": v.get("state"),
                     "health": v.get("health"),
+                    "role": v.get("role", "mixed"),
                     "queue_depth": v.get("queue_depth", 0),
                 }
                 for idx, v in sorted(views.items())
@@ -707,9 +958,14 @@ class RouterAutoscaler:
             "rlt_router_autoscale_replicas",
             "Routable replicas the autoscaler currently targets",
         )
-        self._up_streak = 0
-        self._down_streak = 0
+        #: Per-role-pool streaks ("mixed" covers a homogeneous fleet):
+        #: prefill and decode pools scale INDEPENDENTLY — a
+        #: heavy-prefill mix grows the prefill pool without touching
+        #: decode capacity, and vice versa.
+        self._up_streaks: Dict[str, int] = {}
+        self._down_streaks: Dict[str, int] = {}
         self._shed_seen = 0
+        self._breaches_seen = 0
         self.scale_ups = 0
         self.scale_downs = 0
         self._stop = threading.Event()
@@ -721,98 +977,173 @@ class RouterAutoscaler:
         except Exception:  # noqa: BLE001
             pass
 
+    def _role_of(self, idx: int, views: Dict[int, Dict[str, Any]]) -> str:
+        role = (views.get(idx) or {}).get("role")
+        if role:
+            return str(role)
+        role_fn = getattr(self.client, "role_of", None)
+        return str(role_fn(idx)) if role_fn is not None else "mixed"
+
     def _signals(self) -> Dict[str, Any]:
-        """Fleet load signals for one tick: routable replica count, the
-        total queue depth and active slots over them, and the router's
-        shed delta since the previous tick."""
+        """Fleet load signals for one tick, grouped by role pool:
+        per-pool queue depth / active slots, the router's shed delta,
+        the fleet's SLO-breach delta (PR 5's declarative rules, rolled
+        up through the stats rows), and fleet goodput (PR 8's ledger)
+        — quality signals next to raw queue depth, so a fleet that is
+        busy-but-breaching scales up even before its queues explode."""
         alive = list(self.client.alive_replicas())
         views: Dict[int, Dict[str, Any]] = {}
         if self.router is not None:
             views = self.router.views()
-        queue = sum(
-            views.get(i, {}).get("queue_depth", 0) for i in alive
-        )
-        active = sum(
-            views.get(i, {}).get("active_slots", 0) for i in alive
-        )
+        pools: Dict[str, Dict[str, Any]] = {}
+        for i in alive:
+            role = self._role_of(i, views)
+            pool = pools.setdefault(
+                role,
+                {"members": [], "queue_depth": 0, "active_slots": 0},
+            )
+            pool["members"].append(i)
+            pool["queue_depth"] += views.get(i, {}).get("queue_depth", 0)
+            pool["active_slots"] += views.get(i, {}).get(
+                "active_slots", 0
+            )
         shed_total = (
             self.router.shed_count if self.router is not None else 0
         )
         shed_delta = max(0, shed_total - self._shed_seen)
         self._shed_seen = shed_total
+        breach_total = sum(
+            int(views.get(i, {}).get("slo_breaches") or 0)
+            for i in alive
+        )
+        breach_delta = max(0, breach_total - self._breaches_seen)
+        self._breaches_seen = breach_total
+        goodput = sum(
+            float(views.get(i, {}).get("goodput") or 0.0) for i in alive
+        )
         return {
             "alive": alive,
-            "queue_depth": queue,
-            "active_slots": active,
+            "pools": pools,
+            "queue_depth": sum(
+                p["queue_depth"] for p in pools.values()
+            ),
+            "active_slots": sum(
+                p["active_slots"] for p in pools.values()
+            ),
             "shed_delta": shed_delta,
+            "slo_breach_delta": breach_delta,
+            "goodput": round(goodput, 3),
         }
+
+    def _scale_up(self, role: str, sig: Dict[str, Any]) -> Optional[int]:
+        try:
+            try:
+                idx = self.client.add_replica(
+                    role=None if role == "mixed" else role
+                )
+            except TypeError:
+                # A client without the role knob (tests, custom wiring).
+                idx = self.client.add_replica()
+        except Exception as exc:  # noqa: BLE001 - a failed spawn
+            # must not kill the controller; the pressure persists
+            # and the next sustained window retries.
+            self._event(
+                "autoscale_up_failed", level="warn", role=role,
+                error=f"{type(exc).__name__}: {exc}"[:300],
+            )
+            return None
+        self.scale_ups += 1
+        self._m_rebalances.inc(1, reason="scale_up")
+        self._event(
+            "autoscale_up", replica=idx, role=role,
+            queue_depth=sig["queue_depth"],
+            shed_delta=sig["shed_delta"],
+            slo_breach_delta=sig["slo_breach_delta"],
+        )
+        return idx
 
     def tick(self) -> Dict[str, Any]:
         sig = self._signals()
         alive = sig["alive"]
+        pools = sig["pools"]
         n = len(alive)
         self._m_replicas.set(n)
         out = {"replicas": n, "scaled": None, **sig}
         if n == 0:
             return out  # recovery plane's problem, not capacity's
-        overloaded = (
-            sig["queue_depth"] / n >= self.up_queue_per_replica
-            or sig["shed_delta"] > 0
+        # Shed + SLO-breach pressure lands on the pool already deepest
+        # in queue (ties: the decode side — tokens are what shed/SLOs
+        # starve first); a homogeneous fleet has exactly one pool, so
+        # this reduces to the old global behavior.
+        pressure_pool = max(
+            pools,
+            key=lambda r: (
+                pools[r]["queue_depth"],
+                r != "prefill",  # decode/mixed outrank prefill on ties
+            ),
         )
-        idle = (
-            sig["queue_depth"] == 0
-            and sig["active_slots"] == 0
-            and sig["shed_delta"] == 0
-        )
-        self._up_streak = self._up_streak + 1 if overloaded else 0
-        self._down_streak = self._down_streak + 1 if idle else 0
-        if (
-            self._up_streak >= self.sustain_ticks
-            and n < self.max_replicas
-        ):
-            self._up_streak = 0
-            self._down_streak = 0
-            try:
-                idx = self.client.add_replica()
-            except Exception as exc:  # noqa: BLE001 - a failed spawn
-                # must not kill the controller; the pressure persists
-                # and the next sustained window retries.
-                self._event(
-                    "autoscale_up_failed", level="warn",
-                    error=f"{type(exc).__name__}: {exc}"[:300],
-                )
-                return out
-            self.scale_ups += 1
-            self._m_rebalances.inc(1, reason="scale_up")
-            self._event(
-                "autoscale_up", replica=idx,
-                queue_depth=sig["queue_depth"],
-                shed_delta=sig["shed_delta"],
+        for role in sorted(pools):
+            pool = pools[role]
+            members = pool["members"]
+            extra = (
+                sig["shed_delta"] > 0 or sig["slo_breach_delta"] > 0
+            ) and role == pressure_pool
+            overloaded = (
+                pool["queue_depth"] / max(1, len(members))
+                >= self.up_queue_per_replica
+                or extra
             )
-            out["scaled"] = ("up", idx)
-        elif (
-            self._down_streak >= self.down_sustain_ticks
-            and n > self.min_replicas
-        ):
-            self._down_streak = 0
-            self._up_streak = 0
-            idx = max(alive)  # LIFO: autoscaled capacity retires first
-            try:
-                res = self.client.retire_replica(idx)
-            except Exception as exc:  # noqa: BLE001 - see above
-                self._event(
-                    "autoscale_down_failed", level="warn", replica=idx,
-                    error=f"{type(exc).__name__}: {exc}"[:300],
-                )
-                return out
-            self.scale_downs += 1
-            self._m_rebalances.inc(1, reason="scale_down")
-            self._event(
-                "autoscale_down", replica=idx,
-                migrated=len(res.get("migrated", [])),
-                lost=len(res.get("lost", [])),
+            idle = (
+                pool["queue_depth"] == 0
+                and pool["active_slots"] == 0
+                and sig["shed_delta"] == 0
+                and sig["slo_breach_delta"] == 0
             )
-            out["scaled"] = ("down", idx)
+            self._up_streaks[role] = (
+                self._up_streaks.get(role, 0) + 1 if overloaded else 0
+            )
+            self._down_streaks[role] = (
+                self._down_streaks.get(role, 0) + 1 if idle else 0
+            )
+            if (
+                self._up_streaks[role] >= self.sustain_ticks
+                and n < self.max_replicas
+            ):
+                self._up_streaks[role] = 0
+                self._down_streaks[role] = 0
+                idx = self._scale_up(role, sig)
+                if idx is not None:
+                    out["scaled"] = ("up", idx)
+                return out
+            if (
+                self._down_streaks[role] >= self.down_sustain_ticks
+                and n > self.min_replicas
+                # A role pool never retires its last member: the
+                # router's disagg policy needs one of each while the
+                # fleet runs split.
+                and len(members) > (1 if len(pools) > 1 else 0)
+            ):
+                self._down_streaks[role] = 0
+                self._up_streaks[role] = 0
+                idx = max(members)  # LIFO: newest capacity retires first
+                try:
+                    res = self.client.retire_replica(idx)
+                except Exception as exc:  # noqa: BLE001 - see above
+                    self._event(
+                        "autoscale_down_failed", level="warn",
+                        replica=idx,
+                        error=f"{type(exc).__name__}: {exc}"[:300],
+                    )
+                    return out
+                self.scale_downs += 1
+                self._m_rebalances.inc(1, reason="scale_down")
+                self._event(
+                    "autoscale_down", replica=idx, role=role,
+                    migrated=len(res.get("migrated", [])),
+                    lost=len(res.get("lost", [])),
+                )
+                out["scaled"] = ("down", idx)
+                return out
         return out
 
     # -- thread lifecycle --------------------------------------------------

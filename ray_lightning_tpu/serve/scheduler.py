@@ -85,6 +85,17 @@ class Request:
     #: Set when the request enters a slot (chunked prefill may still be
     #: running); the TTFT queue-vs-prefill breakdown pivots on it.
     admitted_at: float = 0.0
+    #: Fleet KV plane: the router's warm-peer hint
+    #: (``{"peer": idx, "digests": [hex...]}``) — when the local tiers
+    #: miss, admission PARKS the request transfer-pending and fetches
+    #: the chain from the peer instead of re-prefilling cold. Consumed
+    #: (set None) after one attempt; timeout/staleness degrade to the
+    #: cold prefill the hint replaced.
+    kv_hint: Optional[Dict[str, Any]] = None
+    #: Disaggregated prefill: the decode replica this request's
+    #: finished-prefill KV pages ship to (prefill-role placement). None
+    #: = decode locally (the classic path).
+    ship_to: Optional[int] = None
 
     def expired(self, now: float) -> bool:
         return (
@@ -100,11 +111,21 @@ class TokenEvent:
     request_id: str
     token: Optional[int]  # None for lifecycle-only events
     done: bool
-    #: "token" | "finished" | "cancelled" | "expired" | "migrated"
-    #: ("migrated": evicted by a preemption drain FOR resubmission on a
-    #: survivor — terminal on THIS engine, not for the request; the
-    #: client follows its route table instead of failing the stream).
+    #: "token" | "finished" | "cancelled" | "expired" | "migrated" |
+    #: "shipped" ("migrated": evicted by a preemption drain FOR
+    #: resubmission on a survivor — terminal on THIS engine, not for the
+    #: request; the client follows its route table instead of failing
+    #: the stream. "shipped": a prefill-role completion whose KV pages
+    #: went to ``ship_to`` — the client resubmits there and the stream
+    #: continues warm).
     reason: str = "token"
+    #: The decode replica a "shipped" request's pages went to.
+    ship_to: Optional[int] = None
+    #: The shipped digest chain (hexes): the client's follow-up
+    #: resubmission carries them back as a fetch hint, so a lost/raced
+    #: ship self-heals (the decode replica fetches from the shipper)
+    #: instead of silently re-prefilling cold.
+    ship_digests: Optional[List[str]] = None
 
 
 class Scheduler:
@@ -119,8 +140,17 @@ class Scheduler:
         events: Optional["EventLog"] = None,
         journal: Optional["WorkloadJournal"] = None,
         faults: Optional[Any] = None,
+        kvfleet: Optional[Any] = None,
+        role: str = "mixed",
     ) -> None:
         self.engine = engine
+        #: Fleet KV plane (serve.kvfleet.KVFleetPlane): cross-replica
+        #: prefix fetches + disaggregated prefill shipping. None = the
+        #: isolated-cache engine (zero cost). ``role`` shapes step():
+        #: a "prefill" replica ships every finished prefill's pages to
+        #: its request's ``ship_to`` decode replica instead of decoding.
+        self.kvfleet = kvfleet
+        self.role = str(role)
         #: Deterministic fault injection (serve.faults.FaultInjector):
         #: step() reports named lifecycle points so a chaos plan can
         #: kill/delay this process at a FIXED logical step instead of a
@@ -210,6 +240,13 @@ class Scheduler:
         #: the next step() (loop thread) — engine state never mutates
         #: off the driving thread.
         self._pending_imports: List[Any] = []
+        #: Transfer-pending PARK state: requests popped from the queue
+        #: whose warm pages are in flight from a peer —
+        #: request_id -> (priority, seq, Request). They re-queue under
+        #: their ORIGINAL (priority, seq) when the fetch lands (warm
+        #: admit) or fails (cold prefill), so parking never reorders
+        #: the queue around them.
+        self._transfer_pending: Dict[str, Any] = {}
 
     # -- cost ledger ------------------------------------------------------
     def _acct_open(self, req: Request) -> None:
@@ -290,9 +327,16 @@ class Scheduler:
         priority: int = 0,
         deadline_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        kv_hint: Optional[Dict[str, Any]] = None,
+        ship_to: Optional[int] = None,
     ) -> str:
         """Queue a request; returns its id. Rejects (ValueError) requests
-        that can never fit the engine, instead of queueing them to fail."""
+        that can never fit the engine, instead of queueing them to fail.
+
+        ``kv_hint``/``ship_to`` are fleet-KV placement hints (see
+        :class:`Request`) — routing metadata, not request identity, so
+        the journal does NOT record them: a failover resubmission or a
+        replay decodes locally, which is always correct."""
         sampling = sampling or SamplingParams()
         prompt = [int(t) for t in prompt]
         if not prompt or sampling.max_new_tokens < 1:
@@ -314,6 +358,8 @@ class Scheduler:
             deadline_s=deadline_s,
             submitted_at=time.monotonic(),
             tenant=tenant,
+            kv_hint=dict(kv_hint) if kv_hint else None,
+            ship_to=None if ship_to is None else int(ship_to),
         )
         with self._lock:
             heapq.heappush(
@@ -358,6 +404,7 @@ class Scheduler:
         with self._lock:
             known = (
                 request_id in self._admitting
+                or request_id in self._transfer_pending
                 or any(
                     r.request_id == request_id for _, _, r in self._pending
                 )
@@ -378,12 +425,17 @@ class Scheduler:
 
     def has_work(self) -> bool:
         with self._lock:
-            return (
+            if (
                 bool(self._pending)
                 or self.engine.num_active > 0
                 or self._drain_req is not None
                 or bool(self._pending_imports)
-            )
+                or bool(self._transfer_pending)
+            ):
+                return True
+        # Fleet KV inbox (peer fetches/ships): outside the lock — the
+        # emptiness probe may cross a process boundary.
+        return self.kvfleet is not None and self.kvfleet.pending()
 
     # -- preemption drain (thread-safe arm/wait; work runs in step()) -----
     def request_drain(self, budget_s: float) -> None:
@@ -411,6 +463,40 @@ class Scheduler:
         with self._lock:
             self._pending_imports.append(blocks)
         return len(blocks)
+
+    def _service_kvfleet(self) -> None:
+        """One pump of the fleet KV plane (loop thread): answer peer
+        fetches, apply inbound imports, and settle this scheduler's
+        parked transfer-pending requests."""
+        plane = self.kvfleet
+        export_fn = getattr(self.engine, "export_blocks_by_digest", None)
+        svc = plane.service(
+            export_fn=export_fn if export_fn is not None else (
+                lambda digests: []
+            ),
+            import_fn=self.engine.import_prefix_blocks,
+        )
+        resumed: List[Any] = []
+        with self._lock:
+            for rid, _n in svc["fetched"]:
+                entry = self._transfer_pending.pop(rid, None)
+                if entry is not None:
+                    # The blocks are already in the pool; the request
+                    # re-queues under its original (priority, seq) and
+                    # its admission walk now hits warm.
+                    heapq.heappush(self._pending, entry)
+                    resumed.append((rid, "warm"))
+            for rid, reason in svc["failed"]:
+                entry = self._transfer_pending.pop(rid, None)
+                if entry is not None:
+                    heapq.heappush(self._pending, entry)
+                    resumed.append((rid, reason))
+        for rid, how in resumed:
+            self._event(
+                "kv_transfer_resume",
+                level="info" if how == "warm" else "warn",
+                request_id=rid, outcome=how,
+            )
 
     def _apply_drain(self, events: List[TokenEvent]) -> None:
         """Consume a pending drain request (inside step(), loop thread).
@@ -456,7 +542,12 @@ class Scheduler:
                     self._cancelled.add(req.request_id)
                     self._migrating.add(req.request_id)
             queued = [r for _, _, r in self._pending]
+            # Transfer-pending parks are queued work too: their fetches
+            # die with this replica, so they migrate like the queue
+            # (any late fetch response is discarded harmlessly).
+            queued += [r for _, _, r in self._transfer_pending.values()]
             self._pending = []
+            self._transfer_pending = {}
             for req in queued:
                 self._cancelled.discard(req.request_id)
                 migrate.append(req)
@@ -514,10 +605,23 @@ class Scheduler:
             imports, self._pending_imports = self._pending_imports, []
         for blocks in imports:
             self.engine.import_prefix_blocks(blocks)
+        if self.kvfleet is not None:
+            # Fleet KV plane: serve peer fetches (compiled pool reads —
+            # this thread), import inbound ships/fetch responses BEFORE
+            # the admission scan below (so a shipped request admits
+            # warm), and re-queue parked requests whose transfer landed
+            # (warm) or failed (cold prefill — timeout/staleness never
+            # lose the request, they only lose the shortcut).
+            self._service_kvfleet()
         if self._drain_req is not None:
             self._apply_drain(events)
         to_evict: List[Any] = []
         admits: List[Request] = []
+        #: (priority, seq, Request, peer, digests): candidates popped
+        #: for a cross-replica KV fetch instead of admission — the
+        #: fetch RPC runs outside the lock; success parks them
+        #: transfer-pending, refusal re-queues them for cold prefill.
+        to_fetch: List[Any] = []
         #: (rid, outcome) terminals from ENGINE work this step; their
         #: ledger records flush after this step's device-seconds are
         #: attributed, so a request's final fold is in its bill.
@@ -577,7 +681,7 @@ class Scheduler:
             pages_left = self.engine.pages_available() if paged else 0
             parked = False
             while len(admits) < budget and self._pending:
-                _, _, req = self._pending[0]
+                prio, seqno, req = self._pending[0]
                 if req.request_id in self._cancelled:
                     heapq.heappop(self._pending)
                     self._cancelled.discard(req.request_id)
@@ -605,6 +709,31 @@ class Scheduler:
                         TokenEvent(req.request_id, None, True, "expired")
                     )
                     continue
+                if self.kvfleet is not None and req.kv_hint is not None:
+                    # Cross-replica prefix sharing: the router said a
+                    # peer holds this prompt's chain. One attempt per
+                    # request (the hint is consumed here); only worth a
+                    # fetch when the LOCAL tiers hold strictly less
+                    # than the hint promises — the probe is a pure
+                    # host-side digest walk, safe under the lock.
+                    hint, req.kv_hint = req.kv_hint, None
+                    digests = list(hint.get("digests") or [])
+                    peer = hint.get("peer")
+                    probe = getattr(
+                        self.engine, "cached_prefix_blocks", None
+                    )
+                    if (
+                        digests
+                        and peer is not None
+                        and probe is not None
+                        and getattr(self.engine, "prefix_blocks", 0)
+                        and probe(req.prompt) < len(digests)
+                    ):
+                        heapq.heappop(self._pending)
+                        to_fetch.append(
+                            (prio, seqno, req, int(peer), digests)
+                        )
+                        continue
                 if paged:
                     need = self.engine.pages_for(
                         len(req.prompt), req.sampling.max_new_tokens
@@ -624,6 +753,27 @@ class Scheduler:
                 )
             self._kv_parked = parked
         # -- engine work, lock NOT held --------------------------------
+        for prio, seqno, req, peer, digests in to_fetch:
+            # The fetch RPC (a queue put, possibly cross-process) runs
+            # here; a refused fetch (budget, unknown peer, bandwidth
+            # cap) re-queues for cold prefill NEXT step — bounded
+            # in-flight bytes never turn into a queue.
+            if self.kvfleet.request_fetch(req.request_id, peer, digests):
+                with self._lock:
+                    self._transfer_pending[req.request_id] = (
+                        prio, seqno, req,
+                    )
+                self._trace(
+                    req.request_id, _trace.SPAN_KV_FETCH,
+                    peer=peer, blocks=len(digests),
+                )
+                self._event(
+                    "kv_transfer_park", request_id=req.request_id,
+                    peer=peer, blocks=len(digests),
+                )
+            else:
+                with self._lock:
+                    heapq.heappush(self._pending, (prio, seqno, req))
         for slot, req, kind in to_evict:
             self.engine.release(slot)
             (self.metrics.record_expire if kind == "expired"
@@ -646,6 +796,7 @@ class Scheduler:
             events.append(TokenEvent(req.request_id, None, True, kind))
         newly: Dict[int, Request] = {}
         finished_rids: List[str] = []
+        finished_slots: List[int] = []
         if admits:
             # One burst: every admission chain is dispatched before the
             # first token sync (engine.admit_many), so admission i's host
@@ -740,6 +891,11 @@ class Scheduler:
             self.max_prefill_chunks_per_step
         )
         prefilled = 0
+        #: (slot, task, Request): completed prefills whose KV pages
+        #: ship to a decode replica instead of decoding here — the
+        #: disaggregated-prefill handoff (collected in the loop, engine
+        #: work below it so the fold never decodes a shipped slot).
+        to_ship: List[Any] = []
         for slot, task, tok, done in chunk_events:
             prefilled += 1
             now = time.monotonic()
@@ -783,6 +939,58 @@ class Scheduler:
                 finished_rids.append(task.request_id)
                 closed.append((task.request_id, "finished"))
                 newly.pop(slot, None)
+            elif (
+                self.kvfleet is not None
+                and req is not None
+                and req.ship_to is not None
+            ):
+                # Disaggregated prefill: the first token streamed above
+                # (the client's cursor dedups it when the decode
+                # replica re-emits the identical stream); the slot's KV
+                # pages ship below instead of decoding here.
+                to_ship.append((slot, task, req))
+                newly.pop(slot, None)
+                finished_slots.append(slot)
+                finished_rids.append(task.request_id)
+        for slot, task, req in to_ship:
+            # Release FIRST (the fold below must not decode a shipped
+            # slot; the finished prompt's blocks already entered the
+            # pool at prefill completion, so they survive the release
+            # as digest-keyed cache pages), then export + ship. A
+            # failed ship only costs the decode replica a cold prefill
+            # — the client's resubmission carries a fetch hint back to
+            # THIS replica, whose pool still holds the pages.
+            self.engine.release(slot)
+            blocks = (
+                self.engine.export_prefix_blocks(task.tokens)
+                if getattr(self.engine, "prefix_blocks", 0)
+                else []
+            )
+            self.kvfleet.ship(req.ship_to, req.request_id, blocks)
+            if self.journal is not None:
+                # A ship looks like a cancel to a replay of THIS
+                # journal (truncation after the recorded first token);
+                # the decode replica's journal carries the decode, and
+                # the CLIENT journal is what re-drives the request
+                # there.
+                self.journal.record_cancel(req.request_id, True)
+            self.metrics.record_cancel(queue_depth=self.queue_depth())
+            self._trace(
+                req.request_id, _trace.SPAN_SHIPPED,
+                target=req.ship_to, blocks=len(blocks),
+            )
+            self._event(
+                "kv_ship", request_id=req.request_id,
+                target=req.ship_to, blocks=len(blocks),
+            )
+            closed.append((req.request_id, "shipped"))
+            events.append(
+                TokenEvent(
+                    req.request_id, None, True, "shipped",
+                    ship_to=req.ship_to,
+                    ship_digests=[b[0] for b in blocks],
+                )
+            )
         if chunk_events or prefilling:
             # Fault point: a multi-chunk prompt is part-way through its
             # prefill (device KV holds a partial range nobody can read
@@ -792,7 +1000,6 @@ class Scheduler:
         # tokens per slot fan out of a single dispatch+harvest).
         active = self.engine.num_active
         emitted = 0
-        finished_slots: List[int] = []
         fold_results = self.engine.step()
         # Tokens per request this fold: the shared granularity of the
         # decode-side trace events, the spec attribution, and the cost

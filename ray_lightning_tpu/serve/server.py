@@ -253,6 +253,14 @@ class _GangLeaderEngine:
         self._broadcast("import_prefix_blocks", args, kwargs)
         return self._engine.import_prefix_blocks(*args, **kwargs)
 
+    def export_blocks_by_digest(self, *args: Any, **kwargs: Any) -> Any:
+        # Like export_prefix_blocks: a read that RUNS the compiled pool
+        # read — the whole gang must issue the same dispatch sequence
+        # (followers discard the result; the fleet KV fetch ships the
+        # leader's view, same leader-shards-only caveat).
+        self._broadcast("export_blocks_by_digest", args, kwargs)
+        return self._engine.export_blocks_by_digest(*args, **kwargs)
+
     def export_prefix_blocks(self, *args: Any, **kwargs: Any) -> Any:
         # A read, but it RUNS the compiled pool read — under a real
         # multi-host mesh every process must issue the same dispatch
@@ -440,6 +448,13 @@ class ServeReplica:
         preempt_grace_s: float = 30.0,
         preempt_sigterm: bool = True,
         preempt_metadata: bool = False,
+        role: str = "mixed",
+        kv_self: Optional[int] = None,
+        kv_inbox: Any = None,
+        kv_peers: Optional[Dict[int, Any]] = None,
+        kvfleet_timeout_s: float = 5.0,
+        kvfleet_inflight_mb: float = 64.0,
+        kvfleet_bandwidth_mbps: float = 0.0,
     ) -> None:
         from ray_lightning_tpu.obs import blackbox as obs_blackbox
         from ray_lightning_tpu.obs import health as obs_health
@@ -496,6 +511,23 @@ class ServeReplica:
         if self._gang_queues:
             self._sched_engine = _GangLeaderEngine(
                 self.engine, self._gang_queues
+            )
+        # Fleet KV plane: this replica's role (mixed | prefill |
+        # decode) plus the cross-replica transfer wiring (its own inbox
+        # queue + every peer's). A prefill replica ships every finished
+        # prefill's KV pages, which only exist with a prefix pool —
+        # reject the pointless config up front.
+        from ray_lightning_tpu.serve.kvfleet import ROLES, KVFleetPlane
+
+        self.role = str(role)
+        if self.role not in ROLES:
+            raise ValueError(
+                f"unknown replica role {role!r}; valid roles: {ROLES}"
+            )
+        if self.role == "prefill" and not self.engine.prefix_blocks:
+            raise ValueError(
+                "role='prefill' needs a prefix pool to ship from: set "
+                "prefix_blocks/prefix_cache (dense) or kv_pages (paged)"
             )
         self._registry = get_registry()
         self._registry.gauge(
@@ -568,6 +600,21 @@ class ServeReplica:
                 # the policy that shaped this replica's traffic rides
                 # the journal a replay rebuilds from).
                 router=router_config,
+                # Fleet-KV/disagg provenance: the role and transfer
+                # knobs that shaped this capture (shipped outcomes
+                # replay as their recorded truncations; `rlt replay`
+                # surfaces the section as kvfleet_config).
+                kvfleet=(
+                    {
+                        "role": self.role,
+                        "peers": len(kv_peers or {}),
+                        "timeout_s": float(kvfleet_timeout_s),
+                        "max_inflight_mb": float(kvfleet_inflight_mb),
+                        "bandwidth_mbps": float(kvfleet_bandwidth_mbps),
+                    }
+                    if (kv_inbox is not None or self.role != "mixed")
+                    else None
+                ),
             ))
         # Deterministic fault injection (serve.faults): an explicit plan
         # beats the RLT_FAULTS env gate; armed rules fire at named
@@ -579,6 +626,24 @@ class ServeReplica:
         self.faults = FaultInjector.parse(
             faults, events=self.events
         ) or FaultInjector.from_env(events=self.events)
+        # The fleet KV plane proper: built only when transfer wiring
+        # was handed in (start_replicas creates one inbox per replica
+        # when fleet sharing is on); a lone replica or an isolated
+        # fleet runs without it at zero cost.
+        self.kvfleet = None
+        if kv_inbox is not None:
+            self.kvfleet = KVFleetPlane(
+                index=0 if kv_self is None else int(kv_self),
+                role=self.role,
+                inbox=kv_inbox,
+                peers=kv_peers,
+                block_bytes=self.engine.prefix_block_nbytes,
+                timeout_s=float(kvfleet_timeout_s),
+                max_inflight_mb=float(kvfleet_inflight_mb),
+                bandwidth_mbps=float(kvfleet_bandwidth_mbps),
+                registry=self._registry,
+                events=self.events,
+            )
         self.scheduler = Scheduler(
             self._sched_engine,
             metrics=self.metrics,
@@ -589,6 +654,8 @@ class ServeReplica:
             events=self.events,
             journal=self.journal,
             faults=self.faults,
+            kvfleet=self.kvfleet,
+            role=self.role,
         )
         self._serve_config: Dict[str, Any] = {
             "num_slots": self.engine.num_slots,
@@ -606,6 +673,8 @@ class ServeReplica:
             "spec_depth": self.engine.spec_depth,
             "int8": self.int8,
             "mesh": self.engine.mesh_desc,
+            "role": self.role,
+            "kvfleet": self.kvfleet is not None,
             "gang_hosts": int(self._dist.get("num_hosts", 1)),
             "watchdog": bool(watchdog),
             "stall_s": float(stall_s),
@@ -708,6 +777,16 @@ class ServeReplica:
                                 "finished" if ev.reason in ("token", "finished")
                                 else ev.reason
                             )
+                            target = getattr(ev, "ship_to", None)
+                            if target is not None:
+                                # Disagg handoff: the client resubmits
+                                # to this decode replica and the stream
+                                # continues warm there.
+                                buf["ship_to"] = int(target)
+                                buf["ship_digests"] = list(
+                                    getattr(ev, "ship_digests", None)
+                                    or []
+                                )
                     self._cond.notify_all()
             self.metrics.maybe_log()
             if self._tick:
@@ -730,11 +809,16 @@ class ServeReplica:
         deadline_s: Optional[float] = None,
         request_id: Optional[str] = None,
         tenant: Optional[str] = None,
+        kv_hint: Optional[Dict[str, Any]] = None,
+        ship_to: Optional[int] = None,
     ) -> str:
         """``request_id`` lets the CLIENT mint the id before the RPC —
         the trace-stitching anchor: its client_submit span and this
         replica's spans share the id, so the merged export ties them.
-        ``tenant`` labels the request's cost-ledger record."""
+        ``tenant`` labels the request's cost-ledger record.
+        ``kv_hint``/``ship_to`` are the router's fleet-KV placement
+        hints (fetch the prefix chain from a warm peer / ship the
+        finished prefill's pages to that decode replica)."""
         from ray_lightning_tpu.serve.scheduler import SamplingParams
 
         if self.faults is not None:
@@ -753,6 +837,8 @@ class ServeReplica:
             priority=priority,
             deadline_s=deadline_s,
             tenant=tenant,
+            kv_hint=kv_hint,
+            ship_to=ship_to,
         )
         with self._cond:
             self._buffers[rid] = {
@@ -783,11 +869,15 @@ class ServeReplica:
                 if remaining <= 0:
                     break
                 self._cond.wait(timeout=remaining)
-            return {
+            out = {
                 "tokens": list(buf["tokens"][cursor:]),
                 "done": buf["done"],
                 "status": buf["status"],
             }
+            if "ship_to" in buf:
+                out["ship_to"] = buf["ship_to"]
+                out["ship_digests"] = buf.get("ship_digests") or []
+            return out
 
     def cancel(self, request_id: str) -> bool:
         ok = self.scheduler.cancel(request_id)
@@ -830,8 +920,26 @@ class ServeReplica:
                 "metrics": self._registry.to_dict(),
             }
         )
+        snap["role"] = self.role
+        if self.kvfleet is not None:
+            snap["kvfleet"] = self.kvfleet.stats()
+        # SLO-breach total (rlt_slo_breaches_total over every rule):
+        # the router/autoscaler's quality signal next to raw queue
+        # depth — summed here so the fleet rows need no registry walk.
+        snap["slo_breaches"] = int(sum(
+            self._registry.counter(
+                "rlt_slo_breaches_total"
+            ).samples().values()
+        ))
         if self.engine.prefix_blocks:
             snap["prefix"] = self.engine.prefix_stats()
+            # Eviction-invalidation feed for the driver-side fleet
+            # directory: digests this engine dropped from EVERY tier
+            # (bounded ring + lifetime count; idempotent to re-read).
+            snap["kv_dropped"] = {
+                "total": self.engine.kv_dropped_total,
+                "recent": self.engine.dropped_digests(),
+            }
         if self.engine.paged:
             # The allocator's live state (the scheduler-refreshed metrics
             # copy can lag a step; this one is read straight off the
@@ -937,6 +1045,15 @@ class ServeReplica:
         n = self.scheduler.enqueue_prefix_import(blocks)
         self._work.set()
         return n
+
+    def register_kv_peer(self, idx: int, queue: Any) -> bool:
+        """Adopt a new fleet member's KV inbox (autoscale-up wires the
+        grown fleet without respawning anyone). No-op without a fleet
+        KV plane."""
+        if self.kvfleet is None:
+            return False
+        self.kvfleet.register_peer(int(idx), queue)
+        return True
 
     def journal_dump(self, n: Optional[int] = None) -> Dict[str, Any]:
         """This replica's workload journal in the wire form (header +

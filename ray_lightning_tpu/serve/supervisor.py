@@ -505,13 +505,21 @@ class FleetSupervisor:
     # -- read side ---------------------------------------------------------
     def rows(self) -> List[Dict[str, Any]]:
         """The supervisor table (one row per replica) embedded in the
-        ``/fleet`` payload and rendered by ``rlt top``."""
+        ``/fleet`` payload and rendered by ``rlt top``. Rows carry the
+        replica's ROLE (prefill/decode/mixed) — a respawn re-runs the
+        retained per-index recipe, so a restarted prefill replica comes
+        back a prefill replica, and the table shows what it is."""
+        role_fn = getattr(self.client, "role_of", None)
         with self._lock:
             return [
                 {
                     "replica": idx,
                     "state": st["state"],
                     "verdict": st["verdict"],
+                    "role": (
+                        str(role_fn(idx))
+                        if role_fn is not None else "mixed"
+                    ),
                     "restarts": st["restarts"],
                     "attempts": st["attempts"],
                     "preemptions": st["preemptions"],

@@ -49,7 +49,9 @@ def test_bench_smoke_cpu():
     proc = _run_bench(
         {"RLT_BENCH_ALLOW_CPU": "1"},
         "--rounds", "1", "--epochs", "2", "--n-train", "256",
-        timeout=600,
+        # The serve sweep grew the disagg fleet (d=256 engines x 4
+        # replicas across two modes); give the full run headroom.
+        timeout=1200,
     )
     out = _json_line(proc)
     assert out["metric"] == "mnist_steps_per_sec_per_chip"
@@ -256,6 +258,32 @@ def test_bench_smoke_cpu():
     assert out["extra"]["router_shed_holds_slo"] is True
     assert out["extra"]["router_shed_off_collapses"] is True
     assert out["extra"]["router_cpu_control"] is True
+    # Fleet KV plane: under the heavy-prefill mix, disaggregated
+    # prefill/decode must IMPROVE the residents' inter-token p95 over
+    # the mixed fleet (long prompts stop stealing fold time) with
+    # bit-identical streams; and the fleet cache must beat isolated
+    # caches on prefix hit rate when revisits are steered off the warm
+    # replica (pages fetched, not re-prefilled).
+    disagg = {
+        (r["workload"], r["mode"]): r
+        for r in out["extra"]["disagg_rows"]
+    }
+    d_mixed = disagg[("disagg_prefill", "mixed")]
+    d_split = disagg[("disagg_prefill", "disagg")]
+    assert d_split["ships"] > 0, disagg
+    assert d_split["exact_vs_mixed"] is True, disagg
+    assert (
+        d_split["inter_token_p95_s"] < d_mixed["inter_token_p95_s"]
+    ), disagg
+    assert out["extra"]["disagg_inter_token_p95_ratio"] > 1.0
+    f_iso = disagg[("fleet_prefix", "isolated")]
+    f_on = disagg[("fleet_prefix", "fleet")]
+    assert f_on["kv_fetches"] > 0 and f_iso["kv_fetches"] == 0, disagg
+    assert f_on["exact_vs_isolated"] is True, disagg
+    assert (
+        f_on["fleet_prefix_hit_rate"] > f_iso["fleet_prefix_hit_rate"]
+    ), disagg
+    assert out["extra"]["disagg_cpu_control"] is True
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

@@ -1,0 +1,1072 @@
+"""Fleet KV plane tests: cross-replica prefix sharing + disaggregated
+prefill/decode (serve/kvfleet.py and its engine/scheduler/router/client
+hooks).
+
+The load-bearing property is the serve oracle extended across process
+boundaries: a request whose prefix pages were FETCHED from a peer, or
+whose prefill ran on replica A with the decode on replica B, emits
+greedy tokens bit-identical to a fully local run and to solo
+``gpt_generate`` — K/V are a pure function of the token prefix and the
+transferred bytes are the spill-tier wire form PR 10 proved exact. On
+top ride the failure matrix (peer dead mid-fetch -> timeout, stale
+directory -> explicit miss, decode death with a transfer pending ->
+journal failover; all degrade to cold prefill with zero lost requests
+and exact output), the router/directory unification (one digest store,
+one invalidation path incl. evicted blocks), role-aware
+routing/autoscaling fed by the goodput/SLO ledger, and the
+observability plumbing (counters, fleet rows, `rlt top` columns,
+journal header provenance).
+
+Fast tests drive in-process engines/schedulers over plain queues and
+fake replicas (no fabric processes); the slow e2e at the bottom runs a
+real disaggregated fleet.
+"""
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import fabric, obs
+from ray_lightning_tpu.models.gpt import (
+    GPTConfig,
+    gpt_generate,
+    init_gpt_params,
+)
+from ray_lightning_tpu.serve.kvfleet import (
+    FleetKVDirectory,
+    KVFleetPlane,
+    blocks_nbytes,
+)
+from ray_lightning_tpu.serve.router import (
+    Router,
+    RouterAutoscaler,
+    prompt_block_digests,
+)
+
+#: fp32 + reference attention: the exactness-contract config (MHA so a
+#: model axis of 2 divides both head counts on the 2x4 mesh).
+CFG = GPTConfig(
+    vocab_size=97,
+    n_layer=2,
+    n_head=4,
+    d_model=32,
+    max_seq=64,
+    attn_impl="reference",
+    compute_dtype="float32",
+)
+
+BLOCK = 4  # prefix_block == kv_page everywhere below
+
+MESH_SHAPE = (2, 4)
+
+_REF_MEMO = {}
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+
+    return init_gpt_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def tp_mesh():
+    import jax
+
+    needed = MESH_SHAPE[0] * MESH_SHAPE[1]
+    if len(jax.devices()) != needed:
+        pytest.skip(
+            f"needs {needed} devices "
+            f"(xla_force_host_platform_device_count), have "
+            f"{len(jax.devices())}"
+        )
+    from ray_lightning_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(MESH_SHAPE, ("model", "data"))
+
+
+def _ref(params, prompt, n):
+    key = (tuple(prompt), n)
+    if key not in _REF_MEMO:
+        out = gpt_generate(
+            params, CFG, np.asarray(prompt, np.int32)[None], n
+        )
+        _REF_MEMO[key] = np.asarray(out)[0, len(prompt):].tolist()
+    return _REF_MEMO[key]
+
+
+DENSE_KW = dict(
+    num_slots=3, max_seq=64, prefill_buckets=[16], prefill_chunk=4,
+    prefix_blocks=16, prefix_block=BLOCK, decode_fold=2,
+)
+PAGED_KW = dict(
+    num_slots=3, max_seq=64, prefill_buckets=[16], prefill_chunk=4,
+    kv_page=BLOCK, kv_pages=48, decode_fold=2,
+)
+SPEC_KW = dict(DENSE_KW, spec="ngram", spec_depth=2)
+
+
+def _engine(params, engine_kw, mesh=None):
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+
+    return DecodeEngine(params, CFG, mesh=mesh, **engine_kw)
+
+
+class _Duo:
+    """Two in-process schedulers joined by a fleet KV plane over plain
+    queues — the whole transfer fabric without any processes."""
+
+    def __init__(
+        self,
+        params,
+        engine_kw,
+        roles=("mixed", "mixed"),
+        mesh=None,
+        clock=time.monotonic,
+        timeout_s=5.0,
+        **plane_kw,
+    ):
+        from ray_lightning_tpu.serve.scheduler import Scheduler
+
+        inboxes = {0: queue.Queue(), 1: queue.Queue()}
+        self.engines = []
+        self.planes = []
+        self.scheds = []
+        for i in (0, 1):
+            eng = _engine(params, engine_kw, mesh=mesh)
+            plane = KVFleetPlane(
+                index=i,
+                role=roles[i],
+                inbox=inboxes[i],
+                peers=dict(inboxes),
+                block_bytes=eng.prefix_block_nbytes,
+                timeout_s=timeout_s,
+                min_poll_s=0.0,
+                clock=clock,
+                **plane_kw,
+            )
+            self.engines.append(eng)
+            self.planes.append(plane)
+            self.scheds.append(Scheduler(eng, kvfleet=plane, role=roles[i]))
+
+    def drive(self, max_steps=400):
+        """Step both schedulers until neither has work; returns every
+        TokenEvent per scheduler index."""
+        events = ([], [])
+        for _ in range(max_steps):
+            busy = False
+            for i, s in enumerate(self.scheds):
+                if s.has_work():
+                    busy = True
+                events[i].extend(s.step())
+            if not busy:
+                break
+        return events
+
+
+def _tokens(events, rid):
+    return [e.token for e in events if e.request_id == rid
+            and e.token is not None]
+
+
+def _sp(n=8, seed=0):
+    from ray_lightning_tpu.serve.scheduler import SamplingParams
+
+    return SamplingParams(max_new_tokens=n, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# FleetKVDirectory
+# ---------------------------------------------------------------------------
+def test_directory_observe_chain_forget():
+    d = FleetKVDirectory(capacity=64)
+    a = [bytes([i] * 16) for i in range(4)]
+    d.observe(a[:3], replica=1)
+    assert d.chain(a) == (1, 3)
+    assert d.holder(a[0]) == 1 and d.holder(a[3]) is None
+    # A broken chain (block 1 moves elsewhere) stops the walk at it.
+    d.observe([a[1]], replica=2)
+    assert d.chain(a) == (1, 1)
+    # Eviction invalidation is replica-scoped: replica 2 dropping a[0]
+    # must not erase replica 1's live copy...
+    assert d.forget_digests([a[0]], replica=2) == 0
+    assert d.holder(a[0]) == 1
+    # ... while the holder's own drop does (idempotently).
+    assert d.forget_digests([a[0]], replica=1) == 1
+    assert d.forget_digests([a[0]], replica=1) == 0
+    assert d.holder(a[0]) is None
+    # Replica loss forgets every entry pointing at it.
+    assert d.forget_replica(1) == 1  # a[2]
+    assert d.chain(a) == (None, 0) or d.holder(a[1]) == 2
+
+
+def test_directory_bounded_lru():
+    d = FleetKVDirectory(capacity=16)
+    digs = [bytes([i, i + 1] * 8) for i in range(40)]
+    d.observe(digs, replica=0)
+    assert len(d) == 16
+    # Newest survive, oldest rotated out.
+    assert d.holder(digs[-1]) == 0 and d.holder(digs[0]) is None
+
+
+# ---------------------------------------------------------------------------
+# KVFleetPlane (unit, fake export/import)
+# ---------------------------------------------------------------------------
+def _fake_blocks(hexes):
+    blk = np.zeros((2, 1, 4, 2, 8), np.float32)
+    return [(h, blk, blk) for h in hexes]
+
+
+def test_plane_fetch_roundtrip_and_accounting():
+    inboxes = {0: queue.Queue(), 1: queue.Queue()}
+    planes = [
+        KVFleetPlane(
+            index=i, inbox=inboxes[i], peers=dict(inboxes),
+            block_bytes=1024, min_poll_s=0.0,
+        )
+        for i in (0, 1)
+    ]
+    store = {"aa" * 16: True, "bb" * 16: True}
+    imported = []
+    assert planes[0].request_fetch(
+        "r1", peer=1, digests_hex=["aa" * 16, "bb" * 16]
+    )
+    assert planes[0].pending_fetches() == 1
+    # A second fetch for the same id is refused while one is in flight.
+    assert not planes[0].request_fetch("r1", 1, ["aa" * 16])
+    # Peer services the fetch (export stops at the first miss).
+    svc1 = planes[1].service(
+        export_fn=lambda ds: _fake_blocks([d for d in ds if d in store]),
+        import_fn=lambda blocks: len(blocks),
+    )
+    assert svc1 == {"fetched": [], "failed": []}
+    assert planes[1].served_fetches == 1
+    # Requester imports the response and reports the fetch complete.
+    svc0 = planes[0].service(
+        export_fn=lambda ds: [],
+        import_fn=lambda blocks: imported.append(len(blocks)) or len(blocks),
+    )
+    assert svc0["fetched"] == [("r1", 2)] and svc0["failed"] == []
+    assert imported == [2]
+    assert planes[0].fetch_bytes == blocks_nbytes(
+        _fake_blocks(["aa" * 16, "bb" * 16])
+    )
+    assert planes[0].pending_fetches() == 0
+    s = planes[0].stats()
+    assert s["fetches"] == 1 and s["fetch_blocks"] == 2
+    assert s["fetch_timeouts"] == 0
+
+
+def test_plane_timeout_and_stale_and_budgets():
+    t = [0.0]
+    inboxes = {0: queue.Queue(), 1: queue.Queue()}
+    planes = [
+        KVFleetPlane(
+            index=i, inbox=inboxes[i], peers=dict(inboxes),
+            block_bytes=1 << 20, timeout_s=1.0, max_inflight_mb=3.0,
+            min_poll_s=0.0, clock=lambda: t[0],
+        )
+        for i in (0, 1)
+    ]
+    # Peer dead mid-fetch: no response -> the deadline expires and the
+    # request re-queues for cold prefill.
+    assert planes[0].request_fetch("r1", 1, ["aa" * 16])
+    t[0] = 2.0
+    svc = planes[0].service(export_fn=lambda ds: [], import_fn=len)
+    assert svc["failed"] == [("r1", "timeout")]
+    assert planes[0].fetch_timeouts == 1
+    # Directory staleness: the peer answers with NOTHING (evicted
+    # between lookup and fetch) — an explicit miss, not a timeout.
+    assert planes[0].request_fetch("r2", 1, ["cc" * 16])
+    planes[1].service(export_fn=lambda ds: [], import_fn=len)
+    svc = planes[0].service(export_fn=lambda ds: [], import_fn=len)
+    assert svc["failed"] == [("r2", "stale")]
+    assert planes[0].fetch_stale == 1
+    # In-flight byte budget: 3 MiB cap, 1 MiB/block estimate -> a
+    # 2-block fetch fits, a second 2-block fetch is refused.
+    assert planes[0].request_fetch("r3", 1, ["dd" * 16])
+    assert not planes[0].request_fetch("r4", 1, ["ee" * 16, "ff" * 16])
+    assert planes[0].fetch_refused == 1
+    # Unknown peer and self-fetch are refused outright.
+    assert not planes[0].request_fetch("r5", 7, ["aa" * 16])
+    assert not planes[0].request_fetch("r6", 0, ["aa" * 16])
+
+
+def test_plane_bandwidth_cap_refuses_fetches():
+    t = [0.0]
+    inboxes = {0: queue.Queue(), 1: queue.Queue()}
+    plane = KVFleetPlane(
+        index=0, inbox=inboxes[0], peers=dict(inboxes), block_bytes=64,
+        bandwidth_mbps=1.0, bandwidth_window_s=1.0, min_poll_s=0.0,
+        clock=lambda: t[0],
+    )
+    # Saturate the window: a shipped payload over the 1 MiB/s cap.
+    big = [("aa" * 16, np.zeros(1 << 21, np.uint8), None)]
+    assert plane.ship(1, "rx", big)
+    assert not plane.request_fetch("r1", 1, ["bb" * 16])
+    assert plane.fetch_refused == 1
+    # The window slides: capacity returns.
+    t[0] = 5.0
+    assert plane.request_fetch("r1", 1, ["bb" * 16])
+
+
+# ---------------------------------------------------------------------------
+# Cross-replica prefix sharing: fetch -> warm admit, bit-exact
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "engine_kw", [DENSE_KW, PAGED_KW], ids=["dense", "paged"]
+)
+def test_peer_fetch_warm_admit_bit_exact(params, engine_kw):
+    """Replica 1 misses locally, fetches the chain from replica 0 over
+    the plane, and admits WARM — output bit-identical to replica 0's
+    local run and to solo gpt_generate."""
+    duo = _Duo(params, engine_kw)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, CFG.vocab_size, size=14).tolist()
+    n = 8
+    # Warm replica 0 the ordinary way.
+    duo.scheds[0].submit(prompt, _sp(n), request_id="warm")
+    evA, _ = duo.drive()
+    local = _tokens(evA, "warm")
+    assert local == _ref(params, prompt, n)
+    # Replica 1: full local miss + a hint naming replica 0.
+    digests = prompt_block_digests(prompt, BLOCK)
+    assert duo.engines[1].cached_prefix_blocks(prompt) == 0
+    duo.scheds[1].submit(
+        prompt, _sp(n), request_id="fetched",
+        kv_hint={
+            "peer": 0,
+            "digests": [d.hex() for d in digests],
+            "blocks": len(digests),
+        },
+    )
+    _, evB = duo.drive()
+    assert _tokens(evB, "fetched") == local
+    # The admission really was warm through the transfer: pages
+    # imported from the peer, and the walk consumed them.
+    assert duo.engines[1].prefix_handoff_imports > 0
+    assert duo.engines[1].prefix_hit_tokens > 0
+    assert duo.planes[1].fetches == 1 and duo.planes[1].fetch_timeouts == 0
+    assert duo.planes[0].served_fetches == 1
+
+
+def test_fetch_stale_and_timeout_degrade_to_cold_exact(params):
+    """The transfer failure matrix on one fleet, both arms exact:
+
+    - directory staleness — the hint names digests the peer no longer
+      holds; the peer answers with an EXPLICIT miss and the request
+      cold-prefills immediately (no timeout wait);
+    - peer dead mid-fetch — the peer never services; the parked
+      request times out, re-queues, and cold-prefills.
+
+    A lost transfer only ever costs latency, never the request."""
+    duo = _Duo(params, DENSE_KW, timeout_s=0.8)
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(0, CFG.vocab_size, size=12).tolist()
+    digests = prompt_block_digests(prompt, BLOCK)
+    expected = _ref(params, prompt, 6)  # compiles outside the timing
+    t0 = time.monotonic()
+    duo.scheds[1].submit(
+        prompt, _sp(6), request_id="stale",
+        kv_hint={"peer": 0, "digests": [d.hex() for d in digests]},
+    )
+    _, evB = duo.drive()
+    assert _tokens(evB, "stale") == expected
+    assert duo.planes[1].fetch_stale == 1
+    assert duo.planes[1].fetch_timeouts == 0
+    assert time.monotonic() - t0 < 0.7  # an answer, not a timeout
+    # Arm 2: the peer is "dead" now — drive ONLY replica 1.
+    prompt2 = rng.integers(0, CFG.vocab_size, size=12).tolist()
+    duo.scheds[1].submit(
+        prompt2, _sp(6), request_id="dead",
+        kv_hint={
+            "peer": 0,
+            "digests": [
+                d.hex() for d in prompt_block_digests(prompt2, BLOCK)
+            ],
+        },
+    )
+    out = []
+    deadline = time.monotonic() + 10.0
+    while duo.scheds[1].has_work() and time.monotonic() < deadline:
+        out.extend(duo.scheds[1].step())
+    assert _tokens(out, "dead") == _ref(params, prompt2, 6)
+    assert duo.planes[1].fetch_timeouts == 1
+    assert duo.engines[1].prefix_handoff_imports == 0
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode: ship -> warm decode, bit-exact
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "engine_kw",
+    [SPEC_KW, PAGED_KW],
+    ids=["dense+spec", "paged"],
+)
+def test_disagg_prefill_ship_decode_bit_exact(params, engine_kw):
+    """Prefill on replica 0 (role=prefill), KV pages shipped, decode on
+    replica 1: the prefill side emits exactly the first token + a
+    `shipped` terminal naming the target; the decode side re-runs the
+    request under the same id/seed and the FULL stream is bit-identical
+    to solo gpt_generate (the client's cursor dedups the first token)."""
+    duo = _Duo(params, engine_kw, roles=("prefill", "decode"))
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(0, CFG.vocab_size, size=14).tolist()
+    n = 8
+    duo.scheds[0].submit(prompt, _sp(n), request_id="r", ship_to=1)
+    evA, evB = duo.drive()
+    shipped = [e for e in evA if e.reason == "shipped"]
+    assert len(shipped) == 1 and shipped[0].ship_to == 1
+    first = _tokens(evA, "r")
+    assert len(first) == 1  # prefill-only: one token, zero decode folds
+    # The ship landed in replica 1's pool before any decode ran there.
+    assert duo.planes[0].ships == 1
+    assert duo.engines[1].prefix_handoff_imports > 0
+    # The client-side follow: same id/seed resubmitted on the target.
+    duo.scheds[1].submit(prompt, _sp(n), request_id="r")
+    _, evB2 = duo.drive()
+    full = _tokens(evB2, "r")
+    assert full == _ref(params, prompt, n)
+    assert full[0] == first[0]  # the cursor-dedup contract
+    assert duo.engines[1].prefix_hit_tokens > 0  # admitted warm
+
+
+def test_disagg_mesh_sharded_exact_zero_compiles(params, tp_mesh):
+    """The 2x4-mesh corner of the grid: shard-aware page export/import
+    across the split (each block travels as its per-device shards), the
+    decode side bit-exact, with compiles_since_init == 0 through the
+    whole fetch+ship traffic (every transfer executable pre-lowered)."""
+    import jax
+
+    from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, CFG.vocab_size, size=13).tolist()
+    n = 6
+    expected = _ref(params, prompt, n)  # compiles OUTSIDE the window
+    stats = install_compile_listener()
+    duo = _Duo(params, PAGED_KW, roles=("prefill", "decode"), mesh=tp_mesh)
+    jax.random.PRNGKey(0)
+    baseline = stats.count("backend_compile")
+    duo.scheds[0].submit(prompt, _sp(n), request_id="r", ship_to=1)
+    evA, _ = duo.drive()
+    assert [e.reason for e in evA if e.done] == ["shipped"]
+    duo.scheds[1].submit(prompt, _sp(n), request_id="r")
+    _, evB = duo.drive()
+    assert _tokens(evB, "r") == expected
+    assert duo.engines[1].prefix_hit_tokens > 0
+    assert stats.count("backend_compile") == baseline
+
+
+def test_shipped_outcome_journals_as_truncation_and_replays(params):
+    """A prefill replica's journal records the ship as a cancel +
+    `shipped` outcome carrying the one emitted token — so a replay of
+    that journal (single engine, no fleet) reproduces it bit-exactly as
+    a truncation, the same contract PR 12's migrations ride."""
+    from ray_lightning_tpu.obs.journal import (
+        WorkloadJournal,
+        engine_header,
+        replay_journal,
+    )
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    duo = _Duo(params, DENSE_KW, roles=("prefill", "decode"))
+    journal = WorkloadJournal(capacity=64)
+    journal.set_header(engine_header(
+        duo.engines[0],
+        kvfleet={"role": "prefill", "peers": 2, "timeout_s": 5.0,
+                 "max_inflight_mb": 64.0, "bandwidth_mbps": 0.0},
+    ))
+    duo.scheds[0].journal = journal
+    rng = np.random.default_rng(29)
+    prompt = rng.integers(0, CFG.vocab_size, size=12).tolist()
+    duo.scheds[0].submit(prompt, _sp(8), request_id="r", ship_to=1)
+    duo.drive()
+    entries = journal.dump(None)["entries"]
+    kinds = [e["kind"] for e in entries if e["request_id"] == "r"]
+    assert kinds == ["submit", "cancel", "outcome"]
+    out = [e for e in entries if e["kind"] == "outcome"][0]
+    assert out["outcome"] == "shipped" and len(out["tokens"]) == 1
+    # Replay on a fresh engine: exact (the recorded truncation fires at
+    # the recorded token count), and the kvfleet section surfaces.
+    fresh = Scheduler(_engine(params, DENSE_KW))
+    verdict = replay_journal(journal.dump(None), scheduler=fresh)
+    assert verdict["exact"] is True
+    assert verdict["kvfleet_config"]["role"] == "prefill"
+    assert verdict["kvfleet_config"]["timeout_s"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# Router: one directory, role-aware plans, goodput/SLO feed
+# ---------------------------------------------------------------------------
+class _RowsClient:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def stats(self):
+        return [dict(r) for r in self.rows]
+
+    def health(self):
+        return [
+            {"verdict": r.get("health", "healthy")} for r in self.rows
+        ]
+
+
+def _row(role="mixed", queue_=0, slots=2, rate=100.0, health="healthy",
+         breaches=0, dropped=None):
+    row = {
+        "queue_depth": queue_,
+        "active_slots": 0,
+        "num_slots": slots,
+        "decode_tokens_per_sec": rate,
+        "health": health,
+        "role": role,
+        "slo_breaches": breaches,
+    }
+    if dropped is not None:
+        row["kv_dropped"] = {"total": len(dropped), "recent": dropped}
+    return row
+
+
+def _mk_router(rows, **kw):
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    return Router(
+        client=_RowsClient(rows), registry=MetricsRegistry(),
+        events=obs.EventLog(), refresh_s=0.0, prefix_block=BLOCK, **kw
+    )
+
+
+def test_router_directory_is_one_source_of_truth():
+    router = _mk_router([_row(), _row()])
+    prompt = list(range(12))
+    router.observe_route(prompt, 1)
+    digests = prompt_block_digests(prompt, BLOCK)
+    assert router.directory.chain(digests)[0] == 1
+    assert router.affinity_entries() == len(digests)
+    # Replica loss: ONE forget covers affinity and fetch hints alike.
+    router.forget_replica(1)
+    assert router.directory.chain(digests) == (None, 0)
+    assert router.affinity_entries() == 0
+
+
+def test_router_refresh_prunes_evicted_digests():
+    """The invalidation gap this PR closes: a replica EVICTING a block
+    now removes the directory entry (before, only death/retire did)."""
+    prompt = list(range(8))
+    digests = prompt_block_digests(prompt, BLOCK)
+    rows = [_row(), _row()]
+    router = _mk_router(rows)
+    router.observe_route(prompt, 1)
+    assert router.directory.chain(digests)[0] == 1
+    rows[1] = _row(dropped=[d.hex() for d in digests])
+    router.refresh(force=True)
+    assert router.directory.chain(digests) == (None, 0)
+    # A drop reported by the NON-holder must not erase the entry.
+    router.observe_route(prompt, 1)
+    rows[0] = _row(dropped=[d.hex() for d in digests])
+    rows[1] = _row()
+    router.refresh(force=True)
+    assert router.directory.chain(digests)[0] == 1
+
+
+def test_router_plan_carries_fetch_hint_when_steered_away():
+    """Load steers a warm-prefix request to the cold replica: the plan
+    carries a kv_hint naming the holder, so the target fetches instead
+    of re-prefilling — and a DEAD holder yields no hint."""
+    rows = [_row(), _row(queue_=40)]  # replica 1 overloaded
+    router = _mk_router(rows, shed=False)
+    prompt = list(range(16))
+    router.observe_route(prompt, 1)
+    plan = router.plan(prompt, alive=[0, 1])
+    assert plan.replica == 0
+    assert plan.kv_hint is not None and plan.kv_hint["peer"] == 1
+    assert plan.kv_hint["blocks"] == len(
+        prompt_block_digests(prompt, BLOCK)
+    )
+    # Holder on the same replica the plan picked: no hint.
+    router2 = _mk_router([_row(), _row(queue_=40)], shed=False)
+    router2.observe_route(prompt, 0)
+    assert router2.plan(prompt, alive=[0, 1]).kv_hint is None
+    # A dead/unreachable holder's pages died with it: no hint.
+    rows3 = [_row(), _row(health="unreachable")]
+    router3 = _mk_router(rows3, shed=False)
+    router3.observe_route(prompt, 1)
+    plan3 = router3.plan(prompt, alive=[0])
+    assert plan3.replica == 0 and plan3.kv_hint is None
+
+
+def test_router_plan_disagg_roles_and_warm_direct():
+    rows = [_row(role="prefill"), _row(role="decode")]
+    router = _mk_router(rows, shed=False)
+    prompt = list(range(16))
+    plan = router.plan(prompt, alive=[0, 1])
+    assert plan.policy == "disagg"
+    assert plan.replica == 0 and plan.ship_to == 1
+    # Warm shortcut: the whole usable chain already lives on the decode
+    # replica — no prefill hop, route straight there.
+    router.observe_route(prompt, 1)
+    plan2 = router.plan(prompt, alive=[0, 1])
+    assert plan2.policy == "warm_direct"
+    assert plan2.replica == 1 and plan2.ship_to is None
+
+
+def test_router_demotes_actively_breaching_replica():
+    """Satellite: the goodput/SLO ledger feeds routing — a replica with
+    a RISING slo_breach count is demoted below its clean twin."""
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    rows = [_row(), _row()]
+    # A long refresh interval so views() reads the cached refresh
+    # instead of re-pulling (the delta lives for one refresh cycle).
+    router = Router(
+        client=_RowsClient(rows), registry=MetricsRegistry(),
+        events=obs.EventLog(), refresh_s=100.0, prefix_block=BLOCK,
+        shed=False,
+    )
+    router.refresh(force=True)
+    rows[1] = _row(breaches=3)
+    router.refresh(force=True)
+    views = router.views()
+    assert views[1]["slo_breach_delta"] == 3
+    w0 = router._base_weight(views[0])
+    w1 = router._base_weight(views[1])
+    assert w1 == pytest.approx(w0 * 0.5)
+    # Steady (non-rising) breach counts stop demoting.
+    router.refresh(force=True)
+    views = router.views()
+    assert views[1]["slo_breach_delta"] == 0
+
+
+class _ScaleClient:
+    def __init__(self, roles):
+        self.roles = list(roles)
+        self.added = []
+        self.retired = []
+
+    def alive_replicas(self):
+        return list(range(len(self.roles)))
+
+    def role_of(self, idx):
+        return self.roles[idx]
+
+    def add_replica(self, role=None):
+        self.roles.append(role or "mixed")
+        self.added.append((len(self.roles) - 1, role))
+        return len(self.roles) - 1
+
+    def retire_replica(self, idx, **kw):
+        self.roles.pop(idx)
+        self.retired.append(idx)
+        return {"migrated": [], "lost": []}
+
+
+class _ViewStub:
+    def __init__(self, rows):
+        self.rows = rows
+        self.shed_count = 0
+
+    def views(self):
+        return {i: dict(r) for i, r in enumerate(self.rows)}
+
+
+def test_autoscaler_scales_role_pools_independently():
+    """Heavy prefill pressure grows the PREFILL pool (role-tagged
+    add_replica) while the decode pool stays put."""
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    client = _ScaleClient(["prefill", "decode"])
+    stub = _ViewStub([
+        {"role": "prefill", "queue_depth": 20, "active_slots": 1},
+        {"role": "decode", "queue_depth": 0, "active_slots": 0},
+    ])
+    auto = RouterAutoscaler(
+        client, router=stub, min_replicas=2, max_replicas=4,
+        sustain_ticks=2, registry=MetricsRegistry(),
+        events=obs.EventLog(),
+    )
+    auto.tick()
+    out = auto.tick()
+    assert out["scaled"] is not None and out["scaled"][0] == "up"
+    assert client.added == [(2, "prefill")]
+    assert client.roles[2] == "prefill"
+
+
+def test_autoscaler_scales_up_on_slo_breach_rate():
+    """Satellite: SLO breaches count as pressure even with shallow
+    queues — the fleet is busy-but-breaching, not idle."""
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    client = _ScaleClient(["mixed"])
+    rows = [{"queue_depth": 0, "active_slots": 1, "slo_breaches": 0}]
+    stub = _ViewStub(rows)
+    auto = RouterAutoscaler(
+        client, router=stub, min_replicas=1, max_replicas=2,
+        sustain_ticks=2, registry=MetricsRegistry(),
+        events=obs.EventLog(),
+    )
+    assert auto.tick()["scaled"] is None
+    rows[0]["slo_breaches"] = 2
+    assert auto.tick()["slo_breach_delta"] == 2
+    rows[0]["slo_breaches"] = 4
+    out = auto.tick()
+    assert out["scaled"] is not None and out["scaled"][0] == "up"
+
+
+# ---------------------------------------------------------------------------
+# Client: ship-follow, decode-death with transfer pending (fake replicas)
+# ---------------------------------------------------------------------------
+class _RemoteShim:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def remote(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _FakeReplica:
+    """In-memory decode replica (the client's RPC surface)."""
+
+    def __init__(self):
+        self.dead = False
+        self.submits = []
+        self.requests = {}
+
+    @staticmethod
+    def tokens_for(prompt, seed, n):
+        return [(sum(prompt) + 7 * seed + i) % 97 for i in range(n)]
+
+    def is_alive(self):
+        return not self.dead
+
+    def _check(self):
+        if self.dead:
+            raise fabric.ActorDiedError("fake replica dead")
+
+    def _rpc_submit(self, prompt, request_id=None, **kw):
+        self._check()
+        self.submits.append((request_id, dict(kw)))
+        self.requests[request_id] = self.tokens_for(
+            prompt, kw.get("seed", 0), kw.get("max_new_tokens", 32)
+        )
+        return request_id
+
+    def _rpc_result(self, rid, cursor, wait_s=0.0):
+        self._check()
+        toks = self.requests[rid]
+        out = toks[cursor: cursor + 4]
+        return {
+            "tokens": out,
+            "done": cursor + len(out) >= len(toks),
+            "status": "finished",
+        }
+
+    def _rpc_cancel(self, rid):
+        self._check()
+        return True
+
+    def _rpc_stop(self):
+        self._check()
+
+    def _rpc_ping(self):
+        self._check()
+        return "ok"
+
+    def __getattr__(self, name):
+        try:
+            return _RemoteShim(
+                object.__getattribute__(self, f"_rpc_{name}")
+            )
+        except AttributeError:
+            raise AttributeError(name) from None
+
+
+class _FakePrefill(_FakeReplica):
+    """Serves exactly the first token, then reports `shipped`."""
+
+    def __init__(self, ship_to):
+        super().__init__()
+        self.ship_to = ship_to
+
+    def _rpc_result(self, rid, cursor, wait_s=0.0):
+        self._check()
+        toks = self.requests[rid]
+        out = toks[:1][cursor:]
+        return {
+            "tokens": out,
+            "done": True,
+            "status": "shipped",
+            "ship_to": self.ship_to,
+            "ship_digests": ["ab" * 16, "cd" * 16],
+        }
+
+
+def _client(replicas, **kw):
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+    from ray_lightning_tpu.serve.client import ServeClient
+
+    return ServeClient(
+        replicas, registry=MetricsRegistry(), events=obs.EventLog(), **kw
+    )
+
+
+def test_client_follows_ship_to_decode_replica(start_fabric):
+    start_fabric(num_cpus=2)
+    prefill, decode = _FakePrefill(ship_to=1), _FakeReplica()
+    client = _client(
+        [prefill, decode], roles=["prefill", "decode"],
+    )
+    prompt = [3, 1, 4, 1, 5]
+    toks = list(client.stream(
+        prompt, replica=0, ship_to=1, max_new_tokens=8, seed=5,
+        timeout_s=30,
+    ))
+    assert toks == _FakeReplica.tokens_for(prompt, 5, 8)
+    # The follow resubmitted the SAME id to the ship target with a
+    # fetch hint pointing back at the prefill replica.
+    rid0, _ = prefill.submits[0]
+    rid1, kw1 = decode.submits[0]
+    assert rid0 == rid1
+    hint = kw1.get("kv_hint") or {}
+    assert hint.get("peer") == 0
+    assert hint.get("digests") == ["ab" * 16, "cd" * 16]
+    assert client.role_of(0) == "prefill"
+
+
+def test_client_ship_target_dead_fails_over_zero_lost(start_fabric):
+    """Decode-replica death with a transfer pending: the ship names a
+    corpse — the follow falls back to a survivor via the journal,
+    the stream completes exactly, nothing is lost."""
+    start_fabric(num_cpus=2)
+    prefill = _FakePrefill(ship_to=1)
+    dead = _FakeReplica()
+    dead.dead = True
+    survivor = _FakeReplica()
+    client = _client(
+        [prefill, dead, survivor],
+        roles=["prefill", "decode", "decode"],
+    )
+    prompt = [2, 7, 1, 8]
+    toks = list(client.stream(
+        prompt, replica=0, ship_to=1, max_new_tokens=6, seed=3,
+        timeout_s=30,
+    ))
+    assert toks == _FakeReplica.tokens_for(prompt, 3, 6)
+    assert survivor.submits, "survivor never received the failover"
+    from ray_lightning_tpu.obs.journal import incomplete_requests
+
+    assert not incomplete_requests(client.journal.dump(None))
+
+
+# ---------------------------------------------------------------------------
+# Observability: counters, rows, top, supervisor role
+# ---------------------------------------------------------------------------
+def test_kvfleet_metrics_rows_and_top_columns():
+    from ray_lightning_tpu.cli import render_fleet
+    from ray_lightning_tpu.obs.fleet import (
+        aggregate_fleet,
+        summarize_replica,
+    )
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    inboxes = {0: queue.Queue(), 1: queue.Queue()}
+    plane = KVFleetPlane(
+        index=0, role="prefill", inbox=inboxes[0], peers=dict(inboxes),
+        block_bytes=128, min_poll_s=0.0, registry=reg,
+    )
+    plane.request_fetch("r1", 1, ["aa" * 16])
+    plane.ship(1, "r2", _fake_blocks(["bb" * 16]))
+    text = reg.render()
+    assert 'rlt_serve_kvfleet_fetches_total{role="prefill"} 1' in text
+    assert 'rlt_serve_kvfleet_ships_total{role="prefill"} 1' in text
+    assert "rlt_serve_kvfleet_fetch_timeouts_total" in text
+    assert "rlt_serve_kvfleet_fetch_bytes_total" in text
+    stats = {
+        "role": "prefill",
+        "kvfleet": plane.stats(),
+        "slo_breaches": 2,
+        "queue_depth": 0,
+    }
+    row = summarize_replica(stats)
+    assert row["role"] == "prefill"
+    assert row["kvfleet"]["fetches"] == 1 and row["kvfleet"]["ships"] == 1
+    assert row["slo_breaches"] == 2
+    fleet = aggregate_fleet([row, summarize_replica({"queue_depth": 0})])
+    assert fleet["kvfleet_fetches"] == 1 and fleet["kvfleet_ships"] == 1
+    frame = render_fleet(
+        {"latest": {"replicas": [row], "fleet": fleet}}
+    )
+    assert "role" in frame and "prefill" in frame
+    assert "fetch/ship" in frame and "1/1" in frame
+    assert "kvfleet: fetches=1" in frame
+    # A plane-less fleet renders "-" cells, no kvfleet line.
+    bare = render_fleet(
+        {"latest": {
+            "replicas": [summarize_replica({"queue_depth": 0})],
+            "fleet": aggregate_fleet(
+                [summarize_replica({"queue_depth": 0})]
+            ),
+        }}
+    )
+    assert "kvfleet:" not in bare
+
+
+def test_supervisor_rows_carry_roles():
+    from ray_lightning_tpu.serve.supervisor import FleetSupervisor
+
+    class _C:
+        num_replicas = 1
+
+        def role_of(self, idx):
+            return "prefill"
+
+        def health_one(self, idx, timeout=None):
+            return {"verdict": "healthy"}
+
+        def replica_is_alive(self, idx):
+            return True
+
+        def replica_heartbeat_age(self, idx):
+            return None
+
+        def exclude(self, idx):
+            pass
+
+        def restore(self, idx):
+            pass
+
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    sup = FleetSupervisor(
+        _C(), registry=MetricsRegistry(), events=obs.EventLog()
+    )
+    sup.tick()
+    (row,) = sup.rows()
+    assert row["role"] == "prefill" and row["state"] == "healthy"
+
+
+def test_engine_reports_dropped_digests(params):
+    """The directory's eviction feed: an untiered pool evicting a block
+    under pressure reports the digest in kv_dropped."""
+    kw = dict(DENSE_KW, prefix_blocks=4, num_slots=2)
+    eng = _engine(params, kw)
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    sched = Scheduler(eng)
+    rng = np.random.default_rng(41)
+    for s in range(4):  # distinct prompts churn the 4-block pool
+        p = rng.integers(0, CFG.vocab_size, size=12).tolist()
+        sched.submit(p, _sp(4, seed=s))
+        sched.run_until_idle()
+    assert eng.kv_dropped_total > 0
+    assert len(eng.dropped_digests()) == eng.kv_dropped_total or (
+        len(eng.dropped_digests()) == 256
+    )
+    int(eng.dropped_digests()[0], 16)  # real hex digests
+
+
+def test_serve_cli_knows_the_kvfleet_knobs(tmp_path):
+    from ray_lightning_tpu.cli import cli_entry
+
+    # prefill_replicas must leave a decode replica...
+    with pytest.raises(ValueError, match="at least one decode replica"):
+        cli_entry([
+            "serve", "--serve.ckpt_path", "/nonexistent.ckpt",
+            "--serve.prompts", "/nonexistent.txt",
+            "--serve.replicas", "2", "--serve.prefill_replicas", "2",
+        ])
+    # ... and needs a prefix cache to ship through.
+    with pytest.raises(ValueError, match="prefix pool"):
+        cli_entry([
+            "serve", "--serve.ckpt_path", "/nonexistent.ckpt",
+            "--serve.prompts", "/nonexistent.txt",
+            "--serve.replicas", "2", "--serve.prefill_replicas", "1",
+        ])
+    # A typo'd kvfleet knob names the vocabulary up front.
+    with pytest.raises(ValueError, match="kvfleet_timeout_s"):
+        cli_entry([
+            "serve", "--serve.ckpt_path", "/nonexistent.ckpt",
+            "--serve.prompts", "/nonexistent.txt",
+            "--serve.kvfleet_timeout", "5",
+        ])
+
+
+# ---------------------------------------------------------------------------
+# e2e: a real disaggregated fleet (slow)
+# ---------------------------------------------------------------------------
+def _write_ckpt(tmp_path, params):
+    import dataclasses
+    import os
+
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file,
+        to_state_stream,
+    )
+
+    path = os.path.join(str(tmp_path), "kvfleet.ckpt")
+    state_stream_to_file(
+        to_state_stream(
+            {
+                "params": params,
+                "gpt_config": dataclasses.asdict(CFG),
+            }
+        ),
+        path,
+    )
+    return path
+
+
+@pytest.mark.slow
+def test_e2e_disagg_fleet_bit_exact_with_ships(
+    start_fabric, tmp_path, params
+):
+    """Acceptance e2e: a real 1-prefill + 1-decode fleet behind the
+    router — every stream bit-identical to solo gpt_generate, pages
+    really shipped (kvfleet ships > 0), the decode replica admitting
+    warm, zero lost."""
+    start_fabric(num_cpus=4)
+    from ray_lightning_tpu.serve.client import start_replicas
+
+    ckpt = _write_ckpt(tmp_path, params)
+    client = start_replicas(
+        2,
+        ckpt_path=ckpt,
+        env={"JAX_PLATFORMS": "cpu"},
+        roles=["prefill", "decode"],
+        rpc_timeout_s=60.0,
+        num_slots=3,
+        max_seq=64,
+        prefill_buckets=[16],
+        prefill_chunk=4,
+        prefix_blocks=16,
+        prefix_block=BLOCK,
+        decode_fold=2,
+    )
+    client.router = Router(
+        client=client, refresh_s=0.0, prefix_block=BLOCK, shed=False,
+    )
+    try:
+        rng = np.random.default_rng(51)
+        jobs = [
+            rng.integers(0, CFG.vocab_size, size=14).tolist()
+            for _ in range(3)
+        ]
+        for i, prompt in enumerate(jobs):
+            toks = list(client.stream(
+                prompt, max_new_tokens=8, seed=i, timeout_s=120,
+            ))
+            assert toks == _ref(params, prompt, 8), f"job {i} diverged"
+        stats = client.stats()
+        assert stats[0]["role"] == "prefill"
+        assert stats[1]["role"] == "decode"
+        assert stats[0]["kvfleet"]["ships"] >= 1
+        assert stats[1]["kvfleet"]["imports"] >= 1
+        # The decode replica admitted warm off the shipped pages.
+        assert stats[1]["prefix"]["hit_tokens"] > 0
+        from ray_lightning_tpu.obs.journal import incomplete_requests
+
+        assert not incomplete_requests(client.journal.dump(None))
+    finally:
+        client.shutdown()

@@ -27,6 +27,7 @@ have_failover=0
 have_preempt=0
 have_paged=0
 have_router=0
+have_kvfleet=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -41,6 +42,7 @@ failover_fails=0
 preempt_fails=0
 paged_fails=0
 router_fails=0
+kvfleet_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -59,6 +61,7 @@ failover_status=pending
 preempt_status=pending
 paged_status=pending
 router_status=pending
+kvfleet_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -84,6 +87,7 @@ write_manifest() {
     echo "stage=preempt status=$preempt_status fails=$preempt_fails"
     echo "stage=paged status=$paged_status fails=$paged_fails"
     echo "stage=router status=$router_status fails=$router_fails"
+    echo "stage=kvfleet status=$kvfleet_status fails=$kvfleet_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -265,6 +269,33 @@ while true; do
             have_router=1
             router_status=skipped
             echo "$(date -u +%H:%M:%S) router serve bench SKIPPED after $router_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_kvfleet" -eq 0 ]; then
+        # Stage 4a+: fleet-KV-plane artifact - the serve sweep now
+        # carries disagg_rows (heavy-prefill mix mixed vs disaggregated
+        # prefill/decode: resident inter-token p95 + ships; shared
+        # prefixes isolated vs fleet cache: hit rate + fetches), so the
+        # next healthy window records the disaggregation story ON CHIP
+        # next to the CPU control.
+        echo "$(date -u +%H:%M:%S) launching KVFLEET serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/kvfleet_bench.json 2> /tmp/kvfleet_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/kvfleet_bench.json ] && \
+           grep -q disagg_rows /tmp/kvfleet_bench.json; then
+          have_kvfleet=1
+          kvfleet_status=ok
+          echo "$(date -u +%H:%M:%S) KVFLEET serve bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          kvfleet_fails=$((kvfleet_fails+1))
+          kvfleet_status=failed
+          echo "$(date -u +%H:%M:%S) kvfleet serve bench failed rc=$rc (fail $kvfleet_fails)" >> /tmp/tpu_watch.log
+          if [ "$kvfleet_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_kvfleet=1
+            kvfleet_status=skipped
+            echo "$(date -u +%H:%M:%S) kvfleet serve bench SKIPPED after $kvfleet_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_sharded" -eq 0 ]; then
