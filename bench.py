@@ -2637,6 +2637,246 @@ def bench_disagg(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
     return _in_worker(run, False, timeout=1800.0)
 
 
+def bench_kvstore(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
+    """``kvstore_rows``: the persistent object-store KV tier measured
+    on 2-replica CPU fleets (driver + store machinery — always a CPU
+    control):
+
+    - ``kvstore_warm_start``: a fleet warms shared prefixes with
+      write-through on, then the WHOLE fleet is stopped and restarted
+      over the same store dir. The fresh fleet pre-seeds its directory
+      from the store manifest; revisits must hit via real store
+      fetches (isolated restarts would re-prefill cold) with every
+      stream bit-identical to the pre-bounce fleet's.
+    - ``kvstore_park``: a finished conversation is parked (exported to
+      the store, pages freed), then the next turn restores it — the
+      round-trip latency plus an exactness check against the same
+      two-turn conversation run uninterrupted.
+    """
+
+    def run():
+        import dataclasses
+        import os as _os
+        import tempfile as _tempfile
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu import fabric as _fabric
+        from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+        from ray_lightning_tpu.serve.client import start_replicas
+        from ray_lightning_tpu.serve.router import Router
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        _fabric.init(num_cpus=max(8.0, float(_os.cpu_count() or 1)))
+        cfg = GPTConfig(
+            vocab_size=256, n_layer=2, n_head=4, n_kv_head=2,
+            d_model=256, max_seq=256, attn_impl="reference",
+            compute_dtype="float32",
+        )
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        work = _tempfile.mkdtemp(prefix="rlt_kvstore_")
+        ckpt = _os.path.join(work, "m.ckpt")
+        state_stream_to_file(
+            to_state_stream(
+                {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+            ),
+            ckpt,
+        )
+        store = _os.path.join(work, "store")
+        g = np.random.default_rng(0)
+
+        def pct(vals, q):
+            vals = sorted(vals)
+            idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+            return vals[idx]
+
+        # Shared-prefix jobs fixed up front: shared=48 is exactly 3
+        # full blocks, so a warm job's write-through chain IS the
+        # prefix a revisit re-derives. The session is sized the same
+        # way: park exports prompt+turn-1 tokens (52 -> 3 blocks)
+        # and turn 2's first 48 tokens re-derive that chain.
+        shared, uniq, n_new, fp_block = 48, 8, 8, 16
+        prefixes = [
+            g.integers(0, cfg.vocab_size, size=shared).tolist()
+            for _ in range(3)
+        ]
+        warm_jobs = [
+            p + g.integers(0, cfg.vocab_size, size=uniq).tolist()
+            for p in prefixes
+        ]
+        revisit_jobs = [
+            p + g.integers(0, cfg.vocab_size, size=uniq).tolist()
+            for p in prefixes
+        ]
+        sess_prompt = g.integers(0, cfg.vocab_size, size=40).tolist()
+        sess_turn2_tail = g.integers(0, cfg.vocab_size, size=8).tolist()
+        kw = dict(
+            num_slots=2, max_seq=96, prefill_buckets=[64],
+            prefill_chunk=8, prefix_blocks=32, prefix_block=fp_block,
+            decode_fold=1, kvstore_dir=store, kvstore_mb=64.0,
+            kvstore_writethrough=True,
+        )
+
+        def boot():
+            client = start_replicas(
+                2, ckpt_path=ckpt, env={"JAX_PLATFORMS": "cpu"},
+                kvfleet=True, rpc_timeout_s=120.0, **kw,
+            )
+            client.router = Router(
+                client=client, refresh_s=0.05, prefix_block=fp_block,
+                shed=False,
+            )
+            return client
+
+        def timed_stream(client, prompt, seed):
+            t0 = _time.monotonic()
+            first, toks = None, []
+            for tok in client.stream(
+                prompt, max_new_tokens=n_new, seed=seed, timeout_s=120,
+            ):
+                if first is None:
+                    first = _time.monotonic() - t0
+                toks.append(tok)
+            return first, toks
+
+        rows = []
+
+        # ---- phase A: cold fleet, write-through on -------------------
+        client = boot()
+        try:
+            cold_ttfts, outs = [], {}
+            for i, prompt in enumerate(warm_jobs):
+                ttft, toks = timed_stream(client, prompt, seed=i)
+                cold_ttfts.append(ttft)
+                outs[("warm", i)] = toks
+            for i, prompt in enumerate(revisit_jobs):
+                outs[("revisit", i)] = list(client.stream(
+                    prompt, max_new_tokens=n_new, seed=50 + i,
+                    timeout_s=120,
+                ))
+            # Uninterrupted two-turn conversation: the park exactness
+            # baseline.
+            t1 = list(client.stream(
+                sess_prompt, max_new_tokens=12, seed=7, timeout_s=120,
+            ))
+            turn2 = sess_prompt + t1 + sess_turn2_tail
+            t2_base = list(client.stream(
+                turn2, max_new_tokens=12, seed=9, timeout_s=120,
+            ))
+            stats = client.stats()
+            writes = sum(
+                (s.get("kvstore") or {}).get("writes", 0) for s in stats
+            )
+            assert writes > 0, "write-through stored no pages"
+        finally:
+            client.shutdown()
+        rows.append({
+            "workload": "kvstore_warm_start", "mode": "cold",
+            "ttft_p50_s": round(pct(cold_ttfts, 0.5), 6),
+            "store_writes": writes,
+        })
+
+        # ---- phase B: full fleet bounce, warm-start from the store ---
+        client = boot()
+        try:
+            seeded = client.seed_store_directory(client.router)
+            assert seeded > 0, "manifest seeding found an empty store"
+            warm_ttfts, outs2 = [], {}
+            for i, prompt in enumerate(revisit_jobs):
+                ttft, toks = timed_stream(client, prompt, seed=50 + i)
+                warm_ttfts.append(ttft)
+                outs2[("revisit", i)] = toks
+            stats = client.stats()
+            store_fetches = sum(
+                (s.get("kvfleet") or {}).get("store_fetches", 0)
+                for s in stats
+            )
+            hit = sum(
+                (s.get("prefix") or {}).get("hit_tokens", 0)
+                for s in stats
+            )
+            looked = sum(
+                (s.get("prefix") or {}).get("prompt_tokens", 0)
+                for s in stats
+            )
+            hit_rate = round(hit / looked, 4) if looked else 0.0
+            warm_exact = all(
+                outs2[("revisit", i)] == outs[("revisit", i)]
+                for i in range(len(revisit_jobs))
+            )
+            assert store_fetches > 0, (
+                "bounced fleet revisits fetched nothing from the store"
+            )
+            assert hit_rate > 0, "bounced fleet revisits hit nothing"
+            assert warm_exact, "store-warm streams diverged from cold"
+            rows.append({
+                "workload": "kvstore_warm_start", "mode": "bounced",
+                "ttft_p50_s": round(pct(warm_ttfts, 0.5), 6),
+                "directory_seeded": seeded,
+                "store_fetches": store_fetches,
+                "prefix_hit_rate": hit_rate,
+                "exact_vs_cold": warm_exact,
+            })
+
+            # ---- park / restore round-trip ---------------------------
+            h = client.submit(sess_prompt, max_new_tokens=12, seed=7)
+            t1b = list(client.stream_handle(
+                h, poll_s=0.002, timeout_s=120,
+            ))
+            tp = _time.monotonic()
+            park = client.park_session(h, wait_s=30.0)
+            park_s = _time.monotonic() - tp
+            # Let the router's refresh cycle fold the eviction +
+            # store-write rings into the directory, so turn 2 routes
+            # through the store instead of a stale replica claim.
+            _time.sleep(0.3)
+            turn2 = sess_prompt + t1b + sess_turn2_tail
+            tr = _time.monotonic()
+            first, t2_parked = None, []
+            for tok in client.stream(
+                turn2, max_new_tokens=12, seed=9, timeout_s=120,
+            ):
+                if first is None:
+                    first = _time.monotonic() - tr
+                t2_parked.append(tok)
+            park_exact = (t1b == t1) and (t2_parked == t2_base)
+            assert park_exact, (
+                "parked-and-restored stream diverged from the "
+                "uninterrupted conversation"
+            )
+            compiles = sum(
+                int(s.get("compiles_since_init", 0))
+                for s in client.stats()
+            )
+            rows.append({
+                "workload": "kvstore_park",
+                "park_s": round(park_s, 6),
+                "restore_ttft_s": round(first, 6),
+                "park_digests": len(park.get("digests") or ()),
+                "park_freed": int(park.get("freed", 0)),
+                "exact_vs_uninterrupted": park_exact,
+                "compiles_since_init": compiles,
+            })
+        finally:
+            client.shutdown()
+
+        return {
+            "kvstore_rows": rows,
+            "kvstore_bounce_store_fetches": store_fetches,
+            "kvstore_bounce_hit_rate": hit_rate,
+            "kvstore_warm_exact": warm_exact,
+            "kvstore_park_exact": park_exact,
+            "kvstore_cpu_control": True,
+        }
+
+    return _in_worker(run, False, timeout=1800.0)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=3)
@@ -2800,6 +3040,10 @@ def main() -> None:
             extra.update(bench_disagg(use_tpu))
         except Exception as exc:  # noqa: BLE001 - still emit a record
             extra["disagg_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_kvstore(use_tpu))
+        except Exception as exc:  # noqa: BLE001 - still emit a record
+            extra["kvstore_error"] = f"{type(exc).__name__}: {exc}"
         extra["bench_wall_s"] = round(time.time() - t0, 1)
         val = extra.get("serve_shared_prefix_ttft_speedup", 0.0)
         print(

@@ -408,6 +408,7 @@ _SERVE_KEYS = frozenset((
     "autoscale_min", "autoscale_max", "autoscale_interval_s",
     "prefill_replicas", "kvfleet", "kvfleet_timeout_s",
     "kvfleet_inflight_mb", "kvfleet_bandwidth_mbps",
+    "kvstore_dir", "kvstore_mb", "kvstore_writethrough",
 ))
 
 
@@ -709,6 +710,25 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         bytes; kvfleet_bandwidth_mbps caps transfer throughput
         (0 = uncapped). Traffic lands in
         rlt_serve_kvfleet_*_total{role=} and the fleet rows.
+      kvstore_dir: fleet-shared persistent KV store (tier of last
+        resort, content-addressed by the engines' chained page
+        digests): evictions falling off the bottom of a replica's
+        local tiers write through here instead of dying, a chain no
+        live peer holds fetches from here through the same
+        park->import->admit-warm path, a restarted fleet pre-seeds
+        its routing directory from the store manifest (yesterday's
+        system prompts hit on the first request), and park_session
+        exports an idle conversation here and frees its pages —
+        restored bit-exactly on the next turn, on any replica.
+        kvstore_mb bounds the store (LRU-by-last-access GC on
+        measured bytes; 0 = unbounded); kvstore_writethrough
+        additionally writes EVERY completed prefill through (pages
+        survive autoscale-retire, at extra write amplification).
+        Corrupt/vanished entries degrade to cold prefill, never a
+        crash. Traffic lands in rlt_serve_kvstore_*_total and the
+        fleet rows. NOTE: one store dir per single-host fleet —
+        multi-host gang processes would each hold only their own
+        shard subset.
       tracing: record request traces on the replicas (default on);
         trace_out: after serving, write the replicas' recent traces as
         Chrome trace-event JSON to this path (opens in Perfetto).
@@ -939,6 +959,30 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     kvfleet_bandwidth_mbps = float(
         serve_cfg.pop("kvfleet_bandwidth_mbps", 0.0)
     )
+    # Persistent KV store (fleet-shared tier of last resort):
+    # --serve.kvstore_dir mounts it, --serve.kvstore_mb bounds it (LRU
+    # GC; 0 = unbounded), --serve.kvstore_writethrough makes prefill
+    # replicas write every completed prefill through so pages survive
+    # autoscale-retire.
+    kvstore_dir = serve_cfg.pop("kvstore_dir", None)
+    kvstore_mb = float(serve_cfg.pop("kvstore_mb", 0.0))
+    if kvstore_mb < 0:
+        raise ValueError(
+            f"--serve.kvstore_mb {kvstore_mb} must be >= 0 (MiB budget; "
+            "0 = unbounded)"
+        )
+    kvstore_writethrough = bool(
+        serve_cfg.pop("kvstore_writethrough", False)
+    )
+    if kvstore_writethrough and kvstore_dir is None:
+        raise ValueError(
+            "--serve.kvstore_writethrough needs --serve.kvstore_dir "
+            "(the store to write through to)"
+        )
+    if kvstore_dir is not None:
+        replica_kwargs["kvstore_dir"] = str(kvstore_dir)
+        replica_kwargs["kvstore_mb"] = kvstore_mb
+        replica_kwargs["kvstore_writethrough"] = kvstore_writethrough
     pc = serve_cfg.pop("prefix_cache", "off")
     if isinstance(pc, str):
         pc_norm = pc.strip().lower()
@@ -1134,6 +1178,10 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             shed_queue_factor=shed_queue_factor,
         )
         client.router = router
+        # Warm-start: a fresh fleet inherits the persistent store's
+        # manifest as store-held directory routes, so yesterday's
+        # prefixes hit (via a store fetch) on the FIRST request.
+        client.seed_store_directory(router)
         if autoscale_max is not None:
             autoscaler = RouterAutoscaler(
                 client,
@@ -1438,8 +1486,8 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{'slots':>7} "
             f"{'tok/s':>9} {'ttft_p50':>9} {'ttft_p95':>9} "
             f"{'accept':>7} {'hit':>6} {'hit d/h/k':>14} "
-            f"{'pages f/r/a':>12} {'fetch/ship':>11} {'goodput':>9} "
-            f"{'weight':>7}"
+            f"{'pages f/r/a':>12} {'fetch/ship':>11} {'store h/m/w':>12} "
+            f"{'goodput':>9} {'weight':>7}"
         ),
     ]
     # Router weights keyed by replica (absent without a router).
@@ -1479,6 +1527,17 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             if kvf
             else None
         )
+        # Persistent object-store tier: hits/misses/writes — "-" when
+        # the replica runs without a store.
+        kvs = r.get("kvstore") or {}
+        kvs_cell = (
+            "{}/{}/{}".format(
+                kvs.get("hits", 0), kvs.get("misses", 0),
+                kvs.get("writes", 0),
+            )
+            if kvs
+            else None
+        )
         out.append(
             f"{_fmt_cell(r.get('replica'), 7)} "
             f"{_fmt_cell(r.get('health'), 9)} "
@@ -1495,6 +1554,7 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{_fmt_cell(tier_cell, 14)} "
             f"{_fmt_cell(page_cell, 12)} "
             f"{_fmt_cell(kvf_cell, 11)} "
+            f"{_fmt_cell(kvs_cell, 12)} "
             f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)} "
             f"{_fmt_cell(weights.get(r.get('replica')), 7, 2)}"
         )
@@ -1514,6 +1574,17 @@ def render_fleet(payload: Dict[str, Any]) -> str:
                 f"kvfleet: fetches={fleet.get('kvfleet_fetches', 0)} "
                 f"timeouts={fleet.get('kvfleet_fetch_timeouts', 0)} "
                 f"ships={fleet.get('kvfleet_ships', 0)}"
+            )
+        # Persistent store roll-up: only rendered once the store saw
+        # traffic (a storeless fleet stays clean).
+        if (fleet.get("kvstore_hits") or fleet.get("kvstore_misses")
+                or fleet.get("kvstore_writes")):
+            out.append(
+                f"kvstore: hits={fleet.get('kvstore_hits', 0)} "
+                f"misses={fleet.get('kvstore_misses', 0)} "
+                f"writes={fleet.get('kvstore_writes', 0)} "
+                f"write_errors={fleet.get('kvstore_write_errors', 0)} "
+                f"evictions={fleet.get('kvstore_evictions', 0)}"
             )
     # Recovery plane (when a FleetSupervisor is wired): one cell per
     # replica — state, lifetime restarts, pending attempts.

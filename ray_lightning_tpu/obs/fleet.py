@@ -65,6 +65,7 @@ def summarize_replica(
     # hold warm prefixes, the router's affinity tiebreaker.
     prefix_bytes = sum(int(r.get("bytes", 0)) for r in tiers.values())
     kvf = stats.get("kvfleet")
+    kvs = stats.get("kvstore")
     return {
         "replica": int(index),
         "health": str(verdict),
@@ -78,10 +79,29 @@ def summarize_replica(
                 for k in (
                     "fetches", "fetch_bytes", "fetch_timeouts",
                     "fetch_stale", "ships", "served_fetches",
-                    "pending_fetches",
+                    "pending_fetches", "store_fetches",
+                    "store_fetch_misses",
                 )
             }
             if isinstance(kvf, dict)
+            else None
+        ),
+        # Persistent object-store tier: counters for dashboards PLUS
+        # the recent_writes/recent_dropped rings verbatim — the router
+        # refresh loop reads those rings off this row to keep the
+        # directory's store-held half current, so they must survive
+        # summarization.
+        "kvstore": (
+            {
+                k: kvs.get(k)
+                for k in (
+                    "backend", "budget_mb", "hits", "misses", "writes",
+                    "write_errors", "bytes_written", "bytes_read",
+                    "evictions", "corrupt", "recent_writes",
+                    "recent_dropped",
+                )
+            }
+            if isinstance(kvs, dict)
             else None
         ),
         # Quality signals for the router/autoscaler: cumulative
@@ -136,6 +156,7 @@ def aggregate_fleet(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     dev = sum(r["cost_device_seconds"] for r in rows)
     p95s = [r["ttft_p95_s"] for r in rows if r.get("ttft_p95_s") is not None]
     kvf_rows = [r.get("kvfleet") or {} for r in rows]
+    kvs_rows = [r.get("kvstore") or {} for r in rows]
     return {
         "replicas": len(rows),
         "healthy": sum(1 for r in rows if r["health"] == "healthy"),
@@ -149,6 +170,24 @@ def aggregate_fleet(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             for k in kvf_rows
         ),
         "kvfleet_ships": sum(int(k.get("ships", 0)) for k in kvf_rows),
+        # Persistent store roll-up (zeros on storeless fleets). Note:
+        # replicas sharing one store dir each count their own traffic,
+        # so these are fleet I/O totals, not unique-entry counts.
+        "kvstore_hits": sum(
+            int(k.get("hits") or 0) for k in kvs_rows
+        ),
+        "kvstore_misses": sum(
+            int(k.get("misses") or 0) for k in kvs_rows
+        ),
+        "kvstore_writes": sum(
+            int(k.get("writes") or 0) for k in kvs_rows
+        ),
+        "kvstore_write_errors": sum(
+            int(k.get("write_errors") or 0) for k in kvs_rows
+        ),
+        "kvstore_evictions": sum(
+            int(k.get("evictions") or 0) for k in kvs_rows
+        ),
         "queue_depth": sum(r["queue_depth"] for r in rows),
         "active_slots": sum(r["active_slots"] for r in rows),
         "num_slots": sum(r["num_slots"] for r in rows),

@@ -80,6 +80,7 @@ def engine_header(
     priority_age_s: Optional[float] = None,
     router: Optional[Dict[str, Any]] = None,
     kvfleet: Optional[Dict[str, Any]] = None,
+    kvstore: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The config/checkpoint-identity header from a live engine: the
     RESOLVED knobs (buckets expanded, chunk coerced, mesh normalized),
@@ -126,6 +127,12 @@ def engine_header(
             "spec_draft_ckpt": spec_draft_ckpt,
             "spec_draft_config": spec_draft_config,
             "spec_draft_int8": bool(spec_draft_int8),
+            # Persistent-store knobs ride the ENGINE section (they are
+            # engine ctor params, _ENGINE_REBUILD_KEYS carries them into
+            # a replay's build_engine) — replaying against the recorded
+            # store dir reproduces recorded store hits.
+            "kvstore_dir": getattr(engine, "kvstore_dir", None),
+            "kvstore_mb": getattr(engine, "kvstore_mb", 0.0),
             "mesh": engine.mesh_desc,
         },
         "scheduler": {
@@ -147,6 +154,12 @@ def engine_header(
         # PR 12's migrations), so the replay stays bit-exact while the
         # section tells the operator what shaped the traffic.
         header["kvfleet"] = dict(kvfleet)
+    if kvstore is not None:
+        # Persistent-store provenance (serve.kvstore.KVSTORE_HEADER_KEYS):
+        # dir/budget/write-through policy — the fleet-shared tier that
+        # shaped this capture's hit pattern (`rlt replay` surfaces it as
+        # kvstore_config).
+        header["kvstore"] = dict(kvstore)
     header.update(checkpoint_identity(ckpt_path))
     return header
 
@@ -458,7 +471,8 @@ def incomplete_requests(journal: Dict[str, Any]) -> List[Dict[str, Any]]:
 _ENGINE_REBUILD_KEYS = frozenset((
     "num_slots", "max_seq", "prefill_buckets", "decode_fold", "pipeline",
     "prefill_chunk", "prefix_blocks", "prefix_block", "prefix_host_mb",
-    "prefix_disk_dir", "prefix_disk_mb", "kv_page", "kv_pages",
+    "prefix_disk_dir", "prefix_disk_mb", "kvstore_dir", "kvstore_mb",
+    "kv_page", "kv_pages",
     "spec", "spec_depth",
     "spec_window", "spec_draft_ckpt", "spec_draft_config",
     "spec_draft_int8", "mesh",
@@ -774,6 +788,12 @@ def replay_journal(
         )
 
         result["kvfleet_config"] = kvfleet_config_from_header(header)
+    if header and header.get("kvstore"):
+        from ray_lightning_tpu.serve.kvstore import (
+            kvstore_config_from_header,
+        )
+
+        result["kvstore_config"] = kvstore_config_from_header(header)
     if timing == "wall":
         snap = scheduler.metrics.snapshot()
         rep_tokens = sum(len(v) for v in replayed.values())

@@ -58,6 +58,16 @@ SPAN_EXPIRE = "expire"
 #: Fleet KV plane: the request parked transfer-pending while its warm
 #: pages fetch from a peer (attrs: peer, blocks).
 SPAN_KV_FETCH = "kv_fetch"
+#: Persistent KV store: the request parked while its chain fetches from
+#: the object store (no live peer held it; attrs: blocks).
+SPAN_KVSTORE_FETCH = "kvstore_fetch"
+#: Persistent KV store: a parked/stored chain imported back into this
+#: replica's pool — the request admits warm on its next queue pass.
+SPAN_KV_RESTORE = "kv_restore"
+#: Session parking: an idle conversation's chain exported to the
+#: persistent store and its device pages freed (attrs: blocks, stored,
+#: freed).
+SPAN_KV_PARK = "kv_park"
 #: Disaggregated prefill: this engine finished the prefill and shipped
 #: the KV pages to a decode replica (attrs: target, blocks) — terminal
 #: HERE, the stream continues on the target.

@@ -142,6 +142,7 @@ class ServeClient:
         hedge_after_s: Optional[float] = None,
         roles: Optional[Sequence[str]] = None,
         kv_queues: Optional[Dict[int, Any]] = None,
+        kvstore: Optional[Any] = None,
     ) -> None:
         from ray_lightning_tpu.obs.events import get_event_log
         from ray_lightning_tpu.obs.journal import WorkloadJournal
@@ -275,6 +276,12 @@ class ServeClient:
         #: spawn closure: add_replica broadcasts a new member's inbox
         #: to the live fleet through register_kv_peer.
         self._kv_queues: Dict[int, Any] = dict(kv_queues or {})
+        #: Driver-side handle on the persistent KV store
+        #: (serve.kvstore.FleetKVStore over the same dir the replicas
+        #: use): preemption drains write migrating chains through it,
+        #: and start_replicas seeds the router directory from its
+        #: manifest (warm-start). None = no persistent tier.
+        self.kvstore = kvstore
 
     # -- internals --------------------------------------------------------
     def _event(self, name: str, level: str = "info", **kv: Any) -> None:
@@ -737,6 +744,86 @@ class ServeClient:
         ok = bool(self._rpc(idx, "cancel", handle.request_id))
         self._finish(handle.request_id, "cancelled")
         return ok
+
+    # -- session parking (persistent KV store) -----------------------------
+    def park_session(
+        self,
+        handle: RequestHandle,
+        tokens: Optional[Sequence[int]] = None,
+        wait_s: float = 15.0,
+    ) -> Dict[str, Any]:
+        """Park a finished conversation: export its cached KV chain to
+        the persistent store and free the replica's pages. ``tokens``
+        is the conversation's full token sequence (prompt + generated);
+        when omitted it is reconstructed from this client's journal
+        (the submit prompt) plus the replica's result buffer. The next
+        submit sharing the prefix restores bit-exactly through the
+        store-fetch path — on ANY replica, including one spawned after
+        a full fleet bounce."""
+        rid = handle.request_id
+        idx = self._route_of(handle)
+        if idx is None:
+            raise ReplicaLostError(
+                handle.replica, f"request {rid} was lost"
+            )
+        if tokens is None:
+            prompt: Optional[List[int]] = None
+            for entry in self.journal.dump().get("entries", []):
+                if (
+                    entry.get("kind") == "submit"
+                    and entry.get("request_id") == rid
+                ):
+                    prompt = list(entry.get("prompt") or [])
+            if prompt is None:
+                raise KeyError(
+                    f"request {rid} has no journal submit record; pass "
+                    "tokens= explicitly"
+                )
+            res = self._rpc(idx, "result", rid, 0)
+            tokens = prompt + [int(t) for t in res.get("tokens") or []]
+        out = self._rpc(
+            idx, "park_session",
+            [int(t) for t in tokens], request_id=rid, wait_s=wait_s,
+        )
+        digests = out.get("digests") or []
+        if digests and self.router is not None:
+            try:
+                # Open the store-held route NOW (the stats-ring feed
+                # would catch up on the next refresh; the very next
+                # submit should already hit).
+                self.router.directory.observe_store(
+                    [bytes.fromhex(h) for h in digests]
+                )
+            except Exception:  # noqa: BLE001 - routing hints only
+                pass
+        self._event(
+            "session_parked", request_id=rid, replica=idx,
+            blocks=int(out.get("blocks") or 0),
+            stored=int(out.get("stored") or 0),
+            freed=int(out.get("freed") or 0),
+        )
+        return out
+
+    def seed_store_directory(self, router: Optional[Any] = None) -> int:
+        """Warm-start: pre-seed the router directory's store-held half
+        from the persistent store's manifest, so a freshly started
+        fleet routes yesterday's prefixes to a store fetch on the FIRST
+        request instead of rediscovering them one cold miss at a time.
+        Call after attaching a router (the CLI does). Returns digests
+        seeded; 0 with no store or no router."""
+        router = router if router is not None else self.router
+        if self.kvstore is None or router is None:
+            return 0
+        try:
+            hexes = self.kvstore.manifest()
+            router.directory.observe_store(
+                [bytes.fromhex(h) for h in hexes]
+            )
+        except Exception:  # noqa: BLE001 - warm-start is advisory
+            return 0
+        if hexes:
+            self._event("kvstore_warm_seed", digests=len(hexes))
+        return len(hexes)
 
     # -- failover ----------------------------------------------------------
     def _follow_ship(
@@ -1211,6 +1298,15 @@ class ServeClient:
                 continue
             blocks = item.get("blocks") or []
             kv_blocks += len(blocks)
+            if blocks and self.kvstore is not None:
+                # Fleet persistence: the migrating chain outlives BOTH
+                # replicas once it is in the store. A failed put counts
+                # in kvstore_write_errors_total and the drain proceeds
+                # — lost loudly, never silently, never blocking.
+                try:
+                    self.kvstore.put_blocks(blocks)
+                except Exception:  # noqa: BLE001 - best-effort tier
+                    pass
             if self._resubmit_from_journal(rid, exclude=idx, blocks=blocks):
                 moved.append(rid)
             else:
@@ -1870,6 +1966,17 @@ def start_replicas(
             except Exception:  # noqa: BLE001
                 pass
         raise
+    # Driver-side handle on the persistent KV store (same dir the
+    # replicas mount): preemption-drain write-through + the warm-start
+    # manifest the router directory seeds from (seed_store_directory).
+    kvstore = None
+    if replica_kwargs.get("kvstore_dir"):
+        from ray_lightning_tpu.serve.kvstore import FleetKVStore
+
+        kvstore = FleetKVStore(
+            str(replica_kwargs["kvstore_dir"]),
+            budget_mb=float(replica_kwargs.get("kvstore_mb", 0.0)),
+        )
     return ServeClient(
         replicas,
         pg=pg,
@@ -1882,4 +1989,5 @@ def start_replicas(
         hedge_after_s=hedge_after_s,
         roles=roles_list,
         kv_queues=kv_queues,
+        kvstore=kvstore,
     )

@@ -263,6 +263,8 @@ class DecodeEngine:
         prefix_host_mb: float = 0.0,
         prefix_disk_dir: Optional[str] = None,
         prefix_disk_mb: float = 0.0,
+        kvstore_dir: Optional[str] = None,
+        kvstore_mb: float = 0.0,
         kv_page: int = 0,
         kv_pages: int = 0,
         spec: str = "off",
@@ -395,6 +397,26 @@ class DecodeEngine:
             raise ValueError(
                 "prefix tiers (prefix_host_mb / prefix_disk_dir) need a "
                 "device prefix pool (prefix_blocks > 0) to spill from"
+            )
+        # Persistent object-store tier (tier of last resort, fleet
+        # shared): evictions that would otherwise die at the bottom of
+        # the local tier walk write through here instead, and the fleet
+        # plane fetches from it when no live peer holds a chain. Unlike
+        # the disk tier the store is NOT adopted into this engine's own
+        # maps at startup (gang op-stream determinism — see
+        # _disk_prune_stale); warm content re-enters only through the
+        # directory + fetch path.
+        self.kvstore_dir = str(kvstore_dir) if kvstore_dir else None
+        self.kvstore_mb = float(kvstore_mb)
+        self.kvstore: Any = None
+        if self.kvstore_dir:
+            from ray_lightning_tpu.obs.registry import get_registry
+            from ray_lightning_tpu.serve.kvstore import FleetKVStore
+
+            self.kvstore = FleetKVStore(
+                self.kvstore_dir,
+                budget_mb=self.kvstore_mb,
+                registry=get_registry(),
             )
         # Mesh-native serving (tensor-parallel decode): with a mesh
         # bound, every per-slot device tensor becomes a mesh-sharded
@@ -2301,6 +2323,7 @@ class DecodeEngine:
                 self._disk_insert(digest, kp, vp)
             else:
                 self.tier_counters["host"]["evictions"] += 1
+                self._store_sink(digest, kp, vp)
                 self._note_dropped(digest)
             return
         while self._host_map and (
@@ -2312,6 +2335,7 @@ class DecodeEngine:
                 self._disk_insert(old_d, ok, ov)
             else:
                 self.tier_counters["host"]["evictions"] += 1
+                self._store_sink(old_d, ok, ov)
                 self._note_dropped(old_d)
         self._host_map[digest] = (kp, vp)
 
@@ -2376,19 +2400,27 @@ class DecodeEngine:
                 os.replace(tmp, path)
                 size += os.path.getsize(path)
         except OSError:
-            # Best-effort tier: a full/failing disk drops the block.
+            # Best-effort tier: a full/failing disk drops the block
+            # (after a write-through attempt to the persistent store).
             for path in paths:
                 try:
                     os.remove(path)
                 except OSError:
                     pass
             self.tier_counters["disk"]["evictions"] += 1
+            self._store_sink(digest, kp, vp)
             self._note_dropped(digest)
             return
         while self._disk_map and (
             self._disk_bytes + size > self._disk_budget
         ):
             oldest = next(iter(self._disk_map))
+            if self.kvstore is not None:
+                # Read the victim back before its files go: this is
+                # the bottom of the local tier walk, the ONLY copy.
+                payload = self._disk_load(oldest)
+                if payload is not None:
+                    self._store_sink(oldest, payload[0], payload[1])
             self._disk_drop(oldest)
             self.tier_counters["disk"]["evictions"] += 1
             self._note_dropped(oldest)
@@ -2401,6 +2433,7 @@ class DecodeEngine:
                 except OSError:
                     pass
             self.tier_counters["disk"]["evictions"] += 1
+            self._store_sink(digest, kp, vp)
             self._note_dropped(digest)
             return
         self._disk_map[digest] = size
@@ -2490,6 +2523,15 @@ class DecodeEngine:
         self.refill_s += time.monotonic() - t0
         return idx
 
+    def _store_sink(self, digest: bytes, kp: Any, vp: Any) -> None:
+        """Tier of last resort: a block falling off the bottom of the
+        local tier walk writes through to the persistent store (when
+        configured) instead of dying. A failed put counts in the
+        store's ``write_errors`` and the drop proceeds regardless —
+        pages are lost loudly, never silently."""
+        if self.kvstore is not None:
+            self.kvstore.put_block(digest.hex(), kp, vp)
+
     # -- cross-replica KV handoff (preempt drain + fleet KV plane) --------
     def _note_dropped(self, digest: bytes) -> None:
         """A digest left EVERY tier (nowhere to spill / disk pruned /
@@ -2503,6 +2545,49 @@ class DecodeEngine:
         from — idempotent by construction, so multiple consumers can
         read the same ring."""
         return list(self._dropped_ring)
+
+    def evict_prefix_chain(self, digests_hex: Sequence[str]) -> int:
+        """Free a parked chain's blocks from EVERY local tier — the
+        session-parking back half (the caller persisted the chain to
+        the object store first; this reclaims the pages). Pool pages
+        free only when unreferenced (a resident request's pins win —
+        same safe-to-free invariant as _pool_alloc's eviction scan);
+        freed digests go through the dropped ring so the fleet
+        directory forgets this replica's now-stale route, while the
+        store's write feed keeps the store-held route alive. Returns
+        the number of blocks freed across all tiers."""
+        freed = 0
+        for hexd in digests_hex:
+            try:
+                digest = bytes.fromhex(hexd)
+            except (ValueError, TypeError):
+                continue
+            dropped = False
+            idx = (
+                self._pool_map.get(digest)
+                if self.prefix_blocks else None
+            )
+            if idx is not None:
+                meta = self._pool_meta[idx]
+                if meta is not None and meta.refs == 0:
+                    del self._pool_map[digest]
+                    self._pool_meta[idx] = None
+                    self._pool_free.append(idx)
+                    self.page_frees += 1
+                    self.prefix_evictions += 1
+                    self.tier_counters["device"]["evictions"] += 1
+                    dropped = True
+            if self._host_map.pop(digest, None) is not None:
+                self.tier_counters["host"]["evictions"] += 1
+                dropped = True
+            if digest in self._disk_map:
+                self._disk_drop(digest)
+                self.tier_counters["disk"]["evictions"] += 1
+                dropped = True
+            if dropped:
+                self._note_dropped(digest)
+                freed += 1
+        return freed
 
     @property
     def prefix_block_nbytes(self) -> int:

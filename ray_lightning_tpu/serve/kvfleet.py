@@ -128,6 +128,16 @@ class FleetKVDirectory:
     (:meth:`forget_digests` — fed from the engines' dropped-digest
     stats rows by the router's refresh, and from explicit fetch-miss
     responses). Thread-safe; pure host-side dict work.
+
+    Entries split in two by what holds the pages: REPLICA-HELD (digest
+    -> replica index — dies with the replica, pruned by
+    :meth:`forget_replica` / :meth:`forget_digests`) and STORE-HELD
+    (digest present in the persistent object store — outlives every
+    replica, so a full fleet bounce keeps the route; pruned only by the
+    store's own eviction/corruption reports through
+    :meth:`forget_store_digests`). PR 15's single map conflated the
+    two, so retiring the last holder also erased chains the store still
+    served.
     """
 
     def __init__(self, capacity: int = 65536) -> None:
@@ -135,6 +145,10 @@ class FleetKVDirectory:
         self._lock = threading.Lock()
         #: digest -> replica index (bounded LRU, newest at the end).
         self._map: "OrderedDict[bytes, int]" = OrderedDict()
+        #: store-held digests (bounded LRU set, newest at the end) —
+        #: deliberately a SEPARATE structure so replica invalidation
+        #: can never touch it.
+        self._store: "OrderedDict[bytes, None]" = OrderedDict()
 
     def __len__(self) -> int:
         with self._lock:
@@ -207,6 +221,52 @@ class FleetKVDirectory:
                 n += 1
         return n
 
+    # -- the store-held half ----------------------------------------------
+    def observe_store(self, digests: Sequence[bytes]) -> None:
+        """The chain is in the persistent store now (a write-through, a
+        park, or the warm-start manifest seed) — remember a route that
+        survives every replica."""
+        if not digests:
+            return
+        with self._lock:
+            for d in digests:
+                self._store[d] = None
+                self._store.move_to_end(d)
+            while len(self._store) > self.capacity:
+                self._store.popitem(last=False)
+
+    def store_holds(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest in self._store
+
+    def store_chain(self, digests: Sequence[bytes]) -> int:
+        """Longest unbroken LEADING run the store holds — the fetch
+        hint of last resort when :meth:`chain` finds no live replica."""
+        run = 0
+        with self._lock:
+            for d in digests:
+                if d not in self._store:
+                    break
+                run += 1
+        return run
+
+    def forget_store_digests(self, digests: Iterable[bytes]) -> int:
+        """The store EVICTED these (budget GC or corruption, reported
+        through its dropped ring): the persistent route is gone.
+        Idempotent, like :meth:`forget_digests`. The ONLY path that
+        prunes store-held entries — ``forget_replica`` never does."""
+        n = 0
+        with self._lock:
+            for d in digests:
+                if d in self._store:
+                    del self._store[d]
+                    n += 1
+        return n
+
+    def store_entries(self) -> int:
+        with self._lock:
+            return len(self._store)
+
 
 class KVFleetPlane:
     """Replica-side half of the fleet KV plane: one inbox queue this
@@ -240,6 +300,7 @@ class KVFleetPlane:
         min_poll_s: float = 0.005,
         registry: Optional[Any] = None,
         events: Optional[Any] = None,
+        store: Optional[Any] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if role not in ROLES:
@@ -249,6 +310,10 @@ class KVFleetPlane:
         self.index = int(index)
         self.role = str(role)
         self.inbox = inbox
+        #: Optional :class:`~ray_lightning_tpu.serve.kvstore.
+        #: FleetKVStore` — the tier of last resort a store-kind fetch
+        #: reads on the loop thread when no live peer holds the chain.
+        self.store = store
         self.peers: Dict[int, Any] = dict(peers or {})
         self.block_bytes = max(0, int(block_bytes))
         self.timeout_s = float(timeout_s)
@@ -281,6 +346,13 @@ class KVFleetPlane:
         self.ship_bytes = 0
         self.served_fetches = 0
         self.imports = 0
+        # Persistent-store fetch accounting (store hits/misses/bytes
+        # live on the FleetKVStore itself; these count the PLANE's use
+        # of it as a fetch source).
+        self.store_fetches = 0
+        self.store_fetch_blocks = 0
+        self.store_fetch_bytes = 0
+        self.store_fetch_misses = 0
         self._m = None
         if registry is not None:
             self._m = {
@@ -413,6 +485,54 @@ class KVFleetPlane:
         )
         return True
 
+    def request_store_fetch(
+        self, request_id: str, digests_hex: Sequence[str]
+    ) -> bool:
+        """Park a request on a PERSISTENT-STORE fetch: no live peer
+        holds the chain, but the object store does (per the directory's
+        store-held half). Same budgets and same park -> import ->
+        admit-warm contract as :meth:`request_fetch`; the read itself
+        runs inside :meth:`service` on the loop thread (the import is a
+        compiled pool write). False = cold prefill, never a queue."""
+        digests_hex = list(digests_hex)
+        if not digests_hex or self.store is None:
+            return False
+        est = 2 * self.block_bytes * len(digests_hex)
+        now = self._clock()
+        with self._lock:
+            if request_id in self._pending:
+                return False
+            inflight = sum(
+                p["est_bytes"] for p in self._pending.values()
+            )
+            if (
+                self.max_inflight_bytes
+                and inflight + est > self.max_inflight_bytes
+            ):
+                self.fetch_refused += 1
+                return False
+            if (
+                self.bandwidth_bytes_per_s
+                and self._window_rate(now) > self.bandwidth_bytes_per_s
+            ):
+                self.fetch_refused += 1
+                return False
+            self._pending[request_id] = {
+                "peer": None,
+                "store": True,
+                "digests": digests_hex,
+                "deadline": now + self.timeout_s,
+                "est_bytes": est,
+            }
+            self.store_fetches += 1
+        if self._m is not None:
+            self._m["fetches"].inc(1, role=self.role)
+        self._event(
+            "kvstore_fetch", request_id=request_id,
+            blocks=len(digests_hex),
+        )
+        return True
+
     def ship(
         self, target: int, request_id: str, blocks: Sequence[Any]
     ) -> bool:
@@ -459,17 +579,71 @@ class KVFleetPlane:
         - pending fetches past their deadline expire.
 
         Returns ``{"fetched": [(request_id, blocks_imported)],
-        "failed": [(request_id, reason)]}`` for the scheduler to
-        re-queue its parked requests (warm or cold respectively).
+        "failed": [(request_id, reason)], "store_fetched":
+        [request_id]}`` for the scheduler to re-queue its parked
+        requests (warm or cold respectively); ``store_fetched`` lists
+        the subset of ``fetched`` satisfied by the persistent store
+        rather than a live peer.
         """
         fetched: List[Tuple[str, int]] = []
         failed: List[Tuple[str, str]] = []
+        store_fetched: List[str] = []
         now = self._clock()
         with self._lock:
             have_pending = bool(self._pending)
         if not have_pending and now - self._last_drain < self.min_poll_s:
-            return {"fetched": fetched, "failed": failed}
+            return {
+                "fetched": fetched, "failed": failed,
+                "store_fetched": store_fetched,
+            }
         self._last_drain = now
+        # Store-kind pendings resolve synchronously here (the read is
+        # local I/O; the import is a compiled pool write that must run
+        # on this thread) — before the deadline sweep can expire them.
+        # A vanished/corrupt store entry is an explicit miss -> cold
+        # prefill, never a lost request.
+        with self._lock:
+            store_rids = [
+                rid for rid, p in self._pending.items() if p.get("store")
+            ]
+        for rid in store_rids:
+            with self._lock:
+                pend = self._pending.pop(rid, None)
+            if pend is None:
+                continue
+            try:
+                blocks, missing = self.store.get_chain(pend["digests"])
+            except Exception:  # noqa: BLE001 - a vanished store dir
+                blocks, missing = [], list(pend["digests"])  # = miss
+            if not blocks:
+                with self._lock:
+                    self.store_fetch_misses += 1
+                if self._m is not None:
+                    self._m["fetch_timeouts"].inc(1, role=self.role)
+                self._event(
+                    "kvstore_fetch_miss", level="warn", request_id=rid,
+                    missing=len(missing),
+                )
+                failed.append((rid, "store_miss"))
+                continue
+            n = 0
+            if import_fn is not None:
+                n = int(import_fn(blocks))
+            nbytes = blocks_nbytes(blocks)
+            with self._lock:
+                self.store_fetch_blocks += len(blocks)
+                self.store_fetch_bytes += nbytes
+                self.imports += n
+                self._charge(nbytes, now)
+            if self._m is not None:
+                self._m["fetch_bytes"].inc(nbytes, role=self.role)
+            self._event(
+                "kvstore_fetch_done", request_id=rid,
+                blocks=len(blocks), missing=len(missing),
+                nbytes=nbytes,
+            )
+            fetched.append((rid, n))
+            store_fetched.append(rid)
         while True:
             try:
                 item = self.inbox.get_nowait()
@@ -560,7 +734,10 @@ class KVFleetPlane:
                 "kvfleet_fetch_timeout", level="warn", request_id=rid,
             )
             failed.append((rid, "timeout"))
-        return {"fetched": fetched, "failed": failed}
+        return {
+            "fetched": fetched, "failed": failed,
+            "store_fetched": store_fetched,
+        }
 
     # -- read side ---------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
@@ -581,6 +758,10 @@ class KVFleetPlane:
                 "ship_blocks": self.ship_blocks,
                 "ship_bytes": self.ship_bytes,
                 "imports": self.imports,
+                "store_fetches": self.store_fetches,
+                "store_fetch_blocks": self.store_fetch_blocks,
+                "store_fetch_bytes": self.store_fetch_bytes,
+                "store_fetch_misses": self.store_fetch_misses,
                 "pending_fetches": len(self._pending),
                 "timeout_s": self.timeout_s,
                 "max_inflight_mb": round(

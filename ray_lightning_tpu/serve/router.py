@@ -426,6 +426,28 @@ class Router:
                     )
                 except (TypeError, ValueError):
                     pass  # malformed report; advisory only
+            # Persistent-store feeds: recent write-throughs open
+            # store-held routes (a chain that died locally is still
+            # fetchable from the store), recent GC drops close them.
+            # Both rings are idempotent to re-read, like kv_dropped.
+            kvs = row.get("kvstore") or {}
+            if isinstance(kvs, dict):
+                written = kvs.get("recent_writes") or []
+                if written:
+                    try:
+                        self.directory.observe_store(
+                            [bytes.fromhex(h) for h in written]
+                        )
+                    except (TypeError, ValueError):
+                        pass
+                gone = kvs.get("recent_dropped") or []
+                if gone:
+                    try:
+                        self.directory.forget_store_digests(
+                            bytes.fromhex(h) for h in gone
+                        )
+                    except (TypeError, ValueError):
+                        pass
         with self._lock:
             self._views = views
             prev = self._routable_prev
@@ -665,26 +687,42 @@ class Router:
         a DIFFERENT live replica holds the prompt's digest chain, the
         target can fetch the pages instead of re-prefilling cold — the
         cross-replica sharing that fires exactly when load/health/role
-        steered the request AWAY from its warm replica."""
+        steered the request AWAY from its warm replica. With no usable
+        live holder, the directory's store-held half gets the last
+        word: a ``store: True`` hint sends the target to the
+        persistent object store (warm-start after a fleet bounce,
+        parked-session restore)."""
         if not digests:
             return None
         holder, run = self.directory.chain(digests)
-        if holder is None or not run or holder == idx:
-            return None
-        view = views.get(holder)
-        if view is None:
-            if holder not in set(cand):
-                return None  # unknown AND unroutable: assume gone
-        elif (
-            view.get("state") in self._HOLDER_GONE_STATES
-            or view.get("health") in ("unreachable", "retired")
-        ):
-            return None  # its pages died with it; nothing to fetch
-        return {
-            "peer": int(holder),
-            "digests": [d.hex() for d in digests[:run]],
-            "blocks": int(run),
-        }
+        if holder == idx and run:
+            return None  # routed to the warm replica: local hit
+        usable = holder is not None and run
+        if usable:
+            view = views.get(holder)
+            if view is None:
+                if holder not in set(cand):
+                    usable = False  # unknown AND unroutable: gone
+            elif (
+                view.get("state") in self._HOLDER_GONE_STATES
+                or view.get("health") in ("unreachable", "retired")
+            ):
+                usable = False  # its pages died with it
+        if usable:
+            return {
+                "peer": int(holder),
+                "digests": [d.hex() for d in digests[:run]],
+                "blocks": int(run),
+            }
+        srun = self.directory.store_chain(digests)
+        if srun:
+            return {
+                "peer": None,
+                "store": True,
+                "digests": [d.hex() for d in digests[:srun]],
+                "blocks": int(srun),
+            }
+        return None
 
     def _useful_blocks(self, prompt: Sequence[int]) -> int:
         """Full prompt blocks a warm admission can actually consume —

@@ -142,6 +142,8 @@ class Scheduler:
         faults: Optional[Any] = None,
         kvfleet: Optional[Any] = None,
         role: str = "mixed",
+        kvstore: Optional[Any] = None,
+        kvstore_writethrough: bool = False,
     ) -> None:
         self.engine = engine
         #: Fleet KV plane (serve.kvfleet.KVFleetPlane): cross-replica
@@ -151,6 +153,13 @@ class Scheduler:
         #: its request's ``ship_to`` decode replica instead of decoding.
         self.kvfleet = kvfleet
         self.role = str(role)
+        #: Persistent KV store (serve.kvstore.FleetKVStore): the tier
+        #: of last resort. With ``kvstore_writethrough`` on, every
+        #: completed prefill's exported pages write through (so they
+        #: survive autoscale-retire and full fleet bounces); session
+        #: parking exports land here too. None = no persistent tier.
+        self.kvstore = kvstore
+        self.kvstore_writethrough = bool(kvstore_writethrough)
         #: Deterministic fault injection (serve.faults.FaultInjector):
         #: step() reports named lifecycle points so a chaos plan can
         #: kill/delay this process at a FIXED logical step instead of a
@@ -247,6 +256,14 @@ class Scheduler:
         #: admit) or fails (cold prefill), so parking never reorders
         #: the queue around them.
         self._transfer_pending: Dict[str, Any] = {}
+        #: Session parking: a pending ``request_park`` (the idle
+        #: conversation's full token stream) the next step() consumes —
+        #: engine exports/evictions must run on the loop thread, so the
+        #: RPC surface arms the park and waits on the condition, exactly
+        #: like the preemption drain above.
+        self._park_req: Optional[Any] = None
+        self._park_result: Optional[Dict[str, Any]] = None
+        self._park_cv = threading.Condition()
 
     # -- cost ledger ------------------------------------------------------
     def _acct_open(self, req: Request) -> None:
@@ -429,6 +446,7 @@ class Scheduler:
                 bool(self._pending)
                 or self.engine.num_active > 0
                 or self._drain_req is not None
+                or self._park_req is not None
                 or bool(self._pending_imports)
                 or bool(self._transfer_pending)
             ):
@@ -456,6 +474,70 @@ class Scheduler:
             plan, self._drain_result = self._drain_result, None
             return plan
 
+    # -- session parking (thread-safe arm/wait; work runs in step()) ------
+    def request_park(
+        self, tokens: Sequence[int], request_id: Optional[str] = None
+    ) -> None:
+        """Arm a session park: the next step() exports the idle
+        conversation's cached chain (loop thread — compiled pool
+        reads), writes it through to the persistent store, and frees
+        the local pages ONLY if every block landed (a partial write
+        keeps the warm copies; pages are lost loudly, never silently).
+        The restored turn hits the chain back through the ordinary
+        store-fetch path, bit-exactly."""
+        with self._lock:
+            self._park_req = ([int(t) for t in tokens], request_id)
+
+    def park_result(
+        self, timeout: Optional[float] = 10.0
+    ) -> Optional[Dict[str, Any]]:
+        """Block until the armed park's record is ready (None on
+        timeout); consumes the record."""
+        with self._park_cv:
+            if self._park_result is None:
+                self._park_cv.wait(timeout)
+            out, self._park_result = self._park_result, None
+            return out
+
+    def _apply_park(self) -> None:
+        """Consume a pending park request (inside step(), loop
+        thread): export -> store write-through -> local eviction."""
+        with self._lock:
+            req, self._park_req = self._park_req, None
+        if req is None:
+            return
+        tokens, rid = req
+        blocks: List[Any] = []
+        stored = freed = 0
+        if getattr(self.engine, "prefix_blocks", 0):
+            blocks = self.engine.export_prefix_blocks(tokens)
+        if blocks and self.kvstore is not None:
+            stored = self.kvstore.put_blocks(blocks)
+            if stored == len(blocks):
+                evict = getattr(self.engine, "evict_prefix_chain", None)
+                if evict is not None:
+                    freed = evict([b[0] for b in blocks])
+        result = {
+            "digests": [b[0] for b in blocks],
+            "blocks": len(blocks),
+            "stored": stored,
+            "freed": freed,
+        }
+        if rid is not None:
+            self._trace(
+                rid, _trace.SPAN_KV_PARK,
+                blocks=len(blocks), stored=stored, freed=freed,
+            )
+        self._event(
+            "kv_park",
+            level="info" if stored == len(blocks) else "warn",
+            request_id=rid, blocks=len(blocks), stored=stored,
+            freed=freed,
+        )
+        with self._park_cv:
+            self._park_result = result
+            self._park_cv.notify_all()
+
     def enqueue_prefix_import(self, blocks: Any) -> int:
         """Queue a dying peer's exported prefix blocks for import at the
         top of the next step() (engine mutations stay on the loop
@@ -477,6 +559,7 @@ class Scheduler:
             import_fn=self.engine.import_prefix_blocks,
         )
         resumed: List[Any] = []
+        store_rids = set(svc.get("store_fetched") or ())
         with self._lock:
             for rid, _n in svc["fetched"]:
                 entry = self._transfer_pending.pop(rid, None)
@@ -486,6 +569,9 @@ class Scheduler:
                     # its admission walk now hits warm.
                     heapq.heappush(self._pending, entry)
                     resumed.append((rid, "warm"))
+        for rid in store_rids:
+            self._trace(rid, _trace.SPAN_KV_RESTORE)
+        with self._lock:
             for rid, reason in svc["failed"]:
                 entry = self._transfer_pending.pop(rid, None)
                 if entry is not None:
@@ -615,6 +701,8 @@ class Scheduler:
             self._service_kvfleet()
         if self._drain_req is not None:
             self._apply_drain(events)
+        if self._park_req is not None:
+            self._apply_park()
         to_evict: List[Any] = []
         admits: List[Request] = []
         #: (priority, seq, Request, peer, digests): candidates popped
@@ -711,7 +799,9 @@ class Scheduler:
                     continue
                 if self.kvfleet is not None and req.kv_hint is not None:
                     # Cross-replica prefix sharing: the router said a
-                    # peer holds this prompt's chain. One attempt per
+                    # peer holds this prompt's chain — or, with
+                    # ``store: True``, that no live replica does but
+                    # the persistent store does. One attempt per
                     # request (the hint is consumed here); only worth a
                     # fetch when the LOCAL tiers hold strictly less
                     # than the hint promises — the probe is a pure
@@ -719,20 +809,23 @@ class Scheduler:
                     hint, req.kv_hint = req.kv_hint, None
                     digests = list(hint.get("digests") or [])
                     peer = hint.get("peer")
+                    from_store = bool(hint.get("store"))
                     probe = getattr(
                         self.engine, "cached_prefix_blocks", None
                     )
                     if (
                         digests
-                        and peer is not None
+                        and (peer is not None or from_store)
                         and probe is not None
                         and getattr(self.engine, "prefix_blocks", 0)
                         and probe(req.prompt) < len(digests)
                     ):
                         heapq.heappop(self._pending)
-                        to_fetch.append(
-                            (prio, seqno, req, int(peer), digests)
-                        )
+                        to_fetch.append((
+                            prio, seqno, req,
+                            None if from_store else int(peer),
+                            digests,
+                        ))
                         continue
                 if paged:
                     need = self.engine.pages_for(
@@ -757,19 +850,30 @@ class Scheduler:
             # The fetch RPC (a queue put, possibly cross-process) runs
             # here; a refused fetch (budget, unknown peer, bandwidth
             # cap) re-queues for cold prefill NEXT step — bounded
-            # in-flight bytes never turn into a queue.
-            if self.kvfleet.request_fetch(req.request_id, peer, digests):
+            # in-flight bytes never turn into a queue. ``peer is None``
+            # means the hint pointed at the persistent store, not a
+            # live replica; same park→import→admit-warm path, different
+            # resolver.
+            ok = (
+                self.kvfleet.request_store_fetch(req.request_id, digests)
+                if peer is None
+                else self.kvfleet.request_fetch(req.request_id, peer, digests)
+            )
+            if ok:
                 with self._lock:
                     self._transfer_pending[req.request_id] = (
                         prio, seqno, req,
                     )
                 self._trace(
-                    req.request_id, _trace.SPAN_KV_FETCH,
+                    req.request_id,
+                    _trace.SPAN_KVSTORE_FETCH if peer is None
+                    else _trace.SPAN_KV_FETCH,
                     peer=peer, blocks=len(digests),
                 )
                 self._event(
                     "kv_transfer_park", request_id=req.request_id,
                     peer=peer, blocks=len(digests),
+                    store=peer is None,
                 )
             else:
                 with self._lock:
@@ -952,6 +1056,23 @@ class Scheduler:
                 newly.pop(slot, None)
                 finished_slots.append(slot)
                 finished_rids.append(task.request_id)
+        if (
+            self.kvstore_writethrough
+            and self.kvstore is not None
+            and getattr(self.engine, "prefix_blocks", 0)
+        ):
+            # Write-through: every completed prefill's chain goes to
+            # the persistent store so the pages survive this replica's
+            # retirement (the prefill pool is the autoscaler's favorite
+            # victim). Shipped slots reuse the export below; put errors
+            # count loudly in kvstore_write_errors_total, never raise.
+            shipped_slots = {s for s, _t, _r in to_ship}
+            for slot, task, _tok, _done in chunk_events:
+                if slot in shipped_slots:
+                    continue
+                wt = self.engine.export_prefix_blocks(task.tokens)
+                if wt:
+                    self.kvstore.put_blocks(wt)
         for slot, task, req in to_ship:
             # Release FIRST (the fold below must not decode a shipped
             # slot; the finished prompt's blocks already entered the
@@ -966,6 +1087,12 @@ class Scheduler:
                 if getattr(self.engine, "prefix_blocks", 0)
                 else []
             )
+            if (
+                self.kvstore_writethrough
+                and self.kvstore is not None
+                and blocks
+            ):
+                self.kvstore.put_blocks(blocks)
             self.kvfleet.ship(req.ship_to, req.request_id, blocks)
             if self.journal is not None:
                 # A ship looks like a cancel to a replay of THIS

@@ -70,7 +70,11 @@ def load_serve_params(
 #: Engine-facing construction kwargs a sharded-gang follower consumes —
 #: leader-only knobs (scheduler, watchdog, obs, blackbox, RPC plumbing)
 #: are absent from this set and are dropped before a follower builds its
-#: engine mirror.
+#: engine mirror. ``kvstore_dir``/``kvstore_mb`` are deliberately
+#: leader-only too: a follower writing its shard subset under the same
+#: content digest would clobber the leader's store entry, so followers
+#: run with no store and their broadcast ``evict_prefix_chain`` calls
+#: are pure pool bookkeeping.
 ENGINE_KEYS = frozenset((
     "ckpt_path", "model_config", "params", "int8", "num_slots", "max_seq",
     "prefill_buckets", "decode_fold", "pipeline", "prefill_chunk",
@@ -97,6 +101,8 @@ def build_engine(
     prefix_host_mb: float = 0.0,
     prefix_disk_dir: Optional[str] = None,
     prefix_disk_mb: float = 0.0,
+    kvstore_dir: Optional[str] = None,
+    kvstore_mb: float = 0.0,
     kv_page: int = 0,
     kv_pages: int = 0,
     spec: str = "off",
@@ -170,6 +176,8 @@ def build_engine(
         prefix_host_mb=prefix_host_mb,
         prefix_disk_dir=prefix_disk_dir,
         prefix_disk_mb=prefix_disk_mb,
+        kvstore_dir=kvstore_dir,
+        kvstore_mb=kvstore_mb,
         kv_page=kv_page,
         kv_pages=kv_pages,
         spec=spec,
@@ -272,6 +280,16 @@ class _GangLeaderEngine:
         # stays correct either way).
         self._broadcast("export_prefix_blocks", args, kwargs)
         return self._engine.export_prefix_blocks(*args, **kwargs)
+
+    def evict_prefix_chain(self, *args: Any, **kwargs: Any) -> Any:
+        # Pool mutation (session parking frees the chain's pages):
+        # followers must free the identical pages so later
+        # alloc/promote choices stay in lockstep. The persistent-store
+        # write happened BEFORE this call, leader-side only — followers
+        # hold no kvstore (ENGINE_KEYS drops the config), so their
+        # eviction is pure bookkeeping.
+        self._broadcast("evict_prefix_chain", args, kwargs)
+        return self._engine.evict_prefix_chain(*args, **kwargs)
 
     def close(self) -> None:
         """End-of-life sentinel: followers drain and exit their loops."""
@@ -455,6 +473,9 @@ class ServeReplica:
         kvfleet_timeout_s: float = 5.0,
         kvfleet_inflight_mb: float = 64.0,
         kvfleet_bandwidth_mbps: float = 0.0,
+        kvstore_dir: Optional[str] = None,
+        kvstore_mb: float = 0.0,
+        kvstore_writethrough: bool = False,
     ) -> None:
         from ray_lightning_tpu.obs import blackbox as obs_blackbox
         from ray_lightning_tpu.obs import health as obs_health
@@ -491,6 +512,8 @@ class ServeReplica:
             prefix_host_mb=prefix_host_mb,
             prefix_disk_dir=prefix_disk_dir,
             prefix_disk_mb=prefix_disk_mb,
+            kvstore_dir=kvstore_dir,
+            kvstore_mb=kvstore_mb,
             kv_page=kv_page,
             kv_pages=kv_pages,
             spec=spec,
@@ -615,6 +638,18 @@ class ServeReplica:
                     if (kv_inbox is not None or self.role != "mixed")
                     else None
                 ),
+                # Persistent-store provenance: `rlt replay` rebuilds an
+                # engine with the same store wiring (the dir/budget live
+                # in the engine section via _ENGINE_REBUILD_KEYS).
+                kvstore=(
+                    {
+                        "dir": self.engine.kvstore_dir,
+                        "budget_mb": float(kvstore_mb),
+                        "writethrough": bool(kvstore_writethrough),
+                    }
+                    if self.engine.kvstore is not None
+                    else None
+                ),
             ))
         # Deterministic fault injection (serve.faults): an explicit plan
         # beats the RLT_FAULTS env gate; armed rules fire at named
@@ -630,6 +665,11 @@ class ServeReplica:
         # was handed in (start_replicas creates one inbox per replica
         # when fleet sharing is on); a lone replica or an isolated
         # fleet runs without it at zero cost.
+        # The persistent store was built inside the engine ctor (it has
+        # no event log yet at that point); hand it the replica's event
+        # stream now so GC drops / write errors land in obs.
+        if self.engine.kvstore is not None:
+            self.engine.kvstore._events = self.events
         self.kvfleet = None
         if kv_inbox is not None:
             self.kvfleet = KVFleetPlane(
@@ -643,6 +683,7 @@ class ServeReplica:
                 bandwidth_mbps=float(kvfleet_bandwidth_mbps),
                 registry=self._registry,
                 events=self.events,
+                store=self.engine.kvstore,
             )
         self.scheduler = Scheduler(
             self._sched_engine,
@@ -656,6 +697,8 @@ class ServeReplica:
             faults=self.faults,
             kvfleet=self.kvfleet,
             role=self.role,
+            kvstore=self.engine.kvstore,
+            kvstore_writethrough=bool(kvstore_writethrough),
         )
         self._serve_config: Dict[str, Any] = {
             "num_slots": self.engine.num_slots,
@@ -675,6 +718,9 @@ class ServeReplica:
             "mesh": self.engine.mesh_desc,
             "role": self.role,
             "kvfleet": self.kvfleet is not None,
+            "kvstore_dir": self.engine.kvstore_dir,
+            "kvstore_mb": self.engine.kvstore_mb,
+            "kvstore_writethrough": bool(kvstore_writethrough),
             "gang_hosts": int(self._dist.get("num_hosts", 1)),
             "watchdog": bool(watchdog),
             "stall_s": float(stall_s),
@@ -923,6 +969,10 @@ class ServeReplica:
         snap["role"] = self.role
         if self.kvfleet is not None:
             snap["kvfleet"] = self.kvfleet.stats()
+        if self.engine.kvstore is not None:
+            # Persistent-store block: counters + the write/drop rings
+            # the driver-side directory feeds its store-held half from.
+            snap["kvstore"] = self.engine.kvstore.stats()
         # SLO-breach total (rlt_slo_breaches_total over every rule):
         # the router/autoscaler's quality signal next to raw queue
         # depth — summed here so the fleet rows need no registry walk.
@@ -1045,6 +1095,35 @@ class ServeReplica:
         n = self.scheduler.enqueue_prefix_import(blocks)
         self._work.set()
         return n
+
+    def park_session(
+        self,
+        tokens: Sequence[int],
+        request_id: Optional[str] = None,
+        wait_s: float = 15.0,
+    ) -> Dict[str, Any]:
+        """Park an idle conversation: export ``tokens``' cached chain
+        to the persistent store and free its local pages (only when
+        EVERY block stored — a partial write keeps the pages, lost
+        loudly via ``kvstore_write_errors_total``, never silently).
+        Blocks until the loop thread publishes the result (export and
+        evict are engine work). The next submit of the same prefix
+        restores it bit-exactly through the store-fetch path — on ANY
+        replica."""
+        if self.engine.kvstore is None:
+            raise RuntimeError(
+                "park_session needs a persistent store: start the "
+                "replica with kvstore_dir (--serve.kvstore_dir)"
+            )
+        self.scheduler.request_park(tokens, request_id=request_id)
+        self._work.set()  # an idle loop must still produce the result
+        out = self.scheduler.park_result(timeout=float(wait_s))
+        if out is None:
+            raise TimeoutError(
+                f"park result not produced within {wait_s}s (loop "
+                "thread wedged?)"
+            )
+        return out
 
     def register_kv_peer(self, idx: int, queue: Any) -> bool:
         """Adopt a new fleet member's KV inbox (autoscale-up wires the

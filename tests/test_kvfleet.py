@@ -240,7 +240,7 @@ def test_plane_fetch_roundtrip_and_accounting():
         export_fn=lambda ds: _fake_blocks([d for d in ds if d in store]),
         import_fn=lambda blocks: len(blocks),
     )
-    assert svc1 == {"fetched": [], "failed": []}
+    assert svc1 == {"fetched": [], "failed": [], "store_fetched": []}
     assert planes[1].served_fetches == 1
     # Requester imports the response and reports the fetch complete.
     svc0 = planes[0].service(

@@ -1,0 +1,917 @@
+"""PR 16: the shared object-store KV tier.
+
+One persistent, content-addressed page store the whole fleet shares —
+evictions and completed prefills write through, admission misses with
+no live peer fetch back, a restarted fleet warm-starts from the
+manifest, and idle conversations park their chains and restore
+bit-exactly. The standing contracts from the fleet plane hold
+unchanged: greedy output identical to solo ``gpt_generate`` across
+{local hit, store fetch, parked-and-restored} and zero compiles inside
+the steady-state window.
+
+Layout mirrors ``test_kvfleet.py``: envelope/backends first, then the
+store itself (budget GC, corruption, loud write errors), the
+directory's store-held half, the plane's store-fetch path, the
+scheduler-level tentpole flows, and the observability/journal/CLI
+faces. The real-fleet e2e rides at the bottom, marked slow.
+"""
+import os
+import queue
+import shutil
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import obs
+from ray_lightning_tpu.models.gpt import (
+    GPTConfig,
+    gpt_generate,
+    init_gpt_params,
+)
+from ray_lightning_tpu.serve.kvfleet import FleetKVDirectory, KVFleetPlane
+from ray_lightning_tpu.serve.kvstore import (
+    FleetKVStore,
+    LocalDirBackend,
+    S3ObjectBackend,
+    decode_entry,
+    encode_entry,
+    kvstore_config_from_header,
+    open_backend,
+)
+from ray_lightning_tpu.serve.router import Router, prompt_block_digests
+
+#: fp32 + reference attention: the exactness-contract config (same as
+#: the fleet-plane suite — the store is one more path that must not
+#: perturb a single logit).
+CFG = GPTConfig(
+    vocab_size=97,
+    n_layer=2,
+    n_head=4,
+    d_model=32,
+    max_seq=64,
+    attn_impl="reference",
+    compute_dtype="float32",
+)
+
+BLOCK = 4
+
+_REF_MEMO = {}
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+
+    return init_gpt_params(jax.random.PRNGKey(0), CFG)
+
+
+def _ref(params, prompt, n):
+    key = (tuple(prompt), n)
+    if key not in _REF_MEMO:
+        out = gpt_generate(
+            params, CFG, np.asarray(prompt, np.int32)[None], n
+        )
+        _REF_MEMO[key] = np.asarray(out)[0, len(prompt):].tolist()
+    return _REF_MEMO[key]
+
+
+DENSE_KW = dict(
+    num_slots=3, max_seq=64, prefill_buckets=[16], prefill_chunk=4,
+    prefix_blocks=16, prefix_block=BLOCK, decode_fold=2,
+)
+PAGED_KW = dict(
+    num_slots=3, max_seq=64, prefill_buckets=[16], prefill_chunk=4,
+    kv_page=BLOCK, kv_pages=48, decode_fold=2,
+)
+
+
+def _engine(params, engine_kw, mesh=None):
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+
+    return DecodeEngine(params, CFG, mesh=mesh, **engine_kw)
+
+
+def _solo(params, engine_kw, store=None, writethrough=False,
+          events=None, **eng_extra):
+    """One engine + plane + scheduler wired to an (optional) persistent
+    store — the single-replica harness every store flow below rides."""
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    eng = _engine(params, dict(engine_kw, **eng_extra))
+    inbox = queue.Queue()
+    plane = KVFleetPlane(
+        index=0, inbox=inbox, peers={0: inbox},
+        block_bytes=eng.prefix_block_nbytes, min_poll_s=0.0,
+        store=store,
+    )
+    sched = Scheduler(
+        eng, kvfleet=plane, kvstore=store,
+        kvstore_writethrough=writethrough, events=events,
+    )
+    return eng, plane, sched
+
+
+def _tokens(events, rid):
+    return [e.token for e in events if e.request_id == rid
+            and e.token is not None]
+
+
+def _sp(n=8, seed=0):
+    from ray_lightning_tpu.serve.scheduler import SamplingParams
+
+    return SamplingParams(max_new_tokens=n, seed=seed)
+
+
+def _hexd(i):
+    """A distinct well-formed 32-hex digest per index."""
+    return f"{i:02x}" * 16
+
+
+def _blk(i, shape=(2, 4)):
+    return np.full(shape, float(i), np.float32)
+
+
+def _fake_blocks(n):
+    """Store wire form with distinguishable payloads."""
+    return [(_hexd(i), _blk(i), _blk(i + 100)) for i in range(n)]
+
+
+def _store_hint(prompt, run=None):
+    """The router-shaped ``store: True`` fetch hint for ``prompt``."""
+    digs = [d.hex() for d in prompt_block_digests(prompt, BLOCK)]
+    if run is not None:
+        digs = digs[:run]
+    return {"peer": None, "store": True, "digests": digs,
+            "blocks": len(digs)}
+
+
+# ---------------------------------------------------------------------------
+# Envelope + backends
+# ---------------------------------------------------------------------------
+def test_entry_roundtrip_array_payloads():
+    kp, vp = _blk(1), _blk(2)
+    data = encode_entry(_hexd(7), kp, vp)
+    key, k2, v2 = decode_entry(data)
+    assert key == _hexd(7)
+    assert k2.dtype == np.float32 and np.array_equal(k2, kp)
+    assert np.array_equal(v2, vp)
+
+
+def test_entry_roundtrip_shard_dict_and_bfloat16():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    bf16 = np.arange(8, dtype=np.float32).reshape(2, 4).astype(
+        ml_dtypes.bfloat16
+    )
+    # The sharded host form the spill tiers keep under a mesh:
+    # (start, stop)-per-dim tuple keys -> np shards.
+    kp = {
+        ((0, 2), (0, 4)): bf16,
+        ((2, 4), (0, 4)): np.full((2, 4), 3.0, np.float32),
+    }
+    vp = _blk(9)
+    key, k2, v2 = decode_entry(encode_entry(_hexd(3), kp, vp))
+    assert key == _hexd(3)
+    assert set(k2) == set(kp)
+    got = k2[((0, 2), (0, 4))]
+    assert got.dtype == bf16.dtype
+    assert np.array_equal(
+        got.astype(np.float32), bf16.astype(np.float32)
+    )
+    assert np.array_equal(k2[((2, 4), (0, 4))], kp[((2, 4), (0, 4))])
+    assert np.array_equal(v2, vp)
+
+
+def test_decode_entry_rejects_every_kind_of_damage():
+    good = encode_entry(_hexd(1), _blk(1), _blk(2))
+    assert decode_entry(good) is not None
+    assert decode_entry(b"") is None
+    assert decode_entry(b"not an entry at all") is None
+    assert decode_entry(good[:-3]) is None  # truncated body
+    assert decode_entry(b"XXXXXXXX" + good[8:]) is None  # wrong magic
+    flipped = bytearray(good)
+    flipped[-1] ^= 0xFF  # checksum catches a body flip
+    assert decode_entry(bytes(flipped)) is None
+
+
+def test_local_backend_atomic_puts_and_prunes_partials(tmp_path):
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    # A writer that died mid-put leaves only a .tmp — no entry exists.
+    with open(os.path.join(root, _hexd(5) + ".kv.999.tmp"), "wb") as f:
+        f.write(b"torn")
+    be = LocalDirBackend(root)  # construction prunes the leftovers
+    assert not [n for n in os.listdir(root) if n.endswith(".tmp")]
+    assert be.entries() == []
+    n = be.put(_hexd(1), b"payload")
+    assert n == 7 and be.get(_hexd(1)) == b"payload"
+    assert be.get(_hexd(2)) is None
+    [(key, nbytes, _mtime)] = be.entries()
+    assert key == _hexd(1) and nbytes == 7
+    be.delete(_hexd(1))
+    be.delete(_hexd(1))  # idempotent
+    assert be.entries() == []
+
+
+def test_s3_backend_is_interface_only():
+    be = open_backend("s3://warm-pages/fleet/a")
+    assert isinstance(be, S3ObjectBackend)
+    assert be.bucket == "warm-pages" and be.prefix == "fleet/a"
+    with pytest.raises(ValueError, match="names no bucket"):
+        S3ObjectBackend("s3://")
+    for op in (lambda: be.put("k", b"x"), lambda: be.get("k"),
+               lambda: be.entries()):
+        with pytest.raises(NotImplementedError, match="interface-only"):
+            op()
+    # The store layer over the stub: constructible (config plumbing /
+    # journal headers carry the URL today), every write a LOUD error,
+    # every read an explicit miss — never an exception to a caller.
+    store = FleetKVStore("s3://warm-pages/fleet", budget_mb=16.0)
+    assert store.put_block(_hexd(1), _blk(1), _blk(2)) is False
+    assert store.write_errors == 1
+    blocks, missing = store.get_chain([_hexd(1)])
+    assert blocks == [] and missing == [_hexd(1)]
+    assert store.manifest() == [] and store.entry_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# FleetKVStore: chains, corruption, budget GC, loud write errors
+# ---------------------------------------------------------------------------
+def test_store_chain_order_stops_at_first_miss(tmp_path):
+    store = FleetKVStore(str(tmp_path))
+    assert store.put_blocks(_fake_blocks(3)) == 3
+    blocks, missing = store.get_chain(
+        [_hexd(0), _hexd(1), _hexd(9), _hexd(2)]
+    )
+    # Chain order, stop at the first miss: a later block without its
+    # ancestors can never be matched engine-side.
+    assert [b[0] for b in blocks] == [_hexd(0), _hexd(1)]
+    assert missing == [_hexd(9), _hexd(2)]
+    assert np.array_equal(blocks[1][1], _blk(1))
+    assert store.hits == 2 and store.misses == 1 and store.writes == 3
+    assert store.contains(_hexd(2)) and not store.contains(_hexd(9))
+    s = store.stats()
+    assert s["backend"] == "local-dir"
+    assert s["bytes_written"] > 0 and s["bytes_read"] > 0
+    assert list(store._recent_writes) == [_hexd(i) for i in range(3)]
+    # Manifest is MRU-last (same-tick writes tie, so pin the clock).
+    base = os.stat(store.backend._path(_hexd(0))).st_mtime
+    for i, age in ((2, 300), (0, 200), (1, 100)):
+        t = base - age
+        os.utime(store.backend._path(_hexd(i)), (t, t))
+    assert store.manifest() == [_hexd(2), _hexd(0), _hexd(1)]
+
+
+def test_store_corrupt_entry_is_an_explicit_miss(tmp_path):
+    store = FleetKVStore(str(tmp_path))
+    store.put_blocks(_fake_blocks(2))
+    path = store.backend._path(_hexd(0))
+    with open(path, "wb") as f:
+        f.write(b"rotted on disk")
+    blocks, missing = store.get_chain([_hexd(0), _hexd(1)])
+    assert blocks == [] and missing == [_hexd(0), _hexd(1)]
+    # Deleted, counted, and rung — the directory feed forgets the route.
+    assert not os.path.exists(path)
+    assert store.corrupt == 1 and store.evictions == 1
+    assert store.misses == 1
+    assert _hexd(0) in list(store._recent_dropped)
+    # The undamaged neighbor still serves once addressed first.
+    blocks, missing = store.get_chain([_hexd(1)])
+    assert len(blocks) == 1 and missing == []
+
+
+def test_store_budget_gc_is_lru_by_last_access(tmp_path):
+    store = FleetKVStore(str(tmp_path))  # unbounded writer
+    store.put_blocks(_fake_blocks(4))
+    per = store.total_bytes() // 4
+    # Pin distinct last-access times (same-tick writes would tie), with
+    # entry 0 touched MOST recently: LRU must spare it.
+    base = os.stat(store.backend._path(_hexd(0))).st_mtime
+    for i, age in ((1, 400), (2, 300), (3, 200), (0, 100)):
+        t = base - age
+        os.utime(store.backend._path(_hexd(i)), (t, t))
+    # Construction over the survivors enforces the budget up front.
+    bounded = FleetKVStore(
+        str(tmp_path), budget_mb=(per * 2 + per // 2) / (1 << 20)
+    )
+    assert bounded.evictions == 2
+    assert sorted(bounded.manifest()) == sorted([_hexd(0), _hexd(3)])
+    assert set(bounded._recent_dropped) == {_hexd(1), _hexd(2)}
+    assert bounded.total_bytes() <= bounded.budget_bytes
+    # Steady-state: every put_blocks re-enforces.
+    bounded.put_blocks([(_hexd(7), _blk(7), _blk(8))])
+    assert bounded.entry_count() == 2
+    assert bounded.total_bytes() <= bounded.budget_bytes
+
+
+def test_store_write_error_is_loud_not_fatal(tmp_path, monkeypatch):
+    log = obs.EventLog()
+    store = FleetKVStore(str(tmp_path), events=log)
+
+    def _die(key, data):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(store.backend, "put", _die)
+    assert store.put_block(_hexd(1), _blk(1), _blk(2)) is False
+    assert store.put_blocks(_fake_blocks(2)) == 0
+    assert store.write_errors == 3 and store.writes == 0
+    evs = log.tail(name="kvstore_write_error")
+    assert len(evs) == 3
+    assert "OSError" in str(evs[-1])
+
+
+def test_kvstore_header_config_filter():
+    assert kvstore_config_from_header(None) == {}
+    assert kvstore_config_from_header({"engine": {}}) == {}
+    got = kvstore_config_from_header({
+        "kvstore": {"dir": "/x", "budget_mb": 64.0,
+                    "writethrough": True, "secret": 1},
+    })
+    assert got == {"dir": "/x", "budget_mb": 64.0, "writethrough": True}
+
+
+# ---------------------------------------------------------------------------
+# Directory: the store-held half vs. replica-held half
+# ---------------------------------------------------------------------------
+def test_directory_store_half_survives_forget_replica():
+    d = FleetKVDirectory()
+    digs = [bytes.fromhex(_hexd(i)) for i in range(3)]
+    d.observe(digs, replica=1)
+    d.observe_store(digs)
+    assert d.store_chain(digs) == 3
+    # THE regression this PR guards: retiring the replica must not
+    # forget the persistent route — the store outlives every replica.
+    d.forget_replica(1)
+    assert len(d) == 0  # replica-held half gone...
+    assert d.store_chain(digs) == 3  # ...store-held half intact
+    assert d.store_holds(digs[0])
+    # Replica-scoped digest invalidation is equally blind to the store.
+    d.observe(digs, replica=0)
+    d.forget_digests(digs, replica=0)
+    assert d.store_chain(digs) == 3
+    # forget_store_digests is the ONLY prune path, and idempotent.
+    assert d.forget_store_digests(digs[:1]) == 1
+    assert d.forget_store_digests(digs[:1]) == 0
+    assert d.store_chain(digs) == 0  # leading block gone: no run
+    assert d.store_holds(digs[1])  # later entries still known
+
+
+def test_directory_store_half_is_lru_bounded():
+    d = FleetKVDirectory(capacity=16)  # the floor the ctor enforces
+    digs = [bytes.fromhex(_hexd(i)) for i in range(20)]
+    d.observe_store(digs)
+    assert d.store_entries() == 16
+    # Oldest observations fell off; the newest survive.
+    assert not d.store_holds(digs[0]) and d.store_holds(digs[19])
+    # store_chain wants the LEADING run, not any run.
+    assert d.store_chain(digs) == 0
+    assert d.store_chain(digs[4:]) == 16
+
+
+# ---------------------------------------------------------------------------
+# Plane: the store-kind fetch (park -> read -> import -> admit warm)
+# ---------------------------------------------------------------------------
+def test_plane_store_fetch_imports_and_counts(tmp_path):
+    store = FleetKVStore(str(tmp_path))
+    store.put_blocks(_fake_blocks(3))
+    plane = KVFleetPlane(
+        index=0, inbox=queue.Queue(), block_bytes=64, min_poll_s=0.0,
+        store=store,
+    )
+    assert plane.request_store_fetch("r1", []) is False
+    digs = [_hexd(i) for i in range(3)]
+    assert plane.request_store_fetch("r1", digs) is True
+    assert plane.request_store_fetch("r1", digs) is False  # one pending
+    assert plane.store_fetches == 1
+    imported = []
+    out = plane.service(None, lambda blocks: imported.append(blocks)
+                        or len(blocks))
+    assert out["store_fetched"] == ["r1"]
+    assert out["fetched"] == [("r1", 3)] and out["failed"] == []
+    assert [b[0] for b in imported[0]] == digs
+    assert plane.store_fetch_blocks == 3 and plane.store_fetch_bytes > 0
+    assert plane.imports == 3
+    # A store-less plane refuses instead of parking forever.
+    bare = KVFleetPlane(index=0, inbox=queue.Queue(), min_poll_s=0.0)
+    assert bare.request_store_fetch("r2", digs) is False
+
+
+def test_plane_store_miss_and_vanished_dir_fail_cold(tmp_path):
+    root = str(tmp_path / "store")
+    store = FleetKVStore(root)
+    plane = KVFleetPlane(
+        index=0, inbox=queue.Queue(), block_bytes=64, min_poll_s=0.0,
+        store=store,
+    )
+    # Empty store: explicit miss, request fails to a cold prefill.
+    assert plane.request_store_fetch("r1", [_hexd(0)]) is True
+    out = plane.service(None, lambda blocks: len(blocks))
+    assert out["failed"] == [("r1", "store_miss")]
+    assert out["store_fetched"] == [] and plane.store_fetch_misses == 1
+    # The whole directory vanishing mid-fetch is the same explicit miss.
+    store.put_blocks(_fake_blocks(1))
+    assert plane.request_store_fetch("r2", [_hexd(0)]) is True
+    shutil.rmtree(root)
+    out = plane.service(None, lambda blocks: len(blocks))
+    assert out["failed"] == [("r2", "store_miss")]
+    assert plane.store_fetch_misses == 2 and plane.store_fetch_blocks == 0
+
+
+# ---------------------------------------------------------------------------
+# Tentpole flows: write-through -> bounce -> warm-start; park -> restore
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "engine_kw", [DENSE_KW, PAGED_KW], ids=["dense", "paged"]
+)
+def test_fleet_bounce_warm_starts_from_store_bit_exact(
+    params, tmp_path, engine_kw
+):
+    """The acceptance flow: fleet 1 write-throughs its prefills, dies;
+    fleet 2 (same store dir, fresh everything) serves the revisit
+    through a store fetch — bit-identical to solo gpt_generate, zero
+    compiles inside the window."""
+    from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(0, CFG.vocab_size, size=14).tolist()
+    n = 6
+    expected = _ref(params, prompt, n)  # compiles OUTSIDE the window
+    root = str(tmp_path / "store")
+    store1 = FleetKVStore(root)
+    eng1, _plane1, sched1 = _solo(
+        params, engine_kw, store=store1, writethrough=True
+    )
+    store2 = FleetKVStore(root)  # "restarted fleet" opens the same dir
+    eng2, plane2, sched2 = _solo(params, engine_kw, store=store2)
+    stats = install_compile_listener()
+    baseline = stats.count("backend_compile")
+    ev1 = []
+    sched1.submit(prompt, _sp(n), request_id="warm")
+    ev1 = sched1.run_until_idle()
+    assert _tokens(ev1, "warm") == expected
+    digs = [d.hex() for d in prompt_block_digests(prompt, BLOCK)]
+    assert store1.writes >= len(digs)  # write-through landed
+    # Warm-start: the manifest names yesterday's chain.
+    assert set(digs) <= set(store2.manifest())
+    sched2.submit(
+        prompt, _sp(n), request_id="bounce",
+        kv_hint=_store_hint(prompt),
+    )
+    ev2 = sched2.run_until_idle()
+    assert _tokens(ev2, "bounce") == expected
+    assert plane2.store_fetches == 1 and plane2.store_fetch_misses == 0
+    assert plane2.store_fetch_blocks == len(digs)
+    assert eng2.prefix_hit_tokens > 0  # admitted WARM off the store
+    assert store2.hits >= len(digs)
+    assert stats.count("backend_compile") == baseline
+
+
+def test_park_restores_bit_exact_on_a_different_replica(
+    params, tmp_path
+):
+    """Session parking: turn 1 on replica A, park (export -> store ->
+    free), turn 2 lands on replica B and restores through the store —
+    the stream identical to one uninterrupted conversation."""
+    store = FleetKVStore(str(tmp_path))
+    engA, _planeA, schedA = _solo(params, DENSE_KW, store=store)
+    engB, planeB, schedB = _solo(params, DENSE_KW, store=store)
+    rng = np.random.default_rng(37)
+    p1 = rng.integers(0, CFG.vocab_size, size=13).tolist()
+    schedA.submit(p1, _sp(6, seed=0), request_id="t1")
+    t1 = _tokens(schedA.run_until_idle(), "t1")
+    assert t1 == _ref(params, p1, 6)
+    convo = p1 + t1
+    schedA.request_park(convo, request_id="t1")
+    assert schedA.has_work()
+    schedA.step()
+    rec = schedA.park_result(timeout=5.0)
+    assert rec is not None
+    assert rec["blocks"] >= len(p1) // BLOCK
+    assert rec["stored"] == rec["blocks"] > 0
+    assert rec["freed"] == rec["blocks"]  # pages reclaimed...
+    assert engA.cached_prefix_blocks(convo) == 0  # ...really gone
+    # Turn 2 shares the parked chain as its prefix; replica B has
+    # never seen any of it.
+    p2 = convo + rng.integers(0, CFG.vocab_size, size=5).tolist()
+    run = 0
+    for d in prompt_block_digests(p2, BLOCK):
+        if not store.contains(d.hex()):
+            break
+        run += 1
+    assert run >= len(p1) // BLOCK
+    schedB.submit(
+        p2, _sp(6, seed=1), request_id="t2",
+        kv_hint=_store_hint(p2, run=run),
+    )
+    t2 = _tokens(schedB.run_until_idle(), "t2")
+    assert t2 == _ref(params, p2, 6)  # == the uninterrupted oracle
+    assert planeB.store_fetches == 1 and engB.prefix_hit_tokens > 0
+
+
+def test_park_partial_write_keeps_pages(params, tmp_path, monkeypatch):
+    """A park whose store write fails must NOT free the local pages:
+    lost loudly (write_errors, warn event), never silently."""
+    log = obs.EventLog()
+    store = FleetKVStore(str(tmp_path), events=log)
+    eng, _plane, sched = _solo(params, DENSE_KW, store=store, events=log)
+    rng = np.random.default_rng(41)
+    p1 = rng.integers(0, CFG.vocab_size, size=13).tolist()
+    sched.submit(p1, _sp(4), request_id="t1")
+    t1 = _tokens(sched.run_until_idle(), "t1")
+    convo = p1 + t1
+
+    def _die(key, data):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(store.backend, "put", _die)
+    sched.request_park(convo, request_id="t1")
+    sched.step()
+    rec = sched.park_result(timeout=5.0)
+    assert rec["blocks"] > 0 and rec["stored"] == 0
+    assert rec["freed"] == 0
+    assert eng.cached_prefix_blocks(convo) > 0  # still warm locally
+    assert store.write_errors >= rec["blocks"]
+    evs = log.tail(name="kv_park")
+    assert evs and "warn" in str(evs[-1])
+
+
+def test_store_vanishes_mid_fetch_degrades_cold_and_exact(
+    params, tmp_path
+):
+    """A store-hinted request whose store died is a cold prefill with
+    identical output — a counted miss, never a lost request."""
+    root = str(tmp_path / "store")
+    store = FleetKVStore(root)
+    eng, plane, sched = _solo(params, DENSE_KW, store=store)
+    rng = np.random.default_rng(43)
+    prompt = rng.integers(0, CFG.vocab_size, size=14).tolist()
+    shutil.rmtree(root)  # the hint is now a lie
+    sched.submit(
+        prompt, _sp(6), request_id="r", kv_hint=_store_hint(prompt),
+    )
+    toks = _tokens(sched.run_until_idle(), "r")
+    assert toks == _ref(params, prompt, 6)
+    assert plane.store_fetches == 1 and plane.store_fetch_misses == 1
+    assert plane.store_fetch_blocks == 0
+    assert eng.prefix_handoff_imports == 0
+
+
+def test_writethrough_failure_never_blocks_requests(
+    params, tmp_path, monkeypatch
+):
+    store = FleetKVStore(str(tmp_path))
+
+    def _die(key, data):
+        raise OSError(30, "Read-only file system")
+
+    monkeypatch.setattr(store.backend, "put", _die)
+    _eng, _plane, sched = _solo(
+        params, DENSE_KW, store=store, writethrough=True
+    )
+    rng = np.random.default_rng(47)
+    prompt = rng.integers(0, CFG.vocab_size, size=14).tolist()
+    sched.submit(prompt, _sp(6), request_id="r")
+    toks = _tokens(sched.run_until_idle(), "r")
+    assert toks == _ref(params, prompt, 6)
+    assert store.write_errors > 0 and store.writes == 0
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: eviction sink + parked-chain eviction
+# ---------------------------------------------------------------------------
+def test_engine_tier_evictions_sink_to_store(params, tmp_path):
+    """Pages squeezed out of the local tiers write through instead of
+    dying: a tiny host budget (one CFG block is 4096B, the budget 512B)
+    turns every pool eviction into a store write."""
+    root = str(tmp_path / "store")
+    eng = _engine(params, dict(
+        DENSE_KW, num_slots=2, prefix_blocks=4,
+        prefix_host_mb=0.0005, kvstore_dir=root,
+    ))
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    sched = Scheduler(eng)
+    assert eng.kvstore is not None
+    rng = np.random.default_rng(53)
+    for s in range(5):  # 5 x 3-block chains through a 4-block pool
+        p = rng.integers(0, CFG.vocab_size, size=13).tolist()
+        sched.submit(p, _sp(3, seed=s))
+        sched.run_until_idle()
+    assert eng.kvstore.writes > 0
+    # A sunk digest reads back as a real entry, not a tombstone.
+    [key, *_rest] = eng.kvstore.manifest()
+    blocks, missing = eng.kvstore.get_chain([key])
+    assert len(blocks) == 1 and missing == []
+
+
+def test_evict_prefix_chain_frees_every_tier(params):
+    eng = _engine(params, DENSE_KW)
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    sched = Scheduler(eng)
+    rng = np.random.default_rng(59)
+    prompt = rng.integers(0, CFG.vocab_size, size=13).tolist()
+    sched.submit(prompt, _sp(4))
+    sched.run_until_idle()
+    assert eng.cached_prefix_blocks(prompt) > 0
+    digs = [d.hex() for d in prompt_block_digests(prompt, BLOCK)]
+    freed = eng.evict_prefix_chain(digs)
+    assert freed == len(digs)
+    assert eng.cached_prefix_blocks(prompt) == 0
+    # Freed digests ride the dropped ring (the directory's replica-held
+    # invalidation feed), and the call is idempotent + hex-tolerant.
+    assert set(digs) <= set(eng.dropped_digests())
+    assert eng.evict_prefix_chain(digs) == 0
+    assert eng.evict_prefix_chain(["zz-not-hex", ""]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Router: the store hint of last resort + refresh ring feeds
+# ---------------------------------------------------------------------------
+class _RowsClient:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def stats(self):
+        return [dict(r) for r in self.rows]
+
+    def health(self):
+        return [
+            {"verdict": r.get("health", "healthy")} for r in self.rows
+        ]
+
+
+def _row(role="mixed", health="healthy"):
+    return {
+        "queue_depth": 0,
+        "active_slots": 0,
+        "num_slots": 2,
+        "decode_tokens_per_sec": 100.0,
+        "health": health,
+        "role": role,
+        "slo_breaches": 0,
+    }
+
+
+def _mk_router(rows, **kw):
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    return Router(
+        client=_RowsClient(rows), registry=MetricsRegistry(),
+        events=obs.EventLog(), refresh_s=0.0, prefix_block=BLOCK, **kw
+    )
+
+
+def test_router_store_hint_is_the_last_word():
+    router = _mk_router([_row(), _row()])
+    prompt = list(range(16))
+    digests = prompt_block_digests(prompt, BLOCK)
+    # Store-held only (a fleet bounce seeded the directory): the plan
+    # carries the store hint from the first request.
+    router.directory.observe_store(digests)
+    plan = router.plan(prompt)
+    assert plan.kv_hint == {
+        "peer": None, "store": True,
+        "digests": [d.hex() for d in digests],
+        "blocks": len(digests),
+    }
+    # A LIVE peer holding the chain outranks the store...
+    router.observe_route(prompt, 1)
+    plan = router.plan(prompt, alive=[0])
+    assert plan.kv_hint["peer"] == 1 and "store" not in plan.kv_hint
+    # ...until that peer is a corpse — then the store gets the last
+    # word instead of a fetch that can only burn the timeout.
+    rows = [_row(), _row(health="unreachable")]
+    router2 = _mk_router(rows)
+    router2.observe_route(prompt, 1)
+    router2.directory.observe_store(digests)
+    plan = router2.plan(prompt, alive=[0])
+    assert plan.replica == 0
+    assert plan.kv_hint["store"] is True and plan.kv_hint["peer"] is None
+
+
+def test_router_refresh_feeds_the_store_rings():
+    rows = [_row()]
+    router = _mk_router(rows)
+    prompt = list(range(16))
+    digests = prompt_block_digests(prompt, BLOCK)
+    # The write ring opens store-held routes...
+    rows[0]["kvstore"] = {
+        "recent_writes": [d.hex() for d in digests],
+        "recent_dropped": [],
+    }
+    router.refresh()
+    assert router.directory.store_chain(digests) == len(digests)
+    # ...the dropped ring (budget GC / corruption) closes them, and a
+    # re-seen ring is idempotent either way.
+    rows[0]["kvstore"] = {
+        "recent_writes": [],
+        "recent_dropped": [digests[0].hex(), "not-hex-is-advisory"],
+    }
+    router.refresh()
+    router.refresh()
+    assert router.directory.store_chain(digests) == 0
+    assert router.directory.store_holds(digests[1])
+
+
+# ---------------------------------------------------------------------------
+# Observability: metrics, fleet rows, rlt top
+# ---------------------------------------------------------------------------
+def test_kvstore_metrics_and_fleet_faces(tmp_path):
+    from ray_lightning_tpu.cli import render_fleet
+    from ray_lightning_tpu.obs.fleet import (
+        aggregate_fleet,
+        summarize_replica,
+    )
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    store = FleetKVStore(str(tmp_path), registry=reg)
+    store.put_blocks(_fake_blocks(2))
+    store.get_chain([_hexd(0), _hexd(9)])  # 1 hit + 1 miss
+    text = reg.render()
+    for frag in (
+        "rlt_serve_kvstore_writes_total 2",
+        "rlt_serve_kvstore_hits_total 1",
+        "rlt_serve_kvstore_misses_total 1",
+        'direction="write"',
+        'direction="read"',
+    ):
+        assert frag in text
+    # The replica row keeps the stats block INCLUDING the rings (the
+    # router refresh reads them off this row), and the fleet roll-up
+    # sums the counters.
+    row = summarize_replica({"queue_depth": 0, "kvstore": store.stats()})
+    assert row["kvstore"]["writes"] == 2
+    assert row["kvstore"]["recent_writes"] == [_hexd(0), _hexd(1)]
+    assert row["kvstore"]["backend"] == "local-dir"
+    fleet = aggregate_fleet([row])
+    assert fleet["kvstore_writes"] == 2 and fleet["kvstore_hits"] == 1
+    assert fleet["kvstore_misses"] == 1
+    frame = render_fleet({"latest": {"replicas": [row], "fleet": fleet}})
+    assert "store h/m/w" in frame and "1/1/2" in frame
+    assert "kvstore: hits=1" in frame
+    # A store-less fleet renders no phantom column values or roll-up.
+    bare_row = summarize_replica({"queue_depth": 0})
+    assert bare_row["kvstore"] is None
+    bare = render_fleet({
+        "latest": {
+            "replicas": [bare_row],
+            "fleet": aggregate_fleet([bare_row]),
+        },
+    })
+    assert "kvstore: hits=" not in bare
+
+
+def test_journal_header_carries_kvstore_config(params, tmp_path):
+    from ray_lightning_tpu.obs.journal import (
+        _ENGINE_REBUILD_KEYS,
+        WorkloadJournal,
+        engine_header,
+        replay_journal,
+    )
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    assert {"kvstore_dir", "kvstore_mb"} <= set(_ENGINE_REBUILD_KEYS)
+    root = str(tmp_path / "store")
+    eng = _engine(params, dict(DENSE_KW, kvstore_dir=root, kvstore_mb=8.0))
+    journal = WorkloadJournal(capacity=64)
+    journal.set_header(engine_header(
+        eng,
+        kvstore={"dir": root, "budget_mb": 8.0, "writethrough": True},
+    ))
+    sched = Scheduler(eng, journal=journal)
+    rng = np.random.default_rng(61)
+    prompt = rng.integers(0, CFG.vocab_size, size=12).tolist()
+    sched.submit(prompt, _sp(5), request_id="r")
+    sched.run_until_idle()
+    dump = journal.dump(None)
+    assert dump["header"]["engine"]["kvstore_dir"] == root
+    assert dump["header"]["engine"]["kvstore_mb"] == 8.0
+    # Replay on a store-less engine: exact (the store never changes a
+    # logit), with the recorded store config surfaced in the verdict.
+    fresh = Scheduler(_engine(params, DENSE_KW))
+    verdict = replay_journal(dump, scheduler=fresh)
+    assert verdict["exact"] is True
+    assert verdict["kvstore_config"] == {
+        "dir": root, "budget_mb": 8.0, "writethrough": True,
+    }
+
+
+def test_serve_cli_knows_the_kvstore_knobs(tmp_path):
+    from ray_lightning_tpu.cli import cli_entry
+
+    with pytest.raises(ValueError, match="kvstore_mb .* must be >= 0"):
+        cli_entry([
+            "serve", "--serve.ckpt_path", "/nonexistent.ckpt",
+            "--serve.prompts", "/nonexistent.txt",
+            "--serve.kvstore_dir", str(tmp_path),
+            "--serve.kvstore_mb", "-1",
+        ])
+    with pytest.raises(
+        ValueError, match="kvstore_writethrough needs"
+    ):
+        cli_entry([
+            "serve", "--serve.ckpt_path", "/nonexistent.ckpt",
+            "--serve.prompts", "/nonexistent.txt",
+            "--serve.kvstore_writethrough", "true",
+        ])
+
+
+# ---------------------------------------------------------------------------
+# e2e: a real fleet bounce over a real store (slow)
+# ---------------------------------------------------------------------------
+def _write_ckpt(tmp_path, params):
+    import dataclasses
+
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file,
+        to_state_stream,
+    )
+
+    path = os.path.join(str(tmp_path), "kvstore.ckpt")
+    state_stream_to_file(
+        to_state_stream(
+            {
+                "params": params,
+                "gpt_config": dataclasses.asdict(CFG),
+            }
+        ),
+        path,
+    )
+    return path
+
+
+@pytest.mark.slow
+def test_e2e_fleet_bounce_warm_starts_and_parks(
+    start_fabric, tmp_path, params
+):
+    """Acceptance e2e: a real 2-replica fleet with write-through warms
+    the store and parks a session; a FULL stop/start over the same dir
+    seeds its directory from the manifest and serves the revisit
+    through a real store fetch — bit-exact, compiles_since_init == 0."""
+    start_fabric(num_cpus=4)
+    from ray_lightning_tpu.serve.client import start_replicas
+
+    ckpt = _write_ckpt(tmp_path, params)
+    kw = dict(
+        ckpt_path=ckpt,
+        env={"JAX_PLATFORMS": "cpu"},
+        kvfleet=True,
+        rpc_timeout_s=60.0,
+        num_slots=3,
+        max_seq=64,
+        prefill_buckets=[16],
+        prefill_chunk=4,
+        prefix_blocks=16,
+        prefix_block=BLOCK,
+        decode_fold=2,
+        kvstore_dir=str(tmp_path / "store"),
+        kvstore_mb=64.0,
+        kvstore_writethrough=True,
+    )
+    rng = np.random.default_rng(67)
+    prompt = rng.integers(0, CFG.vocab_size, size=14).tolist()
+    expected = _ref(params, prompt, 8)
+    client = start_replicas(2, **kw)
+    client.router = Router(
+        client=client, refresh_s=0.0, prefix_block=BLOCK, shed=False,
+    )
+    try:
+        h = client.submit(prompt, max_new_tokens=8, seed=0)
+        t1 = list(client.stream_handle(h, timeout_s=120))
+        assert t1 == expected
+        park = client.park_session(h, wait_s=30.0)
+        assert park["stored"] == park["blocks"] > 0
+        assert sum(
+            (s.get("kvstore") or {}).get("writes", 0)
+            for s in client.stats()
+        ) > 0
+    finally:
+        client.shutdown()
+    # The bounce: a FRESH fleet over the same store directory.
+    client = start_replicas(2, **kw)
+    client.router = Router(
+        client=client, refresh_s=0.0, prefix_block=BLOCK, shed=False,
+    )
+    try:
+        assert client.seed_store_directory(client.router) > 0
+        toks = list(client.stream(
+            prompt, max_new_tokens=8, seed=0, timeout_s=120,
+        ))
+        assert toks == expected
+        stats = client.stats()
+        assert sum(
+            (s.get("kvfleet") or {}).get("store_fetches", 0)
+            for s in stats
+        ) >= 1
+        assert sum(
+            (s.get("kvstore") or {}).get("hits", 0) for s in stats
+        ) > 0
+        assert sum(
+            (s.get("prefix") or {}).get("hit_tokens", 0) for s in stats
+        ) > 0
+        assert all(
+            int(s.get("compiles_since_init") or 0) == 0 for s in stats
+        )
+    finally:
+        client.shutdown()

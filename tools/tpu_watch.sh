@@ -28,6 +28,7 @@ have_preempt=0
 have_paged=0
 have_router=0
 have_kvfleet=0
+have_kvstore=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -43,6 +44,7 @@ preempt_fails=0
 paged_fails=0
 router_fails=0
 kvfleet_fails=0
+kvstore_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -62,6 +64,7 @@ preempt_status=pending
 paged_status=pending
 router_status=pending
 kvfleet_status=pending
+kvstore_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -88,6 +91,7 @@ write_manifest() {
     echo "stage=paged status=$paged_status fails=$paged_fails"
     echo "stage=router status=$router_status fails=$router_fails"
     echo "stage=kvfleet status=$kvfleet_status fails=$kvfleet_fails"
+    echo "stage=kvstore status=$kvstore_status fails=$kvstore_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -296,6 +300,34 @@ while true; do
             have_kvfleet=1
             kvfleet_status=skipped
             echo "$(date -u +%H:%M:%S) kvfleet serve bench SKIPPED after $kvfleet_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_kvstore" -eq 0 ]; then
+        # Stage 4a++: persistent-KV-store artifact - the serve sweep now
+        # carries kvstore_rows (shared prefixes warmed with write-through
+        # on, then the WHOLE fleet bounced over the same store dir:
+        # warm-start revisit TTFT + store fetches + hit rate, all
+        # bit-exact; plus a park/restore round-trip on a two-turn
+        # conversation), so the next healthy window records the
+        # restart-warm story ON CHIP next to the CPU control.
+        echo "$(date -u +%H:%M:%S) launching KVSTORE serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/kvstore_bench.json 2> /tmp/kvstore_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/kvstore_bench.json ] && \
+           grep -q kvstore_rows /tmp/kvstore_bench.json; then
+          have_kvstore=1
+          kvstore_status=ok
+          echo "$(date -u +%H:%M:%S) KVSTORE serve bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          kvstore_fails=$((kvstore_fails+1))
+          kvstore_status=failed
+          echo "$(date -u +%H:%M:%S) kvstore serve bench failed rc=$rc (fail $kvstore_fails)" >> /tmp/tpu_watch.log
+          if [ "$kvstore_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_kvstore=1
+            kvstore_status=skipped
+            echo "$(date -u +%H:%M:%S) kvstore serve bench SKIPPED after $kvstore_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_sharded" -eq 0 ]; then
