@@ -390,6 +390,7 @@ _SERVE_KEYS = frozenset((
     "eos_token", "replicas", "num_slots", "max_seq", "mesh",
     "hosts_per_replica",
     "prefill_buckets", "max_prefills_per_step", "decode_fold",
+    "fold_ladder", "piggyback_chunks",
     "pipeline", "prefill_chunk", "prefix_cache", "prefix_block",
     "prefix_host_mb", "prefix_disk_dir", "prefix_disk_mb",
     "kv_page", "kv_pages",
@@ -408,6 +409,7 @@ _SERVE_KEYS = frozenset((
     "autoscale_min", "autoscale_max", "autoscale_interval_s",
     "prefill_replicas", "kvfleet", "kvfleet_timeout_s",
     "kvfleet_inflight_mb", "kvfleet_bandwidth_mbps",
+    "kvfleet_layerwise",
     "kvstore_dir", "kvstore_mb", "kvstore_writethrough",
 ))
 
@@ -593,9 +595,23 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         slot per engine step; amortizes dispatch/sync, admissions land at
         fold boundaries). pipeline: double-buffer fold dispatch (default
         on).
+      fold_ladder: pre-lowered fold depths, e.g. "1,2,8" (comma list or
+        YAML list; every rung >= 1, must include decode_fold). Each
+        dispatch picks the deepest rung the current queue pressure
+        allows — short folds while admissions wait, deep folds on a
+        quiet queue — with zero steady-state compiles (the whole
+        ladder compiles at construction). Dispatch counts land in
+        stats fold_k and rlt_serve_fold_depth.
       prefill_chunk: chunked prefill (tokens per chunk, 0 = monolithic):
         long prompts prefill in chunks interleaved between decode folds.
         max_prefill_chunks_per_step: chunk-vs-fold interleave budget.
+      piggyback_chunks: fuse prefill into the decode dispatch (Sarathi
+        -style chunked piggybacking): up to C chunked-prefill rows ride
+        INSIDE each decode fold instead of issuing separate
+        prefill_step dispatches (0 = off; 1 <= C <= num_slots; needs
+        prefill_chunk > 0). Resident decodes stop stalling behind
+        admissions; outputs stay bit-exact. Traffic lands in
+        rlt_serve_piggyback_*_total and stats piggyback.
       prefix_cache: "off" (default), "on" (64 blocks), or a block count
         — device-resident prefix KV reuse for shared prompt prefixes
         (implies chunked prefill). prefix_block: tokens per pool block.
@@ -710,6 +726,12 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         bytes; kvfleet_bandwidth_mbps caps transfer throughput
         (0 = uncapped). Traffic lands in
         rlt_serve_kvfleet_*_total{role=} and the fleet rows.
+        kvfleet_layerwise: stream a disaggregated prefill's shipped
+        pages to the decode target PER LAYER as each ships, instead
+        of one whole-prompt blob at completion — the decode replica
+        imports layer l while layer l+1 is in flight, cutting
+        ship-to-first-decode latency. A target dying mid-stream
+        aborts the staged partial (cold prefill, nothing lost).
       kvstore_dir: fleet-shared persistent KV store (tier of last
         resort, content-addressed by the engines' chained page
         digests): evictions falling off the bottom of a replica's
@@ -830,6 +852,44 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             serve_cfg.pop("max_prefill_chunks_per_step", 1)
         ),
     }
+    # Fused-dispatch knobs, validated up front with named ranges (the
+    # engine re-validates, but a fleet launch should die on the driver
+    # with the flag name, not in replica 3's traceback).
+    ladder = serve_cfg.pop("fold_ladder", None)
+    if ladder is not None:
+        if isinstance(ladder, str):
+            ladder = [r for r in ladder.replace(",", " ").split() if r]
+        elif isinstance(ladder, (int, float)):
+            ladder = [ladder]
+        rungs = sorted({int(r) for r in ladder})
+        bad = [r for r in rungs if r < 1]
+        if bad:
+            raise ValueError(
+                f"--serve.fold_ladder rungs {bad} out of range: every "
+                "rung must be >= 1 (decode iterations per dispatch)"
+            )
+        if replica_kwargs["decode_fold"] not in rungs:
+            raise ValueError(
+                f"--serve.fold_ladder {rungs} must include decode_fold="
+                f"{replica_kwargs['decode_fold']} (the rung a "
+                "full-runway dispatch uses)"
+            )
+        replica_kwargs["fold_ladder"] = rungs
+    pbc = int(serve_cfg.pop("piggyback_chunks", 0))
+    if not 0 <= pbc <= replica_kwargs["num_slots"]:
+        raise ValueError(
+            f"--serve.piggyback_chunks {pbc} out of range: need 0 <= C "
+            f"<= num_slots={replica_kwargs['num_slots']} (each "
+            "piggyback row targets one slot; 0 = off)"
+        )
+    if pbc and replica_kwargs["prefill_chunk"] <= 0:
+        raise ValueError(
+            "--serve.piggyback_chunks needs --serve.prefill_chunk > 0 "
+            "(piggyback rows are chunked-prefill rows riding the "
+            "decode fold)"
+        )
+    if pbc:
+        replica_kwargs["piggyback_chunks"] = pbc
     if mesh_spec is not None:
         replica_kwargs["mesh"] = mesh_spec
     age = serve_cfg.pop("priority_age_s", None)
@@ -959,6 +1019,15 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     kvfleet_bandwidth_mbps = float(
         serve_cfg.pop("kvfleet_bandwidth_mbps", 0.0)
     )
+    kvfleet_layerwise = bool(serve_cfg.pop("kvfleet_layerwise", False))
+    if kvfleet_layerwise and not (kvfleet or prefill_replicas):
+        raise ValueError(
+            "--serve.kvfleet_layerwise streams shipped KV pages per "
+            "layer over the fleet plane: enable --serve.kvfleet or "
+            "set --serve.prefill_replicas first"
+        )
+    if kvfleet_layerwise:
+        replica_kwargs["kvfleet_layerwise"] = True
     # Persistent KV store (fleet-shared tier of last resort):
     # --serve.kvstore_dir mounts it, --serve.kvstore_mb bounds it (LRU
     # GC; 0 = unbounded), --serve.kvstore_writethrough makes prefill
@@ -1487,7 +1556,7 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{'tok/s':>9} {'ttft_p50':>9} {'ttft_p95':>9} "
             f"{'accept':>7} {'hit':>6} {'hit d/h/k':>14} "
             f"{'pages f/r/a':>12} {'fetch/ship':>11} {'store h/m/w':>12} "
-            f"{'goodput':>9} {'weight':>7}"
+            f"{'pb d/r':>9} {'goodput':>9} {'weight':>7}"
         ),
     ]
     # Router weights keyed by replica (absent without a router).
@@ -1538,6 +1607,16 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             if kvs
             else None
         )
+        # Fused dispatches: piggyback dispatches / chunk rows that rode
+        # decode folds — "-" when piggybacking is off.
+        pb = r.get("piggyback") or {}
+        pb_cell = (
+            "{}/{}".format(
+                pb.get("dispatches", 0), pb.get("chunk_rows", 0)
+            )
+            if pb
+            else None
+        )
         out.append(
             f"{_fmt_cell(r.get('replica'), 7)} "
             f"{_fmt_cell(r.get('health'), 9)} "
@@ -1555,6 +1634,7 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{_fmt_cell(page_cell, 12)} "
             f"{_fmt_cell(kvf_cell, 11)} "
             f"{_fmt_cell(kvs_cell, 12)} "
+            f"{_fmt_cell(pb_cell, 9)} "
             f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)} "
             f"{_fmt_cell(weights.get(r.get('replica')), 7, 2)}"
         )

@@ -1456,6 +1456,144 @@ def sample_logits_batched(
     )
 
 
+def _piggyback_prefill(
+    params: Dict[str, Any],
+    cfg: GPTConfig,
+    piggyback: Tuple[jax.Array, ...],
+    cur: jax.Array,
+    pos: jax.Array,
+    keys: jax.Array,
+    active: jax.Array,
+    remaining: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    *,
+    hist: Optional[jax.Array] = None,
+    page_table: Optional[jax.Array] = None,
+    page_size: int = 0,
+) -> Tuple[jax.Array, ...]:
+    """The piggyback block of a fused prefill+decode fold: up to C
+    prefill-chunk rows run INSIDE the decode dispatch, after the fold's
+    scan (Sarathi-style chunked piggybacking — admissions stop paying a
+    separate dispatch per chunk).
+
+    ``piggyback`` is a 12-tuple of (C, ...) arrays: ``(chunk (C, cb)
+    int32 right-padded, start (C,), len (C,), slot (C,), key0 (C, 2)
+    uint32, temp (C,), top_k (C,), top_p (C,), n_new (C,), eos (C,),
+    final (C,) bool, on (C,) bool)``. Each ON row replays the engine's
+    chunk executable verbatim — cache-seeded causal forward over its
+    slot's rows ``[start, start+len)`` via :func:`gpt_prefill_chunk`'s
+    masked row-gather writes (a piggybacked row can never scribble on a
+    resident slot: only its own slot's masked range is written), and on
+    the FINAL chunk the first-token sample plus the slot's arming state
+    write, consuming the rng chain exactly like the standalone chunk
+    path. OFF rows force ``len = 0``, which makes every cache write a
+    bit-exact no-op (the chunk's validity mask is empty) and every state
+    write a guarded identity — padding the block to a fixed C costs
+    wasted flops, never correctness.
+
+    Runs AFTER the decode scan so the chunk heals the one row the
+    fold's idle-lane writes scribble at the parked slot's position —
+    the same heal order the separate-dispatch interleave had (chunk
+    executables run between folds). Returns ``(pb_toks (C,) int32 with
+    -1 at non-final/off rows, cur, pos, keys, active, remaining,
+    k_cache, v_cache, hist)``.
+    """
+    (
+        pb_chunk, pb_start, pb_len, pb_slot, pb_key0, pb_temp, pb_tk,
+        pb_tp, pb_n_new, pb_eos, pb_final, pb_on,
+    ) = piggyback
+    norm_fn = _make_norm(cfg)
+    L, Hkv, hd = cfg.n_layer, cfg.kv_head, cfg.head_dim
+    C_rows, cb = pb_chunk.shape
+    head_w = _head_weight(params, cfg)
+    toks_out = []
+    # Python loop over rows: C is small and static, and each row may
+    # target a different slot (the engine never schedules two chunks of
+    # one slot in a single dispatch, so rows are order-independent).
+    for r in range(C_rows):
+        on = pb_on[r]
+        slot = pb_slot[r]
+        start = pb_start[r]
+        # OFF rows run with true_len = 0: gpt_prefill_chunk's masked
+        # writes become empty and the row is a bit-exact no-op.
+        tl = jnp.where(on, pb_len[r], 0)
+        chunk_r = pb_chunk[r][None]  # (1, cb)
+        if page_table is None:
+            S = k_cache.shape[2]
+            k_slot = jax.lax.dynamic_slice(
+                k_cache, (0, slot, 0, 0, 0), (L, 1, S, Hkv, hd)
+            )
+            v_slot = jax.lax.dynamic_slice(
+                v_cache, (0, slot, 0, 0, 0), (L, 1, S, Hkv, hd)
+            )
+            h, k_slot, v_slot = gpt_prefill_chunk(
+                params, cfg, chunk_r, k_slot, v_slot, start, tl
+            )
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k_slot, (0, slot, 0, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v_slot, (0, slot, 0, 0, 0)
+            )
+        else:
+            trow = jax.lax.dynamic_slice(
+                page_table, (slot, 0), (1, page_table.shape[1])
+            )
+            h, k_cache, v_cache = gpt_prefill_chunk_paged(
+                params, cfg, chunk_r, k_cache, v_cache, trow, start, tl,
+                page=page_size,
+            )
+        h_last = jax.lax.dynamic_slice_in_dim(
+            h, jnp.maximum(tl - 1, 0), 1, axis=1
+        )
+        h_last = norm_fn(h_last, params["lnf_g"], params["lnf_b"])[:, 0]
+        logits = _lm_head(h_last, head_w)
+        key, sub = jax.random.split(pb_key0[r])
+        tok = sample_logits_batched(
+            sub[None], logits, pb_temp[r][None], pb_tk[r][None],
+            pb_tp[r][None],
+        )[0]
+        final = pb_final[r]
+        live = final & (pb_n_new[r] > 1) & (tok != pb_eos[r])
+        end = start + tl
+
+        def upd(arr, v, on=on, slot=slot):
+            old = arr[slot]
+            return jax.lax.dynamic_update_index_in_dim(
+                arr, jnp.where(on, v, old), slot, 0
+            )
+
+        # The sampling knobs / eos table are read-only fold inputs: the
+        # admission park already wrote the task's real knobs, and they
+        # never change over a task's lifetime, so only the arming state
+        # moves here (exactly chunk_impl's writes minus the knob
+        # re-writes).
+        cur = upd(cur, jnp.where(final, tok, 0))
+        pos = upd(pos, end)
+        keys = upd(keys, jnp.where(final, key, pb_key0[r]))
+        active = upd(active, live)
+        remaining = upd(remaining, jnp.where(final, pb_n_new[r] - 1, 0))
+        if hist is not None:
+            # Token-history heal for the drafters, identical to
+            # chunk_spec_impl's (tl = 0 leaves the row untouched).
+            S_ = hist.shape[1]
+            rows_ = jnp.arange(S_, dtype=jnp.int32)
+            hidx = rows_ - start
+            hvalid = (hidx >= 0) & (hidx < tl)
+            vals = pb_chunk[r][jnp.clip(hidx, 0, cb - 1)]
+            old_row = jax.lax.dynamic_slice(hist, (slot, 0), (1, S_))
+            new_row = jnp.where(hvalid[None], vals[None], old_row)
+            hist = jax.lax.dynamic_update_slice(hist, new_row, (slot, 0))
+        toks_out.append(
+            jnp.where(on & final, tok, jnp.asarray(-1, jnp.int32))
+        )
+    return (
+        jnp.stack(toks_out), cur, pos, keys, active, remaining,
+        k_cache, v_cache, hist,
+    )
+
+
 def gpt_decode_fold(
     params: Dict[str, Any],
     cfg: GPTConfig,
@@ -1474,6 +1612,7 @@ def gpt_decode_fold(
     fold: int,
     page_table: Optional[jax.Array] = None,
     page_size: int = 0,
+    piggyback: Optional[Tuple[jax.Array, ...]] = None,
 ) -> Tuple[jax.Array, ...]:
     """``fold`` decode+sample iterations in ONE traced program (a
     ``lax.scan`` over :func:`gpt_decode_step`) with per-slot in-graph
@@ -1500,7 +1639,10 @@ def gpt_decode_fold(
 
     Returns ``(tok_block (fold, B) int32 with -1 at non-emitted lanes,
     emit_block (fold, B) bool, cur, pos, keys, active, remaining,
-    k_cache, v_cache)``. ``fold=1`` is exactly one unfolded step.
+    k_cache, v_cache)``. ``fold=1`` is exactly one unfolded step. With
+    ``piggyback`` set (see :func:`_piggyback_prefill`) the fold also
+    runs up to C prefill-chunk rows after the scan — one fused dispatch
+    for all work — and appends ``pb_toks (C,)`` to the return tuple.
     """
 
     def body(carry, _):
@@ -1535,9 +1677,20 @@ def gpt_decode_fold(
         length=int(fold),
     )
     cur, pos, keys, active, remaining, k_cache, v_cache = carry
+    if piggyback is None:
+        return (
+            tok_block, emit_block, cur, pos, keys, active, remaining,
+            k_cache, v_cache,
+        )
+    (
+        pb_toks, cur, pos, keys, active, remaining, k_cache, v_cache, _,
+    ) = _piggyback_prefill(
+        params, cfg, piggyback, cur, pos, keys, active, remaining,
+        k_cache, v_cache, page_table=page_table, page_size=page_size,
+    )
     return (
         tok_block, emit_block, cur, pos, keys, active, remaining,
-        k_cache, v_cache,
+        k_cache, v_cache, pb_toks,
     )
 
 
@@ -1978,6 +2131,7 @@ def gpt_decode_fold_spec(
     draft_fn: Any,
     page_table: Optional[jax.Array] = None,
     page_size: int = 0,
+    piggyback: Optional[Tuple[jax.Array, ...]] = None,
 ) -> Tuple[jax.Array, ...]:
     """Speculative :func:`gpt_decode_fold`: each of the ``fold``
     iterations proposes up to ``depth`` tokens per slot (``draft_fn``),
@@ -2004,7 +2158,9 @@ def gpt_decode_fold_spec(
     token at its position, so the history is live up to ``pos[b]`` at
     every draft. Returns ``(tok_block (fold * (depth+1), B) int32 with
     -1 at non-emitted lanes, emit_block, cur, pos, keys, active,
-    remaining, hist, k_cache, v_cache)``.
+    remaining, hist, k_cache, v_cache)``; with ``piggyback`` set
+    (:func:`_piggyback_prefill`, which also heals the piggybacked
+    rows' token history) ``pb_toks (C,)`` is appended.
     """
     D = int(depth)
 
@@ -2083,10 +2239,25 @@ def gpt_decode_fold_spec(
     )
     cur, pos, keys, active, remaining, hist, k_cache, v_cache = carry
     B = cur.shape[0]
+    if piggyback is None:
+        return (
+            tok_block.reshape(int(fold) * (D + 1), B),
+            emit_block.reshape(int(fold) * (D + 1), B),
+            cur, pos, keys, active, remaining, hist, k_cache, v_cache,
+        )
+    (
+        pb_toks, cur, pos, keys, active, remaining, k_cache, v_cache,
+        hist,
+    ) = _piggyback_prefill(
+        params, cfg, piggyback, cur, pos, keys, active, remaining,
+        k_cache, v_cache, hist=hist, page_table=page_table,
+        page_size=page_size,
+    )
     return (
         tok_block.reshape(int(fold) * (D + 1), B),
         emit_block.reshape(int(fold) * (D + 1), B),
         cur, pos, keys, active, remaining, hist, k_cache, v_cache,
+        pb_toks,
     )
 
 

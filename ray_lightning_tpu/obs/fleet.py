@@ -80,7 +80,8 @@ def summarize_replica(
                     "fetches", "fetch_bytes", "fetch_timeouts",
                     "fetch_stale", "ships", "served_fetches",
                     "pending_fetches", "store_fetches",
-                    "store_fetch_misses",
+                    "store_fetch_misses", "layerwise", "layer_ships",
+                    "ship_partial_drops",
                 )
             }
             if isinstance(kvf, dict)
@@ -136,6 +137,26 @@ def summarize_replica(
             if isinstance(kv := stats.get("kv_pages"), dict)
             else None
         ),
+        # Fused-dispatch row: piggybacked prefill traffic + the fold
+        # ladder's per-depth dispatch counts (None when piggyback is
+        # off / the ladder has one rung) — `rlt top`'s pb column.
+        "piggyback": (
+            {
+                "chunks": pb.get("chunks", 0),
+                "dispatches": pb.get("dispatches", 0),
+                "chunk_rows": pb.get("chunk_rows", 0),
+            }
+            if isinstance(pb := stats.get("piggyback"), dict)
+            else None
+        ),
+        "fold_k": (
+            {
+                "ladder": fk.get("ladder") or [],
+                "dispatches": fk.get("dispatches") or {},
+            }
+            if isinstance(fk := stats.get("fold_k"), dict)
+            else None
+        ),
         "submitted": int(stats.get("submitted", 0)),
         "finished": int(stats.get("finished", 0)),
         "compiles_since_init": int(stats.get("compiles_since_init", 0)),
@@ -170,6 +191,16 @@ def aggregate_fleet(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             for k in kvf_rows
         ),
         "kvfleet_ships": sum(int(k.get("ships", 0)) for k in kvf_rows),
+        # Fused-dispatch roll-up: prefill chunk rows that rode decode
+        # folds fleet-wide (zeros when piggybacking is off).
+        "piggyback_dispatches": sum(
+            int((r.get("piggyback") or {}).get("dispatches", 0))
+            for r in rows
+        ),
+        "piggyback_chunk_rows": sum(
+            int((r.get("piggyback") or {}).get("chunk_rows", 0))
+            for r in rows
+        ),
         # Persistent store roll-up (zeros on storeless fleets). Note:
         # replicas sharing one store dir each count their own traffic,
         # so these are fleet I/O totals, not unique-entry counts.

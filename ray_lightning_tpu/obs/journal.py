@@ -99,6 +99,13 @@ def engine_header(
             "max_seq": engine.max_seq,
             "prefill_buckets": list(engine.prefill_buckets),
             "decode_fold": engine.decode_fold,
+            # Fused-dispatch knobs: a replay must rebuild the same
+            # pre-lowered fold ladder and piggyback row budget — the
+            # per-dispatch K choice and the chunk-rides-the-fold plan
+            # are deterministic functions of the op stream, but only on
+            # an engine built with the same knobs.
+            "fold_ladder": list(getattr(engine, "fold_ladder", ()) or ()),
+            "piggyback_chunks": getattr(engine, "piggyback_chunks", 0),
             "pipeline": engine.pipeline,
             "prefill_chunk": engine.prefill_chunk,
             # Paged engines fold the prefix pool into the page allocator:
@@ -133,6 +140,11 @@ def engine_header(
             # store dir reproduces recorded store hits.
             "kvstore_dir": getattr(engine, "kvstore_dir", None),
             "kvstore_mb": getattr(engine, "kvstore_mb", 0.0),
+            # Model-identity namespace: without it a replay against the
+            # recorded store dir would derive a namespace from ITS view
+            # of the config and could silently miss (or worse, hit a
+            # different model's entries).
+            "kvstore_namespace": getattr(engine, "kvstore_namespace", ""),
             "mesh": engine.mesh_desc,
         },
         "scheduler": {
@@ -476,6 +488,7 @@ _ENGINE_REBUILD_KEYS = frozenset((
     "spec", "spec_depth",
     "spec_window", "spec_draft_ckpt", "spec_draft_config",
     "spec_draft_int8", "mesh",
+    "fold_ladder", "piggyback_chunks", "kvstore_namespace",
 ))
 
 

@@ -1971,11 +1971,22 @@ def start_replicas(
     # manifest the router directory seeds from (seed_store_directory).
     kvstore = None
     if replica_kwargs.get("kvstore_dir"):
-        from ray_lightning_tpu.serve.kvstore import FleetKVStore
+        from ray_lightning_tpu.serve.kvstore import (
+            FleetKVStore,
+            kvstore_namespace,
+        )
 
+        # Same model-identity namespace the replicas derive in
+        # build_engine — the driver's manifest/write-through handle must
+        # see the same keys or warm-start would seed nothing.
+        ns = replica_kwargs.get("kvstore_namespace") or kvstore_namespace(
+            replica_kwargs.get("ckpt_path"),
+            replica_kwargs.get("model_config"),
+        )
         kvstore = FleetKVStore(
             str(replica_kwargs["kvstore_dir"]),
             budget_mb=float(replica_kwargs.get("kvstore_mb", 0.0)),
+            namespace=ns,
         )
     return ServeClient(
         replicas,

@@ -256,6 +256,8 @@ class DecodeEngine:
         max_seq: Optional[int] = None,
         prefill_buckets: Optional[Sequence[int]] = None,
         decode_fold: int = 1,
+        fold_ladder: Optional[Sequence[int]] = None,
+        piggyback_chunks: int = 0,
         pipeline: bool = True,
         prefill_chunk: int = 0,
         prefix_blocks: int = 0,
@@ -267,6 +269,7 @@ class DecodeEngine:
         kvstore_mb: float = 0.0,
         kv_page: int = 0,
         kv_pages: int = 0,
+        kvstore_namespace: Optional[str] = None,
         spec: str = "off",
         spec_depth: int = 4,
         spec_params: Any = None,
@@ -287,6 +290,31 @@ class DecodeEngine:
         self.decode_fold = int(decode_fold)
         if self.decode_fold < 1:
             raise ValueError("decode_fold must be >= 1")
+        # Dynamic fold depth: a small ladder of fold-K rungs, ALL
+        # pre-lowered at construction; _pick_fold_k chooses a rung per
+        # dispatch from queue pressure, so ladder switches never compile.
+        if fold_ladder:
+            ladder = tuple(sorted({int(k) for k in fold_ladder}))
+            if ladder[0] < 1:
+                raise ValueError(
+                    f"fold_ladder {list(fold_ladder)} rungs must be "
+                    ">= 1 (decode iterations per dispatch)"
+                )
+            if self.decode_fold not in ladder:
+                raise ValueError(
+                    f"fold_ladder {list(ladder)} must include decode_fold"
+                    f" {self.decode_fold} (the default rung)"
+                )
+        else:
+            ladder = (self.decode_fold,)
+        self.fold_ladder = ladder
+        self.piggyback_chunks = int(piggyback_chunks)
+        if not 0 <= self.piggyback_chunks <= self.num_slots:
+            raise ValueError(
+                f"piggyback_chunks {self.piggyback_chunks} must be in "
+                f"[0, num_slots={self.num_slots}] (prefill-chunk rows "
+                "fused into each decode dispatch; one row per slot)"
+            )
         self.pipeline = bool(pipeline)
         self.max_seq = int(max_seq or config.max_seq)
         if self.max_seq > config.max_seq:
@@ -361,6 +389,13 @@ class DecodeEngine:
             prefill_chunk = buckets[-1]
         self.prefill_chunk = int(prefill_chunk)
         self.chunked = self.prefill_chunk > 0
+        if self.piggyback_chunks and not self.chunked:
+            raise ValueError(
+                f"piggyback_chunks {self.piggyback_chunks} needs chunked "
+                "prefill (prefill_chunk > 0, or any prefix pool / paged "
+                "KV): only chunk-state-machine admissions can ride a "
+                "decode dispatch"
+            )
         if self.chunked:
             if self.prefill_chunk > self.max_seq:
                 raise ValueError(
@@ -409,14 +444,30 @@ class DecodeEngine:
         self.kvstore_dir = str(kvstore_dir) if kvstore_dir else None
         self.kvstore_mb = float(kvstore_mb)
         self.kvstore: Any = None
+        self.kvstore_namespace: Optional[str] = None
         if self.kvstore_dir:
             from ray_lightning_tpu.obs.registry import get_registry
-            from ray_lightning_tpu.serve.kvstore import FleetKVStore
+            from ray_lightning_tpu.serve.kvstore import (
+                FleetKVStore,
+                kvstore_namespace as _kvs_ns,
+            )
 
+            # Store identity: the shared store is content-addressed by
+            # token digests, which do NOT encode the model — namespace
+            # every key by the checkpoint identity (path + config hash
+            # when build_engine supplies it; config hash alone
+            # otherwise) so one store can never serve pages across
+            # model versions.
+            self.kvstore_namespace = (
+                str(kvstore_namespace)
+                if kvstore_namespace
+                else _kvs_ns(None, config)
+            )
             self.kvstore = FleetKVStore(
                 self.kvstore_dir,
                 budget_mb=self.kvstore_mb,
                 registry=get_registry(),
+                namespace=self.kvstore_namespace,
             )
         # Mesh-native serving (tensor-parallel decode): with a mesh
         # bound, every per-slot device tensor becomes a mesh-sharded
@@ -683,9 +734,53 @@ class DecodeEngine:
         self._slots: List[Optional[SlotInfo]] = [None] * B
         #: slot -> in-progress chunked admission (chunked mode only).
         self._prefills: Dict[int, PrefillTask] = {}
-        #: Double buffer: ((tok_block, emit_block), dispatch-time slot
-        #: snapshot) of the fold currently executing on device.
-        self._inflight: Optional[Tuple[Tuple[Any, Any], List[Optional[SlotInfo]]]] = None
+        #: Chunk completions of piggybacked FINAL rows, REPLACED at each
+        #: harvest (bounded by piggyback_chunks; the scheduler drains it
+        #: via pop_chunk_events — a host-side read, never broadcast, so
+        #: gang followers that never pop cannot leak).
+        self._pb_events: List[Tuple[int, PrefillTask, int, bool]] = []
+        #: Layer-pipelined imports in flight: digest -> staging record
+        #: {"idx": pool block, "next": layer expected, "n": n_layers}.
+        #: Staged blocks are UNKEYED (meta.digest None) and ref-pinned —
+        #: invisible to prefix matching and safe from eviction until the
+        #: last layer lands or the transfer aborts.
+        self._layer_imports: Dict[bytes, Dict[str, int]] = {}
+        self.layer_block_imports = 0
+        self.layer_import_aborts = 0
+        #: Fused-dispatch accounting (stats blocks + registry metrics).
+        self.piggyback_dispatches = 0
+        self.piggyback_chunk_rows = 0
+        self.fold_dispatches: Dict[int, int] = {
+            k: 0 for k in self.fold_ladder
+        }
+        from ray_lightning_tpu.obs.registry import get_registry as _greg
+
+        _reg = _greg()
+        self._m_pb_dispatches = _reg.counter(
+            "rlt_serve_piggyback_dispatches_total",
+            "Decode dispatches that carried >= 1 piggybacked prefill "
+            "chunk row",
+        )
+        self._m_pb_rows = _reg.counter(
+            "rlt_serve_piggyback_chunk_rows_total",
+            "Prefill chunk rows run inside decode dispatches",
+        )
+        self._m_fold_depth = _reg.histogram(
+            "rlt_serve_fold_depth",
+            "Fold depth K chosen per decode dispatch",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        #: Double buffer: ((tok_block, emit_block, pb_toks|None),
+        #: dispatch-time slot snapshot, piggybacked finals, fold K) of
+        #: the fold currently executing on device.
+        self._inflight: Optional[
+            Tuple[
+                Tuple[Any, Any, Any],
+                List[Optional[SlotInfo]],
+                List[Tuple[int, int, PrefillTask, Optional[SlotInfo]]],
+                int,
+            ]
+        ] = None
         #: Optional obs.trace.RequestTracer: the engine records the spans
         #: only it can see (prefill dispatches, chunk advances, prefix
         #: seeds). Set by the Scheduler/ServeReplica after construction;
@@ -818,45 +913,63 @@ class DecodeEngine:
                 tok,
             )
 
-        def step_impl(
-            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps,
-            keys, active, remaining, eos_toks,
-        ):
-            return gpt_decode_fold(
-                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
-                active, remaining, eos_toks, k_cache, v_cache,
-                fold=self.decode_fold,
-            )
+        # The fold factories take fold-K explicitly: one executable per
+        # ladder rung, all pre-lowered below, so _pick_fold_k switches
+        # depth per dispatch with zero steady-state compiles. The *pb
+        # tail (empty when piggyback is off) carries the fused
+        # prefill-chunk rows — appended AFTER the existing args so the
+        # donation indices never move.
+        def make_step_impl(fold_k):
+            def step_impl(
+                params, k_cache, v_cache, cur, pos, temps, top_ks,
+                top_ps, keys, active, remaining, eos_toks, *pb,
+            ):
+                return gpt_decode_fold(
+                    params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                    active, remaining, eos_toks, k_cache, v_cache,
+                    fold=fold_k, piggyback=pb or None,
+                )
+
+            return step_impl
 
         # Speculative step: drafter + verify + accept live INSIDE the one
         # folded executable — one dispatch per fold iteration, compile
         # count unchanged by the drafter choice.
-        def step_spec_impl(
-            params, k_cache, v_cache, cur, pos, temps, top_ks, top_ps,
-            keys, active, remaining, eos_toks, hist,
-        ):
-            return gpt_decode_fold_spec(
-                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
-                active, remaining, eos_toks, hist, k_cache, v_cache,
-                fold=self.decode_fold, depth=self.spec_depth,
-                draft_fn=lambda h, p, c: ngram_propose(
-                    h, p, c, depth=self.spec_depth
-                ),
-            )
+        def make_step_spec_impl(fold_k):
+            def step_spec_impl(
+                params, k_cache, v_cache, cur, pos, temps, top_ks,
+                top_ps, keys, active, remaining, eos_toks, hist, *pb,
+            ):
+                return gpt_decode_fold_spec(
+                    params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                    active, remaining, eos_toks, hist, k_cache, v_cache,
+                    fold=fold_k, depth=self.spec_depth,
+                    draft_fn=lambda h, p, c: ngram_propose(
+                        h, p, c, depth=self.spec_depth
+                    ),
+                    piggyback=pb or None,
+                )
 
-        def step_spec_model_impl(
-            params, dparams, k_cache, v_cache, cur, pos, temps, top_ks,
-            top_ps, keys, active, remaining, eos_toks, hist,
-        ):
-            return gpt_decode_fold_spec(
-                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
-                active, remaining, eos_toks, hist, k_cache, v_cache,
-                fold=self.decode_fold, depth=self.spec_depth,
-                draft_fn=lambda h, p, c: model_propose(
-                    dparams, self._spec_cfg, h, p, c,
-                    depth=self.spec_depth, window=self.spec_window,
-                ),
-            )
+            return step_spec_impl
+
+        def make_step_spec_model_impl(fold_k):
+            def step_spec_model_impl(
+                params, dparams, k_cache, v_cache, cur, pos, temps,
+                top_ks, top_ps, keys, active, remaining, eos_toks, hist,
+                *pb,
+            ):
+                return gpt_decode_fold_spec(
+                    params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                    active, remaining, eos_toks, hist, k_cache, v_cache,
+                    fold=fold_k, depth=self.spec_depth,
+                    draft_fn=lambda h, p, c: model_propose(
+                        dparams, self._spec_cfg, h, p, c,
+                        depth=self.spec_depth, window=self.spec_window,
+                    ),
+                    piggyback=pb or None,
+                )
+
+            return step_spec_model_impl
 
         def hist_write_impl(hist, slot, row, length):
             # Seed one slot's token history rows [0, length) from a
@@ -1105,44 +1218,57 @@ class DecodeEngine:
             hist = jax.lax.dynamic_update_slice(hist, new, (slot, 0))
             return out + (hist,)
 
-        def step_paged_impl(
-            params, pool_k, pool_v, table, cur, pos, temps, top_ks,
-            top_ps, keys, active, remaining, eos_toks,
-        ):
-            return gpt_decode_fold(
-                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
-                active, remaining, eos_toks, pool_k, pool_v,
-                fold=self.decode_fold, page_table=table, page_size=page,
-            )
+        def make_step_paged_impl(fold_k):
+            def step_paged_impl(
+                params, pool_k, pool_v, table, cur, pos, temps, top_ks,
+                top_ps, keys, active, remaining, eos_toks, *pb,
+            ):
+                return gpt_decode_fold(
+                    params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                    active, remaining, eos_toks, pool_k, pool_v,
+                    fold=fold_k, page_table=table, page_size=page,
+                    piggyback=pb or None,
+                )
 
-        def step_paged_spec_impl(
-            params, pool_k, pool_v, table, cur, pos, temps, top_ks,
-            top_ps, keys, active, remaining, eos_toks, hist,
-        ):
-            return gpt_decode_fold_spec(
-                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
-                active, remaining, eos_toks, hist, pool_k, pool_v,
-                fold=self.decode_fold, depth=self.spec_depth,
-                draft_fn=lambda h, p, c: ngram_propose(
-                    h, p, c, depth=self.spec_depth
-                ),
-                page_table=table, page_size=page,
-            )
+            return step_paged_impl
 
-        def step_paged_spec_model_impl(
-            params, dparams, pool_k, pool_v, table, cur, pos, temps,
-            top_ks, top_ps, keys, active, remaining, eos_toks, hist,
-        ):
-            return gpt_decode_fold_spec(
-                params, cfg, cur, pos, keys, temps, top_ks, top_ps,
-                active, remaining, eos_toks, hist, pool_k, pool_v,
-                fold=self.decode_fold, depth=self.spec_depth,
-                draft_fn=lambda h, p, c: model_propose(
-                    dparams, self._spec_cfg, h, p, c,
-                    depth=self.spec_depth, window=self.spec_window,
-                ),
-                page_table=table, page_size=page,
-            )
+        def make_step_paged_spec_impl(fold_k):
+            def step_paged_spec_impl(
+                params, pool_k, pool_v, table, cur, pos, temps, top_ks,
+                top_ps, keys, active, remaining, eos_toks, hist, *pb,
+            ):
+                return gpt_decode_fold_spec(
+                    params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                    active, remaining, eos_toks, hist, pool_k, pool_v,
+                    fold=fold_k, depth=self.spec_depth,
+                    draft_fn=lambda h, p, c: ngram_propose(
+                        h, p, c, depth=self.spec_depth
+                    ),
+                    page_table=table, page_size=page,
+                    piggyback=pb or None,
+                )
+
+            return step_paged_spec_impl
+
+        def make_step_paged_spec_model_impl(fold_k):
+            def step_paged_spec_model_impl(
+                params, dparams, pool_k, pool_v, table, cur, pos, temps,
+                top_ks, top_ps, keys, active, remaining, eos_toks, hist,
+                *pb,
+            ):
+                return gpt_decode_fold_spec(
+                    params, cfg, cur, pos, keys, temps, top_ks, top_ps,
+                    active, remaining, eos_toks, hist, pool_k, pool_v,
+                    fold=fold_k, depth=self.spec_depth,
+                    draft_fn=lambda h, p, c: model_propose(
+                        dparams, self._spec_cfg, h, p, c,
+                        depth=self.spec_depth, window=self.spec_window,
+                    ),
+                    page_table=table, page_size=page,
+                    piggyback=pb or None,
+                )
+
+            return step_paged_spec_model_impl
 
         def table_write_impl(table, slot, row):
             # One slot's whole page-table row in one tiny executable —
@@ -1373,77 +1499,69 @@ class DecodeEngine:
                 .compile()
             )
             self.compiled_count += 1
+            self._pool_layer_write_exec = None
+            if not mesh_on:
+                # Layer-pipelined imports: one LAYER of one block lands
+                # per write, so a disaggregated prefill's pages start
+                # streaming in while upper layers are still computing.
+                # Single-device only — mesh shard-dict payloads arrive
+                # whole-block and fall back to _pool_write_exec.
+                def pool_layer_write_impl(
+                    pool_k, pool_v, kl, vl, block, layer
+                ):
+                    pool_k = jax.lax.dynamic_update_slice(
+                        pool_k, kl, (layer, block, 0, 0, 0)
+                    )
+                    pool_v = jax.lax.dynamic_update_slice(
+                        pool_v, vl, (layer, block, 0, 0, 0)
+                    )
+                    return pool_k, pool_v
+
+                lyr_spec = jax.ShapeDtypeStruct(
+                    (1, 1, bs, Hkv, hd), jnp.dtype(cfg.compute_dtype)
+                )
+                self._pool_layer_write_exec = (
+                    jit_exec(pool_layer_write_impl, (0, 1), None)
+                    .lower(
+                        pool_spec, pool_spec, lyr_spec, lyr_spec, i32,
+                        i32,
+                    )
+                    .compile()
+                )
+                self.compiled_count += 1
         # The folded step: caches + in-graph-updated state donated; the
         # sampling knobs and eos table are read-only inputs (slot writes
         # own their updates). With spec on the token history rides the
         # same donation chain, and the drafter (n-gram search or draft
-        # model) compiles INTO this one executable.
+        # model) compiles INTO this one executable. One executable per
+        # fold_ladder rung; with piggyback on, each also carries the
+        # C-row prefill-chunk tail (read-only, replicated) and returns
+        # the piggybacked first-token samples appended to its outputs.
+        pbC = self.piggyback_chunks
+        pb_specs: Tuple[Any, ...] = ()
+        if pbC:
+            i32C = jax.ShapeDtypeStruct((pbC,), np.int32, sharding=sc_sh)
+            f32C = jax.ShapeDtypeStruct(
+                (pbC,), np.float32, sharding=sc_sh
+            )
+            b1C = jax.ShapeDtypeStruct((pbC,), np.bool_, sharding=sc_sh)
+            pb_specs = (
+                jax.ShapeDtypeStruct(
+                    (pbC, self.prefill_chunk), np.int32, sharding=sc_sh
+                ),
+                i32C, i32C, i32C,
+                jax.ShapeDtypeStruct((pbC, 2), np.uint32, sharding=sc_sh),
+                f32C, i32C, f32C, i32C, i32C, b1C, b1C,
+            )
         step_out = None
         step_spec_out = None
         if mesh_on:
             tail = (pool_out, pool_out) if paged else (cache_out, cache_out)
-            step_out = (rep_sh,) * 7 + tail
-            step_spec_out = (rep_sh,) * 8 + tail
-        if paged:
-            # Paged fold: the pools + the (read-only) page table replace
-            # the dense caches; donation covers pools + in-graph state.
-            if not spec_on:
-                self._step_exec = (
-                    jit_exec(
-                        step_paged_impl, (1, 2, 4, 5, 9, 10, 11), step_out
-                    )
-                    .lower(p_spec, pool_spec, pool_spec, table_spec,
-                           *state_specs)
-                    .compile()
-                )
-            elif self.spec == "ngram":
-                self._step_exec = (
-                    jit_exec(
-                        step_paged_spec_impl,
-                        (1, 2, 4, 5, 9, 10, 11, 13),
-                        step_spec_out,
-                    )
-                    .lower(p_spec, pool_spec, pool_spec, table_spec,
-                           *state_specs, hist_spec)
-                    .compile()
-                )
-            else:
-                dp_spec = jax.tree_util.tree_map(
-                    lambda a: jax.ShapeDtypeStruct(
-                        a.shape,
-                        a.dtype,
-                        sharding=a.sharding if mesh_on else None,
-                    ),
-                    self._spec_params,
-                )
-                self._step_exec = (
-                    jit_exec(
-                        step_paged_spec_model_impl,
-                        (2, 3, 5, 6, 10, 11, 12, 14),
-                        step_spec_out,
-                    )
-                    .lower(p_spec, dp_spec, pool_spec, pool_spec,
-                           table_spec, *state_specs, hist_spec)
-                    .compile()
-                )
-        elif not spec_on:
-            self._step_exec = (
-                jit_exec(step_impl, (1, 2, 3, 4, 8, 9, 10), step_out)
-                .lower(p_spec, cache_spec, cache_spec, *state_specs)
-                .compile()
-            )
-        elif self.spec == "ngram":
-            self._step_exec = (
-                jit_exec(
-                    step_spec_impl,
-                    (1, 2, 3, 4, 8, 9, 10, 12),
-                    step_spec_out,
-                )
-                .lower(p_spec, cache_spec, cache_spec, *state_specs,
-                       hist_spec)
-                .compile()
-            )
-        else:
+            pb_tail = (rep_sh,) if pbC else ()
+            step_out = (rep_sh,) * 7 + tail + pb_tail
+            step_spec_out = (rep_sh,) * 8 + tail + pb_tail
+        dp_spec = None
+        if self.spec == "model":
             dp_spec = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(
                     a.shape,
@@ -1452,17 +1570,79 @@ class DecodeEngine:
                 ),
                 self._spec_params,
             )
-            self._step_exec = (
-                jit_exec(
-                    step_spec_model_impl,
-                    (2, 3, 4, 5, 9, 10, 11, 13),
-                    step_spec_out,
+        self._step_exec: Dict[int, Any] = {}
+        for fk in self.fold_ladder:
+            if paged:
+                # Paged fold: the pools + the (read-only) page table
+                # replace the dense caches; donation covers pools +
+                # in-graph state.
+                if not spec_on:
+                    self._step_exec[fk] = (
+                        jit_exec(
+                            make_step_paged_impl(fk),
+                            (1, 2, 4, 5, 9, 10, 11),
+                            step_out,
+                        )
+                        .lower(p_spec, pool_spec, pool_spec, table_spec,
+                               *state_specs, *pb_specs)
+                        .compile()
+                    )
+                elif self.spec == "ngram":
+                    self._step_exec[fk] = (
+                        jit_exec(
+                            make_step_paged_spec_impl(fk),
+                            (1, 2, 4, 5, 9, 10, 11, 13),
+                            step_spec_out,
+                        )
+                        .lower(p_spec, pool_spec, pool_spec, table_spec,
+                               *state_specs, hist_spec, *pb_specs)
+                        .compile()
+                    )
+                else:
+                    self._step_exec[fk] = (
+                        jit_exec(
+                            make_step_paged_spec_model_impl(fk),
+                            (2, 3, 5, 6, 10, 11, 12, 14),
+                            step_spec_out,
+                        )
+                        .lower(p_spec, dp_spec, pool_spec, pool_spec,
+                               table_spec, *state_specs, hist_spec,
+                               *pb_specs)
+                        .compile()
+                    )
+            elif not spec_on:
+                self._step_exec[fk] = (
+                    jit_exec(
+                        make_step_impl(fk), (1, 2, 3, 4, 8, 9, 10),
+                        step_out,
+                    )
+                    .lower(p_spec, cache_spec, cache_spec, *state_specs,
+                           *pb_specs)
+                    .compile()
                 )
-                .lower(p_spec, dp_spec, cache_spec, cache_spec,
-                       *state_specs, hist_spec)
-                .compile()
-            )
-        self.compiled_count += 1
+            elif self.spec == "ngram":
+                self._step_exec[fk] = (
+                    jit_exec(
+                        make_step_spec_impl(fk),
+                        (1, 2, 3, 4, 8, 9, 10, 12),
+                        step_spec_out,
+                    )
+                    .lower(p_spec, cache_spec, cache_spec, *state_specs,
+                           hist_spec, *pb_specs)
+                    .compile()
+                )
+            else:
+                self._step_exec[fk] = (
+                    jit_exec(
+                        make_step_spec_model_impl(fk),
+                        (2, 3, 4, 5, 9, 10, 11, 13),
+                        step_spec_out,
+                    )
+                    .lower(p_spec, dp_spec, cache_spec, cache_spec,
+                           *state_specs, hist_spec, *pb_specs)
+                    .compile()
+                )
+            self.compiled_count += 1
         if paged:
             self._table_write_exec = (
                 jit_exec(table_write_impl, (0,), rep_sh if mesh_on else None)
@@ -1917,10 +2097,19 @@ class DecodeEngine:
                         self._pool_meta[b].refs += 1
                 # Park the slot: inactive, pos at the first unseeded row
                 # (the only row interleaved folds can scribble on; the
-                # first chunk rewrites it before reading).
+                # first chunk rewrites it before reading). The REAL
+                # sampling knobs + eos go in now: the piggybacked chunk
+                # path reads them from device state (the fused fold's
+                # knob arrays are read-only inputs), while the separate
+                # chunk executables overwrite them redundantly — same
+                # values, bit-identical either way.
+                top_k = r.get("top_k")
+                top_p = r.get("top_p")
                 self._slot_write(
-                    slot, 0, matched, 0.0, 0, 1.0,
-                    np.zeros(2, np.uint32), False, 0, -1,
+                    slot, 0, matched, float(r.get("temperature", 0.0)),
+                    0 if top_k is None else int(top_k),
+                    1.0 if top_p is None else float(top_p),
+                    key0, False, 0, eos,
                 )
                 if self.spec != "off":
                     # The whole prompt (matched prefix included — the
@@ -1951,8 +2140,6 @@ class DecodeEngine:
                             },
                         },
                     )
-                top_k = r.get("top_k")
-                top_p = r.get("top_p")
                 self._prefills[slot] = PrefillTask(
                     request_id=r["request_id"],
                     tokens=prompt,
@@ -2750,6 +2937,91 @@ class DecodeEngine:
             )
         return accepted
 
+    def import_prefix_block_layer(
+        self, hexd: str, kp: Any, vp: Any, layer: int, n_layers: int
+    ) -> bool:
+        """Accept ONE LAYER of a peer's prefix block (layer-pipelined
+        shipping): the block stages into an UNKEYED, refs-pinned pool
+        slot — invisible to prefix matching (``digest=None``) and safe
+        from eviction — and only gains its digest when the last layer
+        lands, so a half-shipped block can never serve a hit. Layers
+        must arrive in order (the sender streams them in order; a gap
+        means a lost/aborted transfer) — out-of-order arrival aborts the
+        staging and returns False so the caller falls back to
+        whole-prompt shipping or cold prefill. Returns True when the
+        layer was absorbed (including the block-already-resident case,
+        where the rest of the stream is dropped as a no-op)."""
+        if not self.prefix_blocks or self._pool_layer_write_exec is None:
+            return False
+        d = bytes.fromhex(hexd)
+        resident = self._pool_map.get(d)
+        if resident is not None:
+            # Already keyed (alias admitted it, a local prefill finished
+            # first, or a concurrent import won): LRU-touch, swallow the
+            # stream — and drop any half-staged twin so its pin can't
+            # leak.
+            self._pool_tick += 1
+            self._pool_meta[resident].stamp = self._pool_tick
+            if d in self._layer_imports:
+                self.abort_layer_imports([hexd])
+            return True
+        st = self._layer_imports.get(d)
+        if st is None:
+            if layer != 0:
+                return False
+            idx = self._pool_alloc()
+            if idx is None:
+                return False
+            self._pool_tick += 1
+            self._pool_meta[idx] = _PoolBlock(
+                digest=None, refs=1, stamp=self._pool_tick
+            )
+            st = {"idx": idx, "next": 0, "n": int(n_layers)}
+            self._layer_imports[d] = st
+        if layer != st["next"]:
+            self.abort_layer_imports([hexd])
+            return False
+        kl = np.ascontiguousarray(kp)
+        vl = np.ascontiguousarray(vp)
+        self._pool_k, self._pool_v = self._pool_layer_write_exec(
+            self._pool_k, self._pool_v, kl, vl,
+            np.int32(st["idx"]), np.int32(layer),
+        )
+        st["next"] += 1
+        if st["next"] < st["n"]:
+            return True
+        # Last layer: key the digest — the block becomes matchable and
+        # evictable in the same instant, exactly like a whole-block
+        # import landing.
+        idx = st["idx"]
+        meta = self._pool_meta[idx]
+        meta.digest = d
+        meta.refs = 0
+        self._pool_map[d] = idx
+        if self._tiered:
+            self._host_map.pop(d, None)
+            if d in self._disk_map:
+                self._disk_drop(d)
+        del self._layer_imports[d]
+        self.layer_block_imports += 1
+        self.prefix_handoff_imports += 1
+        return True
+
+    def abort_layer_imports(self, digests_hex: Sequence[str]) -> None:
+        """Tear down half-staged layer imports (sender died mid-stream,
+        out-of-order layer, deadline passed): the pinned unkeyed slots go
+        straight back to the free list — nothing was ever matchable, so
+        nothing can dangle."""
+        for hexd in digests_hex:
+            st = self._layer_imports.pop(bytes.fromhex(hexd), None)
+            if st is None:
+                continue
+            idx = st["idx"]
+            self._pool_meta[idx] = None
+            self._pool_free.append(idx)
+            self.page_frees += 1
+            self.layer_import_aborts += 1
+
     def _insert_prefix(self, slot: int, tokens: np.ndarray) -> None:
         """Insert the freshly-prefilled prompt's full blocks (slot rows ->
         pool, compiled copy). Chain-ordered: stop at the first block that
@@ -2943,87 +3215,227 @@ class DecodeEngine:
         self._release_pages(slot)
 
     # -- the hot loop ----------------------------------------------------
-    def _dispatch(self) -> Tuple[Tuple[Any, Any], List[Optional[SlotInfo]]]:
+    def _pick_fold_k(self) -> int:
+        """Choose this dispatch's fold depth from the pre-lowered ladder —
+        a pure function of the op stream (slot bookkeeping + prefill
+        queue), so every gang member picks the same rung without any
+        cross-host chatter. Shallow under pressure (pending prefills want
+        frequent piggyback rows; short-remaining slots would waste deep
+        folds on frozen iterations), deep when every resident has runway.
+        Ladder switches hit pre-compiled executables: zero steady-state
+        compiles by construction."""
+        ladder = self.fold_ladder
+        if len(ladder) == 1:
+            return ladder[0]
+        if self._prefills:
+            # Admissions in flight: shallowest rung so piggybacked chunk
+            # rows (and, without piggyback, interleaved chunk dispatches)
+            # get a slice of the device as often as possible.
+            return ladder[0]
+        runway = 0
+        for info in self._slots:
+            if info is None or info.released:
+                continue
+            runway = max(runway, info.max_new_tokens - info.n_generated)
+        best = ladder[0]
+        for k in ladder:
+            if k <= runway and k > best:
+                best = k
+        return best
+
+    def _plan_piggyback(
+        self,
+    ) -> Tuple[
+        Tuple[Any, ...],
+        List[Tuple[int, int, PrefillTask, Optional[SlotInfo]]],
+        List[Tuple[int, np.ndarray]],
+        int,
+    ]:
+        """Build the piggyback tail for one fused dispatch: up to
+        ``piggyback_chunks`` rows of prefill-chunk work, one per
+        prefilling slot in slot order (the same round-robin key
+        ``prefill_step`` uses, so the op stream stays gang-deterministic).
+        Host bookkeeping advances NOW — tasks step forward, finals leave
+        ``_prefills`` and arm their ``SlotInfo`` — because by the time the
+        fused executable is enqueued the device work is as committed as a
+        separate chunk dispatch would be; only the final's first TOKEN is
+        deferred to harvest. Returns ``(pb_args, finals, inserts, n_on)``
+        where ``inserts`` are prefix-pool insertions that MUST run after
+        the fold is enqueued (their copy executables chain on the donated
+        caches and must read post-chunk bytes)."""
+        C = self.piggyback_chunks
+        cb = self.prefill_chunk
+        chunk = np.zeros((C, cb), np.int32)
+        start = np.zeros(C, np.int32)
+        length = np.zeros(C, np.int32)
+        slot_ix = np.zeros(C, np.int32)
+        key0 = np.zeros((C, 2), np.uint32)
+        temp = np.zeros(C, np.float32)
+        tks = np.zeros(C, np.int32)
+        tps = np.ones(C, np.float32)
+        n_new = np.zeros(C, np.int32)
+        eos = np.full(C, -1, np.int32)
+        final = np.zeros(C, np.bool_)
+        on = np.zeros(C, np.bool_)
+        finals: List[Tuple[int, int, PrefillTask, Optional[SlotInfo]]] = []
+        inserts: List[Tuple[int, np.ndarray]] = []
+        r = 0
+        for slot in sorted(self._prefills):
+            if r >= C:
+                break
+            task = self._prefills[slot]
+            P = len(task.tokens)
+            this_len = min(cb, P - task.next)
+            is_final = task.next + this_len >= P
+            chunk[r, :this_len] = task.tokens[
+                task.next : task.next + this_len
+            ]
+            start[r] = task.next
+            length[r] = this_len
+            slot_ix[r] = slot
+            key0[r] = task.key0
+            temp[r] = task.temperature
+            tks[r] = task.top_k
+            tps[r] = task.top_p
+            n_new[r] = task.max_new_tokens
+            eos[r] = task.eos_token
+            final[r] = is_final
+            on[r] = True
+            task.next += this_len
+            task.chunks += 1
+            if self.tracer is not None:
+                from ray_lightning_tpu.obs.trace import SPAN_PREFILL_CHUNK
+
+                self.tracer.event(
+                    task.request_id, SPAN_PREFILL_CHUNK,
+                    attrs={
+                        "index": task.chunks - 1,
+                        "tokens": this_len,
+                        "start": task.next - this_len,
+                        "slot": slot,
+                        "final": is_final,
+                        "piggyback": True,
+                    },
+                )
+            if is_final:
+                del self._prefills[slot]
+                self._unref_blocks(task)
+                inserts.append((slot, task.tokens))
+                # Arm the slot NOW (the device's own `live` predicate
+                # already froze done-at-first-token requests) so a
+                # pipelined fold N+1 snapshot carries the tenant; the
+                # first token itself is harvested from pb_toks later.
+                info = SlotInfo(
+                    request_id=task.request_id,
+                    max_new_tokens=task.max_new_tokens,
+                    n_generated=1,
+                    eos_token=task.eos_token,
+                )
+                self._slots[slot] = info
+                finals.append((r, slot, task, info))
+            r += 1
+        pb_args = (
+            chunk, start, length, slot_ix, key0, temp, tks, tps,
+            n_new, eos, final, on,
+        )
+        return pb_args, finals, inserts, r
+
+    def _dispatch(
+        self,
+    ) -> Tuple[
+        Tuple[Any, Any, Any],
+        List[Optional[SlotInfo]],
+        List[Tuple[int, int, PrefillTask, Optional[SlotInfo]]],
+        int,
+    ]:
         """Launch one fold against the current device state (async); the
         donated state arrays are replaced by the fold's outputs, so
         subsequent writes (admission, eviction) queue after it. With
         spec on the fold is propose-then-verify: the token block grows to
-        ``fold * (spec_depth + 1)`` rows, most of them non-emitted."""
+        ``fold * (spec_depth + 1)`` rows, most of them non-emitted. With
+        piggyback on, up to C prefill-chunk rows ride the SAME dispatch
+        (their first-token samples come back appended), and the fold
+        depth K is picked per dispatch from the pre-lowered ladder."""
+        k = self._pick_fold_k()
+        self.fold_dispatches[k] = self.fold_dispatches.get(k, 0) + 1
+        self._m_fold_depth.observe(float(k))
+        pb_args: Tuple[Any, ...] = ()
+        pb_finals: List[
+            Tuple[int, int, PrefillTask, Optional[SlotInfo]]
+        ] = []
+        inserts: List[Tuple[int, np.ndarray]] = []
+        if self.piggyback_chunks:
+            pb_args, pb_finals, inserts, n_on = self._plan_piggyback()
+            if n_on:
+                self.piggyback_dispatches += 1
+                self.piggyback_chunk_rows += n_on
+                self._m_pb_dispatches.inc()
+                self._m_pb_rows.inc(float(n_on))
+        spec_on = self.spec != "off"
+        args: List[Any] = [self.params]
+        if self.spec == "model":
+            args.append(self._spec_params)
         if self.paged:
             # Same shapes of state in and out; the pools + the read-only
             # page table stand in for the dense caches.
-            args = [self.params]
-            if self.spec == "model":
-                args.append(self._spec_params)
             args += [self._pool_k, self._pool_v, self._table]
-            if self.spec == "off":
-                args += [
-                    self._cur, self._pos, self._temps, self._top_ks,
-                    self._top_ps, self._keys, self._active,
-                    self._remaining, self._eos,
-                ]
-                (
-                    tok_block, emit_block, self._cur, self._pos,
-                    self._keys, self._active, self._remaining,
-                    self._pool_k, self._pool_v,
-                ) = self._step_exec(*args)
-            else:
-                args += [
-                    self._cur, self._pos, self._temps, self._top_ks,
-                    self._top_ps, self._keys, self._active,
-                    self._remaining, self._eos, self._hist,
-                ]
-                (
-                    tok_block, emit_block, self._cur, self._pos,
-                    self._keys, self._active, self._remaining,
-                    self._hist, self._pool_k, self._pool_v,
-                ) = self._step_exec(*args)
-            return (tok_block, emit_block), list(self._slots)
-        if self.spec == "off":
+        else:
+            args += [self._k, self._v]
+        args += [
+            self._cur, self._pos, self._temps, self._top_ks,
+            self._top_ps, self._keys, self._active, self._remaining,
+            self._eos,
+        ]
+        if spec_on:
+            args.append(self._hist)
+        res = self._step_exec[k](*args, *pb_args)
+        pb_toks = None
+        if self.piggyback_chunks:
+            pb_toks = res[-1]
+            res = res[:-1]
+        if spec_on:
             (
                 tok_block, emit_block, self._cur, self._pos, self._keys,
-                self._active, self._remaining, self._k, self._v,
-            ) = self._step_exec(
-                self.params,
-                self._k,
-                self._v,
-                self._cur,
-                self._pos,
-                self._temps,
-                self._top_ks,
-                self._top_ps,
-                self._keys,
-                self._active,
-                self._remaining,
-                self._eos,
-            )
-            return (tok_block, emit_block), list(self._slots)
-        args = [self.params]
-        if self.spec == "model":
-            args.append(self._spec_params)
-        args += [
-            self._k, self._v, self._cur, self._pos, self._temps,
-            self._top_ks, self._top_ps, self._keys, self._active,
-            self._remaining, self._eos, self._hist,
-        ]
-        (
-            tok_block, emit_block, self._cur, self._pos, self._keys,
-            self._active, self._remaining, self._hist, self._k, self._v,
-        ) = self._step_exec(*args)
-        return (tok_block, emit_block), list(self._slots)
+                self._active, self._remaining, self._hist, c0, c1,
+            ) = res
+        else:
+            (
+                tok_block, emit_block, self._cur, self._pos, self._keys,
+                self._active, self._remaining, c0, c1,
+            ) = res
+        if self.paged:
+            self._pool_k, self._pool_v = c0, c1
+        else:
+            self._k, self._v = c0, c1
+        # Deferred prefix inserts: their copy/registration executables
+        # chain on the caches just donated to the fold above, so they
+        # read the post-chunk bytes — never the pre-chunk ones.
+        for slot, tokens in inserts:
+            self._insert_prefix(slot, tokens)
+        return (
+            (tok_block, emit_block, pb_toks),
+            list(self._slots),
+            pb_finals,
+            k,
+        )
 
-    def _want_next(self, snapshot: List[Optional[SlotInfo]]) -> bool:
+    def _want_next(
+        self, snapshot: List[Optional[SlotInfo]], k_used: int
+    ) -> bool:
         """Speculation predicate: dispatch fold N+1 before harvesting fold
-        N iff some occupied slot can outlive fold N by token count. (An
-        EOS inside fold N can still idle the speculative fold — frozen
-        slots emit nothing, so it only costs compute, never correctness.)
-        With spec on, fold N consumes AT LEAST decode_fold tokens per
-        live slot (each verify emits >= 1) and up to (depth+1)x that;
-        speculating on the minimum keeps the pipeline full on low-accept
-        workloads at the price of an occasional idle fold on high-accept
-        ones.
+        N iff some occupied slot can outlive fold N by token count, or a
+        prefill is pending and piggyback is on (each fused dispatch
+        advances the prefill queue, so this terminates). (An EOS inside
+        fold N can still idle the speculative fold — frozen slots emit
+        nothing, so it only costs compute, never correctness.) With spec
+        on, fold N consumes AT LEAST ``k_used`` tokens per live slot
+        (each verify emits >= 1) and up to (depth+1)x that; speculating
+        on the minimum keeps the pipeline full on low-accept workloads at
+        the price of an occasional idle fold on high-accept ones.
         """
-        K = self.decode_fold
+        if self.piggyback_chunks and self._prefills:
+            return True
+        K = k_used
         for slot, info in enumerate(self._slots):
             if info is None:
                 continue
@@ -3034,27 +3446,46 @@ class DecodeEngine:
 
     def step(self) -> List[Tuple[int, str, int, bool]]:
         """One fold boundary: dispatch (double-buffered) and fan out up to
-        ``decode_fold`` tokens per occupied slot, in fold order; returns
+        ``fold K`` tokens per occupied slot, in fold order; returns
         ``(slot, request_id, token, done)`` per emitted token. Finished
-        slots are evicted and recycled before returning."""
+        slots are evicted and recycled before returning. Piggybacked
+        prefill completions are NOT returned here — the scheduler reads
+        them via :meth:`pop_chunk_events` right after this call."""
         if self._inflight is None:
-            # Only DECODING residents warrant a fold (mid-prefill slots
-            # are parked inactive and emit nothing).
-            if not any(s is not None for s in self._slots):
+            # Only DECODING residents (or, with piggyback on, pending
+            # prefill chunks) warrant a fold — otherwise parked slots
+            # emit nothing and the dispatch would be pure waste.
+            if not any(s is not None for s in self._slots) and not (
+                self.piggyback_chunks and self._prefills
+            ):
                 return []
             self._inflight = self._dispatch()
-        outs, snapshot = self._inflight
+        outs, snapshot, pb_finals, k_used = self._inflight
         self._inflight = (
             self._dispatch()
-            if self.pipeline and self._want_next(snapshot)
+            if self.pipeline and self._want_next(snapshot, k_used)
             else None
         )
-        return self._harvest(outs, snapshot)
+        return self._harvest(outs, snapshot, pb_finals)
+
+    def pop_chunk_events(self) -> List[Tuple[int, PrefillTask, int, bool]]:
+        """Drain the piggybacked prefill completions of the LAST harvested
+        fold — same ``(slot, task, first_token, done)`` rows
+        ``prefill_step`` returns, so the scheduler's completion plumbing
+        is shared verbatim. Host-side read, never broadcast: gang
+        followers that don't pop still converge because the buffer is
+        REPLACED (not appended) every harvest."""
+        out = self._pb_events
+        self._pb_events = []
+        return out
 
     def _harvest(
         self,
-        outs: Tuple[Any, Any],
+        outs: Tuple[Any, Any, Any],
         snapshot: List[Optional[SlotInfo]],
+        pb_finals: Sequence[
+            Tuple[int, int, PrefillTask, Optional[SlotInfo]]
+        ] = (),
     ) -> List[Tuple[int, str, int, bool]]:
         # The ONE D2H sync per fold: the (K, B) token block + emit mask
         # (K = fold * (spec_depth + 1) with spec on).
@@ -3099,6 +3530,24 @@ class DecodeEngine:
             self.spec_accepted_tokens += sum(
                 m - 1 for m in counts.values()
             )
+        if pb_finals:
+            # Piggybacked prefill completions: their first tokens rode
+            # back in the SAME sync as the token block above. Buffered
+            # (replaced, not appended) for pop_chunk_events.
+            events: List[Tuple[int, PrefillTask, int, bool]] = []
+            pb_toks_np = np.asarray(outs[2])
+            for r, slot, task, info in pb_finals:
+                if info is not None and info.released:
+                    # Cancel raced the fused dispatch: release() already
+                    # tore the slot down and its queued deactivate write
+                    # wins over the in-graph arm. Drop the token.
+                    continue
+                tok = int(pb_toks_np[r])
+                done = task.max_new_tokens == 1 or tok == task.eos_token
+                if done and info is not None:
+                    self._release_synced(slot, info)
+                events.append((slot, task, tok, done))
+            self._pb_events = events
         return out
 
     def spec_stats(self) -> Dict[str, Any]:

@@ -301,6 +301,7 @@ class KVFleetPlane:
         registry: Optional[Any] = None,
         events: Optional[Any] = None,
         store: Optional[Any] = None,
+        layerwise_ship: bool = False,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if role not in ROLES:
@@ -330,8 +331,20 @@ class KVFleetPlane:
         self._clock = clock
         self._events = events
         self._lock = threading.Lock()
+        #: Layer-pipelined disagg shipping: a finished prefill's pages
+        #: stream to the decode target one LAYER at a time instead of
+        #: as one blob, so the receiver's imports (and its resident
+        #: decode compute) overlap the remaining transfer. Falls back
+        #: to whole-prompt shipping per call when the payload is mesh-
+        #: sharded (shard dicts ship whole-block only).
+        self.layerwise_ship = bool(layerwise_ship)
         #: request_id -> {"peer", "digests", "deadline", "est_bytes"}.
         self._pending: Dict[str, Dict[str, Any]] = {}
+        #: (src, request_id) -> partial layerwise-ship state on the
+        #: RECEIVER: digests staged so far, next expected layer, and a
+        #: deadline after which the half-staged blocks are aborted
+        #: (sender died mid-stream -> cold prefill, zero lost pages).
+        self._ship_parts: Dict[Tuple[int, str], Dict[str, Any]] = {}
         #: (t, bytes) of transfer payloads inside the bandwidth window.
         self._window: deque = deque()
         # Cumulative accounting (the stats block / fleet row face).
@@ -344,6 +357,9 @@ class KVFleetPlane:
         self.ships = 0
         self.ship_blocks = 0
         self.ship_bytes = 0
+        self.layer_ships = 0
+        self.layer_ship_messages = 0
+        self.ship_partial_drops = 0
         self.served_fetches = 0
         self.imports = 0
         # Persistent-store fetch accounting (store hits/misses/bytes
@@ -375,6 +391,20 @@ class KVFleetPlane:
                     "rlt_serve_kvfleet_ships_total",
                     "Finished-prefill KV page sets shipped to decode "
                     "replicas, by replica role",
+                ),
+                "layer_ships": registry.counter(
+                    "rlt_serve_kvfleet_layer_ships_total",
+                    "Ships streamed per layer (layerwise pipelining), "
+                    "by replica role",
+                ),
+                "layer_ship_messages": registry.counter(
+                    "rlt_serve_kvfleet_layer_ship_messages_total",
+                    "Per-layer ship messages sent, by replica role",
+                ),
+                "ship_partial_drops": registry.counter(
+                    "rlt_serve_kvfleet_ship_partial_drops_total",
+                    "Layerwise ships abandoned mid-stream (staged "
+                    "partial aborted; cold prefill), by replica role",
                 ),
             }
 
@@ -534,16 +564,38 @@ class KVFleetPlane:
         return True
 
     def ship(
-        self, target: int, request_id: str, blocks: Sequence[Any]
+        self,
+        target: int,
+        request_id: str,
+        blocks: Sequence[Any],
+        layerwise: Optional[bool] = None,
     ) -> bool:
         """Ship a finished prefill's exported pages to the decode
         replica ``target``. Best-effort: a failed ship only costs the
-        decode side a cold prefill (the journal resubmit still runs)."""
+        decode side a cold prefill (the journal resubmit still runs).
+
+        ``layerwise`` (None = the plane's ``layerwise_ship`` default)
+        streams one ``ship_layer`` message per LAYER instead of one
+        whole-prompt blob, so the receiver starts importing layer 0
+        while the upper layers are still in flight — the transfer hides
+        behind the receiver's compute instead of stacking in front of
+        its first decode. Mesh-sharded payloads (shard dicts) always
+        fall back to the whole-prompt form."""
+        blocks = list(blocks)
+        use_layers = self.layerwise_ship if layerwise is None else bool(
+            layerwise
+        )
+        if use_layers and blocks and all(
+            not isinstance(kp, dict) and not isinstance(vp, dict)
+            and getattr(kp, "ndim", 0) >= 1
+            for _, kp, vp in blocks
+        ):
+            return self._ship_layerwise(int(target), request_id, blocks)
         nbytes = blocks_nbytes(blocks)
         ok = self._put(int(target), (
             "ship",
             {"src": self.index, "request_id": request_id,
-             "blocks": list(blocks)},
+             "blocks": blocks},
         ))
         if ok:
             now = self._clock()
@@ -556,15 +608,69 @@ class KVFleetPlane:
                 self._m["ships"].inc(1, role=self.role)
             self._event(
                 "kvfleet_ship", request_id=request_id, target=int(target),
-                blocks=len(blocks), nbytes=nbytes,
+                blocks=len(blocks), nbytes=nbytes, layerwise=False,
             )
         return ok
+
+    def _ship_layerwise(
+        self, target: int, request_id: str, blocks: List[Any]
+    ) -> bool:
+        """The layer-pipelined send: one message per layer, each
+        carrying every block's ``(digest, k_layer, v_layer)`` slice in
+        chain order. Aborting on the first failed put leaves the
+        receiver with a half-staged set its deadline sweep cleans up —
+        never a matchable half-block."""
+        import numpy as np
+
+        n_layers = int(blocks[0][1].shape[0])
+        nbytes = blocks_nbytes(blocks)
+        for layer in range(n_layers):
+            msg_blocks = [
+                (
+                    hexd,
+                    np.ascontiguousarray(kp[layer:layer + 1]),
+                    np.ascontiguousarray(vp[layer:layer + 1]),
+                )
+                for hexd, kp, vp in blocks
+            ]
+            ok = self._put(target, (
+                "ship_layer",
+                {"src": self.index, "request_id": request_id,
+                 "layer": layer, "n_layers": n_layers,
+                 "blocks": msg_blocks},
+            ))
+            if not ok:
+                return False
+            with self._lock:
+                self.layer_ship_messages += 1
+            if self._m is not None:
+                self._m["layer_ship_messages"].inc(1, role=self.role)
+        now = self._clock()
+        with self._lock:
+            self.ships += 1
+            self.layer_ships += 1
+            self.ship_blocks += len(blocks)
+            self.ship_bytes += nbytes
+            self._charge(nbytes, now)
+        if self._m is not None:
+            self._m["ships"].inc(1, role=self.role)
+            self._m["layer_ships"].inc(1, role=self.role)
+        self._event(
+            "kvfleet_ship", request_id=request_id, target=target,
+            blocks=len(blocks), nbytes=nbytes, layerwise=True,
+            layers=n_layers,
+        )
+        return True
 
     # -- the loop-thread pump ---------------------------------------------
     def service(
         self,
         export_fn: Optional[Callable[[Sequence[str]], List[Any]]],
         import_fn: Optional[Callable[[Sequence[Any]], int]],
+        layer_import_fn: Optional[
+            Callable[[str, Any, Any, int, int], bool]
+        ] = None,
+        abort_fn: Optional[Callable[[Sequence[str]], None]] = None,
     ) -> Dict[str, Any]:
         """Drain the inbox and settle deadlines — MUST run on the
         engine's driving thread (``export_fn``/``import_fn`` execute
@@ -714,8 +820,38 @@ class KVFleetPlane:
                 self._event(
                     "kvfleet_ship_import",
                     request_id=body.get("request_id"),
-                    src=body.get("src"), blocks=n,
+                    src=body.get("src"), blocks=n, layerwise=False,
                 )
+            elif kind == "ship_layer":
+                self._apply_ship_layer(
+                    body, now, import_fn, layer_import_fn, abort_fn
+                )
+        # Half-staged layerwise ships whose sender went quiet: abort the
+        # pinned staging blocks so the pool slots recycle — the decode
+        # side's admission simply cold-prefills what never finished.
+        with self._lock:
+            dead_parts = [
+                (key, self._ship_parts.pop(key)["digests"])
+                for key in [
+                    k for k, p in self._ship_parts.items()
+                    if now >= p["deadline"]
+                ]
+            ]
+            self.ship_partial_drops += len(dead_parts)
+        if dead_parts and self._m is not None:
+            self._m["ship_partial_drops"].inc(
+                len(dead_parts), role=self.role
+            )
+        for key, digests in dead_parts:
+            if abort_fn is not None:
+                try:
+                    abort_fn(digests)
+                except Exception:  # noqa: BLE001 - cleanup best-effort
+                    pass
+            self._event(
+                "kvfleet_ship_partial_drop", level="warn",
+                request_id=key[1], src=key[0],
+            )
         # Deadlines: a peer that died mid-fetch (or a transfer slower
         # than the window) never answers — the parked request re-queues
         # for cold prefill instead of waiting forever.
@@ -739,6 +875,94 @@ class KVFleetPlane:
             "store_fetched": store_fetched,
         }
 
+    def _apply_ship_layer(
+        self,
+        body: Dict[str, Any],
+        now: float,
+        import_fn: Optional[Callable[[Sequence[Any]], int]],
+        layer_import_fn: Optional[
+            Callable[[str, Any, Any, int, int], bool]
+        ],
+        abort_fn: Optional[Callable[[Sequence[str]], None]],
+    ) -> None:
+        """One inbound ``ship_layer`` message: import every block's
+        layer slice IMMEDIATELY (this is the overlap win — layer 0
+        lands in the pool while layers 1.. are still in flight). Any
+        per-block refusal (no layer path on this engine, pool full,
+        out-of-order layer) aborts the whole request's staging — the
+        engine-side invariant that a half-shipped block is never
+        matchable makes the abort free."""
+        src = int(body.get("src", -1))
+        rid = str(body.get("request_id"))
+        layer = int(body.get("layer", 0))
+        n_layers = int(body.get("n_layers", 0))
+        blocks = list(body.get("blocks") or [])
+        if not blocks or n_layers <= 0:
+            return
+        key = (src, rid)
+        if layer_import_fn is None:
+            # This engine cannot stage layers (mesh, no pool): buffer is
+            # pointless — just drop; the decode side cold-prefills.
+            with self._lock:
+                self.ship_partial_drops += 1
+                self._ship_parts.pop(key, None)
+            if self._m is not None:
+                self._m["ship_partial_drops"].inc(1, role=self.role)
+            return
+        with self._lock:
+            part = self._ship_parts.get(key)
+            if part is None:
+                part = {
+                    "digests": [],
+                    "next": 0,
+                    "deadline": now + self.timeout_s,
+                }
+                self._ship_parts[key] = part
+        digests = [str(h) for h, _, _ in blocks]
+        ok = True
+        for hexd, kl, vl in blocks:
+            if not layer_import_fn(hexd, kl, vl, layer, n_layers):
+                ok = False
+                break
+        with self._lock:
+            part["next"] = layer + 1
+            part["deadline"] = now + self.timeout_s
+            for h in digests:
+                if h not in part["digests"]:
+                    part["digests"].append(h)
+        if not ok:
+            with self._lock:
+                staged = self._ship_parts.pop(key, None)
+                self.ship_partial_drops += 1
+            if self._m is not None:
+                self._m["ship_partial_drops"].inc(1, role=self.role)
+            if abort_fn is not None and staged is not None:
+                try:
+                    abort_fn(staged["digests"])
+                except Exception:  # noqa: BLE001 - cleanup best-effort
+                    pass
+            self._event(
+                "kvfleet_ship_layer_abort", level="warn",
+                request_id=rid, src=src, layer=layer,
+            )
+            return
+        nbytes = blocks_nbytes(blocks)
+        with self._lock:
+            self._charge(nbytes, now)
+        self._event(
+            "kvfleet_ship_layer", request_id=rid, src=src,
+            layer=layer, n_layers=n_layers, blocks=len(blocks),
+            nbytes=nbytes,
+        )
+        if layer + 1 >= n_layers:
+            with self._lock:
+                self._ship_parts.pop(key, None)
+                self.imports += len(blocks)
+            self._event(
+                "kvfleet_ship_import", request_id=rid, src=src,
+                blocks=len(blocks), layerwise=True,
+            )
+
     # -- read side ---------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """The ``kvfleet`` stats block (rides the replica stats
@@ -757,6 +981,10 @@ class KVFleetPlane:
                 "ships": self.ships,
                 "ship_blocks": self.ship_blocks,
                 "ship_bytes": self.ship_bytes,
+                "layerwise": self.layerwise_ship,
+                "layer_ships": self.layer_ships,
+                "layer_ship_messages": self.layer_ship_messages,
+                "ship_partial_drops": self.ship_partial_drops,
                 "imports": self.imports,
                 "store_fetches": self.store_fetches,
                 "store_fetch_blocks": self.store_fetch_blocks,
@@ -776,6 +1004,7 @@ class KVFleetPlane:
 #: recorded truncations, exactly like PR 12's migrations).
 KVFLEET_HEADER_KEYS = frozenset((
     "role", "peers", "timeout_s", "max_inflight_mb", "bandwidth_mbps",
+    "layerwise",
 ))
 
 

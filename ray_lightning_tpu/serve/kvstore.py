@@ -48,7 +48,9 @@ feeds into the directory's store-held half), and ``kvstore_fetch`` /
 """
 from __future__ import annotations
 
+import dataclasses
 import hashlib
+import json
 import os
 import pickle
 import threading
@@ -67,6 +69,27 @@ _SUFFIX = ".kv"
 
 def _checksum(body: bytes) -> bytes:
     return hashlib.blake2b(body, digest_size=_CHECK_BYTES).digest()
+
+
+def kvstore_namespace(ckpt_path: Optional[str], config: Any) -> str:
+    """The store namespace of one model identity: a short digest over
+    the checkpoint path and the full model config. Two engines share
+    store entries iff this matches — the chained token digests alone
+    say nothing about WHICH model produced the KV bytes, so one shared
+    store serving two model versions would silently hand out wrong
+    pages without this fence. Pure function of its inputs: every gang
+    member and every restart derives the same namespace."""
+    cfg = (
+        dataclasses.asdict(config)
+        if dataclasses.is_dataclass(config)
+        else dict(config or {})
+    )
+    blob = json.dumps(
+        {"ckpt": str(ckpt_path or ""), "cfg": cfg},
+        sort_keys=True,
+        default=str,
+    ).encode()
+    return hashlib.blake2b(blob, digest_size=8).hexdigest()
 
 
 def _pack_payload(payload: Any) -> Any:
@@ -302,8 +325,15 @@ class FleetKVStore:
         budget_mb: float = 0.0,
         registry: Optional[Any] = None,
         events: Optional[Any] = None,
+        namespace: Optional[str] = None,
     ) -> None:
         self.path = str(path)
+        #: Model-identity fence (see :func:`kvstore_namespace`): entry
+        #: keys become ``<namespace>.<digest-hex>`` and the manifest
+        #: only surfaces THIS namespace, so one shared directory can
+        #: hold many model versions without ever cross-serving pages.
+        #: Empty = legacy single-model layout (bare digest keys).
+        self.namespace = str(namespace) if namespace else ""
         self.budget_bytes = int(float(budget_mb) * (1 << 20))
         self.backend = open_backend(path)
         self._lock = threading.Lock()
@@ -362,6 +392,11 @@ class FleetKVStore:
             pass  # the S3 stub: nothing to prune until a client lands
 
     # -- internals --------------------------------------------------------
+    def _key(self, digest_hex: str) -> str:
+        """The backend key of one bare digest under this namespace."""
+        d = str(digest_hex)
+        return f"{self.namespace}.{d}" if self.namespace else d
+
     def _event(self, name: str, level: str = "info", **kv: Any) -> None:
         if self._events is not None:
             try:
@@ -391,8 +426,12 @@ class FleetKVStore:
         raised) when the backend fails — the page is lost LOUDLY via
         ``rlt_serve_kvstore_write_errors_total``, and the caller's own
         path (eviction, retire, park) still completes."""
-        key = str(digest_hex)
+        key = self._key(digest_hex)
         try:
+            # The envelope embeds the FULL namespaced key: a legacy (or
+            # foreign-namespace) entry renamed/copied under this key
+            # fails the round-trip identity check in get_chain and
+            # decodes as an explicit miss, never as wrong-model KV.
             data = encode_entry(key, kp, vp)
             n = self.backend.put(key, data)
         except Exception as exc:  # noqa: BLE001 - full disk, vanished
@@ -438,12 +477,17 @@ class FleetKVStore:
         A corrupt entry is deleted, rung, and treated as the miss."""
         digests_hex = [str(d) for d in digests_hex]
         out: List[Tuple[str, Any, Any]] = []
-        for i, key in enumerate(digests_hex):
+        for i, bare in enumerate(digests_hex):
+            key = self._key(bare)
             try:
                 data = self.backend.get(key)
             except Exception:  # noqa: BLE001 - vanished dir = miss
                 data = None
             entry = decode_entry(data) if data is not None else None
+            # The embedded digest must round-trip the NAMESPACED key: a
+            # legacy bare-digest entry surfacing under this key (moved
+            # file, pre-namespace store) mismatches and is dropped as an
+            # explicit miss — wrong-model KV can never be served.
             if entry is None or entry[0] != key:
                 if data is not None:
                     self._drop(key, "corrupt")
@@ -458,16 +502,17 @@ class FleetKVStore:
             if self._m is not None:
                 self._m["hits"].inc(1)
                 self._m["bytes"].inc(len(data), direction="read")
-            out.append(entry)
+            # Callers speak BARE digests (the engines' wire form); the
+            # namespace is this store's private key prefix.
+            out.append((bare, entry[1], entry[2]))
         return out, []
 
     def contains(self, digest_hex: str) -> bool:
         """Pure existence probe (no payload read, no hit/miss count) —
         the directory-seeding and hint paths' cheap check."""
         try:
-            return any(
-                k == str(digest_hex) for k, _, _ in self.backend.entries()
-            )
+            key = self._key(digest_hex)
+            return any(k == key for k, _, _ in self.backend.entries())
         except Exception:  # noqa: BLE001 - vanished dir holds nothing
             return False
 
@@ -480,7 +525,14 @@ class FleetKVStore:
             ents = sorted(self.backend.entries(), key=lambda e: e[2])
         except Exception:  # noqa: BLE001 - no dir, no manifest
             return []
-        return [k for k, _, _ in ents]
+        if not self.namespace:
+            # Legacy layout: surface only bare-digest keys — another
+            # model's namespaced entries are not OUR warm set.
+            return [k for k, _, _ in ents if "." not in k]
+        prefix = self.namespace + "."
+        return [
+            k[len(prefix):] for k, _, _ in ents if k.startswith(prefix)
+        ]
 
     # -- GC ---------------------------------------------------------------
     def gc(self) -> int:
@@ -524,6 +576,7 @@ class FleetKVStore:
             return {
                 "backend": getattr(self.backend, "name", "?"),
                 "path": self.path,
+                "namespace": self.namespace,
                 "budget_mb": round(self.budget_bytes / (1 << 20), 3),
                 "hits": self.hits,
                 "misses": self.misses,
@@ -541,7 +594,7 @@ class FleetKVStore:
 #: Journal-header ``kvstore`` keys a replayed capture surfaces — which
 #: persistent tier (if any) shaped a recorded session.
 KVSTORE_HEADER_KEYS = frozenset((
-    "dir", "budget_mb", "writethrough",
+    "dir", "budget_mb", "writethrough", "namespace",
 ))
 
 

@@ -557,6 +557,10 @@ class Scheduler:
                 lambda digests: []
             ),
             import_fn=self.engine.import_prefix_blocks,
+            layer_import_fn=getattr(
+                self.engine, "import_prefix_block_layer", None
+            ),
+            abort_fn=getattr(self.engine, "abort_layer_imports", None),
         )
         resumed: List[Any] = []
         store_rids = set(svc.get("store_fetched") or ())
@@ -986,139 +990,27 @@ class Scheduler:
             # first token yet — dying here strands admitted-not-started
             # work (the failover set's hardest case).
             self._fault("post_admit")
-        # 3) Advance chunked prefills — the chunk-vs-fold interleave.
-        # (Snapshot the in-progress count first: the fault hook below
-        # must fire on every step that ADVANCED a chunk, not only the
-        # one that completed a prefill — "mid-prefill" is the point.)
+        # 3) Advance chunked prefills. Two shapes: the classic
+        # chunk-vs-fold interleave (separate prefill_step dispatches
+        # competing with the fold for device time), or — with
+        # piggyback_chunks on — NO separate dispatch at all: chunk rows
+        # ride inside the decode fold below and their completions drain
+        # from pop_chunk_events after it. (Snapshot the in-progress
+        # count first: the fault hook below must fire on every step
+        # that ADVANCED a chunk, not only the one that completed a
+        # prefill — "mid-prefill" is the point.)
+        piggyback = getattr(self.engine, "piggyback_chunks", 0) > 0
         prefilling = getattr(self.engine, "num_prefilling", 0)
-        chunk_events = self.engine.prefill_step(
-            self.max_prefill_chunks_per_step
+        chunk_events = (
+            []
+            if piggyback
+            else self.engine.prefill_step(self.max_prefill_chunks_per_step)
         )
-        prefilled = 0
-        #: (slot, task, Request): completed prefills whose KV pages
-        #: ship to a decode replica instead of decoding here — the
-        #: disaggregated-prefill handoff (collected in the loop, engine
-        #: work below it so the fold never decodes a shipped slot).
-        to_ship: List[Any] = []
-        for slot, task, tok, done in chunk_events:
-            prefilled += 1
-            now = time.monotonic()
-            req = newly.get(slot) or self._slot_req.get(slot)
-            if req is not None:
-                self.metrics.record_first_token(
-                    now - req.submitted_at,
-                    now - (req.admitted_at or now),
-                    task.chunks,
-                    task.matched_tokens,
-                    len(task.tokens),
-                )
-                self._trace(
-                    task.request_id, _trace.SPAN_FIRST_TOKEN, t=now,
-                    ttft_s=round(now - req.submitted_at, 6),
-                    chunks=task.chunks,
-                    prefix_hit_tokens=task.matched_tokens,
-                )
-            acct = self._acct.get(task.request_id)
-            if acct is not None:
-                acct["prefill_chunks"] = task.chunks
-                acct["prefix_hit_tokens"] = task.matched_tokens
-                acct["emitted_tokens"] += 1
-            if self.journal is not None and tok is not None:
-                self._jr_tokens.setdefault(
-                    task.request_id, []
-                ).append(int(tok))
-                if req is not None:
-                    self._jr_ttft.setdefault(
-                        task.request_id, now - req.submitted_at
-                    )
-            events.append(
-                TokenEvent(
-                    task.request_id, tok, done,
-                    "finished" if done else "token",
-                )
-            )
-            if done:
-                self.metrics.record_finish(queue_depth=self.queue_depth())
-                self._trace(task.request_id, _trace.SPAN_FINISH)
-                finished_rids.append(task.request_id)
-                closed.append((task.request_id, "finished"))
-                newly.pop(slot, None)
-            elif (
-                self.kvfleet is not None
-                and req is not None
-                and req.ship_to is not None
-            ):
-                # Disaggregated prefill: the first token streamed above
-                # (the client's cursor dedups it when the decode
-                # replica re-emits the identical stream); the slot's KV
-                # pages ship below instead of decoding here.
-                to_ship.append((slot, task, req))
-                newly.pop(slot, None)
-                finished_slots.append(slot)
-                finished_rids.append(task.request_id)
-        if (
-            self.kvstore_writethrough
-            and self.kvstore is not None
-            and getattr(self.engine, "prefix_blocks", 0)
-        ):
-            # Write-through: every completed prefill's chain goes to
-            # the persistent store so the pages survive this replica's
-            # retirement (the prefill pool is the autoscaler's favorite
-            # victim). Shipped slots reuse the export below; put errors
-            # count loudly in kvstore_write_errors_total, never raise.
-            shipped_slots = {s for s, _t, _r in to_ship}
-            for slot, task, _tok, _done in chunk_events:
-                if slot in shipped_slots:
-                    continue
-                wt = self.engine.export_prefix_blocks(task.tokens)
-                if wt:
-                    self.kvstore.put_blocks(wt)
-        for slot, task, req in to_ship:
-            # Release FIRST (the fold below must not decode a shipped
-            # slot; the finished prompt's blocks already entered the
-            # pool at prefill completion, so they survive the release
-            # as digest-keyed cache pages), then export + ship. A
-            # failed ship only costs the decode replica a cold prefill
-            # — the client's resubmission carries a fetch hint back to
-            # THIS replica, whose pool still holds the pages.
-            self.engine.release(slot)
-            blocks = (
-                self.engine.export_prefix_blocks(task.tokens)
-                if getattr(self.engine, "prefix_blocks", 0)
-                else []
-            )
-            if (
-                self.kvstore_writethrough
-                and self.kvstore is not None
-                and blocks
-            ):
-                self.kvstore.put_blocks(blocks)
-            self.kvfleet.ship(req.ship_to, req.request_id, blocks)
-            if self.journal is not None:
-                # A ship looks like a cancel to a replay of THIS
-                # journal (truncation after the recorded first token);
-                # the decode replica's journal carries the decode, and
-                # the CLIENT journal is what re-drives the request
-                # there.
-                self.journal.record_cancel(req.request_id, True)
-            self.metrics.record_cancel(queue_depth=self.queue_depth())
-            self._trace(
-                req.request_id, _trace.SPAN_SHIPPED,
-                target=req.ship_to, blocks=len(blocks),
-            )
-            self._event(
-                "kv_ship", request_id=req.request_id,
-                target=req.ship_to, blocks=len(blocks),
-            )
-            closed.append((req.request_id, "shipped"))
-            events.append(
-                TokenEvent(
-                    req.request_id, None, True, "shipped",
-                    ship_to=req.ship_to,
-                    ship_digests=[b[0] for b in blocks],
-                )
-            )
-        if chunk_events or prefilling:
+        prefilled = self._finish_prefills(
+            chunk_events, newly, events, finished_rids, finished_slots,
+            closed,
+        )
+        if not piggyback and (chunk_events or prefilling):
             # Fault point: a multi-chunk prompt is part-way through its
             # prefill (device KV holds a partial range nobody can read
             # back — the request MUST be replayed from its submit).
@@ -1128,6 +1020,23 @@ class Scheduler:
         active = self.engine.num_active
         emitted = 0
         fold_results = self.engine.step()
+        if piggyback:
+            # Piggybacked chunk rows rode INSIDE that fold dispatch;
+            # their completions drain here and flow through the same
+            # finish path (first-token metrics, writethrough, ship) —
+            # one dispatch did all the work, the host accounting is
+            # identical either way.
+            pb_events = self.engine.pop_chunk_events()
+            if pb_events:
+                chunk_events = list(chunk_events) + pb_events
+                prefilled += self._finish_prefills(
+                    pb_events, newly, events, finished_rids,
+                    finished_slots, closed,
+                )
+            if pb_events or prefilling:
+                # Same fault point as the separate-dispatch path, just
+                # after the fused fold that advanced the chunks.
+                self._fault("mid_prefill_chunk")
         # Tokens per request this fold: the shared granularity of the
         # decode-side trace events, the spec attribution, and the cost
         # ledger (one dict pass per fold, never per token).
@@ -1286,6 +1195,149 @@ class Scheduler:
             emitted + prefilled + admit_tokens, self.queue_depth(),
         )
         return events
+
+    def _finish_prefills(
+        self,
+        chunk_events: List[Any],
+        newly: Dict[int, Any],
+        events: List[TokenEvent],
+        finished_rids: List[str],
+        finished_slots: List[int],
+        closed: List[Tuple[str, str]],
+    ) -> int:
+        """Process completed/advanced prefill chunk events: first-token
+        metrics + traces, journal tokens, TokenEvents, write-through,
+        and the disaggregated-prefill ship loop. Shared verbatim by the
+        separate-dispatch path (prefill_step) and the piggyback path
+        (pop_chunk_events after the fused fold)."""
+        prefilled = 0
+        #: (slot, task, Request): completed prefills whose KV pages
+        #: ship to a decode replica instead of decoding here — the
+        #: disaggregated-prefill handoff (collected in the loop, engine
+        #: work below it so the fold never decodes a shipped slot).
+        to_ship: List[Any] = []
+        for slot, task, tok, done in chunk_events:
+            prefilled += 1
+            now = time.monotonic()
+            req = newly.get(slot) or self._slot_req.get(slot)
+            if req is not None:
+                self.metrics.record_first_token(
+                    now - req.submitted_at,
+                    now - (req.admitted_at or now),
+                    task.chunks,
+                    task.matched_tokens,
+                    len(task.tokens),
+                )
+                self._trace(
+                    task.request_id, _trace.SPAN_FIRST_TOKEN, t=now,
+                    ttft_s=round(now - req.submitted_at, 6),
+                    chunks=task.chunks,
+                    prefix_hit_tokens=task.matched_tokens,
+                )
+            acct = self._acct.get(task.request_id)
+            if acct is not None:
+                acct["prefill_chunks"] = task.chunks
+                acct["prefix_hit_tokens"] = task.matched_tokens
+                acct["emitted_tokens"] += 1
+            if self.journal is not None and tok is not None:
+                self._jr_tokens.setdefault(
+                    task.request_id, []
+                ).append(int(tok))
+                if req is not None:
+                    self._jr_ttft.setdefault(
+                        task.request_id, now - req.submitted_at
+                    )
+            events.append(
+                TokenEvent(
+                    task.request_id, tok, done,
+                    "finished" if done else "token",
+                )
+            )
+            if done:
+                self.metrics.record_finish(queue_depth=self.queue_depth())
+                self._trace(task.request_id, _trace.SPAN_FINISH)
+                finished_rids.append(task.request_id)
+                closed.append((task.request_id, "finished"))
+                newly.pop(slot, None)
+            elif (
+                self.kvfleet is not None
+                and req is not None
+                and req.ship_to is not None
+            ):
+                # Disaggregated prefill: the first token streamed above
+                # (the client's cursor dedups it when the decode
+                # replica re-emits the identical stream); the slot's KV
+                # pages ship below instead of decoding here.
+                to_ship.append((slot, task, req))
+                newly.pop(slot, None)
+                finished_slots.append(slot)
+                finished_rids.append(task.request_id)
+        if (
+            self.kvstore_writethrough
+            and self.kvstore is not None
+            and getattr(self.engine, "prefix_blocks", 0)
+        ):
+            # Write-through: every completed prefill's chain goes to
+            # the persistent store so the pages survive this replica's
+            # retirement (the prefill pool is the autoscaler's favorite
+            # victim). Shipped slots reuse the export below; put errors
+            # count loudly in kvstore_write_errors_total, never raise.
+            shipped_slots = {s for s, _t, _r in to_ship}
+            for slot, task, _tok, _done in chunk_events:
+                if slot in shipped_slots:
+                    continue
+                wt = self.engine.export_prefix_blocks(task.tokens)
+                if wt:
+                    self.kvstore.put_blocks(wt)
+        for slot, task, req in to_ship:
+            # Release FIRST (the fold below must not decode a shipped
+            # slot; the finished prompt's blocks already entered the
+            # pool at prefill completion, so they survive the release
+            # as digest-keyed cache pages), then export + ship. A
+            # failed ship only costs the decode replica a cold prefill
+            # — the client's resubmission carries a fetch hint back to
+            # THIS replica, whose pool still holds the pages.
+            self.engine.release(slot)
+            blocks = (
+                self.engine.export_prefix_blocks(task.tokens)
+                if getattr(self.engine, "prefix_blocks", 0)
+                else []
+            )
+            if (
+                self.kvstore_writethrough
+                and self.kvstore is not None
+                and blocks
+            ):
+                self.kvstore.put_blocks(blocks)
+            layerwise = bool(getattr(self.kvfleet, "layerwise_ship", False))
+            self.kvfleet.ship(req.ship_to, req.request_id, blocks)
+            if self.journal is not None:
+                # A ship looks like a cancel to a replay of THIS
+                # journal (truncation after the recorded first token);
+                # the decode replica's journal carries the decode, and
+                # the CLIENT journal is what re-drives the request
+                # there.
+                self.journal.record_cancel(req.request_id, True)
+            self.metrics.record_cancel(queue_depth=self.queue_depth())
+            self._trace(
+                req.request_id, _trace.SPAN_SHIPPED,
+                target=req.ship_to, blocks=len(blocks),
+                layerwise=layerwise,
+            )
+            self._event(
+                "kv_ship", request_id=req.request_id,
+                target=req.ship_to, blocks=len(blocks),
+                layerwise=layerwise,
+            )
+            closed.append((req.request_id, "shipped"))
+            events.append(
+                TokenEvent(
+                    req.request_id, None, True, "shipped",
+                    ship_to=req.ship_to,
+                    ship_digests=[b[0] for b in blocks],
+                )
+            )
+        return prefilled
 
     def run_until_idle(self, max_steps: int = 100_000) -> List[TokenEvent]:
         """Drive step() until queue and slots drain (tests, bench)."""

@@ -81,7 +81,7 @@ ENGINE_KEYS = frozenset((
     "prefix_blocks", "prefix_block", "prefix_host_mb", "prefix_disk_dir",
     "prefix_disk_mb", "kv_page", "kv_pages", "spec", "spec_depth",
     "spec_draft_ckpt", "spec_draft_config", "spec_draft_int8",
-    "spec_window", "mesh",
+    "spec_window", "mesh", "piggyback_chunks", "fold_ladder",
 ))
 
 
@@ -103,6 +103,7 @@ def build_engine(
     prefix_disk_mb: float = 0.0,
     kvstore_dir: Optional[str] = None,
     kvstore_mb: float = 0.0,
+    kvstore_namespace: Optional[str] = None,
     kv_page: int = 0,
     kv_pages: int = 0,
     spec: str = "off",
@@ -112,6 +113,8 @@ def build_engine(
     spec_draft_int8: bool = False,
     spec_window: int = 32,
     mesh: Optional[str] = None,
+    piggyback_chunks: int = 0,
+    fold_ladder: Optional[Sequence[int]] = None,
 ) -> Any:
     """Load weights (+ optional draft model) and construct the engine.
 
@@ -124,6 +127,9 @@ def build_engine(
     from ray_lightning_tpu.models.gpt import GPTConfig
     from ray_lightning_tpu.parallel.mesh import mesh_from_spec
     from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.kvstore import (
+        kvstore_namespace as _kvstore_namespace,
+    )
 
     if params is None:
         if ckpt_path is None:
@@ -178,6 +184,15 @@ def build_engine(
         prefix_disk_mb=prefix_disk_mb,
         kvstore_dir=kvstore_dir,
         kvstore_mb=kvstore_mb,
+        # Model-identity namespace for the persistent store. Derived
+        # from the RAW (ckpt_path, model_config) kwargs — not the
+        # loaded config — so the driver-side directory (serve_fleet)
+        # and every gang member compute the identical string from the
+        # identical inputs without loading the checkpoint.
+        kvstore_namespace=(
+            kvstore_namespace
+            or _kvstore_namespace(ckpt_path, model_config)
+        ),
         kv_page=kv_page,
         kv_pages=kv_pages,
         spec=spec,
@@ -186,6 +201,8 @@ def build_engine(
         spec_config=spec_cfg,
         spec_window=spec_window,
         mesh=mesh_from_spec(mesh),
+        piggyback_chunks=piggyback_chunks,
+        fold_ladder=fold_ladder,
     )
 
 
@@ -429,6 +446,8 @@ class ServeReplica:
         prefill_buckets: Optional[Sequence[int]] = None,
         max_prefills_per_step: int = 1,
         decode_fold: int = 1,
+        fold_ladder: Optional[Sequence[int]] = None,
+        piggyback_chunks: int = 0,
         pipeline: bool = True,
         prefill_chunk: int = 0,
         prefix_blocks: int = 0,
@@ -473,8 +492,10 @@ class ServeReplica:
         kvfleet_timeout_s: float = 5.0,
         kvfleet_inflight_mb: float = 64.0,
         kvfleet_bandwidth_mbps: float = 0.0,
+        kvfleet_layerwise: bool = False,
         kvstore_dir: Optional[str] = None,
         kvstore_mb: float = 0.0,
+        kvstore_namespace: Optional[str] = None,
         kvstore_writethrough: bool = False,
     ) -> None:
         from ray_lightning_tpu.obs import blackbox as obs_blackbox
@@ -505,6 +526,8 @@ class ServeReplica:
             max_seq=max_seq,
             prefill_buckets=prefill_buckets,
             decode_fold=decode_fold,
+            fold_ladder=fold_ladder,
+            piggyback_chunks=piggyback_chunks,
             pipeline=pipeline,
             prefill_chunk=prefill_chunk,
             prefix_blocks=prefix_blocks,
@@ -514,6 +537,7 @@ class ServeReplica:
             prefix_disk_mb=prefix_disk_mb,
             kvstore_dir=kvstore_dir,
             kvstore_mb=kvstore_mb,
+            kvstore_namespace=kvstore_namespace,
             kv_page=kv_page,
             kv_pages=kv_pages,
             spec=spec,
@@ -634,6 +658,7 @@ class ServeReplica:
                         "timeout_s": float(kvfleet_timeout_s),
                         "max_inflight_mb": float(kvfleet_inflight_mb),
                         "bandwidth_mbps": float(kvfleet_bandwidth_mbps),
+                        "layerwise": bool(kvfleet_layerwise),
                     }
                     if (kv_inbox is not None or self.role != "mixed")
                     else None
@@ -646,6 +671,7 @@ class ServeReplica:
                         "dir": self.engine.kvstore_dir,
                         "budget_mb": float(kvstore_mb),
                         "writethrough": bool(kvstore_writethrough),
+                        "namespace": self.engine.kvstore_namespace,
                     }
                     if self.engine.kvstore is not None
                     else None
@@ -681,6 +707,7 @@ class ServeReplica:
                 timeout_s=float(kvfleet_timeout_s),
                 max_inflight_mb=float(kvfleet_inflight_mb),
                 bandwidth_mbps=float(kvfleet_bandwidth_mbps),
+                layerwise_ship=bool(kvfleet_layerwise),
                 registry=self._registry,
                 events=self.events,
                 store=self.engine.kvstore,
@@ -704,6 +731,8 @@ class ServeReplica:
             "num_slots": self.engine.num_slots,
             "max_seq": self.engine.max_seq,
             "decode_fold": self.engine.decode_fold,
+            "fold_ladder": list(self.engine.fold_ladder),
+            "piggyback_chunks": self.engine.piggyback_chunks,
             "pipeline": self.engine.pipeline,
             "prefill_chunk": self.engine.prefill_chunk,
             "prefix_blocks": self.engine.prefix_blocks,
@@ -718,8 +747,10 @@ class ServeReplica:
             "mesh": self.engine.mesh_desc,
             "role": self.role,
             "kvfleet": self.kvfleet is not None,
+            "kvfleet_layerwise": bool(kvfleet_layerwise),
             "kvstore_dir": self.engine.kvstore_dir,
             "kvstore_mb": self.engine.kvstore_mb,
+            "kvstore_namespace": self.engine.kvstore_namespace,
             "kvstore_writethrough": bool(kvstore_writethrough),
             "gang_hosts": int(self._dist.get("num_hosts", 1)),
             "watchdog": bool(watchdog),
@@ -995,6 +1026,25 @@ class ServeReplica:
             # copy can lag a step; this one is read straight off the
             # engine for the stats RPC).
             snap["kv_pages"] = self.engine.kv_page_stats()
+        # Fold-depth ladder: every dispatch picked one pre-lowered rung
+        # (zero compiles — the whole ladder lowered at construction);
+        # the per-K histogram is how an operator sees queue pressure
+        # translate into dispatch depth.
+        snap["fold_k"] = {
+            "ladder": list(self.engine.fold_ladder),
+            "dispatches": {
+                str(k): int(n)
+                for k, n in self.engine.fold_dispatches.items()
+            },
+        }
+        if self.engine.piggyback_chunks:
+            # Fused prefill+decode dispatches: chunk rows that rode a
+            # decode fold instead of a separate prefill_step dispatch.
+            snap["piggyback"] = {
+                "chunks": self.engine.piggyback_chunks,
+                "dispatches": int(self.engine.piggyback_dispatches),
+                "chunk_rows": int(self.engine.piggyback_chunk_rows),
+            }
         snap["spec"] = self.engine.spec
         if self.engine.spec != "off":
             snap["spec_stats"] = self.engine.spec_stats()

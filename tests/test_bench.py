@@ -50,8 +50,9 @@ def test_bench_smoke_cpu():
         {"RLT_BENCH_ALLOW_CPU": "1"},
         "--rounds", "1", "--epochs", "2", "--n-train", "256",
         # The serve sweep grew the disagg fleet (d=256 engines x 4
-        # replicas across two modes); give the full run headroom.
-        timeout=1200,
+        # replicas across two modes) and PR17's piggyback/ladder/
+        # layerwise-ship sections; give the full run headroom.
+        timeout=1500,
     )
     out = _json_line(proc)
     assert out["metric"] == "mnist_steps_per_sec_per_chip"
@@ -284,6 +285,41 @@ def test_bench_smoke_cpu():
         f_on["fleet_prefix_hit_rate"] > f_iso["fleet_prefix_hit_rate"]
     ), disagg
     assert out["extra"]["disagg_cpu_control"] is True
+    # Fused piggyback: on the heavy-prefill mix, chunk rows riding
+    # INSIDE the decode dispatch must improve the resident stream's
+    # inter-token p95 over separate chunk dispatches — same greedy
+    # tokens, fewer dispatches.
+    pb = {r["mode"]: r for r in out["extra"]["piggyback_rows"]}
+    assert pb["fused"]["piggyback_dispatches"] > 0, pb
+    assert pb["fused"]["exact_vs_other_mode"] is True, pb
+    assert (
+        pb["fused"]["inter_token_p95_s"]
+        < pb["separate"]["inter_token_p95_s"]
+    ), pb
+    assert out["extra"]["piggyback_inter_token_p95_ratio"] > 1.0
+    # Fold-depth ladder: two admission waves force rung switches
+    # mid-stream; every switch must hit a pre-lowered executable (the
+    # REAL compile listener reads zero in the serving window) and the
+    # streams must match the fixed-depth engine bit for bit.
+    ladder = {r["mode"]: r for r in out["extra"]["fold_ladder_rows"]}
+    assert ladder["ladder124"]["rungs_used"] >= 2, ladder
+    assert ladder["ladder124"]["exact_vs_other_mode"] is True, ladder
+    assert out["extra"]["fold_ladder_compiles_steady"] == 0
+    # Layer-pipelined KV shipping: per-layer messages pipeline across
+    # the two-hop wire, so layerwise must beat the whole-prompt blob
+    # on ship-to-first-decode — both landing warm (real imports, real
+    # prefix hits) with identical decode tokens.
+    lw = {r["mode"]: r for r in out["extra"]["layerwise_rows"]}
+    assert lw["layerwise"]["layer_block_imports"] > 0, lw
+    assert lw["layerwise"]["prefix_hit_tokens"] > 0, lw
+    assert lw["whole_prompt"]["prefix_hit_tokens"] > 0, lw
+    assert lw["layerwise"]["exact_vs_other_mode"] is True, lw
+    assert (
+        lw["layerwise"]["ship_to_first_decode_ms"]
+        < lw["whole_prompt"]["ship_to_first_decode_ms"]
+    ), lw
+    assert out["extra"]["layerwise_ship_speedup"] > 1.0
+    assert out["extra"]["layerwise_cpu_control"] is True
     # The headline's definition is versioned in the artifact (ADVICE r4).
     assert "vs_baseline_definition" in out["extra"], out["extra"]
     # Worker teardown must not stack-trace through manager finalizers into

@@ -414,3 +414,36 @@ def test_cli_tokenize(tmp_path, capsys):
     ])
     assert out2["vocab_size"] == out["vocab_size"]
     assert out2["n_tokens"] == out["n_tokens"]
+
+
+def test_cli_serve_fused_dispatch_flags_validate_up_front():
+    """PR-17 satellite: the fused-dispatch knobs die on the DRIVER with
+    the flag name and the legal range — before any checkpoint loads or
+    replica spawns — and every accepted spelling normalizes."""
+    base = {"ckpt_path": "x", "prompts": "y"}
+    # fold_ladder: rungs must be >= 1...
+    with pytest.raises(ValueError, match=r"fold_ladder.*>= 1"):
+        cli.run_serve({"serve": dict(base, fold_ladder="0,2")})
+    # ...and must include decode_fold (the full-runway rung).
+    with pytest.raises(ValueError, match=r"fold_ladder.*decode_fold=4"):
+        cli.run_serve(
+            {"serve": dict(base, decode_fold=4, fold_ladder=[1, 2])}
+        )
+    # piggyback_chunks: bounded by num_slots, named range in the error.
+    with pytest.raises(
+        ValueError, match=r"piggyback_chunks.*num_slots=4"
+    ):
+        cli.run_serve(
+            {"serve": dict(base, num_slots=4, piggyback_chunks=9)}
+        )
+    with pytest.raises(ValueError, match=r"piggyback_chunks.*-1"):
+        cli.run_serve({"serve": dict(base, piggyback_chunks=-1)})
+    # ...and requires chunked prefill to have rows to ride along.
+    with pytest.raises(
+        ValueError, match=r"piggyback_chunks.*prefill_chunk"
+    ):
+        cli.run_serve({"serve": dict(base, piggyback_chunks=2)})
+    # kvfleet_layerwise only means something with a fleet plane or a
+    # disaggregated prefill tier underneath.
+    with pytest.raises(ValueError, match=r"kvfleet_layerwise"):
+        cli.run_serve({"serve": dict(base, kvfleet_layerwise=True)})

@@ -539,3 +539,44 @@ def test_top_once_json_emits_machine_readable_snapshot(capsys):
         assert out["snapshot"]["latest"]["fleet"]["replicas"] == 1
     finally:
         srv.close()
+
+
+def test_header_carries_fused_dispatch_config_and_replays(
+    jr_params, tmp_path
+):
+    """Replay hygiene for the fused-dispatch knobs: fold_ladder,
+    piggyback_chunks, and the store namespace ride the engine header,
+    build_replay_scheduler rebuilds an engine with the SAME fused
+    config (the op stream depends on them, so replaying on a
+    separate-dispatch engine would diverge), and the replay is exact."""
+    from ray_lightning_tpu.obs.journal import build_replay_scheduler
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    eng = DecodeEngine(
+        jr_params, JR_CFG, num_slots=3, max_seq=64,
+        prefill_buckets=[16], prefill_chunk=4, decode_fold=2,
+        piggyback_chunks=2, fold_ladder=[1, 2],
+        kvstore_dir=str(tmp_path / "kv"),
+    )
+    journal = WorkloadJournal(capacity=256)
+    journal.set_header(engine_header(eng, max_prefills_per_step=2))
+    sched = Scheduler(eng, max_prefills_per_step=2, journal=journal)
+    g = np.random.default_rng(67)
+    for i in range(4):
+        sched.submit(
+            g.integers(0, 97, size=int(g.integers(5, 13))).tolist(),
+            SamplingParams(max_new_tokens=int(g.integers(3, 7))),
+        )
+    sched.run_until_idle()
+    j = journal.dump()
+    h_eng = j["header"]["engine"]
+    assert h_eng["fold_ladder"] == [1, 2]
+    assert h_eng["piggyback_chunks"] == 2
+    assert h_eng["kvstore_namespace"] == eng.kvstore_namespace
+    sched_v = build_replay_scheduler(j["header"], params=jr_params)
+    assert sched_v.engine.piggyback_chunks == 2
+    assert tuple(sched_v.engine.fold_ladder) == (1, 2)
+    res = replay_journal(j, scheduler=sched_v)
+    assert res["exact"] is True and res["divergence"] is None
+    assert sched_v.engine.piggyback_dispatches > 0

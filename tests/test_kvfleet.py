@@ -1070,3 +1070,108 @@ def test_e2e_disagg_fleet_bit_exact_with_ships(
         assert not incomplete_requests(client.journal.dump(None))
     finally:
         client.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Layer-pipelined KV shipping (--serve.kvfleet_layerwise)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "engine_kw", [DENSE_KW, PAGED_KW], ids=["dense", "paged"]
+)
+def test_layerwise_ship_decode_bit_exact(params, engine_kw):
+    """The whole-prompt ship of test_disagg_prefill_ship_decode_bit_exact
+    re-run with the plane streaming ONE MESSAGE PER LAYER: the receiver
+    stages each block layer-by-layer (unkeyed + pinned until the last
+    layer lands), finalizes into matchable prefix state, and the decode
+    side's stream stays bit-identical to solo gpt_generate."""
+    duo = _Duo(
+        params, engine_kw, roles=("prefill", "decode"),
+        layerwise_ship=True,
+    )
+    rng = np.random.default_rng(53)
+    prompt = rng.integers(0, CFG.vocab_size, size=14).tolist()
+    n = 8
+    duo.scheds[0].submit(prompt, _sp(n), request_id="r", ship_to=1)
+    evA, _ = duo.drive()
+    assert [e.reason for e in evA if e.done] == ["shipped"]
+    # One logical ship, streamed as n_layer messages.
+    assert duo.planes[0].ships == 1
+    assert duo.planes[0].layer_ships == 1
+    assert duo.planes[0].layer_ship_messages == CFG.n_layer
+    assert duo.engines[1].layer_block_imports > 0
+    assert duo.engines[1].layer_import_aborts == 0
+    assert duo.planes[1].ship_partial_drops == 0
+    duo.scheds[1].submit(prompt, _sp(n), request_id="r")
+    _, evB = duo.drive()
+    assert _tokens(evB, "r") == _ref(params, prompt, n)
+    assert duo.engines[1].prefix_hit_tokens > 0  # admitted warm
+
+
+def test_layerwise_ship_target_dies_mid_layer_cold_exact(params):
+    """The failure matrix row: the decode target stops hearing from the
+    sender after layer 0 of 2 (sender death mid-stream). The deadline
+    sweep aborts the half-staged blocks — pinned staging pages recycle,
+    nothing is ever matchable — and the request still completes via
+    cold prefill, bit-exact, zero lost."""
+    t = [0.0]
+    duo = _Duo(
+        params, DENSE_KW, roles=("prefill", "decode"),
+        layerwise_ship=True, clock=lambda: t[0], timeout_s=2.0,
+    )
+    rng = np.random.default_rng(59)
+    prompt = rng.integers(0, CFG.vocab_size, size=12).tolist()
+    n = 6
+    duo.scheds[0].submit(prompt, _sp(n), request_id="r", ship_to=1)
+    while duo.scheds[0].has_work():
+        duo.scheds[0].step()
+    assert duo.planes[0].layer_ship_messages == CFG.n_layer
+    # Drop every layer after the first on the wire: the target saw the
+    # sender die mid-stream.
+    inbox = duo.planes[1].inbox
+    kept = []
+    while not inbox.empty():
+        kind, body = inbox.get_nowait()
+        if kind == "ship_layer" and int(body.get("layer", 0)) > 0:
+            continue
+        kept.append((kind, body))
+    for item in kept:
+        inbox.put(item)
+    duo.scheds[1].step()  # imports layer 0, stages pinned blocks
+    # Mid-stage: blocks staged (unkeyed, pinned), but NO block counts as
+    # imported yet — that tick is reserved for the final layer.
+    assert len(duo.engines[1]._layer_imports) > 0
+    assert duo.engines[1].layer_block_imports == 0
+    assert duo.engines[1].prefix_hit_tokens == 0
+    t[0] += 5.0  # past the staging deadline
+    duo.scheds[1].step()  # sweep: abort + free the half-staged set
+    assert duo.planes[1].ship_partial_drops >= 1
+    assert duo.engines[1].layer_import_aborts > 0
+    # Zero lost: the request re-runs COLD on the target, still exact.
+    duo.scheds[1].submit(prompt, _sp(n), request_id="r")
+    _, evB = duo.drive()
+    assert _tokens(evB, "r") == _ref(params, prompt, n)
+    assert duo.engines[1].prefix_hit_tokens == 0  # cold, not half-warm
+
+
+def test_layerwise_mesh_shards_fall_back_whole_prompt(params, tp_mesh):
+    """Mesh-sharded payloads travel as per-device shard dicts the layer
+    stream cannot slice: a layerwise-enabled plane must fall back to the
+    whole-prompt form (layer counters stay zero) and stay bit-exact."""
+    rng = np.random.default_rng(61)
+    prompt = rng.integers(0, CFG.vocab_size, size=13).tolist()
+    n = 6
+    expected = _ref(params, prompt, n)
+    duo = _Duo(
+        params, PAGED_KW, roles=("prefill", "decode"), mesh=tp_mesh,
+        layerwise_ship=True,
+    )
+    duo.scheds[0].submit(prompt, _sp(n), request_id="r", ship_to=1)
+    evA, _ = duo.drive()
+    assert [e.reason for e in evA if e.done] == ["shipped"]
+    assert duo.planes[0].ships == 1
+    assert duo.planes[0].layer_ships == 0
+    assert duo.planes[0].layer_ship_messages == 0
+    duo.scheds[1].submit(prompt, _sp(n), request_id="r")
+    _, evB = duo.drive()
+    assert _tokens(evB, "r") == expected
+    assert duo.engines[1].prefix_hit_tokens > 0

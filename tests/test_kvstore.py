@@ -915,3 +915,88 @@ def test_e2e_fleet_bounce_warm_starts_and_parks(
         )
     finally:
         client.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Store identity: the checkpoint+config-derived namespace
+# ---------------------------------------------------------------------------
+def test_kvstore_namespace_derivation_is_pure_and_model_keyed():
+    """The namespace is a pure function of (ckpt_path, model config):
+    every gang member, every restart, and BOTH derivation sites (the
+    driver's serve_fleet and the replica's build_engine hand over the
+    same raw kwargs) compute the identical string — and it moves the
+    moment either identity input moves."""
+    import dataclasses
+
+    from ray_lightning_tpu.serve.kvstore import kvstore_namespace
+
+    ns = kvstore_namespace("/ckpts/a", CFG)
+    assert ns == kvstore_namespace("/ckpts/a", CFG)  # pure
+    # Dataclass and its dict form agree: the driver often holds the
+    # config as a plain mapping while the replica holds the dataclass.
+    assert ns == kvstore_namespace("/ckpts/a", dataclasses.asdict(CFG))
+    assert ns != kvstore_namespace("/ckpts/b", CFG)  # ckpt moves it
+    other = dataclasses.replace(CFG, n_layer=4)
+    assert ns != kvstore_namespace("/ckpts/a", other)  # config moves it
+    assert len(ns) == 16 and int(ns, 16) >= 0  # short stable hex
+
+
+def test_store_namespace_isolation_and_legacy_entries_miss(tmp_path):
+    """Regression for the store-identity bug: one shared directory,
+    entries written by a LEGACY (pre-namespace) store and by two
+    namespaced stores. Nothing crosses: a namespaced reader treats the
+    legacy bare-hex entry as an explicit miss (even when the file is
+    renamed under its key — the envelope's embedded key fails the
+    round-trip), and the two namespaces never serve each other."""
+    legacy = FleetKVStore(str(tmp_path))
+    ns_a = FleetKVStore(str(tmp_path), namespace="aaaa1111aaaa1111")
+    ns_b = FleetKVStore(str(tmp_path), namespace="bbbb2222bbbb2222")
+    assert legacy.put_blocks(_fake_blocks(2)) == 2
+    assert ns_a.put_blocks([(_hexd(5), _blk(5), _blk(105))]) == 1
+    # The legacy entry exists on disk but is invisible under a
+    # namespace: key-miss, counted, nothing dropped.
+    blocks, missing = ns_a.get_chain([_hexd(0)])
+    assert blocks == [] and missing == [_hexd(0)]
+    assert ns_a.misses == 1 and ns_a.corrupt == 0
+    assert os.path.exists(legacy.backend._path(_hexd(0)))
+    # Rename attack: the legacy file copied under the namespaced key
+    # still misses — the envelope embeds the FULL namespaced key, so a
+    # moved pre-namespace entry fails identity and is dropped loudly.
+    shutil.copy(
+        legacy.backend._path(_hexd(0)),
+        ns_a.backend._path(ns_a._key(_hexd(0))),
+    )
+    blocks, missing = ns_a.get_chain([_hexd(0)])
+    assert blocks == [] and missing == [_hexd(0)]
+    assert ns_a.corrupt == 1
+    assert not os.path.exists(ns_a.backend._path(ns_a._key(_hexd(0))))
+    # Cross-namespace isolation both ways.
+    assert ns_b.get_chain([_hexd(5)]) == ([], [_hexd(5)])
+    got, _ = ns_a.get_chain([_hexd(5)])
+    assert len(got) == 1 and np.array_equal(got[0][1], _blk(5))
+    # Manifests stay per-identity: legacy sees bare keys only, each
+    # namespace sees only its own digests (bare wire form).
+    assert sorted(legacy.manifest()) == sorted([_hexd(0), _hexd(1)])
+    assert ns_a.manifest() == [_hexd(5)]
+    assert ns_b.manifest() == []
+
+
+def test_engine_derives_and_wires_kvstore_namespace(params, tmp_path):
+    """An engine given only kvstore_dir derives the config-hash
+    namespace itself (matching the helper), hands it to its store, and
+    an explicit build_engine-supplied namespace wins over derivation."""
+    from ray_lightning_tpu.serve.kvstore import kvstore_namespace
+
+    eng = _engine(
+        params, dict(DENSE_KW, kvstore_dir=str(tmp_path / "kv"))
+    )
+    assert eng.kvstore_namespace == kvstore_namespace(None, CFG)
+    assert eng.kvstore.namespace == eng.kvstore_namespace
+    eng2 = _engine(
+        params,
+        dict(
+            DENSE_KW, kvstore_dir=str(tmp_path / "kv"),
+            kvstore_namespace="cafe0123cafe0123",
+        ),
+    )
+    assert eng2.kvstore.namespace == "cafe0123cafe0123"

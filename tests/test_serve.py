@@ -1092,3 +1092,160 @@ def test_cli_serve_smoke(tmp_path, serve_params):
     stats = json.loads(stats_line[-1])["serve_stats"]
     assert stats[0]["occupancy"] > 0
     assert stats[0]["tokens_per_sec"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Fused piggyback dispatch + the pre-lowered fold-depth ladder
+# ---------------------------------------------------------------------------
+#: Chunked-prefill engine with fused prefill rows riding the decode
+#: fold: the exactness matrix below must be indistinguishable from the
+#: separate-dispatch engine, token for token.
+PB_KW = dict(
+    num_slots=3, max_seq=64, prefill_buckets=[16], prefill_chunk=4,
+    decode_fold=2, piggyback_chunks=2,
+)
+
+
+def _run_sched_workload(params, engine_kw, seed=11, n_reqs=6):
+    """Scheduler-driven mixed workload; asserts the compile count is
+    frozen at construction and returns (engine, {rid: (p, n, toks)})."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    eng = DecodeEngine(params, SERVE_CFG, **engine_kw)
+    compiles_before = eng.compiled_count
+    sched = Scheduler(eng, max_prefills_per_step=2)
+    rng = np.random.default_rng(seed)
+    reqs = {}
+    for i in range(n_reqs):
+        p = rng.integers(0, 97, size=int(rng.integers(5, 14))).tolist()
+        n = int(rng.integers(3, 8))
+        rid = sched.submit(p, SamplingParams(max_new_tokens=n))
+        reqs[rid] = (p, n, [])
+    for ev in sched.run_until_idle():
+        if ev.token is not None:
+            reqs[ev.request_id][2].append(ev.token)
+    assert not sched.has_work()
+    assert eng.compiled_count == compiles_before
+    return eng, reqs
+
+
+def test_piggyback_fused_dispatch_bit_exact(serve_params):
+    """Piggyback ON vs OFF over the same workload: both bit-identical
+    to solo gpt_generate (so to each other), with the fused engine
+    actually folding chunk rows into decode dispatches (counters move)
+    and the separate-dispatch engine never doing so."""
+    off_kw = {k: v for k, v in PB_KW.items() if k != "piggyback_chunks"}
+    eng_off, reqs_off = _run_sched_workload(serve_params, off_kw)
+    eng_on, reqs_on = _run_sched_workload(serve_params, PB_KW)
+    for eng, reqs in ((eng_off, reqs_off), (eng_on, reqs_on)):
+        for rid, (p, n, toks) in reqs.items():
+            assert p + toks == _reference(serve_params, p, n), rid
+    assert eng_off.piggyback_dispatches == 0
+    assert eng_on.piggyback_dispatches > 0
+    assert eng_on.piggyback_chunk_rows >= eng_on.piggyback_dispatches
+
+
+def test_piggyback_spec_ngram_bit_exact(serve_params):
+    """Speculative decoding under fused dispatch: drafter + verify +
+    piggybacked chunk rows in one executable, still bit-exact."""
+    eng, reqs = _run_sched_workload(
+        serve_params, dict(PB_KW, spec="ngram", spec_depth=2), seed=13
+    )
+    for rid, (p, n, toks) in reqs.items():
+        assert p + toks == _reference(serve_params, p, n), rid
+    assert eng.piggyback_dispatches > 0
+
+
+def test_fold_ladder_switches_mid_stream_zero_compiles(serve_params):
+    """The pre-lowered fold-depth ladder: a second admission wave lands
+    mid-stream, forcing the rung back down for piggyback rows, then back
+    up as the queue drains — at least two rungs dispatched, greedy
+    output exact, and ZERO backend compiles inside the serving window
+    (the real compile listener, not the engine's own counter)."""
+    from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    rng = np.random.default_rng(29)
+    wave1 = [
+        (rng.integers(0, 97, size=9).tolist(), 8),
+        (rng.integers(0, 97, size=6).tolist(), 7),
+    ]
+    wave2 = [
+        (rng.integers(0, 97, size=12).tolist(), 6),
+        (rng.integers(0, 97, size=7).tolist(), 5),
+    ]
+    # References compile OUTSIDE the listener window.
+    expected = {
+        f"w{i}": _reference(serve_params, p, n)
+        for i, (p, n) in enumerate(wave1 + wave2)
+    }
+    stats = install_compile_listener()
+    eng = DecodeEngine(
+        serve_params, SERVE_CFG,
+        **dict(PB_KW, piggyback_chunks=3, fold_ladder=[1, 2, 4]),
+    )
+    sched = Scheduler(eng, max_prefills_per_step=2)
+    baseline = stats.count("backend_compile")
+    outs = {}
+    for i, (p, n) in enumerate(wave1):
+        rid = sched.submit(p, SamplingParams(max_new_tokens=n),
+                           request_id=f"w{i}")
+        outs[rid] = []
+    for _ in range(4):  # wave 1 prefills drain; deep rungs take over
+        for ev in sched.step():
+            if ev.token is not None:
+                outs[ev.request_id].append(ev.token)
+    for j, (p, n) in enumerate(wave2):  # mid-stream: rung forced shallow
+        rid = sched.submit(p, SamplingParams(max_new_tokens=n),
+                           request_id=f"w{len(wave1) + j}")
+        outs[rid] = []
+    for ev in sched.run_until_idle():
+        if ev.token is not None:
+            outs[ev.request_id].append(ev.token)
+    # The compile window closes BEFORE any reference re-run (the
+    # precomputed `expected` keeps gpt_generate's own compiles out).
+    assert stats.count("backend_compile") == baseline
+    rungs_used = [k for k, v in eng.fold_dispatches.items() if v > 0]
+    assert len(rungs_used) >= 2, eng.fold_dispatches
+    for i, (p, n) in enumerate(wave1 + wave2):
+        assert p + outs[f"w{i}"] == expected[f"w{i}"], f"w{i}"
+
+
+def test_piggyback_cancel_mid_fold(serve_params):
+    """A piggybacked prefill cancelled BETWEEN fused dispatches: the
+    boundary eviction drops its chunk state machine, its terminal reads
+    `cancelled`, the survivors stay bit-exact, and no compile moves."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    eng = DecodeEngine(serve_params, SERVE_CFG, **PB_KW)
+    compiles_before = eng.compiled_count
+    sched = Scheduler(eng, max_prefills_per_step=2)
+    rng = np.random.default_rng(31)
+    p_keep = rng.integers(0, 97, size=5).tolist()
+    p_dead = rng.integers(0, 97, size=13).tolist()  # 4 chunks of 4
+    keep = sched.submit(p_keep, SamplingParams(max_new_tokens=8),
+                        request_id="keep")
+    outs = {keep: []}
+    for _ in range(3):  # `keep` admits and starts decoding
+        for ev in sched.step():
+            if ev.token is not None:
+                outs[ev.request_id].append(ev.token)
+    dead = sched.submit(p_dead, SamplingParams(max_new_tokens=6),
+                        request_id="dead")
+    evs = sched.step()  # one fused dispatch carries a `dead` chunk row
+    assert not any(e.done for e in evs if e.request_id == dead)
+    assert eng.piggyback_dispatches > 0
+    assert sched.cancel(dead)
+    tail = sched.run_until_idle()
+    for ev in evs + tail:
+        if ev.token is not None:
+            outs.setdefault(ev.request_id, []).append(ev.token)
+    assert "cancelled" in [
+        e.reason for e in tail if e.request_id == dead and e.done
+    ]
+    assert p_keep + outs[keep] == _reference(serve_params, p_keep, 8)
+    assert eng.num_active == 0 and not sched.has_work()
+    assert eng.compiled_count == compiles_before

@@ -569,3 +569,35 @@ def test_paged_residency_beats_dense_at_fixed_budget(params):
     assert paged_res >= 1.5 * dense_res, (paged_res, dense_res)
 
 
+
+
+def test_paged_piggyback_fused_dispatch_bit_exact(params):
+    """Piggybacked prefill rows over the PAGED pool (chunk writes land
+    through page tables while decode rows read them): scheduler-driven
+    mixed workload bit-identical to solo gpt_generate with frozen
+    compiles and the fused counters moving."""
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    eng = _paged(params, piggyback_chunks=2, fold_ladder=[1, 2])
+    compiles_before = eng.compiled_count
+    sched = Scheduler(eng, max_prefills_per_step=2)
+    rng = np.random.default_rng(41)
+    reqs = {}
+    for i in range(6):
+        p = rng.integers(0, 97, size=int(rng.integers(5, 15))).tolist()
+        n = int(rng.integers(3, 8))
+        rid = sched.submit(p, SamplingParams(max_new_tokens=n))
+        reqs[rid] = (p, n, [])
+    for ev in sched.run_until_idle():
+        if ev.token is not None:
+            reqs[ev.request_id][2].append(ev.token)
+    assert not sched.has_work() and eng.num_active == 0
+    for rid, (p, n, toks) in reqs.items():
+        assert p + toks == _reference(params, p, n), rid
+    assert eng.piggyback_dispatches > 0
+    assert eng.piggyback_chunk_rows > 0
+    assert eng.compiled_count == compiles_before
+    # No page leaked through the fused chunk path: everything left in
+    # the pool is an unreferenced (aliasable) cache entry.
+    for m in eng._pool_meta:
+        assert m is None or m.refs == 0

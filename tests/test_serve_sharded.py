@@ -577,3 +577,48 @@ def test_replica_stats_carry_mesh_and_memory(tp_mesh, shard_params):
         assert kv["per_device_bytes"] == kv["bytes"] // MESH_SHAPE[0]
     finally:
         rep.stop()
+
+
+def test_sharded_piggyback_fold_ladder_bit_identical_zero_compiles(
+    tp_mesh, shard_params
+):
+    """The fused dispatch under the mesh: piggybacked chunk rows + the
+    fold ladder with heads/KV sharded over "model". The rung choice and
+    the piggyback plan are pure functions of the op stream, so the one
+    in-process gang member here exercises the same code path every
+    gang follower replays. Bit-identical to the single-device engine's
+    oracle (solo gpt_generate), zero backend compiles while serving."""
+    from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    rng = np.random.default_rng(47)
+    reqs = [
+        (rng.integers(0, 97, size=int(rng.integers(5, 14))).tolist(),
+         int(rng.integers(3, 8)))
+        for _ in range(5)
+    ]
+    expected = {
+        f"m{i}": _reference(shard_params, p, n)
+        for i, (p, n) in enumerate(reqs)
+    }
+    stats = install_compile_listener()
+    eng = _engine(
+        shard_params, tp_mesh, num_slots=3, max_seq=64,
+        prefill_buckets=[16], prefill_chunk=4, decode_fold=2,
+        piggyback_chunks=2, fold_ladder=[1, 2],
+    )
+    sched = Scheduler(eng, max_prefills_per_step=2)
+    baseline = stats.count("backend_compile")
+    outs = {}
+    for i, (p, n) in enumerate(reqs):
+        rid = sched.submit(p, SamplingParams(max_new_tokens=n),
+                           request_id=f"m{i}")
+        outs[rid] = []
+    for ev in sched.run_until_idle():
+        if ev.token is not None:
+            outs[ev.request_id].append(ev.token)
+    assert not sched.has_work() and eng.num_active == 0
+    assert stats.count("backend_compile") == baseline
+    assert eng.piggyback_dispatches > 0
+    for i, (p, n) in enumerate(reqs):
+        assert p + outs[f"m{i}"] == expected[f"m{i}"], f"m{i}"

@@ -29,6 +29,7 @@ have_paged=0
 have_router=0
 have_kvfleet=0
 have_kvstore=0
+have_piggyback=0
 full_fails=0
 gpt_fails=0
 serve_fails=0
@@ -45,6 +46,7 @@ paged_fails=0
 router_fails=0
 kvfleet_fails=0
 kvstore_fails=0
+piggyback_fails=0
 flash_fails=0
 headline_attempts=0
 flash_attempts=0
@@ -65,6 +67,7 @@ paged_status=pending
 router_status=pending
 kvfleet_status=pending
 kvstore_status=pending
+piggyback_status=pending
 flash_status=pending
 # A stage that fails MAX_STAGE_FAILS times is skipped (marked done) so a
 # deterministically-broken sweep can't hold later stages and BENCH_DONE
@@ -92,6 +95,7 @@ write_manifest() {
     echo "stage=router status=$router_status fails=$router_fails"
     echo "stage=kvfleet status=$kvfleet_status fails=$kvfleet_fails"
     echo "stage=kvstore status=$kvstore_status fails=$kvstore_fails"
+    echo "stage=piggyback status=$piggyback_status fails=$piggyback_fails"
     echo "stage=flash_ab status=$flash_status attempts=$flash_attempts"
   } > /tmp/BENCH_DONE
 }
@@ -328,6 +332,35 @@ while true; do
             have_kvstore=1
             kvstore_status=skipped
             echo "$(date -u +%H:%M:%S) kvstore serve bench SKIPPED after $kvstore_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_piggyback" -eq 0 ]; then
+        # Stage 4a+++: fused-dispatch artifact - the serve sweep now
+        # carries piggyback_rows + fold_ladder_rows (heavy-prefill mix
+        # fused vs separate dispatches, pre-lowered fold-depth ladder
+        # switching rungs mid-stream with zero compiles) and
+        # layerwise_rows (layer-pipelined KV shipping vs whole-prompt,
+        # ship-to-first-decode), so the next healthy window records the
+        # one-dispatch-all-work story ON CHIP next to the CPU control.
+        echo "$(date -u +%H:%M:%S) launching PIGGYBACK serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/piggyback_bench.json 2> /tmp/piggyback_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/piggyback_bench.json ] && \
+           grep -q piggyback_rows /tmp/piggyback_bench.json && \
+           grep -q layerwise_rows /tmp/piggyback_bench.json; then
+          have_piggyback=1
+          piggyback_status=ok
+          echo "$(date -u +%H:%M:%S) PIGGYBACK serve bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          piggyback_fails=$((piggyback_fails+1))
+          piggyback_status=failed
+          echo "$(date -u +%H:%M:%S) piggyback serve bench failed rc=$rc (fail $piggyback_fails)" >> /tmp/tpu_watch.log
+          if [ "$piggyback_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_piggyback=1
+            piggyback_status=skipped
+            echo "$(date -u +%H:%M:%S) piggyback serve bench SKIPPED after $piggyback_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_sharded" -eq 0 ]; then
