@@ -2477,6 +2477,262 @@ def bench_router(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
     return _in_worker(run, False, timeout=1200.0)
 
 
+def bench_router_qps(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
+    """``router_qps_rows``: the submit-side front door at six-figure
+    request counts (driver-side policy — always a CPU control):
+
+    - ``router_qps``: 10k+ synthetic streams admitted through stub
+      admission replicas that are REAL fabric actors (so every submit
+      pays a genuine process-hop RPC, not an in-process call), serial
+      ``submit`` loop vs chunked ``submit_many``. Batched mode coalesces
+      each chunk into ONE vectorized ``Router.plan_many`` call and ONE
+      ``submit_many`` RPC per target replica, so the RPC count drops
+      from N to ~(chunks x replicas). Rows record submit-side QPS, RPC
+      counts, admitted/lost counts, and the router's mean plan batch —
+      the run ASSERTS batched >= 2x serial QPS at equal admitted work
+      with zero lost requests.
+    - ``router_qps_exact``: the same serial-vs-batched pair on a real
+      2-replica tiny CPU fleet, streaming every request to completion —
+      token streams must be bit-identical across modes and
+      ``compiles_since_init`` must stay 0 (the batched path introduces
+      no new compiled shapes; it is driver-side only).
+    """
+
+    def run():
+        import dataclasses
+        import os as _os
+        import tempfile as _tempfile
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu import fabric as _fabric
+        from ray_lightning_tpu.models.gpt import GPTConfig, init_gpt_params
+        from ray_lightning_tpu.serve.client import (
+            RequestHandle,
+            ServeClient,
+            start_replicas,
+        )
+        from ray_lightning_tpu.serve.router import Router
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        _fabric.init(num_cpus=max(8.0, float(_os.cpu_count() or 1)))
+        tiny = _os.environ.get("RLT_BENCH_TINY") == "1"
+        g = np.random.default_rng(0)
+        rows = []
+
+        # ---- QPS leg: stub admission servers (real fabric actors) ----
+        class _StubServer:
+            """Admission-only replica: a real actor process so each
+            submit pays the true RPC hop, but no model — the leg
+            measures the DRIVER'S submit path, nothing else."""
+
+            def __init__(self):
+                self.admitted = []
+                self.rpc_calls = 0
+
+            def submit(self, prompt, request_id=None, **kw):  # noqa: ARG002
+                self.rpc_calls += 1
+                rid = request_id or f"r{len(self.admitted)}"
+                self.admitted.append(rid)
+                return rid
+
+            def submit_many(self, reqs):
+                self.rpc_calls += 1
+                out = []
+                for req in reqs:
+                    rid = req.get("request_id") or f"r{len(self.admitted)}"
+                    self.admitted.append(rid)
+                    out.append(rid)
+                return out
+
+            def counts(self):
+                return {
+                    "admitted": len(self.admitted),
+                    "rpc_calls": self.rpc_calls,
+                }
+
+            def stop(self):
+                return True
+
+        n_req = 2000 if tiny else 10000
+        n_stub, chunk = 4, 256
+        qps_prompts = [
+            g.integers(0, 256, size=12).tolist() for _ in range(n_req)
+        ]
+
+        def qps_run(batched):
+            actors = [
+                _fabric.remote(_StubServer).options(num_cpus=1).remote()
+                for _ in range(n_stub)
+            ]
+            client = ServeClient(
+                actors, rpc_timeout_s=60.0,
+                journal_capacity=2 * n_req,
+            )
+            client.router = Router(
+                client=None, refresh_s=float("inf"), prefix_block=16,
+                shed=False,
+            )
+            try:
+                lost = 0
+                t0 = _time.monotonic()
+                if batched:
+                    for lo in range(0, n_req, chunk):
+                        out = client.submit_many(
+                            qps_prompts[lo:lo + chunk],
+                            sampling=[
+                                {"seed": lo + k}
+                                for k in range(
+                                    len(qps_prompts[lo:lo + chunk])
+                                )
+                            ],
+                            max_new_tokens=4,
+                        )
+                        lost += sum(
+                            1 for r in out
+                            if not isinstance(r, RequestHandle)
+                        )
+                else:
+                    for i, prompt in enumerate(qps_prompts):
+                        client.submit(
+                            prompt, max_new_tokens=4, seed=i
+                        )
+                wall = _time.monotonic() - t0
+                counts = [
+                    _fabric.get(a.counts.remote(), timeout=60)
+                    for a in actors
+                ]
+                plan = (client.router.rows().get("plan") or {})
+                return {
+                    "requests": n_req,
+                    "submit_qps": round(n_req / wall, 1),
+                    "wall_s": round(wall, 4),
+                    "admitted": sum(c["admitted"] for c in counts),
+                    "lost": lost,
+                    "rpc_calls": sum(c["rpc_calls"] for c in counts),
+                    "plan_mean_batch": plan.get("mean_batch", 1.0),
+                }
+            finally:
+                client.shutdown()
+
+        serial = qps_run(batched=False)
+        batched = qps_run(batched=True)
+        rows.append({
+            "workload": "router_qps", "mode": "serial", **serial,
+        })
+        rows.append({
+            "workload": "router_qps", "mode": "batched", **batched,
+        })
+        speedup = round(
+            batched["submit_qps"] / max(serial["submit_qps"], 1e-9), 3
+        )
+        assert serial["lost"] == 0 and batched["lost"] == 0, (
+            f"lost requests: serial={serial['lost']} "
+            f"batched={batched['lost']}"
+        )
+        assert serial["admitted"] == batched["admitted"] == n_req, (
+            "admitted-work goodput differs: "
+            f"serial={serial['admitted']} batched={batched['admitted']} "
+            f"offered={n_req}"
+        )
+        assert speedup >= 2.0, (
+            f"batched submit QPS only {speedup}x serial "
+            f"({batched['submit_qps']} vs {serial['submit_qps']}); "
+            "the batched front door must be >= 2x"
+        )
+
+        # ---- exactness leg: real 2-replica tiny fleet ----------------
+        cfg = GPTConfig(
+            vocab_size=256, n_layer=1, n_head=4, n_kv_head=2, d_model=32,
+            max_seq=128, attn_impl="reference", compute_dtype="float32",
+        )
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        ckpt = _os.path.join(
+            _tempfile.mkdtemp(prefix="rlt_router_qps_"), "m.ckpt"
+        )
+        state_stream_to_file(
+            to_state_stream(
+                {"params": params, "gpt_config": dataclasses.asdict(cfg)}
+            ),
+            ckpt,
+        )
+        n_ex, ex_new = (8 if tiny else 16), 8
+        ex_prompts = [
+            g.integers(0, 256, size=8).tolist() for _ in range(n_ex)
+        ]
+        eng_kw = dict(
+            num_slots=2, max_seq=8 + ex_new, prefill_buckets=[8],
+            decode_fold=2,
+        )
+
+        def exact_run(batched):
+            client = start_replicas(
+                2, ckpt_path=ckpt, env={"JAX_PLATFORMS": "cpu"}, **eng_kw
+            )
+            client.router = Router(
+                client=client, refresh_s=0.0, prefix_block=16, shed=False,
+            )
+            try:
+                if batched:
+                    handles = client.submit_many(
+                        ex_prompts,
+                        sampling=[{"seed": i} for i in range(n_ex)],
+                        max_new_tokens=ex_new,
+                    )
+                else:
+                    handles = [
+                        client.submit(p, max_new_tokens=ex_new, seed=i)
+                        for i, p in enumerate(ex_prompts)
+                    ]
+                assert all(
+                    isinstance(h, RequestHandle) for h in handles
+                ), "a batched submit slot came back as an exception"
+                streams = [
+                    list(client.stream_handle(h, timeout_s=120))
+                    for h in handles
+                ]
+                compiles = sum(
+                    int(s.get("compiles_since_init", 0))
+                    for s in client.stats()
+                )
+                return streams, compiles
+            finally:
+                client.shutdown()
+
+        serial_streams, serial_compiles = exact_run(batched=False)
+        batched_streams, batched_compiles = exact_run(batched=True)
+        exact = serial_streams == batched_streams
+        assert exact, (
+            "batched submit diverged from serial: token streams differ"
+        )
+        assert serial_compiles == 0 and batched_compiles == 0, (
+            f"compiles_since_init: serial={serial_compiles} "
+            f"batched={batched_compiles} (must stay 0 — the batched "
+            "front door is driver-side only)"
+        )
+        rows.append({
+            "workload": "router_qps_exact",
+            "requests": n_ex,
+            "tokens_per_stream": ex_new,
+            "exact": exact,
+            "compiles_since_init": serial_compiles + batched_compiles,
+        })
+
+        return {
+            "router_qps_rows": rows,
+            "router_qps_speedup": speedup,
+            "router_qps_exact": exact,
+            "router_qps_cpu_control": True,
+        }
+
+    return _in_worker(run, False, timeout=1200.0)
+
+
 def bench_disagg(use_tpu: bool) -> Dict[str, Any]:  # noqa: ARG001
     """``disagg_rows``: the fleet KV plane measured on 2-replica CPU
     fleets (driver-side + transfer-plane machinery — always a CPU
@@ -3405,6 +3661,10 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 - still emit a record
             extra["router_error"] = f"{type(exc).__name__}: {exc}"
         try:
+            extra.update(bench_router_qps(use_tpu))
+        except Exception as exc:  # noqa: BLE001 - still emit a record
+            extra["router_qps_error"] = f"{type(exc).__name__}: {exc}"
+        try:
             extra.update(bench_disagg(use_tpu))
         except Exception as exc:  # noqa: BLE001 - still emit a record
             extra["disagg_error"] = f"{type(exc).__name__}: {exc}"
@@ -3556,6 +3816,10 @@ def main() -> None:
             extra.update(bench_router(use_tpu))
         except Exception as exc:  # noqa: BLE001
             extra["router_error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            extra.update(bench_router_qps(use_tpu))
+        except Exception as exc:  # noqa: BLE001
+            extra["router_qps_error"] = f"{type(exc).__name__}: {exc}"
         try:
             extra.update(bench_disagg(use_tpu))
         except Exception as exc:  # noqa: BLE001

@@ -406,6 +406,7 @@ _SERVE_KEYS = frozenset((
     "preempt_grace_s", "preempt_sigterm", "preempt_metadata",
     "router", "router_refresh_s", "router_affinity", "router_shed",
     "shed_queue_factor", "retry_budget", "hedge_after_s",
+    "submit_batch_ms", "directory_shards",
     "autoscale_min", "autoscale_max", "autoscale_interval_s",
     "prefill_replicas", "kvfleet", "kvfleet_timeout_s",
     "kvfleet_inflight_mb", "kvfleet_bandwidth_mbps",
@@ -989,6 +990,25 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     hedge_after_s = serve_cfg.pop("hedge_after_s", None)
     if hedge_after_s is not None:
         hedge_after_s = float(hedge_after_s)
+    # Control-plane throughput knobs (validated up front with named
+    # ranges — a fleet launch dies on the driver with the flag name):
+    # submit_batch_ms arms the client's micro-batching window (one
+    # vectorized plan + one submit_many RPC per target per window),
+    # directory_shards lock-stripes the fleet KV directory.
+    submit_batch_ms = float(serve_cfg.pop("submit_batch_ms", 0.0))
+    if not 0.0 <= submit_batch_ms <= 1000.0:
+        raise ValueError(
+            f"--serve.submit_batch_ms {submit_batch_ms} out of range: "
+            "need 0 <= ms <= 1000 (micro-batching window; 0 = off, the "
+            "serial submit path)"
+        )
+    directory_shards = int(serve_cfg.pop("directory_shards", 1))
+    if not 1 <= directory_shards <= 256:
+        raise ValueError(
+            f"--serve.directory_shards {directory_shards} out of "
+            "range: need 1 <= N <= 256 (lock stripes over the fleet KV "
+            "directory; 1 = the single-shard structure)"
+        )
     autoscale_min = serve_cfg.pop("autoscale_min", None)
     autoscale_max = serve_cfg.pop("autoscale_max", None)
     autoscale_interval_s = float(
@@ -1153,6 +1173,8 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             "autoscale_min": autoscale_min,
             "autoscale_max": autoscale_max,
             "autoscale_interval_s": autoscale_interval_s,
+            "submit_batch_ms": submit_batch_ms,
+            "directory_shards": directory_shards,
         }
         replica_kwargs["router_config"] = router_cfg
     if serve_cfg:
@@ -1200,6 +1222,7 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         rpc_timeout_s=rpc_timeout_s,
         retry_budget_ratio=retry_budget,
         hedge_after_s=hedge_after_s,
+        submit_batch_ms=submit_batch_ms,
         roles=roles,
         kvfleet=kvfleet,
         kvfleet_timeout_s=kvfleet_timeout_s,
@@ -1245,6 +1268,7 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             prefix_block=router_cfg["prefix_block"],
             shed=router_shed,
             shed_queue_factor=shed_queue_factor,
+            directory_shards=directory_shards,
         )
         client.router = router
         # Warm-start: a fresh fleet inherits the persistent store's
@@ -1447,12 +1471,25 @@ def run_replay(config: Dict[str, Any]) -> Dict[str, Any]:
         recorded run's ledger, so the trace doubles as a benchmark).
       replica: which replica's stream to replay from a replica-tagged
         multi-replica journal (default: lowest tag).
+      router: re-drive the capture through the ROUTER instead of the
+        single-engine path — every replica stream merges, every submit
+        routes through a Router.plan rebuilt from the header's recorded
+        policy knobs, and the verdict additionally asserts zero lost
+        (shedding is forced off: a replay must place every request).
+      speed: wall-pace multiplier for --replay.router (1.0 = recorded
+        pace, 10.0 = ten times faster; truncations stay deterministic
+        so exactness holds at any speed). Router mode only.
       max_steps: scheduler-step budget (default 200000).
       out: also write the verdict JSON to this path.
     """
     import json as _json
 
-    from ray_lightning_tpu.obs.journal import load_journal, replay_journal
+    from ray_lightning_tpu.obs.journal import (
+        load_journal,
+        load_journal_streams,
+        replay_journal,
+        replay_journal_router,
+    )
 
     cfg = dict(config.pop("replay", None) or {})
     journal_path = cfg.pop("journal", None)
@@ -1460,6 +1497,8 @@ def run_replay(config: Dict[str, Any]) -> Dict[str, Any]:
     model_cfg = cfg.pop("config", None)
     timing = str(cfg.pop("timing", "virtual"))
     replica = cfg.pop("replica", None)
+    use_router = bool(cfg.pop("router", False))
+    speed = float(cfg.pop("speed", 1.0))
     max_steps = int(cfg.pop("max_steps", 200_000))
     out_path = cfg.pop("out", None)
     if cfg:
@@ -1468,26 +1507,59 @@ def run_replay(config: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError(
             "replay requires a journal path: rlt replay <journal.jsonl>"
         )
-    journal = load_journal(
-        str(journal_path),
-        replica=None if replica is None else int(replica),
-    )
-    result = replay_journal(
-        journal,
-        ckpt_path=None if ckpt is None else str(ckpt),
-        model_config=None if model_cfg is None else dict(model_cfg),
-        timing=timing,
-        max_steps=max_steps,
-    )
-    verdict = "EXACT" if result["exact"] else "DIVERGED"
-    print(
-        f"replay {journal_path} -> {verdict}: "
-        f"{result['compared']}/{result['requests']} requests compared, "
-        f"{result['tokens_compared']} tokens, "
-        f"{result['open']} open at capture, timing={result['timing']}",
-        file=sys.stderr,
-        flush=True,
-    )
+    if speed <= 0:
+        raise ValueError(
+            f"--replay.speed {speed} out of range: need > 0 "
+            "(wall-pace multiplier; 1.0 = recorded pace)"
+        )
+    if speed != 1.0 and not use_router:
+        raise ValueError(
+            "--replay.speed only applies to --replay.router (the "
+            "single-engine path paces with --replay.timing)"
+        )
+    if use_router:
+        result = replay_journal_router(
+            load_journal_streams(str(journal_path)),
+            ckpt_path=None if ckpt is None else str(ckpt),
+            model_config=(
+                None if model_cfg is None else dict(model_cfg)
+            ),
+            speed=speed,
+            max_steps=max_steps,
+        )
+        verdict = "EXACT" if result["exact"] else "DIVERGED"
+        print(
+            f"router replay {journal_path} -> {verdict}: "
+            f"{result['compared']}/{result['requests']} requests "
+            f"compared over {result['streams']} stream(s), "
+            f"{result['planned']} planned, {result['lost']} lost, "
+            f"{result['tokens_compared']} tokens, "
+            f"speed={result['speed']}x",
+            file=sys.stderr,
+            flush=True,
+        )
+    else:
+        journal = load_journal(
+            str(journal_path),
+            replica=None if replica is None else int(replica),
+        )
+        result = replay_journal(
+            journal,
+            ckpt_path=None if ckpt is None else str(ckpt),
+            model_config=None if model_cfg is None else dict(model_cfg),
+            timing=timing,
+            max_steps=max_steps,
+        )
+        verdict = "EXACT" if result["exact"] else "DIVERGED"
+        print(
+            f"replay {journal_path} -> {verdict}: "
+            f"{result['compared']}/{result['requests']} requests "
+            f"compared, {result['tokens_compared']} tokens, "
+            f"{result['open']} open at capture, "
+            f"timing={result['timing']}",
+            file=sys.stderr,
+            flush=True,
+        )
     div = result.get("divergence")
     if div is not None:
         print(
@@ -1690,6 +1762,15 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"shed={router_block.get('shed', 0)}",
             f"affinity_entries={router_block.get('affinity_entries', 0)}",
         ]
+        # Plan throughput: requests planned per µs of planning wall (the
+        # control-plane speedometer) + the mean vectorized batch size.
+        plan = router_block.get("plan") or {}
+        if plan.get("requests"):
+            parts.append(f"plan b/µs={plan.get('per_us', 0.0)}")
+            parts.append(f"plan_batch={plan.get('mean_batch', 1.0)}")
+        shards = (router_block.get("directory") or {}).get("shards")
+        if shards and int(shards) > 1:
+            parts.append(f"dir_shards={shards}")
         out_of_rotation = [
             f"r{w.get('replica')}"
             for w in router_block.get("replicas") or []

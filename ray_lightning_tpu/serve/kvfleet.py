@@ -112,6 +112,23 @@ def blocks_nbytes(blocks: Sequence[Tuple[str, Any, Any]]) -> int:
     return total
 
 
+class _DirectoryShard:
+    """One lock stripe of the directory: its own lock, its own
+    replica-held LRU, its own store-held LRU. Digests hash to a shard,
+    so two threads touching different shards never contend."""
+
+    __slots__ = ("lock", "map", "store")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        #: digest -> replica index (bounded LRU, newest at the end).
+        self.map: "OrderedDict[bytes, int]" = OrderedDict()
+        #: store-held digests (bounded LRU set, newest at the end) —
+        #: deliberately a SEPARATE structure so replica invalidation
+        #: can never touch it.
+        self.store: "OrderedDict[bytes, None]" = OrderedDict()
+
+
 class FleetKVDirectory:
     """Driver-side digest→replica directory: which replica holds which
     chained block digests — ONE store serving both the router's
@@ -138,21 +155,56 @@ class FleetKVDirectory:
     :meth:`forget_store_digests`). PR 15's single map conflated the
     two, so retiring the last holder also erased chains the store still
     served.
+
+    LOCK STRIPING: one global lock serialized every ``observe`` /
+    ``chain`` / ``forget_*`` under concurrent router refresh + submit
+    traffic — at batched-submit rates the directory became the
+    control plane's hottest lock. The maps now split across ``shards``
+    stripes (digest bytes pick the stripe; chained blake2 digests are
+    uniformly random, so the split is even), each with its own lock
+    and its own per-shard LRU bound of ``ceil(capacity / shards)``.
+    Both halves of one digest's state live on the SAME stripe, so the
+    replica-half vs store-half separation is per-shard and every
+    single-digest operation stays atomic. ``shards=1`` (the default)
+    is bit-for-bit the old single-lock behavior.
     """
 
-    def __init__(self, capacity: int = 65536) -> None:
+    def __init__(self, capacity: int = 65536, shards: int = 1) -> None:
         self.capacity = max(16, int(capacity))
-        self._lock = threading.Lock()
-        #: digest -> replica index (bounded LRU, newest at the end).
-        self._map: "OrderedDict[bytes, int]" = OrderedDict()
-        #: store-held digests (bounded LRU set, newest at the end) —
-        #: deliberately a SEPARATE structure so replica invalidation
-        #: can never touch it.
-        self._store: "OrderedDict[bytes, None]" = OrderedDict()
+        self.shards = max(1, int(shards))
+        #: Per-shard LRU bound: ceil so shards * bound >= capacity (the
+        #: directory never remembers LESS for being striped) — but only
+        #: the ceil rounding, so ``capacity`` still bounds the total.
+        self.shard_capacity = max(
+            1, -(-self.capacity // self.shards)
+        )
+        self._stripes = [_DirectoryShard() for _ in range(self.shards)]
+
+    def _stripe(self, digest: bytes) -> _DirectoryShard:
+        if self.shards == 1:
+            return self._stripes[0]
+        # Chained blake2 digests are uniformly random bytes: two bytes
+        # of the digest spread evenly over any practical shard count.
+        return self._stripes[
+            int.from_bytes(digest[:2], "little") % self.shards
+        ]
+
+    def _group(
+        self, digests: Sequence[bytes]
+    ) -> Dict[_DirectoryShard, List[bytes]]:
+        """Digests grouped by owning stripe, order preserved within
+        each group — one lock acquisition per touched stripe."""
+        groups: Dict[_DirectoryShard, List[bytes]] = {}
+        for d in digests:
+            groups.setdefault(self._stripe(d), []).append(d)
+        return groups
 
     def __len__(self) -> int:
-        with self._lock:
-            return len(self._map)
+        total = 0
+        for s in self._stripes:
+            with s.lock:
+                total += len(s.map)
+        return total
 
     def observe(self, digests: Sequence[bytes], replica: int) -> None:
         """The chain is warm on ``replica`` now (routed there, shipped
@@ -160,16 +212,18 @@ class FleetKVDirectory:
         if not digests:
             return
         idx = int(replica)
-        with self._lock:
-            for d in digests:
-                self._map[d] = idx
-                self._map.move_to_end(d)
-            while len(self._map) > self.capacity:
-                self._map.popitem(last=False)
+        for shard, ds in self._group(digests).items():
+            with shard.lock:
+                for d in ds:
+                    shard.map[d] = idx
+                    shard.map.move_to_end(d)
+                while len(shard.map) > self.shard_capacity:
+                    shard.map.popitem(last=False)
 
     def holder(self, digest: bytes) -> Optional[int]:
-        with self._lock:
-            return self._map.get(digest)
+        shard = self._stripe(digest)
+        with shard.lock:
+            return shard.map.get(digest)
 
     def chain(
         self, digests: Sequence[bytes]
@@ -180,25 +234,30 @@ class FleetKVDirectory:
         living elsewhere — only an unbroken chain is a warm prefix."""
         run_idx: Optional[int] = None
         run = 0
-        with self._lock:
-            for d in digests:
-                i = self._map.get(d)
-                if i is None or (run_idx is not None and i != run_idx):
-                    break
-                run_idx = i
-                run += 1
+        for d in digests:
+            shard = self._stripe(d)
+            with shard.lock:
+                i = shard.map.get(d)
+            if i is None or (run_idx is not None and i != run_idx):
+                break
+            run_idx = i
+            run += 1
         return run_idx, run
 
     def forget_replica(self, idx: int) -> int:
         """A replica died/retired: its warm pages are gone — drop every
         entry pointing at it so traffic re-learns instead of chasing a
-        ghost. Returns entries dropped."""
+        ghost. Returns entries dropped. Touches ONLY the replica half
+        of every stripe — never the store half."""
         idx = int(idx)
-        with self._lock:
-            stale = [d for d, i in self._map.items() if i == idx]
-            for d in stale:
-                del self._map[d]
-        return len(stale)
+        n = 0
+        for shard in self._stripes:
+            with shard.lock:
+                stale = [d for d, i in shard.map.items() if i == idx]
+                for d in stale:
+                    del shard.map[d]
+            n += len(stale)
+        return n
 
     def forget_digests(
         self, digests: Iterable[bytes], replica: Optional[int] = None
@@ -210,15 +269,17 @@ class FleetKVDirectory:
         reports are rings, re-seen across refreshes). Returns entries
         dropped."""
         n = 0
-        with self._lock:
-            for d in digests:
-                i = self._map.get(d)
-                if i is None:
-                    continue
-                if replica is not None and i != int(replica):
-                    continue
-                del self._map[d]
-                n += 1
+        rep = None if replica is None else int(replica)
+        for shard, ds in self._group(list(digests)).items():
+            with shard.lock:
+                for d in ds:
+                    i = shard.map.get(d)
+                    if i is None:
+                        continue
+                    if rep is not None and i != rep:
+                        continue
+                    del shard.map[d]
+                    n += 1
         return n
 
     # -- the store-held half ----------------------------------------------
@@ -228,26 +289,30 @@ class FleetKVDirectory:
         survives every replica."""
         if not digests:
             return
-        with self._lock:
-            for d in digests:
-                self._store[d] = None
-                self._store.move_to_end(d)
-            while len(self._store) > self.capacity:
-                self._store.popitem(last=False)
+        for shard, ds in self._group(digests).items():
+            with shard.lock:
+                for d in ds:
+                    shard.store[d] = None
+                    shard.store.move_to_end(d)
+                while len(shard.store) > self.shard_capacity:
+                    shard.store.popitem(last=False)
 
     def store_holds(self, digest: bytes) -> bool:
-        with self._lock:
-            return digest in self._store
+        shard = self._stripe(digest)
+        with shard.lock:
+            return digest in shard.store
 
     def store_chain(self, digests: Sequence[bytes]) -> int:
         """Longest unbroken LEADING run the store holds — the fetch
         hint of last resort when :meth:`chain` finds no live replica."""
         run = 0
-        with self._lock:
-            for d in digests:
-                if d not in self._store:
-                    break
-                run += 1
+        for d in digests:
+            shard = self._stripe(d)
+            with shard.lock:
+                held = d in shard.store
+            if not held:
+                break
+            run += 1
         return run
 
     def forget_store_digests(self, digests: Iterable[bytes]) -> int:
@@ -256,16 +321,29 @@ class FleetKVDirectory:
         Idempotent, like :meth:`forget_digests`. The ONLY path that
         prunes store-held entries — ``forget_replica`` never does."""
         n = 0
-        with self._lock:
-            for d in digests:
-                if d in self._store:
-                    del self._store[d]
-                    n += 1
+        for shard, ds in self._group(list(digests)).items():
+            with shard.lock:
+                for d in ds:
+                    if d in shard.store:
+                        del shard.store[d]
+                        n += 1
         return n
 
     def store_entries(self) -> int:
-        with self._lock:
-            return len(self._store)
+        total = 0
+        for s in self._stripes:
+            with s.lock:
+                total += len(s.store)
+        return total
+
+    def shard_sizes(self) -> List[Tuple[int, int]]:
+        """Per-shard ``(replica_entries, store_entries)`` — the
+        lock-striping read side the router's rows/stats surface."""
+        out: List[Tuple[int, int]] = []
+        for s in self._stripes:
+            with s.lock:
+                out.append((len(s.map), len(s.store)))
+        return out
 
 
 class KVFleetPlane:

@@ -69,7 +69,7 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -132,6 +132,76 @@ def prompt_block_digests(
     return out
 
 
+class DigestChainCache:
+    """Incremental chained-digest cache: computing a prompt's chain
+    walks block by block, and each step is a pure function of
+    ``(previous digest, block bytes)`` — so a bounded LRU keyed on
+    exactly that pair lets a shared-prefix re-visit REUSE every
+    already-hashed step and blake2 only the novel tail. The emitted
+    chain is bit-identical to :func:`prompt_block_digests` (cache
+    hits return the same digests the hash would), so the directory,
+    the engines' prefix pools, and the affinity policy keep agreeing
+    on what a shared prefix is.
+
+    Memory bound: ``capacity`` entries, each holding one
+    ``(16-byte head, block*4-byte block, 16-byte digest)`` triple —
+    ~6 MB at the default 65536 entries with 16-token blocks.
+
+    Counter-instrumented (``chains`` computed, ``blocks_hashed``,
+    ``blocks_reused``) so the one-chain-per-submit contract and the
+    tail-only-hashing behavior are directly testable."""
+
+    def __init__(self, block: int, capacity: int = 65536) -> None:
+        self.block = max(1, int(block))
+        self.capacity = max(16, int(capacity))
+        self._lock = threading.Lock()
+        #: (prev_digest, block_bytes) -> digest (bounded LRU).
+        self._map: "OrderedDict[Any, bytes]" = OrderedDict()
+        self.chains = 0
+        self.blocks_hashed = 0
+        self.blocks_reused = 0
+
+    def digests(self, tokens: Sequence[int]) -> List[bytes]:
+        """The prompt's chained block digests (bit-identical to
+        :func:`prompt_block_digests`), hashing only the steps the LRU
+        has not seen."""
+        import numpy as np
+
+        out: List[bytes] = []
+        d = b""
+        arr = np.asarray(list(tokens), np.int32)
+        n = len(arr) // self.block
+        with self._lock:
+            self.chains += 1
+            for i in range(n):
+                blk = arr[i * self.block : (i + 1) * self.block].tobytes()
+                key = (d, blk)
+                nxt = self._map.get(key)
+                if nxt is None:
+                    nxt = hashlib.blake2b(
+                        d + blk, digest_size=16
+                    ).digest()
+                    self.blocks_hashed += 1
+                else:
+                    self.blocks_reused += 1
+                self._map[key] = nxt
+                self._map.move_to_end(key)
+                out.append(nxt)
+                d = nxt
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "chains": self.chains,
+                "blocks_hashed": self.blocks_hashed,
+                "blocks_reused": self.blocks_reused,
+                "entries": len(self._map),
+            }
+
+
 class RetryBudget:
     """Shared client-side retry budget: transient-failure retries are
     allowed only up to ``ratio`` of the submits seen in the sliding
@@ -188,6 +258,24 @@ class RetryBudget:
             return True
 
 
+def _hex_digests(items: Any) -> List[bytes]:
+    """Decode a replica-reported ring of hex digest strings, dropping
+    malformed entries individually.  The rings are advisory: one bad
+    entry must not veto the valid digests around it (the directory's
+    striped batch paths consume the whole list before acting)."""
+    out: List[bytes] = []
+    try:
+        it = iter(items)
+    except TypeError:
+        return out
+    for h in it:
+        try:
+            out.append(bytes.fromhex(h))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
 def _default_view(idx: int) -> Dict[str, Any]:
     """A neutral view for a replica the fleet plane has not reported on
     yet (e.g. freshly added by the autoscaler): routable, unloaded."""
@@ -220,6 +308,12 @@ class RoutePlan:
     ship_to: Optional[int] = None
     kv_hint: Optional[Dict[str, Any]] = None
     policy: str = "weighted"
+    #: The prompt's chained block digests, computed ONCE for this plan
+    #: — the caller threads them back into ``observe_route`` so one
+    #: submit hashes its chain exactly one time (plan → hint →
+    #: directory observe all share this list). Routing metadata, never
+    #: serialized onto the RPC.
+    digests: Optional[List[bytes]] = None
 
 
 class Router:
@@ -255,6 +349,7 @@ class Router:
         shed: bool = True,
         shed_queue_factor: float = 4.0,
         retry_after_s: float = 0.25,
+        directory_shards: int = 1,
         registry: Optional[Any] = None,
         events: Optional[Any] = None,
         directory: Optional[FleetKVDirectory] = None,
@@ -297,6 +392,11 @@ class Router:
             "Router base weight per replica (0 = excluded; health x "
             "load, before per-request affinity)",
         )
+        self._m_plan_batch = reg.counter(
+            "rlt_router_plan_batch_size",
+            "Vectorized plan calls by batch-size bucket "
+            "(1 / 2-7 / 8-31 / 32-127 / 128+) — histogram-style",
+        )
         self._lock = threading.RLock()
         #: The fleet KV directory (serve.kvfleet): digest -> replica,
         #: ONE source of truth shared by this router's prefix-affinity
@@ -308,7 +408,16 @@ class Router:
         self.directory = (
             directory
             if directory is not None
-            else FleetKVDirectory(capacity=self.affinity_map_size)
+            else FleetKVDirectory(
+                capacity=self.affinity_map_size,
+                shards=max(1, int(directory_shards)),
+            )
+        )
+        #: One chain computation per submit: plan computes the digests
+        #: through this cache, the RoutePlan carries them, and
+        #: observe_route / _fetch_hint consume the SAME list.
+        self.digest_cache = DigestChainCache(
+            self.prefix_block, capacity=self.affinity_map_size
         )
         #: idx -> merged view row (fleet row + supervisor state).
         self._views: Dict[int, Dict[str, Any]] = {}
@@ -324,6 +433,11 @@ class Router:
         # registry counters carry the labelled split).
         self.routed = 0
         self.shed_count = 0
+        # Plan-throughput accounting (batches / requests planned /
+        # wall spent planning — the `plan b/µs` column).
+        self.plan_batches = 0
+        self.plan_requests = 0
+        self.plan_wall_s = 0.0
 
     # -- views -------------------------------------------------------------
     def _event(self, name: str, level: str = "info", **kv: Any) -> None:
@@ -417,37 +531,23 @@ class Router:
             # every tier leave the shared directory (idempotent — the
             # report is a ring re-seen across refreshes; only entries
             # pointing at THIS replica are touched).
-            dropped = (row.get("kv_dropped") or {}).get("recent") or []
+            dropped = _hex_digests(
+                (row.get("kv_dropped") or {}).get("recent") or []
+            )
             if dropped:
-                try:
-                    self.directory.forget_digests(
-                        (bytes.fromhex(h) for h in dropped),
-                        replica=idx,
-                    )
-                except (TypeError, ValueError):
-                    pass  # malformed report; advisory only
+                self.directory.forget_digests(dropped, replica=idx)
             # Persistent-store feeds: recent write-throughs open
             # store-held routes (a chain that died locally is still
             # fetchable from the store), recent GC drops close them.
             # Both rings are idempotent to re-read, like kv_dropped.
             kvs = row.get("kvstore") or {}
             if isinstance(kvs, dict):
-                written = kvs.get("recent_writes") or []
+                written = _hex_digests(kvs.get("recent_writes") or [])
                 if written:
-                    try:
-                        self.directory.observe_store(
-                            [bytes.fromhex(h) for h in written]
-                        )
-                    except (TypeError, ValueError):
-                        pass
-                gone = kvs.get("recent_dropped") or []
+                    self.directory.observe_store(written)
+                gone = _hex_digests(kvs.get("recent_dropped") or [])
                 if gone:
-                    try:
-                        self.directory.forget_store_digests(
-                            bytes.fromhex(h) for h in gone
-                        )
-                    except (TypeError, ValueError):
-                        pass
+                    self.directory.forget_store_digests(gone)
         with self._lock:
             self._views = views
             prev = self._routable_prev
@@ -503,12 +603,27 @@ class Router:
             return {i: dict(v) for i, v in self._views.items()}
 
     # -- affinity (backed by the shared fleet KV directory) ----------------
-    def observe_route(self, prompt: Sequence[int], idx: int) -> None:
+    def _digests(self, prompt: Sequence[int]) -> List[bytes]:
+        """The prompt's chained block digests through the incremental
+        cache (affinity off -> empty: nothing consumes them)."""
+        if not self.affinity:
+            return []
+        return self.digest_cache.digests(prompt)
+
+    def observe_route(
+        self,
+        prompt: Sequence[int],
+        idx: int,
+        digests: Optional[List[bytes]] = None,
+    ) -> None:
         """A request landed on ``idx``: its prefix chain is warm there
-        now — remember it in the shared directory (bounded LRU)."""
+        now — remember it in the shared directory (bounded LRU).
+        ``digests`` threads the chain the plan already computed; absent
+        (a caller without a plan), it is computed here once."""
         if not self.affinity:
             return
-        digests = prompt_block_digests(prompt, self.prefix_block)
+        if digests is None:
+            digests = self._digests(prompt)
         if digests:
             self.directory.observe(digests, int(idx))
 
@@ -526,9 +641,7 @@ class Router:
         elsewhere — only an unbroken chain is a warm prefix."""
         if not self.affinity:
             return {}
-        run_idx, run = self.directory.chain(
-            prompt_block_digests(prompt, self.prefix_block)
-        )
+        run_idx, run = self.directory.chain(self._digests(prompt))
         return {run_idx: run} if run_idx is not None and run else {}
 
     def affinity_entries(self) -> int:
@@ -682,6 +795,7 @@ class Router:
         idx: int,
         cand: Sequence[int],
         views: Dict[int, Dict[str, Any]],
+        chain: Optional[Any] = None,
     ) -> Optional[Dict[str, Any]]:
         """A warm-peer fetch hint for a request routed to ``idx``: when
         a DIFFERENT live replica holds the prompt's digest chain, the
@@ -691,10 +805,14 @@ class Router:
         live holder, the directory's store-held half gets the last
         word: a ``store: True`` hint sends the target to the
         persistent object store (warm-start after a fleet bounce,
-        parked-session restore)."""
+        parked-session restore). ``chain`` threads a ``(holder, run)``
+        the plan already walked so the hint never re-walks the
+        directory."""
         if not digests:
             return None
-        holder, run = self.directory.chain(digests)
+        holder, run = (
+            chain if chain is not None else self.directory.chain(digests)
+        )
         if holder == idx and run:
             return None  # routed to the warm replica: local hit
         usable = holder is not None and run
@@ -741,12 +859,16 @@ class Router:
         priority: int = 0,
         deadline_s: Optional[float] = None,
         alive: Optional[Sequence[int]] = None,
+        digests: Optional[List[bytes]] = None,
     ) -> RoutePlan:
         """Route one submit: returns a :class:`RoutePlan` (replica +
         fleet-KV placement hints), or raises
         :class:`RequestRejectedError` (admission control). ``alive`` is
         the client's own exclusion-filtered candidate list — the router
         only ever narrows it, never resurrects an excluded replica.
+        ``digests`` threads an already-computed chain (a resubmit, a
+        batch); absent, it is computed once through the incremental
+        cache and rides out on the plan.
 
         With role-split replicas in the candidate set (disaggregated
         prefill/decode), the request lands on a PREFILL replica with a
@@ -754,17 +876,111 @@ class Router:
         already warm on a decode-side replica, which then takes it
         directly (no prefill hop for a prefix hit).
         """
+        t0 = self._clock()
         self.refresh()
         with self._lock:
             views = dict(self._views)
             rr = self._rr
             self._rr += 1
-        cand = list(alive) if alive is not None else sorted(views)
-        digests = (
-            prompt_block_digests(prompt, self.prefix_block)
-            if self.affinity
-            else []
+        try:
+            return self._plan_one(
+                prompt, views, rr, alive, max_new_tokens, priority,
+                deadline_s, digests,
+            )
+        finally:
+            self._note_plans(1, self._clock() - t0)
+
+    def plan_many(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        max_new_tokens: Any = 32,
+        priority: Any = 0,
+        deadline_s: Any = None,
+        alive: Optional[Sequence[int]] = None,
+    ) -> List[Any]:
+        """Vectorized :meth:`plan`: ONE refresh, ONE view snapshot, and
+        ONE lock round-trip cover the whole batch — the per-request
+        work left is pure scoring math. Returns a list aligned with
+        ``prompts`` where each element is a :class:`RoutePlan` or the
+        :class:`RequestRejectedError` admission control raised for that
+        request (a shed request never fails its batchmates).
+        ``max_new_tokens`` / ``priority`` / ``deadline_s`` may each be
+        a scalar (applied to all) or a per-request sequence. Raises
+        ``NoReplicasError`` only when there is nothing to route to at
+        all."""
+        prompts = list(prompts)
+        n = len(prompts)
+        if not n:
+            return []
+        t0 = self._clock()
+        self.refresh()
+        with self._lock:
+            views = dict(self._views)
+            rr = self._rr
+            self._rr += n
+        mnt = self._per_request(max_new_tokens, n)
+        pri = self._per_request(priority, n)
+        dls = self._per_request(deadline_s, n)
+        out: List[Any] = []
+        for k, prompt in enumerate(prompts):
+            try:
+                out.append(
+                    self._plan_one(
+                        prompt, views, rr + k, alive, mnt[k], pri[k],
+                        dls[k], None,
+                    )
+                )
+            except RequestRejectedError as exc:
+                out.append(exc)
+        self._note_plans(n, self._clock() - t0)
+        return out
+
+    @staticmethod
+    def _per_request(value: Any, n: int) -> List[Any]:
+        """Scalar-or-sequence batch knob -> one value per request."""
+        if isinstance(value, (list, tuple)):
+            if len(value) != n:
+                raise ValueError(
+                    f"per-request knob has {len(value)} entries for "
+                    f"{n} prompts"
+                )
+            return list(value)
+        return [value] * n
+
+    def _note_plans(self, n: int, wall_s: float) -> None:
+        """Plan-throughput accounting: one batch of ``n`` decisions
+        took ``wall_s`` (the `plan b/µs` signal + the batch-size
+        histogram counter)."""
+        with self._lock:
+            self.plan_batches += 1
+            self.plan_requests += n
+            self.plan_wall_s += max(0.0, float(wall_s))
+        bucket = (
+            "1" if n == 1
+            else "2-7" if n < 8
+            else "8-31" if n < 32
+            else "32-127" if n < 128
+            else "128+"
         )
+        self._m_plan_batch.inc(1, bucket=bucket)
+
+    def _plan_one(
+        self,
+        prompt: Sequence[int],
+        views: Dict[int, Dict[str, Any]],
+        rr: int,
+        alive: Optional[Sequence[int]],
+        max_new_tokens: int,
+        priority: int,
+        deadline_s: Optional[float],
+        digests: Optional[List[bytes]],
+    ) -> RoutePlan:
+        """One routing decision against an already-snapshotted view set
+        — the shared body of :meth:`plan` and :meth:`plan_many`."""
+        cand = list(alive) if alive is not None else sorted(views)
+        if digests is None:
+            digests = self._digests(prompt)
         holder0, run0 = (
             self.directory.chain(digests) if digests else (None, 0)
         )
@@ -779,6 +995,7 @@ class Router:
             plan = self._plan_disagg(
                 prompt, digests, views, rr, cand, prefill_c, decode_c,
                 aff, max_new_tokens, priority, deadline_s,
+                holder0, run0,
             )
             if plan is not None:
                 return plan
@@ -799,7 +1016,9 @@ class Router:
             self._m_routed.inc(1, reason="fallback")
             with self._lock:
                 self.routed += 1
-            return RoutePlan(idx, policy="fallback")
+            return RoutePlan(
+                idx, policy="fallback", digests=digests or None
+            )
         weight, idx, view, by_affinity = self._top(scored, rr)
         self._admission_check(
             view, [v for _, _, v, _ in scored],
@@ -812,8 +1031,11 @@ class Router:
             self.routed += 1
         return RoutePlan(
             idx,
-            kv_hint=self._fetch_hint(digests, idx, cand, views),
+            kv_hint=self._fetch_hint(
+                digests, idx, cand, views, (holder0, run0)
+            ),
             policy="affinity" if by_affinity else "weighted",
+            digests=digests or None,
         )
 
     def _plan_disagg(
@@ -829,6 +1051,8 @@ class Router:
         max_new_tokens: int,
         priority: int,
         deadline_s: Optional[float],
+        holder: Optional[int],
+        run: int,
     ) -> Optional[RoutePlan]:
         """The disaggregated decision: prefill lands on the prefill
         pool, the finished pages ship to a decode-pool replica chosen
@@ -836,7 +1060,8 @@ class Router:
         where the tokens come from). A prompt already warm on a
         decode-pool replica skips the prefill hop entirely. Returns
         None to fall back to the single-pool path (e.g. neither pool
-        has a routable member — availability beats disaggregation)."""
+        has a routable member — availability beats disaggregation).
+        ``(holder, run)`` is the chain walk the caller already did."""
         decode_scored = self._score(prompt, views, decode_c, aff)
         prefill_scored = self._score(prompt, views, prefill_c, {})
         if not decode_scored or not prefill_scored:
@@ -846,7 +1071,6 @@ class Router:
         # covers every usable block — admission there is a pure alias,
         # no prefill worth offloading.
         useful = self._useful_blocks(prompt)
-        holder, run = self.directory.chain(digests)
         if (
             holder is not None
             and useful
@@ -862,7 +1086,9 @@ class Router:
             self._m_routed.inc(1, reason="warm_direct")
             with self._lock:
                 self.routed += 1
-            return RoutePlan(holder, policy="warm_direct")
+            return RoutePlan(
+                holder, policy="warm_direct", digests=digests or None
+            )
         _, d_idx, d_view, _ = self._top(decode_scored, rr)
         self._admission_check(
             d_view, pool_views, max_new_tokens, priority, deadline_s,
@@ -874,8 +1100,11 @@ class Router:
         return RoutePlan(
             p_idx,
             ship_to=d_idx,
-            kv_hint=self._fetch_hint(digests, p_idx, cand, views),
+            kv_hint=self._fetch_hint(
+                digests, p_idx, cand, views, (holder, run)
+            ),
             policy="disagg",
+            digests=digests or None,
         )
 
     def pick(
@@ -905,7 +1134,12 @@ class Router:
         with self._lock:
             views = dict(self._views)
             routed, shed = self.routed, self.shed_count
+            batches = self.plan_batches
+            requests = self.plan_requests
+            wall_s = self.plan_wall_s
         entries = len(self.directory)
+        wall_us = wall_s * 1e6
+        shard_sizes = self.directory.shard_sizes()
         return {
             "replicas": [
                 {
@@ -922,6 +1156,27 @@ class Router:
             "routed": routed,
             "shed": shed,
             "affinity_entries": entries,
+            # Plan throughput: decisions per µs of planning wall (the
+            # `plan b/µs` column) + how batched the calls were.
+            "plan": {
+                "batches": batches,
+                "requests": requests,
+                "wall_us": round(wall_us, 1),
+                "per_us": round(requests / wall_us, 6) if wall_us else 0.0,
+                "mean_batch": (
+                    round(requests / batches, 2) if batches else 0.0
+                ),
+            },
+            "digest_cache": self.digest_cache.stats(),
+            # The lock-striped directory's per-shard occupancy
+            # (replica-held, store-held) — a skewed stripe means a
+            # skewed digest population, not a router bug.
+            "directory": {
+                "shards": self.directory.shards,
+                "entries": entries,
+                "store_entries": self.directory.store_entries(),
+                "per_shard": [list(t) for t in shard_sizes],
+            },
             "config": self.describe(),
         }
 
@@ -936,6 +1191,7 @@ class Router:
             "shed": self.shed,
             "shed_queue_factor": self.shed_queue_factor,
             "retry_after_s": self.retry_after_s,
+            "directory_shards": self.directory.shards,
         }
 
 
@@ -1223,6 +1479,7 @@ ROUTER_HEADER_KEYS = frozenset((
     "shed", "shed_queue_factor", "retry_after_s",
     "hedge_after_s", "retry_budget_ratio",
     "autoscale_min", "autoscale_max", "autoscale_interval_s",
+    "submit_batch_ms", "directory_shards",
 ))
 
 

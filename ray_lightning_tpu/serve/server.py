@@ -12,7 +12,7 @@ jax from its env before anything heavy loads.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 
 def load_serve_params(
@@ -923,6 +923,47 @@ class ServeReplica:
             }
         self._work.set()
         return rid
+
+    def submit_many(
+        self, requests: Sequence[Dict[str, Any]]
+    ) -> List[str]:
+        """Batched admission: ONE RPC admits every request in
+        ``requests`` (each a dict of :meth:`submit` kwargs plus
+        ``prompt``), seeding all result buffers under one lock pass and
+        waking the serve loop once. Per-request semantics are identical
+        to ``submit`` — same scheduler admission, same fault hook, same
+        client-minted ids — only the per-RPC overhead amortizes (the
+        client-side micro-batching window's wire call)."""
+        from ray_lightning_tpu.serve.scheduler import SamplingParams
+
+        rids: List[str] = []
+        for req in requests:
+            if self.faults is not None:
+                self.faults.hit("rpc_submit")
+            rids.append(self.scheduler.submit(
+                req["prompt"],
+                SamplingParams(
+                    max_new_tokens=req.get("max_new_tokens", 32),
+                    temperature=req.get("temperature", 0.0),
+                    top_k=req.get("top_k"),
+                    top_p=req.get("top_p"),
+                    seed=req.get("seed", 0),
+                    eos_token=req.get("eos_token"),
+                ),
+                request_id=req.get("request_id"),
+                priority=req.get("priority", 0),
+                deadline_s=req.get("deadline_s"),
+                tenant=req.get("tenant"),
+                kv_hint=req.get("kv_hint"),
+                ship_to=req.get("ship_to"),
+            ))
+        with self._cond:
+            for rid in rids:
+                self._buffers[rid] = {
+                    "tokens": [], "done": False, "status": "queued",
+                }
+        self._work.set()
+        return rids
 
     def result(
         self, request_id: str, cursor: int = 0, wait_s: float = 0.0

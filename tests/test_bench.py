@@ -259,6 +259,29 @@ def test_bench_smoke_cpu():
     assert out["extra"]["router_shed_holds_slo"] is True
     assert out["extra"]["router_shed_off_collapses"] is True
     assert out["extra"]["router_cpu_control"] is True
+    # Six-figure front door: the batched submit path (submit_many +
+    # vectorized plan_many) must clear >= 2x the serial submit-side QPS
+    # at equal admitted work with zero lost requests, and the
+    # real-fleet leg must stay bit-exact with zero steady-state
+    # compiles (the batching is driver-side only).
+    qps = {
+        r["mode"]: r
+        for r in out["extra"]["router_qps_rows"]
+        if r["workload"] == "router_qps"
+    }
+    assert set(qps) == {"serial", "batched"}, out["extra"]
+    assert qps["serial"]["lost"] == 0 and qps["batched"]["lost"] == 0
+    assert qps["serial"]["admitted"] == qps["batched"]["admitted"]
+    assert qps["batched"]["rpc_calls"] < qps["serial"]["rpc_calls"], qps
+    assert qps["batched"]["plan_mean_batch"] > 1.0, qps
+    assert out["extra"]["router_qps_speedup"] >= 2.0, qps
+    (qx,) = [
+        r for r in out["extra"]["router_qps_rows"]
+        if r["workload"] == "router_qps_exact"
+    ]
+    assert qx["exact"] is True and qx["compiles_since_init"] == 0, qx
+    assert out["extra"]["router_qps_exact"] is True
+    assert out["extra"]["router_qps_cpu_control"] is True
     # Fleet KV plane: under the heavy-prefill mix, disaggregated
     # prefill/decode must IMPROVE the residents' inter-token p95 over
     # the mixed fleet (long prompts stop stealing fold time) with

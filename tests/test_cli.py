@@ -447,3 +447,46 @@ def test_cli_serve_fused_dispatch_flags_validate_up_front():
     # disaggregated prefill tier underneath.
     with pytest.raises(ValueError, match=r"kvfleet_layerwise"):
         cli.run_serve({"serve": dict(base, kvfleet_layerwise=True)})
+
+
+def test_cli_serve_batch_knobs_validate_up_front():
+    """PR-18 satellite: the control-plane throughput knobs die on the
+    DRIVER with the flag name and the legal range — before any
+    checkpoint loads or replica spawns — are part of the serve
+    vocabulary, and round-trip through the journal header's router
+    section (so a replayed capture knows its front-door config)."""
+    from ray_lightning_tpu.cli import _SERVE_KEYS
+    from ray_lightning_tpu.serve.router import (
+        ROUTER_HEADER_KEYS,
+        router_config_from_header,
+    )
+
+    base = {"ckpt_path": "x", "prompts": "y"}
+    with pytest.raises(
+        ValueError, match=r"submit_batch_ms.*0 <= ms <= 1000"
+    ):
+        cli.run_serve({"serve": dict(base, submit_batch_ms=2000)})
+    with pytest.raises(ValueError, match=r"submit_batch_ms"):
+        cli.run_serve({"serve": dict(base, submit_batch_ms=-0.5)})
+    with pytest.raises(
+        ValueError, match=r"directory_shards.*1 <= N <= 256"
+    ):
+        cli.run_serve({"serve": dict(base, directory_shards=0)})
+    with pytest.raises(ValueError, match=r"directory_shards"):
+        cli.run_serve({"serve": dict(base, directory_shards=512)})
+    # Valid values clear the gate and proceed to the next requirement.
+    with pytest.raises(ValueError, match="ckpt_path"):
+        cli.run_serve(
+            {"serve": {"submit_batch_ms": 2.5, "directory_shards": 8}}
+        )
+    assert {"submit_batch_ms", "directory_shards"} <= _SERVE_KEYS
+    # Header provenance round-trip (unknown keys filtered).
+    assert {"submit_batch_ms", "directory_shards"} <= set(
+        ROUTER_HEADER_KEYS
+    )
+    assert router_config_from_header({
+        "version": 1,
+        "router": {
+            "submit_batch_ms": 2.5, "directory_shards": 8, "junk": 1,
+        },
+    }) == {"submit_batch_ms": 2.5, "directory_shards": 8}

@@ -210,6 +210,69 @@ def test_directory_bounded_lru():
     assert d.holder(digs[-1]) == 0 and d.holder(digs[0]) is None
 
 
+def test_directory_sharded_preserves_every_invariant_under_churn():
+    """PR18 regression: the lock-striped directory must behave exactly
+    like the single-lock structure — replica-half vs store-half
+    separation (forget_replica NEVER touches store-held entries,
+    forget_store_digests is the only store pruner), per-replica
+    eviction scoping, and consistent per-shard accounting — while
+    threads hammer every mutation path concurrently."""
+    import threading
+
+    d = FleetKVDirectory(capacity=4096, shards=8)
+    # Digest population spread over every stripe (first two bytes pick
+    # the stripe).
+    digs = [bytes([i % 256, i // 256] + [7] * 14) for i in range(512)]
+    store_digs = digs[::4]
+    d.observe_store(store_digs)
+    errs = []
+
+    def churn(replica):
+        try:
+            for rep in range(20):
+                lo = (replica * 97 + rep * 31) % 384
+                chain = digs[lo:lo + 64]
+                d.observe(chain, replica=replica)
+                d.chain(chain)
+                d.store_chain(chain)
+                d.forget_digests(chain[:8], replica=replica)
+                if rep % 5 == 4:
+                    d.forget_replica(replica)
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errs.append(exc)
+
+    threads = [
+        threading.Thread(target=churn, args=(r,)) for r in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    # Store half untouched by ANY amount of replica churn (every
+    # thread ran forget_replica / forget_digests over these digests).
+    assert d.store_entries() == len(store_digs)
+    assert d.store_chain(store_digs[:4]) == 4
+    # Per-shard accounting sums to the totals the flat API reports.
+    sizes = d.shard_sizes()
+    assert len(sizes) == 8
+    assert sum(rep for rep, _ in sizes) == len(d)
+    assert sum(st for _, st in sizes) == d.store_entries()
+    # The store half prunes ONLY through forget_store_digests.
+    assert d.forget_store_digests(store_digs) == len(store_digs)
+    assert d.store_entries() == 0
+    # Striped capacity still bounds the whole structure: per-shard
+    # ceil(capacity/shards) never under-admits the advertised total.
+    small = FleetKVDirectory(capacity=16, shards=4)
+    flood = [bytes([i, 255 - i] * 8) for i in range(64)]
+    small.observe(flood, replica=0)
+    # ceil(16/4) = 4 per stripe: capacity bounds the TOTAL (the
+    # max(16, ...) per-stripe floor used to multiply to 4x capacity).
+    assert len(small) <= 16
+    # Single-shard behavior is the PR's baseline contract.
+    assert FleetKVDirectory(capacity=16).shards == 1
+
+
 # ---------------------------------------------------------------------------
 # KVFleetPlane (unit, fake export/import)
 # ---------------------------------------------------------------------------

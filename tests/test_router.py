@@ -47,6 +47,10 @@ class _FakeReplica:
         self.cancels = []
         self.stopped = False
         self.requests = {}
+        #: RPC-shape accounting: serial submit calls vs batched
+        #: submit_many calls (the PR18 wire-amortization assertions).
+        self.submit_rpcs = 0
+        self.batch_rpcs = 0
 
     @staticmethod
     def tokens_for(prompt, seed, n):
@@ -62,11 +66,28 @@ class _FakeReplica:
 
     def _rpc_submit(self, prompt, request_id=None, **kw):
         self._check()
+        self.submit_rpcs += 1
+        return self._admit(prompt, request_id, kw)
+
+    def _admit(self, prompt, request_id, kw):
         self.submits.append((request_id, dict(kw)))
         self.requests[request_id] = self.tokens_for(
             prompt, kw.get("seed", 0), kw.get("max_new_tokens", 32)
         )
         return request_id
+
+    def _rpc_submit_many(self, reqs):
+        # The batched wire shape (ServeReplica.submit_many): ONE RPC,
+        # same per-request bookkeeping as submit, rid list back.
+        self._check()
+        self.batch_rpcs += 1
+        rids = []
+        for req in reqs:
+            req = dict(req)
+            prompt = req.pop("prompt")
+            rid = req.pop("request_id", None)
+            rids.append(self._admit(prompt, rid, req))
+        return rids
 
     def _rpc_result(self, rid, cursor, wait_s=0.0):
         self._check()

@@ -27,6 +27,7 @@ have_failover=0
 have_preempt=0
 have_paged=0
 have_router=0
+have_router_qps=0
 have_kvfleet=0
 have_kvstore=0
 have_piggyback=0
@@ -44,6 +45,7 @@ failover_fails=0
 preempt_fails=0
 paged_fails=0
 router_fails=0
+router_qps_fails=0
 kvfleet_fails=0
 kvstore_fails=0
 piggyback_fails=0
@@ -65,6 +67,7 @@ failover_status=pending
 preempt_status=pending
 paged_status=pending
 router_status=pending
+router_qps_status=pending
 kvfleet_status=pending
 kvstore_status=pending
 piggyback_status=pending
@@ -93,6 +96,7 @@ write_manifest() {
     echo "stage=preempt status=$preempt_status fails=$preempt_fails"
     echo "stage=paged status=$paged_status fails=$paged_fails"
     echo "stage=router status=$router_status fails=$router_fails"
+    echo "stage=router_qps status=$router_qps_status fails=$router_qps_fails"
     echo "stage=kvfleet status=$kvfleet_status fails=$kvfleet_fails"
     echo "stage=kvstore status=$kvstore_status fails=$kvstore_fails"
     echo "stage=piggyback status=$piggyback_status fails=$piggyback_fails"
@@ -277,6 +281,35 @@ while true; do
             have_router=1
             router_status=skipped
             echo "$(date -u +%H:%M:%S) router serve bench SKIPPED after $router_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_router_qps" -eq 0 ]; then
+        # Stage 4a''': front-door-QPS artifact — the serve sweep now
+        # carries router_qps_rows (10k synthetic streams through stub
+        # admission actors, serial submit loop vs chunked submit_many:
+        # submit-side QPS + RPC counts, asserted >= 2x at equal admitted
+        # work and zero lost; plus a real-fleet serial-vs-batched
+        # bit-exactness pair with compiles_since_init == 0), so the next
+        # healthy window records the batched-front-door story next to
+        # the CPU control.
+        echo "$(date -u +%H:%M:%S) launching ROUTER_QPS serve bench" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 2400 python bench.py --serve-only \
+            > /tmp/router_qps_bench.json 2> /tmp/router_qps_bench.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/router_qps_bench.json ] && \
+           grep -q router_qps_rows /tmp/router_qps_bench.json; then
+          have_router_qps=1
+          router_qps_status=ok
+          echo "$(date -u +%H:%M:%S) ROUTER_QPS serve bench SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          router_qps_fails=$((router_qps_fails+1))
+          router_qps_status=failed
+          echo "$(date -u +%H:%M:%S) router_qps serve bench failed rc=$rc (fail $router_qps_fails)" >> /tmp/tpu_watch.log
+          if [ "$router_qps_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_router_qps=1
+            router_qps_status=skipped
+            echo "$(date -u +%H:%M:%S) router_qps serve bench SKIPPED after $router_qps_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_kvfleet" -eq 0 ]; then
