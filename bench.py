@@ -1432,6 +1432,148 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
             jr_tps["off"] / max(jr_tps["spill"], 1e-9), 4
         )
 
+        # ---- anatomy observer effect: decode with the phase ledger -----
+        # off vs on. The ledger is a handful of monotonic stashes per
+        # request lifecycle event plus one O(1) dict build at terminal —
+        # no per-token work — so it reuses the journal block's
+        # ALTERNATING protocol on the SAME compiled engine (engine build
+        # variance would swamp the signal in a two-engine ratio). The
+        # slow smoke pins ratio < 1.05.
+        jr_sched.journal = None
+
+        def an_sweep(ledger_on):
+            jr_sched.phase_ledger = ledger_on
+            for p in jr_prompts:
+                jr_sched.submit(
+                    p, SamplingParams(max_new_tokens=obs_new)
+                )
+            jr_sched.run_until_idle()
+
+        for on in (False, True):
+            an_sweep(on)  # warm both toggle states
+        an_tps = {"off": 0.0, "on": 0.0}
+        for _ in range(5):
+            for key, on in (("off", False), ("on", True)):
+                t0 = _time.monotonic()
+                an_sweep(on)
+                an_tps[key] = max(
+                    an_tps[key], 4 * obs_new / (_time.monotonic() - t0)
+                )
+        jr_sched.phase_ledger = True  # serve default, restored
+        for mode, tps in (
+            ("ledger_off", an_tps["off"]),
+            ("ledger_on", an_tps["on"]),
+        ):
+            rows.append(
+                {
+                    "workload": "anatomy_overhead",
+                    "mode": mode,
+                    "tokens_per_sec": round(tps, 2),
+                }
+            )
+        anatomy_overhead = round(
+            an_tps["off"] / max(an_tps["on"], 1e-9), 4
+        )
+
+        # ---- anatomy rows: a slow kv_fetch NAMES ITSELF ----------------
+        # The demo the docs promise: two replicas, a steered peer fetch
+        # with an injected kvfleet_fetch delay (serve.faults), and the
+        # breach attribution over the victim's recorded phase ledger
+        # must name kv_fetch as the top contributor — latency blamed on
+        # the phase that earned it, end to end through the same journal
+        # + aggregation path ``rlt why`` and /fleet use.
+        import queue as _queue
+
+        from ray_lightning_tpu.obs.anatomy import (
+            aggregate_phases,
+            breach_attribution,
+            format_attribution,
+        )
+        from ray_lightning_tpu.serve.faults import FaultInjector
+        from ray_lightning_tpu.serve.kvfleet import KVFleetPlane
+        from ray_lightning_tpu.serve.router import prompt_block_digests
+
+        an_block, an_new = 8, 8
+        an_prompt = g.integers(0, cfg.vocab_size, size=32).tolist()
+        an_warm = g.integers(0, cfg.vocab_size, size=32).tolist()
+        an_inboxes = {0: _queue.Queue(), 1: _queue.Queue()}
+        an_scheds = []
+        an_jr = WorkloadJournal(capacity=256)
+        an_delay = 0.12
+        for i in range(2):
+            eng = DecodeEngine(
+                params, cfg, num_slots=2,
+                max_seq=len(an_prompt) + an_new,
+                prefill_buckets=[len(an_prompt)],
+                prefix_blocks=16, prefix_block=an_block, decode_fold=4,
+            )
+            plane = KVFleetPlane(
+                index=i, role="mixed", inbox=an_inboxes[i],
+                peers=dict(an_inboxes),
+                block_bytes=eng.prefix_block_nbytes,
+                timeout_s=5.0, min_poll_s=0.0,
+            )
+            an_scheds.append(
+                Scheduler(
+                    eng, kvfleet=plane,
+                    journal=an_jr if i == 1 else None,
+                    faults=FaultInjector.parse(
+                        {
+                            "point": "kvfleet_fetch",
+                            "action": "delay",
+                            "seconds": an_delay,
+                        }
+                    ) if i == 1 else None,
+                )
+            )
+        # Replica 0 caches the demo prompt's blocks; replica 1 warms its
+        # executables on a DIFFERENT prompt (compile time must not
+        # pollute the demo request's prefill phase).
+        an_scheds[0].submit(
+            an_prompt, SamplingParams(max_new_tokens=an_new)
+        )
+        an_scheds[0].run_until_idle()
+        an_scheds[1].submit(
+            an_warm, SamplingParams(max_new_tokens=an_new)
+        )
+        an_scheds[1].run_until_idle()
+        an_rid = an_scheds[1].submit(
+            an_prompt, SamplingParams(max_new_tokens=an_new),
+            kv_hint={
+                "peer": 0,
+                "digests": [
+                    d.hex()
+                    for d in prompt_block_digests(an_prompt, an_block)
+                ],
+            },
+        )
+        for _ in range(20000):
+            an_scheds[0].step()
+            an_scheds[1].step()
+            if not an_scheds[1].has_work():
+                break
+        an_phases = next(
+            (
+                e.get("phases")
+                for e in reversed(an_jr.dump().get("entries") or [])
+                if e.get("kind") == "outcome"
+                and e.get("request_id") == an_rid
+            ),
+            None,
+        ) or {}
+        an_shares = breach_attribution(aggregate_phases([an_phases]))
+        for phase, v in sorted(an_phases.items()):
+            if isinstance(v, (int, float)):
+                rows.append(
+                    {
+                        "workload": "anatomy_rows",
+                        "mode": phase,
+                        "seconds": round(float(v), 4),
+                    }
+                )
+        anatomy_top_phase = an_shares[0][0] if an_shares else None
+        anatomy_attribution = format_attribution(an_shares)
+
         # ---- paged KV: residency at a fixed HBM token budget -----------
         # The paged claim, measured: at the SAME KV token budget, the
         # page allocator admits >= 1.5x the resident requests the dense
@@ -1595,6 +1737,9 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
             "fleet_overhead": fleet_overhead,
             "journal_overhead": journal_overhead,
             "journal_spill_overhead": journal_spill_overhead,
+            "anatomy_overhead": anatomy_overhead,
+            "anatomy_top_phase": anatomy_top_phase,
+            "anatomy_attribution": anatomy_attribution,
             "serve_config": (
                 f"layers={cfg.n_layer} d_model={cfg.d_model} "
                 f"prompt={P} (shared={shared}) new={n_new} chunk={chunk}"

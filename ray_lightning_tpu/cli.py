@@ -35,7 +35,7 @@ import yaml
 
 _SUBCOMMANDS = (
     "fit", "validate", "test", "predict", "generate", "convert-hf",
-    "tokenize", "serve", "doctor", "top", "replay",
+    "tokenize", "serve", "doctor", "top", "replay", "why",
 )
 
 
@@ -139,7 +139,7 @@ def _apply_dotted(
             continue
         if section not in (
             "model", "strategy", "trainer", "data", "generate", "tokenize",
-            "serve", "doctor", "top", "replay",
+            "serve", "doctor", "top", "replay", "why",
         ):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
@@ -156,7 +156,7 @@ def _apply_dotted(
         node = config[section]
         if section in (
             "trainer", "generate", "tokenize", "serve", "doctor", "top",
-            "replay",
+            "replay", "why",
         ):  # plain dicts
             node[field] = yaml.safe_load(raw)
             continue
@@ -217,14 +217,16 @@ def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
         arg = rest[i]
         if not arg.startswith("--"):
             # ``rlt doctor <addr>`` / ``rlt top <addr>`` /
-            # ``rlt replay <journal>``: the one positional the CLI
-            # accepts — the serve obs endpoint, or the journal path.
-            pos_key = {"doctor": "addr", "top": "addr",
-                       "replay": "journal"}.get(known.subcommand)
-            if (
-                pos_key is not None
-                and pos_key not in (config.get(known.subcommand) or {})
-            ):
+            # ``rlt replay <journal>`` / ``rlt why <addr|journal> <id>``:
+            # bare positionals fill the subcommand's keys in order (the
+            # explicit dotted flag always wins over a positional).
+            pos_keys = {
+                "doctor": ("addr",), "top": ("addr",),
+                "replay": ("journal",), "why": ("target", "id"),
+            }.get(known.subcommand) or ()
+            taken = config.get(known.subcommand) or {}
+            pos_key = next((k for k in pos_keys if k not in taken), None)
+            if pos_key is not None:
                 config.setdefault(known.subcommand, {})[pos_key] = arg
                 i += 1
                 continue
@@ -446,6 +448,9 @@ def _serve_obs_server(
     - ``/traces``: the stitched cross-process Chrome trace;
     - ``/journal``: the workload journal(s) as JSONL — save it and
       ``rlt replay`` it (multi-replica output is replica-tagged);
+    - ``/why?id=<request_id>``: one request's cross-process anatomy
+      phase ledger (``rlt why``'s feed) — every tracer ring + the
+      driver journal + the event rings stitched under one id;
     - ``/debug/bundle``: a replica flight-recorder bundle augmented
       driver-side with ``fleet.json`` + ``trace_stitched.json`` so a
       pulled post-mortem shows the whole fleet, not one process.
@@ -568,6 +573,7 @@ def _serve_obs_server(
         collect_events=_collect_events,
         collect_traces=lambda: client.export_stitched_trace(n=16),
         collect_journal=client.journal_jsonl,
+        collect_why=lambda rid: obs.anatomy_from_client(client, rid),
         port=int(metrics_port),
     ).start()
     return server, fleet_poller
@@ -1582,6 +1588,30 @@ def run_replay(config: Dict[str, Any]) -> Dict[str, Any]:
             file=sys.stderr,
             flush=True,
         )
+        # Phase-level diff (wall mode, when the capture carried the
+        # anatomy ledgers): recorded vs replayed p95 per phase —
+        # pinpoints WHICH phase the incident lost its time to.
+        ph = perf.get("phases") or {}
+        rec_p, rep_p = ph.get("recorded") or {}, ph.get("replayed") or {}
+        if rec_p or rep_p:
+            from ray_lightning_tpu.obs.anatomy import PHASES
+
+            def _p95(block: Dict[str, Any], phase: str) -> str:
+                row = block.get(phase)
+                return f"{row['p95_s']:g}" if row else "-"
+
+            cells = [
+                f"{phase} {_p95(rec_p, phase)}->{_p95(rep_p, phase)}"
+                for phase in PHASES
+                if phase in rec_p or phase in rep_p
+            ]
+            if cells:
+                print(
+                    "phase p95 recorded vs replayed: "
+                    + "  ".join(cells),
+                    file=sys.stderr,
+                    flush=True,
+                )
     if out_path:
         with open(str(out_path), "w") as f:
             _json.dump(result, f, indent=2, default=str)
@@ -1628,7 +1658,7 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{'tok/s':>9} {'ttft_p50':>9} {'ttft_p95':>9} "
             f"{'accept':>7} {'hit':>6} {'hit d/h/k':>14} "
             f"{'pages f/r/a':>12} {'fetch/ship':>11} {'store h/m/w':>12} "
-            f"{'pb d/r':>9} {'goodput':>9} {'weight':>7}"
+            f"{'pb d/r':>9} {'goodput':>9} {'weight':>7} {'phase':>13}"
         ),
     ]
     # Router weights keyed by replica (absent without a router).
@@ -1689,6 +1719,14 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             if pb
             else None
         )
+        # Anatomy hot spot: the replica's single largest p95 phase —
+        # "-" when the phase ledger is off or idle.
+        rph = r.get("phases") or {}
+        phase_cell = (
+            f"{rph['hot_phase']}"
+            if rph.get("hot_phase")
+            else None
+        )
         out.append(
             f"{_fmt_cell(r.get('replica'), 7)} "
             f"{_fmt_cell(r.get('health'), 9)} "
@@ -1708,7 +1746,8 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"{_fmt_cell(kvs_cell, 12)} "
             f"{_fmt_cell(pb_cell, 9)} "
             f"{_fmt_cell(r.get('goodput_tokens_per_device_s'), 9, 1)} "
-            f"{_fmt_cell(weights.get(r.get('replica')), 7, 2)}"
+            f"{_fmt_cell(weights.get(r.get('replica')), 7, 2)} "
+            f"{_fmt_cell(phase_cell, 13)}"
         )
     if fleet:
         out.append(
@@ -1719,6 +1758,26 @@ def render_fleet(payload: Dict[str, Any]) -> str:
             f"goodput={fleet.get('goodput_tokens_per_device_s', 0.0)} "
             f"ttft_p95_worst={fleet.get('ttft_p95_s_worst')}"
         )
+        # Anatomy decomposition: the fleet's hot phase (largest p95)
+        # plus the per-phase p95 spread — only rendered once the phase
+        # ledger has a window.
+        fph = fleet.get("phases") or {}
+        if fph.get("hot_phase"):
+            spread = "  ".join(
+                f"{p}={row['p95_s']:g}"
+                for p, row in sorted(
+                    (fph.get("by_phase") or {}).items(),
+                    key=lambda kv: -kv[1]["p95_s"],
+                )[:6]
+            )
+            out.append(
+                f"phases: hot={fph['hot_phase']} "
+                f"p95={fph['hot_phase_p95_s']:g}s  {spread}"
+            )
+        # Active SLO-breach attribution — the "where is the breach
+        # coming from" line; absent while nothing is breaching.
+        if fleet.get("breach_attribution"):
+            out.append(f"why: {fleet['breach_attribution']}")
         # Fleet KV plane roll-up: only rendered once the plane moved
         # anything (a homogeneous isolated fleet stays clean).
         if fleet.get("kvfleet_fetches") or fleet.get("kvfleet_ships"):
@@ -1858,6 +1917,94 @@ def run_top(config: Dict[str, Any]) -> Dict[str, Any]:
     return {"snapshot": last}
 
 
+def run_why(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``why``: where one request's latency went — its phase ledger.
+
+    Usage: ``rlt why <target> <request_id>`` where ``<target>`` is
+    either a live serve obs endpoint (``host:port`` — the ledger is
+    assembled from every process's tracer ring via ``/why?id=``, full
+    cross-process timeline) or a captured journal JSONL path (offline
+    autopsy — the outcome record's compact scheduler-local phases, no
+    live fleet needed). Renders the timeline: per-phase durations, the
+    replica/process each phase ran on, the outcome chain, and the
+    coverage line (phases + unaccounted == observed, exactly).
+    ``--why.json true`` prints the raw ledger as one JSON line instead.
+    Exit status: 0 when the request was found, 1 when no ring/journal
+    knows the id. Returns the ledger dict.
+    """
+    import json as _json
+    import os as _os
+    import urllib.error
+    import urllib.request
+    from urllib.parse import quote
+
+    from ray_lightning_tpu.obs.anatomy import (
+        ledger_from_phase_map,
+        render_anatomy,
+    )
+
+    cfg = dict(config.pop("why", None) or {})
+    target = (
+        cfg.pop("target", None) or cfg.pop("addr", None)
+        or cfg.pop("journal", None)
+    )
+    rid = cfg.pop("id", None) or cfg.pop("request_id", None)
+    json_out = bool(cfg.pop("json", False))
+    timeout = float(cfg.pop("timeout_s", 10.0))
+    if cfg:
+        raise ValueError(f"unknown why options: {sorted(cfg)}")
+    if not target or rid is None:
+        raise ValueError(
+            "why requires a target and a request id: "
+            "rlt why <host:port|journal.jsonl> <request_id>"
+        )
+    rid = str(rid)
+    if _os.path.exists(str(target)):
+        # Offline journal autopsy: the newest outcome record's compact
+        # phase ledger (scheduler-local phases; no live fleet).
+        from ray_lightning_tpu.obs.journal import load_journal
+
+        entries = load_journal(str(target)).get("entries") or []
+        outcome = next(
+            (
+                e for e in reversed(entries)
+                if e.get("kind") == "outcome"
+                and str(e.get("request_id")) == rid
+            ),
+            None,
+        )
+        if outcome is None:
+            ledger: Dict[str, Any] = {"request_id": rid, "found": False}
+        else:
+            ledger = ledger_from_phase_map(
+                rid, outcome.get("phases") or {},
+                outcome=str(outcome.get("outcome", "unknown")),
+            )
+    else:
+        base = (
+            str(target) if "://" in str(target)
+            else f"http://{target}"
+        )
+        url = base.rstrip("/") + "/why?id=" + quote(rid)
+        try:
+            body = urllib.request.urlopen(url, timeout=timeout).read()
+        except urllib.error.HTTPError as exc:
+            if exc.code != 404:
+                raise
+            body = exc.read()  # found:false rides the 404 body
+        except urllib.error.URLError as exc:
+            raise ValueError(
+                f"why target {target!r} is neither a readable journal "
+                f"file nor a reachable obs endpoint: {exc.reason}"
+            ) from exc
+        ledger = _json.loads(body)
+    if json_out:
+        print(_json.dumps(ledger, default=str))
+    else:
+        print(render_anatomy(ledger))
+    return ledger
+
+
 def run_tokenize(config: Dict[str, Any]) -> Dict[str, Any]:
     """``tokenize``: train (or load) a ByteBPETokenizer and optionally
     encode the corpus into a pretraining shard.
@@ -1939,6 +2086,8 @@ def main(argv: Optional[List[str]] = None) -> Any:
         return run_top(config)
     if subcommand == "replay":
         return run_replay(config)
+    if subcommand == "why":
+        return run_why(config)
     trainer, model, datamodule = build(config)
     fn = getattr(trainer, subcommand)
     if datamodule is not None:
@@ -1967,6 +2116,9 @@ def cli_entry(argv: Optional[List[str]] = None) -> Any:
         # Replay's contract mirrors doctor: 0 bit-exact, 1 diverged —
         # `rlt replay journal.jsonl && deploy` is the regression gate.
         return 0 if out.get("exact") else 1
+    if args and args[0] == "why":
+        # 0 when some ring/journal knew the request, 1 when nothing did.
+        return 0 if out.get("found") else 1
     # The console wrapper sys.exit()s our return value; any other
     # command's result dict is already on stdout, and a truthy
     # sys.exit(dict) would dump it to stderr and exit 1 — a successful

@@ -9,6 +9,13 @@ PASSIVE (telemetry — the eyes):
 - :mod:`obs.trace` — request tracing: typed lifecycle spans in a bounded
   per-replica ring buffer (:class:`RequestTracer`), exported as Chrome
   trace-event JSON (:func:`to_chrome_trace`) that opens in Perfetto.
+- :mod:`obs.anatomy` — request anatomy (:func:`assemble_anatomy`,
+  :func:`render_anatomy`): one request's cross-process phase ledger
+  stitched from every tracer ring + the journal + the event rings, with
+  an explicit coverage contract (phases + unaccounted == observed
+  latency, exactly) — ``rlt why``'s and ``/why``'s engine, and the
+  phase vocabulary behind the fleet latency decomposition and SLO
+  breach attribution.
 - :mod:`obs.registry` — counter/gauge/histogram registry
   (:class:`MetricsRegistry`, :func:`get_registry` for the process
   default) rendered in Prometheus text format.
@@ -53,6 +60,14 @@ Import cost: everything here is stdlib-only at import time; jax loads
 only when profiling/monitoring is actually used, so the fabric can ship
 this module into workers whose platform env is not yet applied.
 """
+from ray_lightning_tpu.obs.anatomy import (
+    assemble_anatomy,
+    anatomy_from_client,
+    aggregate_phases,
+    breach_attribution,
+    format_attribution,
+    render_anatomy,
+)
 from ray_lightning_tpu.obs.blackbox import (
     FlightRecorder,
     dump_bundle,
@@ -116,9 +131,14 @@ __all__ = [
     "Watchdog",
     "WorkloadJournal",
     "aggregate_fleet",
+    "aggregate_phases",
+    "anatomy_from_client",
+    "assemble_anatomy",
+    "breach_attribution",
     "capture_profile",
     "compile_stats",
     "dump_bundle",
+    "format_attribution",
     "get_event_log",
     "get_registry",
     "heartbeats_to_registry",
@@ -129,6 +149,7 @@ __all__ = [
     "parse_slo_rules",
     "profiler_available",
     "read_bundle",
+    "render_anatomy",
     "replay_journal",
     "summarize_replica",
     "to_chrome_trace",

@@ -160,6 +160,23 @@ def summarize_replica(
         "submitted": int(stats.get("submitted", 0)),
         "finished": int(stats.get("finished", 0)),
         "compiles_since_init": int(stats.get("compiles_since_init", 0)),
+        # Anatomy latency decomposition: the replica's windowed
+        # per-phase percentile block verbatim (None when the phase
+        # ledger is off or idle) — aggregate_fleet folds these into the
+        # fleet-wide decomposition `rlt top` and `/fleet` show.
+        "phases": stats.get("phases"),
+        # Active SLO-breach reasons (with their phase attribution
+        # suffix) — the fleet roll-up surfaces the first one as the
+        # dashboard's `why:` line.
+        "slo_reasons": [
+            reason
+            for name, ch in sorted(
+                ((health or {}).get("components") or {}).items()
+            )
+            if name.startswith("slo:")
+            and ch.get("verdict") == "unhealthy"
+            for reason in ch.get("reasons", [])
+        ] or None,
         # Goodput inputs ride along so the fleet ratio can be computed
         # as sum/sum instead of a mean of per-replica ratios.
         "cost_emitted_tokens": int(cost.get("emitted_tokens", 0)),
@@ -178,6 +195,15 @@ def aggregate_fleet(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
     p95s = [r["ttft_p95_s"] for r in rows if r.get("ttft_p95_s") is not None]
     kvf_rows = [r.get("kvfleet") or {} for r in rows]
     kvs_rows = [r.get("kvstore") or {} for r in rows]
+    phases_block = _aggregate_phase_rows(rows)
+    breach = next(
+        (
+            reason
+            for r in rows
+            for reason in (r.get("slo_reasons") or ())
+        ),
+        None,
+    )
     return {
         "replicas": len(rows),
         "healthy": sum(1 for r in rows if r["health"] == "healthy"),
@@ -231,7 +257,84 @@ def aggregate_fleet(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
             round(toks / dev, 3) if dev > 0 else 0.0
         ),
         "ttft_p95_s_worst": max(p95s) if p95s else None,
+        # Anatomy decomposition roll-up: per-phase p50 (count-weighted
+        # mean of replica p50s), p95/p99 (MAX across replicas — tails
+        # don't average), hot_phase = the fleet's single largest p95 —
+        # `rlt top`'s phase hot-spot column. None when no replica has a
+        # phase window.
+        "phases": phases_block,
+        # The first active SLO-breach reason (attribution suffix
+        # included) — `rlt top`'s `why:` line; None when nothing is
+        # breaching.
+        "breach_attribution": breach,
     }
+
+
+def _aggregate_phase_rows(
+    rows: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """Fold per-replica ``phases`` blocks into the fleet decomposition:
+    weighted-mean centers, max tails, per-role split when the fleet is
+    disaggregated."""
+    by_phase: Dict[str, Dict[str, float]] = {}
+    by_role: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for r in rows:
+        blk = (r.get("phases") or {}).get("by_phase") or {}
+        role = str(r.get("role") or "mixed")
+        for phase, row in blk.items():
+            if not isinstance(row, dict):
+                continue
+            c = int(row.get("count", 0))
+            if c <= 0:
+                continue
+            agg = by_phase.setdefault(phase, {
+                "count": 0, "_mean_w": 0.0, "_p50_w": 0.0,
+                "p95_s": 0.0, "p99_s": 0.0,
+            })
+            agg["count"] += c
+            agg["_mean_w"] += float(row.get("mean_s", 0.0)) * c
+            agg["_p50_w"] += float(row.get("p50_s", 0.0)) * c
+            agg["p95_s"] = max(agg["p95_s"], float(row.get("p95_s", 0.0)))
+            agg["p99_s"] = max(agg["p99_s"], float(row.get("p99_s", 0.0)))
+            role_agg = by_role.setdefault(role, {}).setdefault(
+                phase, {"count": 0, "p95_s": 0.0}
+            )
+            role_agg["count"] += c
+            role_agg["p95_s"] = max(
+                role_agg["p95_s"], float(row.get("p95_s", 0.0))
+            )
+    if not by_phase:
+        return None
+    out_phases = {
+        phase: {
+            "p50_s": round(agg["_p50_w"] / agg["count"], 6),
+            "p95_s": round(agg["p95_s"], 6),
+            "p99_s": round(agg["p99_s"], 6),
+            "mean_s": round(agg["_mean_w"] / agg["count"], 6),
+            "count": int(agg["count"]),
+        }
+        for phase, agg in by_phase.items()
+    }
+    hot_phase, hot_row = max(
+        out_phases.items(), key=lambda kv: kv[1]["p95_s"]
+    )
+    out: Dict[str, Any] = {
+        "by_phase": out_phases,
+        "hot_phase": hot_phase,
+        "hot_phase_p95_s": hot_row["p95_s"],
+    }
+    if len(by_role) > 1:
+        out["by_role"] = {
+            role: {
+                phase: {
+                    "p95_s": round(agg["p95_s"], 6),
+                    "count": int(agg["count"]),
+                }
+                for phase, agg in phases.items()
+            }
+            for role, phases in by_role.items()
+        }
+    return out
 
 
 @dataclass
@@ -329,6 +432,11 @@ class FleetPoller:
                     "Per-replica health (1 healthy, 0.5 degraded, "
                     "0 unhealthy)",
                 ),
+                "phase_p95": registry.gauge(
+                    "rlt_fleet_phase_p95_seconds",
+                    "Fleet-wide anatomy phase p95 (max across "
+                    "replicas), by phase",
+                ),
                 "polls": registry.counter(
                     "rlt_fleet_polls_total", "Fleet snapshot pulls"
                 ),
@@ -377,6 +485,10 @@ class FleetPoller:
                     _VERDICT_SCORE.get(r["health"], 0.0),
                     replica=r["replica"],
                 )
+            for phase, row in (
+                (f.get("phases") or {}).get("by_phase") or {}
+            ).items():
+                self._reg["phase_p95"].set(row["p95_s"], phase=phase)
             self._reg["polls"].inc(1)
         return snap
 

@@ -336,6 +336,21 @@ def _derived(snap: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _breach_shares(snap: Dict[str, Any]) -> str:
+    """Breach attribution from the snapshot's anatomy ``phases`` block:
+    '"kv_fetch 58%, queue 22%" — the verdict names WHERE the breached
+    latency went, not just that it breached. Empty when the ledger is
+    off or has no window yet."""
+    block = snap.get("phases")
+    if not block:
+        return ""
+    from ray_lightning_tpu.obs.anatomy import (
+        breach_attribution, format_attribution,
+    )
+
+    return format_attribution(breach_attribution(block))
+
+
 def slo_check(
     rules: Iterable[SLORule],
     snapshot_fn: Callable[[], Dict[str, Any]],
@@ -344,8 +359,12 @@ def slo_check(
 ) -> Callable[[], List[ComponentHealth]]:
     """Evaluate declarative SLO rules against the serve metrics
     snapshot. A breach marks ``slo:<metric>`` unhealthy, increments
-    ``rlt_slo_breaches_total{rule=...}``, and records an event; a metric
-    with no data yet is healthy (no traffic is not a breach)."""
+    ``rlt_slo_breaches_total{rule=...}``, records an event, and — when
+    the anatomy ledger has a ``phases`` window — appends the top
+    contributing phases by share to the reason ("ttft_p95 breach:
+    kv_fetch 58%, queue 22%"), so the attribution rides the
+    ``verdict_change`` event and the ``/healthz`` body for free; a
+    metric with no data yet is healthy (no traffic is not a breach)."""
     rules = list(rules)
     reg = registry or get_registry()
     breaches = reg.counter(
@@ -363,17 +382,22 @@ def slo_check(
                 continue
             if float(observed) > rule.limit:
                 breaches.inc(1, rule=rule.name)
+                attribution = ""
+                shares = _breach_shares(snap)
+                if shares:
+                    attribution = f"; top phases: {shares}"
                 if events is not None:
                     events.record(
                         "health", "slo_breach", level="warn",
                         rule=rule.name, observed=float(observed),
+                        **({"phases": shares} if shares else {}),
                     )
                 out.append(ComponentHealth(name, UNHEALTHY, [
                     f"SLO breach: {rule.metric}={float(observed):g} "
-                    f"exceeds {rule.limit:g}"
+                    f"exceeds {rule.limit:g}{attribution}"
                 ]))
-            else:
-                out.append(ComponentHealth(name))
+                continue
+            out.append(ComponentHealth(name))
         return out
 
     return check

@@ -1,5 +1,5 @@
 """Tiny obs HTTP endpoint: /metrics, /stats, /healthz, /debug/bundle,
-/fleet, /events, /traces, /journal.
+/fleet, /events, /traces, /journal, /why.
 
 Standard-library only (http.server in a daemon thread). The handler
 calls the collector functions PER REQUEST, so a scrape always sees
@@ -35,6 +35,13 @@ Chrome trace — save it and open in Perfetto). ``/journal`` serves
 ``collect_journal`` as JSONL — the workload journal (obs.journal),
 directly consumable by ``rlt replay``. All are collector-gated exactly
 like the others: an endpoint without the collector 404s.
+
+``/why?id=<request_id>`` (PR 19) serves ``collect_why(id)`` — the
+request's assembled anatomy phase ledger
+(:func:`obs.anatomy.assemble_anatomy` over the live fleet's rings) as
+JSON; 400 without an id, 404 when the id is unknown to every ring
+(``found: false`` rides the body either way). ``rlt why <addr> <id>``
+is the rendering client.
 """
 from __future__ import annotations
 
@@ -90,6 +97,9 @@ class MetricsHTTPServer:
         collect_events: Optional[Callable[[], str]] = None,
         collect_traces: Optional[Callable[[], Dict[str, Any]]] = None,
         collect_journal: Optional[Callable[[], str]] = None,
+        collect_why: Optional[
+            Callable[[str], Dict[str, Any]]
+        ] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ) -> None:
@@ -101,6 +111,7 @@ class MetricsHTTPServer:
         self._collect_events = collect_events
         self._collect_traces = collect_traces
         self._collect_journal = collect_journal
+        self._collect_why = collect_why
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -155,6 +166,21 @@ class MetricsHTTPServer:
                     ):
                         body = outer._collect_journal().encode()
                         ctype = "application/x-ndjson"
+                    elif (
+                        path == "/why"
+                        and outer._collect_why is not None
+                    ):
+                        rid = (parse_qs(query).get("id") or [None])[0]
+                        if not rid:
+                            self.send_error(
+                                400, "missing ?id=<request_id>"
+                            )
+                            return
+                        ledger = outer._collect_why(rid)
+                        if not ledger.get("found"):
+                            code = 404
+                        body = json.dumps(ledger, default=str).encode()
+                        ctype = "application/json"
                     elif (
                         path == "/traces"
                         and outer._collect_traces is not None

@@ -319,12 +319,17 @@ class WorkloadJournal:
         cost: Optional[Dict[str, Any]] = None,
         tokens: Optional[List[int]] = None,
         ttft_s: Optional[float] = None,
+        phases: Optional[Dict[str, Any]] = None,
     ) -> None:
         """One request reached terminal state: emit its outcome entry —
         the emitted token VALUES the replay asserts against (the
         scheduler accumulates them inline in loops it already runs, so
         the journal adds no per-step pass), plus the cost-ledger record
-        and TTFT for the wall-mode perf comparison."""
+        and TTFT for the wall-mode perf comparison. ``phases`` is the
+        compact anatomy ledger (``{phase: seconds}``) — it makes a
+        captured incident autopsy-able offline (``rlt why <journal>
+        <id>``) and lets wall-mode replay diff recorded vs replayed
+        phase timings."""
         if not self.enabled:
             return
         entry: Dict[str, Any] = {
@@ -340,6 +345,8 @@ class WorkloadJournal:
             entry["cost"] = {
                 k: v for k, v in cost.items() if k != "request_id"
             }
+        if phases:
+            entry["phases"] = dict(phases)
         self._append(entry)
 
     # -- read side --------------------------------------------------------
@@ -871,6 +878,23 @@ def replay_journal(
             "replayed": replayed_perf,
             "replay_vs_recorded": ratio,
         }
+        # Phase-level diff: the recorded outcomes' compact anatomy
+        # ledgers vs the ones the replay scheduler just produced —
+        # "the incident's kv_fetch was 40x this machine's" is the
+        # autopsy answer a throughput ratio can't give.
+        rec_phases = [
+            o["phases"] for o in outcomes.values()
+            if isinstance(o.get("phases"), dict)
+        ]
+        phase_fn = getattr(scheduler.metrics, "phase_records", None)
+        rep_phases = phase_fn() if phase_fn is not None else []
+        if rec_phases or rep_phases:
+            from ray_lightning_tpu.obs.anatomy import aggregate_phases
+
+            result["perf"]["phases"] = {
+                "recorded": aggregate_phases(rec_phases),
+                "replayed": aggregate_phases(rep_phases),
+            }
     return result
 
 

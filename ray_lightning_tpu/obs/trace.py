@@ -35,6 +35,13 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 # -- span names (the typed vocabulary) ---------------------------------
+#: Request entered the DRIVER-side client (``submit()`` entry or batch
+#: coalescing enqueue) — the earliest client-observed instant, and the
+#: anchor the anatomy ledger's ``batch_window`` phase starts from.
+SPAN_CLIENT_RECV = "client_recv"
+#: Driver finished route planning for the request (attrs: replica) —
+#: closes ``batch_window`` and opens ``route_plan`` in the ledger.
+SPAN_CLIENT_PLAN = "client_plan"
 #: Request left the DRIVER-side client (recorded by ServeClient in its
 #: own process-local tracer — the cross-process anchor every stitched
 #: trace hangs off: replica/follower spans resolve back to it by
@@ -68,6 +75,16 @@ SPAN_KV_RESTORE = "kv_restore"
 #: persistent store and its device pages freed (attrs: blocks, stored,
 #: freed).
 SPAN_KV_PARK = "kv_park"
+#: Fleet KV plane: a parked transfer resolved — warm pages landed (or
+#: the fetch failed and the request falls back to cold prefill). Attrs:
+#: source ("peer" | "store"), ok, and on failure the reason. Closes the
+#: ledger's ``kv_fetch`` phase; the land→admit gap is ``transfer_park``.
+SPAN_KV_LAND = "kv_land"
+#: Disaggregated prefill, decode side: shipped KV pages imported into
+#: this replica's pool (attrs: src, blocks, layerwise). Recorded by the
+#: fleet plane's service loop — the only mark of the ship transit
+#: landing before the stream's resubmit arrives.
+SPAN_KV_SHIP_LAND = "kv_ship_land"
 #: Disaggregated prefill: this engine finished the prefill and shipped
 #: the KV pages to a decode replica (attrs: target, blocks) — terminal
 #: HERE, the stream continues on the target.
@@ -90,6 +107,13 @@ class RequestTracer:
         self.enabled = bool(enabled)
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=self.capacity)
+        #: Total events evicted by ring wrap over this tracer's lifetime.
+        self.dropped = 0
+        # Request ids that lost at least one event to ring wrap. Pruned
+        # against the live ring once per `capacity` evictions, so a rid
+        # only stays here while it still has events in the ring — i.e.
+        # while its retained trace is genuinely partial.
+        self._evicted: set = set()
         #: Wall-clock minus monotonic at construction. Events record on
         #: the cheap monotonic clock; cross-process merges add this
         #: offset so rings recorded in different processes (each with its
@@ -111,6 +135,12 @@ class RequestTracer:
         if t is None:
             t = time.monotonic()
         with self._lock:
+            if len(self._events) == self.capacity and self.capacity > 0:
+                self._evicted.add(self._events[0][0])
+                self.dropped += 1
+                if self.dropped % self.capacity == 0:
+                    live = {r for r, _, _, _ in self._events}
+                    self._evicted &= live
             self._events.append((request_id, span, t, attrs))
 
     # -- read side --------------------------------------------------------
@@ -118,8 +148,18 @@ class RequestTracer:
         with self._lock:
             return list(self._events)
 
+    def is_truncated(self, request_id: str) -> bool:
+        """True when ring wrap evicted some of this request's events
+        while others remain — the retained trace is partial and any
+        duration derived from its first event under-counts."""
+        with self._lock:
+            return request_id in self._evicted
+
     def trace(self, request_id: str) -> List[Dict[str, Any]]:
-        """All of one request's events, oldest first, as dicts."""
+        """All of one request's events, oldest first, as dicts. When the
+        ring wrapped over part of this request's history, the first
+        retained event carries ``truncated: True`` — consumers must not
+        treat its timestamp as the request's start."""
         out = []
         for rid, span, t, attrs in self._scan():
             if rid != request_id:
@@ -128,6 +168,8 @@ class RequestTracer:
             if attrs:
                 ev.update(attrs)
             out.append(ev)
+        if out and self.is_truncated(request_id):
+            out[0] = dict(out[0], truncated=True)
         return out
 
     def recent_traces(self, n: int = 8) -> Dict[str, List[Dict[str, Any]]]:
@@ -161,11 +203,22 @@ class RequestTracer:
         """The wire form of this process's ring for cross-process trace
         stitching: the ``n`` most recent traces plus the wall-clock
         offset :func:`merge_chrome_trace` needs to align them with rings
-        from other processes."""
-        return {
+        from other processes. ``truncated`` lists the dumped request ids
+        whose retained traces are partial (ring wrap ate early events) —
+        the anatomy layer turns that into ``unaccounted`` provenance
+        instead of mis-attributing the missing time. The key is omitted
+        entirely when nothing was truncated, keeping the healthy-path
+        wire form unchanged."""
+        traces = self.recent_traces(n)
+        with self._lock:
+            truncated = sorted(r for r in traces if r in self._evicted)
+        out: Dict[str, Any] = {
             "wall_offset": self.wall_offset,
-            "traces": self.recent_traces(n),
+            "traces": traces,
         }
+        if truncated:
+            out["truncated"] = truncated
+        return out
 
     def __len__(self) -> int:
         with self._lock:

@@ -519,6 +519,13 @@ class ServeClient:
         record.update(sampling)
         prompt = [int(t) for t in prompt]
         record["prompt"] = prompt
+        # The anatomy ledger's clock starts HERE: recv → plan is the
+        # batch_window phase (the micro-batcher's coalescing wait; ~0 on
+        # the serial path), plan → client_submit is route_plan.
+        self.tracer.event(
+            rid, _trace.SPAN_CLIENT_RECV,
+            attrs={"prompt_tokens": len(prompt)},
+        )
         return {
             "rid": rid,
             "prompt": prompt,
@@ -562,6 +569,7 @@ class ServeClient:
         self._record_submit(rid, prompt, record)
         if self._retry_budget is not None:
             self._retry_budget.note_submit()
+        self.tracer.event(rid, _trace.SPAN_CLIENT_PLAN)
         while True:
             extra: Optional[Dict[str, Any]] = explicit_extra
             digests: Optional[List[bytes]] = None
@@ -745,6 +753,11 @@ class ServeClient:
             if self._retry_budget is not None:
                 self._retry_budget.note_submit()
         self._m_submit_batches.inc(1)
+        for e in entries:
+            self.tracer.event(
+                e["rid"], _trace.SPAN_CLIENT_PLAN,
+                attrs={"batched": True},
+            )
         try:
             plans = self._plan_entries(entries)
         except Exception:

@@ -27,7 +27,11 @@ Points (where the hooks live):
 - ``rpc_submit`` / ``rpc_result`` — top of the replica's submit/result
   RPC handlers (fabric RPC delay/drop);
 - ``follower_op`` — gang follower, before executing a replayed engine
-  op (wedge a follower mid-stream).
+  op (wedge a follower mid-stream);
+- ``kvfleet_fetch`` — fleet KV plane, as a fetched peer/store payload
+  is about to import into the pool (a ``delay`` here lands entirely
+  inside the anatomy ledger's ``kv_fetch`` phase — the latency-
+  attribution demo's knob).
 
 Actions: ``kill`` (``os._exit`` — a hard crash, no flushes, exactly
 what a torn JSONL tail looks like), ``delay`` (sleep ``seconds``),
@@ -66,6 +70,7 @@ FAULT_POINTS = frozenset((
     "rpc_submit",
     "rpc_result",
     "follower_op",
+    "kvfleet_fetch",
 ))
 
 FAULT_ACTIONS = frozenset(("kill", "delay", "drop", "wedge", "preempt"))

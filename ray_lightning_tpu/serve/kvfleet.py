@@ -90,6 +90,8 @@ from typing import (
     Tuple,
 )
 
+from ray_lightning_tpu.obs import trace as _trace
+
 #: Replica roles. ``mixed`` (default) prefills and decodes; ``prefill``
 #: ships every finished prefill's pages to a decode replica; ``decode``
 #: only means the router doesn't hand it raw long-prompt placements —
@@ -408,6 +410,16 @@ class KVFleetPlane:
         self._last_drain = float("-inf")
         self._clock = clock
         self._events = events
+        #: Request tracer (obs.trace): the plane records the phase-
+        #: boundary marks only IT can see — a shipped KV payload landing
+        #: on the decode side before the stream's resubmit arrives.
+        #: The owning scheduler shares its tracer in at construction.
+        self.tracer: Optional[Any] = None
+        #: Fault injector (serve.faults): the ``kvfleet_fetch`` point
+        #: fires as a fetched KV payload is about to import — a delay
+        #: rule here inflates exactly the ledger's kv_fetch phase (the
+        #: bench's attribution demo).
+        self.faults: Optional[Any] = None
         self._lock = threading.Lock()
         #: Layer-pipelined disagg shipping: a finished prefill's pages
         #: stream to the decode target one LAYER at a time instead of
@@ -493,6 +505,14 @@ class KVFleetPlane:
                 self._events.record("kvfleet", name, level=level, **kv)
             except Exception:  # noqa: BLE001 - forensics never block KV
                 pass
+
+    def _mark(self, rid: Any, span: str, **attrs: Any) -> None:
+        if self.tracer is not None and rid is not None:
+            self.tracer.event(str(rid), span, attrs=attrs or None)
+
+    def _fault(self, point: str) -> None:
+        if self.faults is not None:
+            self.faults.hit(point)
 
     def _put(self, peer: int, item: Any) -> bool:
         q = self.peers.get(int(peer))
@@ -811,6 +831,7 @@ class KVFleetPlane:
                 failed.append((rid, "store_miss"))
                 continue
             n = 0
+            self._fault("kvfleet_fetch")
             if import_fn is not None:
                 n = int(import_fn(blocks))
             nbytes = blocks_nbytes(blocks)
@@ -872,6 +893,7 @@ class KVFleetPlane:
                     failed.append((rid, "stale"))
                     continue
                 n = 0
+                self._fault("kvfleet_fetch")
                 if import_fn is not None:
                     n = int(import_fn(blocks))
                 nbytes = blocks_nbytes(blocks)
@@ -895,6 +917,13 @@ class KVFleetPlane:
                 n = int(import_fn(blocks))
                 with self._lock:
                     self.imports += n
+                # Ship-land mark: the decode side's only record of the
+                # transit ending — the stream's resubmit has not arrived
+                # yet, so no scheduler span can carry this boundary.
+                self._mark(
+                    body.get("request_id"), _trace.SPAN_KV_SHIP_LAND,
+                    src=body.get("src"), blocks=n, layerwise=False,
+                )
                 self._event(
                     "kvfleet_ship_import",
                     request_id=body.get("request_id"),
@@ -1036,6 +1065,10 @@ class KVFleetPlane:
             with self._lock:
                 self._ship_parts.pop(key, None)
                 self.imports += len(blocks)
+            self._mark(
+                rid, _trace.SPAN_KV_SHIP_LAND,
+                src=src, blocks=len(blocks), layerwise=True,
+            )
             self._event(
                 "kvfleet_ship_import", request_id=rid, src=src,
                 blocks=len(blocks), layerwise=True,

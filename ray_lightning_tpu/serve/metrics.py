@@ -167,7 +167,19 @@ class ServeMetrics:
                     "Sliding-window emitted tokens per estimated "
                     "device-second",
                 ),
+                # Anatomy ledger: per-request phase durations (queue /
+                # kv_fetch / transfer_park / prefill / decode / ship),
+                # labelled by phase and this replica's fleet role — the
+                # fleet-wide latency decomposition's raw series.
+                "phase_seconds": registry.histogram(
+                    "rlt_serve_phase_seconds",
+                    "Per-request phase durations from the anatomy "
+                    "ledger, by phase and replica role",
+                ),
             }
+        #: Fleet role ("mixed" / "prefill" / "decode") — labels the
+        #: phase histogram; the scheduler sets it at construction.
+        self.role = "mixed"
         # Lifecycle counters (monotonic).
         self.submitted = 0
         self.admitted = 0
@@ -194,6 +206,11 @@ class ServeMetrics:
         #: Scheduler's ledger): the sliding window behind the ``cost``
         #: stats block and the goodput gauge.
         self._costs: deque = deque(maxlen=window)
+        #: Anatomy phase ledgers (one (tenant, {phase: seconds}) per
+        #: terminal request): the sliding window behind the ``phases``
+        #: stats block — per-phase p50/p95/p99, the hot phase, and the
+        #: per-tenant tails the fleet aggregator folds across replicas.
+        self._phases: deque = deque(maxlen=window)
         #: Cumulative tiered prefix-cache counters (device/host/disk) —
         #: accumulated from the scheduler's per-step deltas; feeds the
         #: ``prefix_tiers`` stats block and its hit-rate-by-tier.
@@ -417,6 +434,41 @@ class ServeMetrics:
         with self._lock:
             return [dict(r) for r in self._costs]
 
+    def set_role(self, role: str) -> None:
+        """Label the phase histogram with this replica's fleet role
+        (the scheduler calls it once at construction)."""
+        self.role = str(role)
+
+    def record_phases(
+        self,
+        phases: Dict[str, Any],
+        tenant: Optional[str] = None,
+        outcome: Optional[str] = None,
+    ) -> None:
+        """One terminal request's compact phase ledger ({phase:
+        seconds}; non-numeric detail keys like ``kv_fetch_source`` are
+        kept out of the aggregates). Windowed for the stats ``phases``
+        block and mirrored into the phase/role-labelled
+        ``rlt_serve_phase_seconds`` histogram."""
+        durs = {
+            k: float(v) for k, v in phases.items()
+            if isinstance(v, (int, float))
+        }
+        if not durs:
+            return
+        with self._lock:
+            self._phases.append((tenant or "default", durs))
+        if self._reg is not None:
+            for phase, s in durs.items():
+                self._reg["phase_seconds"].observe(
+                    s, phase=phase, role=self.role
+                )
+
+    def phase_records(self) -> list:
+        """The phase-ledger window, oldest first (tests, anatomy)."""
+        with self._lock:
+            return [dict(p) for _, p in self._phases]
+
     def record_memory(self, mem: Dict[str, Any]) -> None:
         """Resident-footprint gauges from ``engine.memory_stats()``:
         ``rlt_serve_hbm_bytes{component=...}`` carries PER-DEVICE bytes
@@ -571,6 +623,47 @@ class ServeMetrics:
                         sum(r["spec_accepted_tokens"] for r in costs), 3
                     ),
                 }
+            # Anatomy phases: the windowed latency decomposition — per
+            # phase p50/p95/p99/mean over terminal requests, the single
+            # hottest phase by p95 (rlt top's hot-spot column), and
+            # per-tenant p95 tails when the window saw several tenants.
+            if self._phases:
+                by_phase: Dict[str, list] = {}
+                by_tenant: Dict[str, Dict[str, list]] = {}
+                for tenant, durs in self._phases:
+                    for phase, s in durs.items():
+                        by_phase.setdefault(phase, []).append(s)
+                        by_tenant.setdefault(tenant, {}).setdefault(
+                            phase, []
+                        ).append(s)
+                block: Dict[str, Any] = {}
+                for phase, vals in by_phase.items():
+                    vals = sorted(vals)
+                    block[phase] = {
+                        "p50_s": round(_pct(vals, 0.50), 6),
+                        "p95_s": round(_pct(vals, 0.95), 6),
+                        "p99_s": round(_pct(vals, 0.99), 6),
+                        "mean_s": round(sum(vals) / len(vals), 6),
+                        "count": len(vals),
+                    }
+                hot = max(
+                    block.items(), key=lambda kv: kv[1]["p95_s"]
+                )
+                out["phases"] = {
+                    "role": self.role,
+                    "requests": len(self._phases),
+                    "by_phase": block,
+                    "hot_phase": hot[0],
+                    "hot_phase_p95_s": hot[1]["p95_s"],
+                }
+                if len(by_tenant) > 1:
+                    out["phases"]["by_tenant"] = {
+                        tenant: {
+                            phase: round(_pct(sorted(vals), 0.95), 6)
+                            for phase, vals in phases.items()
+                        }
+                        for tenant, phases in by_tenant.items()
+                    }
             return out
 
     def maybe_log(self, every_s: float = 10.0) -> Optional[Dict[str, Any]]:

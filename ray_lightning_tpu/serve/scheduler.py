@@ -166,12 +166,32 @@ class Scheduler:
         #: wall-clock instant. None = off (one attribute check).
         self.faults = faults
         self.metrics = metrics or ServeMetrics(engine.num_slots)
+        # Label the phase histogram with this replica's fleet role (the
+        # anatomy decomposition reports per-role tails).
+        set_role = getattr(self.metrics, "set_role", None)
+        if set_role is not None:
+            set_role(self.role)
         #: Request tracer (obs.trace): lifecycle events recorded from the
         #: scheduler's vantage point; the engine shares the same tracer
         #: for its chunk/seed events. None = tracing off (zero cost).
         self.tracer = tracer
         if tracer is not None and getattr(engine, "tracer", None) is None:
             engine.tracer = tracer
+        # The fleet KV plane records its own phase-boundary marks (ship
+        # landings, faults) — share this scheduler's tracer/injector so
+        # its spans land in the same ring the anatomy ledger stitches.
+        if kvfleet is not None:
+            if getattr(kvfleet, "tracer", None) is None:
+                kvfleet.tracer = tracer
+            if getattr(kvfleet, "faults", None) is None:
+                kvfleet.faults = faults
+        #: Per-request phase ledger (obs.anatomy): at each terminal,
+        #: fold the request's lifecycle timestamps into a compact
+        #: {phase: seconds} map emitted to the metrics window (fleet
+        #: latency decomposition) and the journal outcome record
+        #: (offline autopsy). Toggleable for the anatomy_overhead bench;
+        #: the per-request cost is a handful of float subtractions.
+        self.phase_ledger = True
         #: Structured event log (obs.events): coarse lifecycle happenings
         #: (admission bursts, cancels, expiries) — one event per
         #: occurrence, never per token; the engine shares it for its
@@ -299,6 +319,44 @@ class Scheduler:
         rec["spec_accepted_tokens"] = round(
             rec["spec_accepted_tokens"], 3
         )
+        # Compact phase ledger: the scheduler-local latency decomposition
+        # (the cross-process phases — client_wait, ship transit,
+        # stream_gap — only the anatomy stitcher can see). Underscore
+        # stashes pop out of the record whether or not the ledger is on.
+        fetch_s = rec.pop("_kv_fetch_s", 0.0)
+        land_t = rec.pop("_kv_land_t", None)
+        kv_src = rec.pop("_kv_src", None)
+        rec.pop("_kv_park_t", None)
+        admit_t = rec.pop("_admit_t", None)
+        ttft = rec.pop("_ttft_s", None)
+        phases: Optional[Dict[str, float]] = None
+        if self.phase_ledger:
+            phases = {}
+            park_s = (
+                max(0.0, admit_t - land_t)
+                if admit_t is not None and land_t is not None
+                else 0.0
+            )
+            phases["queue"] = max(
+                0.0, rec["queue_s"] - fetch_s - park_s
+            )
+            if fetch_s > 0.0:
+                phases["kv_fetch"] = fetch_s
+                if kv_src:
+                    phases["kv_fetch_source"] = kv_src
+            if park_s > 0.0:
+                phases["transfer_park"] = park_s
+            if ttft is not None:
+                phases["prefill"] = max(0.0, ttft - rec["queue_s"])
+                tail = max(0.0, rec["total_s"] - ttft)
+                phases["ship" if outcome == "shipped" else "decode"] = tail
+            phases = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in phases.items()
+            }
+            self.metrics.record_phases(
+                phases, tenant=rec["tenant"], outcome=outcome
+            )
         self.metrics.record_cost(rec)
         self._event(
             "request_cost",
@@ -318,6 +376,7 @@ class Scheduler:
                 rid, outcome, cost=rec,
                 tokens=self._jr_tokens.pop(rid, None),
                 ttft_s=self._jr_ttft.pop(rid, None),
+                phases=phases,
             )
 
     def _trace(
@@ -581,7 +640,23 @@ class Scheduler:
                 if entry is not None:
                     heapq.heappush(self._pending, entry)
                     resumed.append((rid, reason))
+        t_land = time.monotonic()
         for rid, how in resumed:
+            # Phase-boundary mark: the parked transfer settled (warm or
+            # failed) — closes the ledger's kv_fetch phase; the land →
+            # re-admit gap becomes transfer_park.
+            acct = self._acct.get(rid)
+            src = "store" if rid in store_rids else (
+                (acct or {}).get("_kv_src") or "peer"
+            )
+            if acct is not None and "_kv_park_t" in acct:
+                acct["_kv_fetch_s"] = t_land - acct["_kv_park_t"]
+                acct["_kv_land_t"] = t_land
+            self._trace(
+                rid, _trace.SPAN_KV_LAND, t=t_land,
+                source=src, ok=how == "warm",
+                **({} if how == "warm" else {"reason": how}),
+            )
             self._event(
                 "kv_transfer_resume",
                 level="info" if how == "warm" else "warn",
@@ -868,6 +943,10 @@ class Scheduler:
                     self._transfer_pending[req.request_id] = (
                         prio, seqno, req,
                     )
+                acct = self._acct.get(req.request_id)
+                if acct is not None:
+                    acct["_kv_park_t"] = time.monotonic()
+                    acct["_kv_src"] = "store" if peer is None else "peer"
                 self._trace(
                     req.request_id,
                     _trace.SPAN_KVSTORE_FETCH if peer is None
@@ -941,6 +1020,7 @@ class Scheduler:
                 acct = self._acct.get(req.request_id)
                 if acct is not None:
                     acct["queue_s"] = t_admit - req.submitted_at
+                    acct["_admit_t"] = t_admit
                 # Record-time timestamp (not t_admit): the engine's own
                 # admission-block events (prefix_seed) land between
                 # queued and here, and a trace's timestamps must be
@@ -965,6 +1045,7 @@ class Scheduler:
                 )
                 if acct is not None:
                     acct["emitted_tokens"] += 1
+                    acct["_ttft_s"] = now - req.submitted_at
                 if self.journal is not None:
                     self._jr_tokens[req.request_id] = [int(first_tok)]
                     self._jr_ttft[req.request_id] = (
@@ -1031,7 +1112,7 @@ class Scheduler:
                 chunk_events = list(chunk_events) + pb_events
                 prefilled += self._finish_prefills(
                     pb_events, newly, events, finished_rids,
-                    finished_slots, closed,
+                    finished_slots, closed, piggyback=True,
                 )
             if pb_events or prefilling:
                 # Same fault point as the separate-dispatch path, just
@@ -1204,6 +1285,7 @@ class Scheduler:
         finished_rids: List[str],
         finished_slots: List[int],
         closed: List[Tuple[str, str]],
+        piggyback: bool = False,
     ) -> int:
         """Process completed/advanced prefill chunk events: first-token
         metrics + traces, journal tokens, TokenEvents, write-through,
@@ -1233,12 +1315,18 @@ class Scheduler:
                     ttft_s=round(now - req.submitted_at, 6),
                     chunks=task.chunks,
                     prefix_hit_tokens=task.matched_tokens,
+                    # The prefill-mode detail the anatomy ledger surfaces:
+                    # piggyback chunks rode inside decode folds, solo
+                    # chunks had their own dispatches.
+                    mode="piggyback" if piggyback else "solo",
                 )
             acct = self._acct.get(task.request_id)
             if acct is not None:
                 acct["prefill_chunks"] = task.chunks
                 acct["prefix_hit_tokens"] = task.matched_tokens
                 acct["emitted_tokens"] += 1
+                if req is not None:
+                    acct.setdefault("_ttft_s", now - req.submitted_at)
             if self.journal is not None and tok is not None:
                 self._jr_tokens.setdefault(
                     task.request_id, []

@@ -1,0 +1,643 @@
+"""Request anatomy tests: the per-request phase ledger (obs/anatomy.py)
+and its integrations — scheduler phase stashes, journal outcome phases,
+the fleet decomposition roll-up, SLO breach attribution, and the
+``/why`` HTTP route.
+
+The load-bearing property is the COVERAGE CONTRACT: every ledger's
+phases plus ``unaccounted`` sum to the observed window exactly — time is
+never silently absorbed into a neighboring phase — and a ring that
+wrapped reports the loss as provenance, not as a mis-attribution. The
+hard paths (disaggregated prefill→ship→decode, steered peer kv_fetch,
+persistent-store fetch after a bounce, hedged streams, migration) each
+reconstruct a full cross-process timeline while keeping the repo's
+standing contracts: greedy output bit-identical to solo
+``gpt_generate`` and zero steady-state compiles.
+"""
+import json
+import queue
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import obs
+from ray_lightning_tpu.models.gpt import (
+    GPTConfig,
+    gpt_generate,
+    init_gpt_params,
+)
+from ray_lightning_tpu.obs import trace as obs_trace
+from ray_lightning_tpu.obs.anatomy import (
+    DEFAULT_TOLERANCE,
+    PHASES,
+    aggregate_phases,
+    assemble_anatomy,
+    breach_attribution,
+    format_attribution,
+    ledger_from_phase_map,
+    render_anatomy,
+)
+from ray_lightning_tpu.obs.journal import WorkloadJournal
+from ray_lightning_tpu.serve.kvfleet import KVFleetPlane
+from ray_lightning_tpu.serve.router import prompt_block_digests
+
+CFG = GPTConfig(
+    vocab_size=97,
+    n_layer=2,
+    n_head=4,
+    d_model=32,
+    max_seq=64,
+    attn_impl="reference",
+    compute_dtype="float32",
+)
+
+BLOCK = 4
+
+DENSE_KW = dict(
+    num_slots=3, max_seq=64, prefill_buckets=[16], prefill_chunk=4,
+    prefix_blocks=16, prefix_block=BLOCK, decode_fold=2,
+)
+
+_REF_MEMO = {}
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+
+    return init_gpt_params(jax.random.PRNGKey(0), CFG)
+
+
+def _ref(params, prompt, n):
+    key = (tuple(prompt), n)
+    if key not in _REF_MEMO:
+        out = gpt_generate(
+            params, CFG, np.asarray(prompt, np.int32)[None], n
+        )
+        _REF_MEMO[key] = np.asarray(out)[0, len(prompt):].tolist()
+    return _REF_MEMO[key]
+
+
+def _engine(params, engine_kw):
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+
+    return DecodeEngine(params, CFG, **engine_kw)
+
+
+def _sp(n=8, seed=0):
+    from ray_lightning_tpu.serve.scheduler import SamplingParams
+
+    return SamplingParams(max_new_tokens=n, seed=seed)
+
+
+def _tokens(events, rid):
+    return [e.token for e in events if e.request_id == rid
+            and e.token is not None]
+
+
+class _Duo:
+    """Two in-process schedulers on a fleet KV plane, each with its own
+    tracer — the anatomy stitching harness."""
+
+    def __init__(self, params, roles=("mixed", "mixed"), journal=None):
+        from ray_lightning_tpu.serve.scheduler import Scheduler
+
+        inboxes = {0: queue.Queue(), 1: queue.Queue()}
+        self.engines, self.planes = [], []
+        self.scheds, self.tracers = [], []
+        for i in (0, 1):
+            eng = _engine(params, DENSE_KW)
+            plane = KVFleetPlane(
+                index=i, role=roles[i], inbox=inboxes[i],
+                peers=dict(inboxes),
+                block_bytes=eng.prefix_block_nbytes,
+                timeout_s=5.0, min_poll_s=0.0,
+            )
+            tracer = obs.RequestTracer(capacity=256)
+            self.engines.append(eng)
+            self.planes.append(plane)
+            self.tracers.append(tracer)
+            self.scheds.append(Scheduler(
+                eng, kvfleet=plane, role=roles[i], tracer=tracer,
+                journal=journal if i == 0 else None,
+            ))
+
+    def drive(self, max_steps=400):
+        events = ([], [])
+        for _ in range(max_steps):
+            busy = False
+            for i, s in enumerate(self.scheds):
+                if s.has_work():
+                    busy = True
+                events[i].extend(s.step())
+            if not busy:
+                break
+        return events
+
+    def processes(self, n=16):
+        return [
+            dict(t.dump(n), name=f"replica{i}")
+            for i, t in enumerate(self.tracers)
+        ]
+
+
+def _assert_exact_sum(led):
+    assert led["found"], led
+    assert led["observed_s"] == pytest.approx(
+        led["accounted_s"] + led["unaccounted_s"], abs=2e-6
+    ), led
+    # Rows are a single non-overlapping chronological timeline. Rows are
+    # rounded to 1µs, so adjacent rounding can overlap by up to 2µs.
+    cursor = 0.0
+    for row in led["phases"]:
+        assert row["start_s"] + 2e-6 >= cursor, led["phases"]
+        cursor = row["start_s"] + row["duration_s"]
+
+
+# ---------------------------------------------------------------------------
+# Synthetic ledgers: the stitching algebra without an engine
+# ---------------------------------------------------------------------------
+def _proc(name, evs, wall_offset=0.0, truncated=()):
+    return {
+        "name": name,
+        "wall_offset": wall_offset,
+        "traces": {"r": evs},
+        "truncated": list(truncated),
+    }
+
+
+def _ev(span, t, **attrs):
+    return dict({"span": span, "t": t}, **attrs)
+
+
+def test_synthetic_disagg_full_timeline():
+    """Client -> replica0 (prefill, ship) -> replica1 (warm prefill,
+    decode): every cross-process gap lands in a named phase and the sum
+    is exact."""
+    client = _proc("client", [
+        _ev(obs_trace.SPAN_CLIENT_RECV, 0.00),
+        _ev(obs_trace.SPAN_CLIENT_PLAN, 0.02),
+        _ev(obs_trace.SPAN_CLIENT_SUBMIT, 0.03),
+    ])
+    rep0 = _proc("replica0", [
+        _ev(obs_trace.SPAN_SUBMIT, 0.05),
+        _ev(obs_trace.SPAN_ADMITTED, 0.07),
+        _ev(obs_trace.SPAN_FIRST_TOKEN, 0.12, mode="solo"),
+        _ev(obs_trace.SPAN_SHIPPED, 0.14),
+    ])
+    rep1 = _proc("replica1", [
+        _ev(obs_trace.SPAN_KV_SHIP_LAND, 0.17),
+        _ev(obs_trace.SPAN_SUBMIT, 0.18),
+        _ev(obs_trace.SPAN_ADMITTED, 0.19),
+        _ev(obs_trace.SPAN_FIRST_TOKEN, 0.20),
+        _ev(obs_trace.SPAN_FINISH, 0.30),
+    ])
+    led = assemble_anatomy("r", [client, rep0, rep1])
+    _assert_exact_sum(led)
+    assert led["coverage"] == pytest.approx(1.0)
+    assert led["covered"] is True
+    t = led["totals"]
+    assert t["batch_window"] == pytest.approx(0.02, abs=1e-6)
+    assert t["route_plan"] == pytest.approx(0.01, abs=1e-6)
+    assert t["queue"] == pytest.approx(0.02 + 0.01, abs=1e-6)
+    assert t["prefill"] == pytest.approx(0.05 + 0.01, abs=1e-6)
+    assert t["ship"] == pytest.approx(0.02 + 0.03, abs=1e-6)
+    assert t["decode"] == pytest.approx(0.10, abs=1e-6)
+    details = {
+        (r["phase"], r.get("detail")) for r in led["phases"]
+    }
+    assert ("ship", "export") in details
+    assert ("ship", "transit") in details
+    assert ("prefill", "solo") in details
+    assert ("prefill", "warm") in details
+    chain = [(o["process"], o["outcome"]) for o in led["outcome"]]
+    assert chain == [("replica0", "shipped"), ("replica1", "finished")]
+    assert led["markers"] == []
+    text = render_anatomy(led)
+    assert "shipped@replica0 -> finished@replica1" in text
+    assert "transit" in text
+
+
+def test_synthetic_hedged_clipping_no_double_count():
+    """Two replicas racing the same id: the overlap is clipped out of
+    the timeline (accounted <= observed, never >) and the hedge is
+    marked even without an event ring."""
+    rep0 = _proc("replica0", [
+        _ev(obs_trace.SPAN_SUBMIT, 0.00),
+        _ev(obs_trace.SPAN_ADMITTED, 0.01),
+        _ev(obs_trace.SPAN_FIRST_TOKEN, 0.05),
+        _ev(obs_trace.SPAN_FINISH, 0.20),
+    ])
+    rep1 = _proc("replica1", [  # the hedge, launched mid-flight
+        _ev(obs_trace.SPAN_SUBMIT, 0.08),
+        _ev(obs_trace.SPAN_ADMITTED, 0.09),
+        _ev(obs_trace.SPAN_FIRST_TOKEN, 0.11),
+        _ev(obs_trace.SPAN_CANCEL, 0.15),
+    ])
+    led = assemble_anatomy("r", [rep0, rep1])
+    _assert_exact_sum(led)
+    assert led["observed_s"] == pytest.approx(0.20, abs=1e-6)
+    assert led["accounted_s"] <= led["observed_s"] + 1e-9
+    assert "hedged" in led["markers"]
+
+
+def test_synthetic_markers_from_events():
+    rep0 = _proc("replica0", [
+        _ev(obs_trace.SPAN_SUBMIT, 0.0),
+        _ev(obs_trace.SPAN_ADMITTED, 0.1),
+        _ev(obs_trace.SPAN_CANCEL, 0.2),
+    ])
+    rep1 = _proc("replica1", [
+        _ev(obs_trace.SPAN_SUBMIT, 0.3),
+        _ev(obs_trace.SPAN_ADMITTED, 0.4),
+        _ev(obs_trace.SPAN_FIRST_TOKEN, 0.5),
+        _ev(obs_trace.SPAN_FINISH, 0.6),
+    ])
+    events = [
+        {"name": "cancel", "request_id": "r", "migrated": True},
+        {"name": "failover", "kv": {"request_id": "r"}},
+        {"name": "request_hedged", "request_id": "OTHER"},
+    ]
+    led = assemble_anatomy("r", [rep0, rep1], events=events)
+    _assert_exact_sum(led)
+    assert set(led["markers"]) == {"migrated", "failover"}
+    # The inter-segment re-drive gap is attributed, not lost.
+    assert any(
+        r["phase"] == "client_wait" and r.get("detail") == "re-drive"
+        for r in led["phases"]
+    )
+
+
+def test_truncated_ring_reports_provenance_not_misattribution():
+    rep0 = _proc("replica0", [
+        # Ring wrapped: the submit span is gone; first retained event
+        # carries the truncation flag.
+        _ev(obs_trace.SPAN_QUEUED, 0.10, truncated=True),
+        _ev(obs_trace.SPAN_ADMITTED, 0.12),
+        _ev(obs_trace.SPAN_FIRST_TOKEN, 0.15),
+        _ev(obs_trace.SPAN_FINISH, 0.25),
+    ])
+    journal = [
+        {"kind": "submit", "request_id": "r", "t_wall": 0.0},
+        {"kind": "outcome", "request_id": "r", "t_wall": 0.26,
+         "outcome": "finished"},
+    ]
+    led = assemble_anatomy("r", [rep0], journal=journal)
+    _assert_exact_sum(led)
+    assert led["truncated"] is True
+    assert any("ring wrapped" in p for p in led["provenance"])
+    # The pre-wrap window (journal submit at 0.0 -> first retained span
+    # at 0.10) is UNACCOUNTED, not folded into queue.
+    assert led["unaccounted_s"] >= 0.10 - 1e-6
+    assert "truncated rings" in render_anatomy(led)
+
+
+def test_journal_only_ledger_and_not_found():
+    phases = {"queue": 0.01, "kv_fetch": 0.2, "prefill": 0.05,
+              "decode": 0.1, "kv_fetch_source": "store"}
+    led = assemble_anatomy(
+        "r", [], journal=[{
+            "kind": "outcome", "request_id": "r", "t_wall": 1.0,
+            "outcome": "finished", "phases": phases,
+        }],
+    )
+    assert led["found"] and led["coverage"] == 1.0
+    fetch = [r for r in led["phases"] if r["phase"] == "kv_fetch"]
+    assert fetch and fetch[0]["detail"] == "store"
+    # Canonical phase order regardless of dict order.
+    assert [r["phase"] for r in led["phases"]] == [
+        "queue", "kv_fetch", "prefill", "decode",
+    ]
+    assert assemble_anatomy("nope", [])["found"] is False
+    assert "not found" in render_anatomy({"request_id": "nope"})
+    assert ledger_from_phase_map("r", {})["found"] is False
+
+
+# ---------------------------------------------------------------------------
+# Aggregation + attribution units
+# ---------------------------------------------------------------------------
+def test_aggregate_phases_percentiles():
+    maps = [{"decode": 0.001 * (i + 1), "queue": 0.01,
+             "kv_fetch_source": "peer"} for i in range(100)]
+    agg = aggregate_phases(maps)
+    assert set(agg) == {"decode", "queue"}  # detail keys excluded
+    assert agg["decode"]["count"] == 100
+    assert agg["decode"]["p50_s"] == pytest.approx(0.051, abs=1e-3)
+    assert agg["decode"]["p95_s"] == pytest.approx(0.095, abs=2e-3)
+    assert agg["queue"]["mean_s"] == pytest.approx(0.01)
+    assert aggregate_phases([]) == {}
+
+
+def test_breach_attribution_shares_and_format():
+    block = {
+        "by_phase": {
+            "kv_fetch": {"mean_s": 0.58, "count": 10},
+            "queue": {"mean_s": 0.22, "count": 10},
+            "decode": {"mean_s": 0.17, "count": 10},
+            "route_plan": {"mean_s": 0.03, "count": 10},  # < min_share
+        },
+    }
+    shares = breach_attribution(block)
+    assert [p for p, _ in shares] == ["kv_fetch", "queue", "decode"]
+    assert shares[0][1] == pytest.approx(0.58, abs=1e-3)
+    assert format_attribution(shares).startswith("kv_fetch 58%")
+    # Accepts the bare by_phase dict / aggregate_phases output too.
+    assert breach_attribution(block["by_phase"])[0][0] == "kv_fetch"
+    assert breach_attribution(None) == []
+    assert breach_attribution({"by_phase": {}}) == []
+
+
+def test_fleet_rollup_weighted_centers_max_tails():
+    from ray_lightning_tpu.obs.fleet import aggregate_fleet
+
+    def row(role, phases, reasons=None):
+        return {
+            "health": "healthy", "role": role, "queue_depth": 0,
+            "active_slots": 0, "num_slots": 0, "tokens_per_sec": 0.0,
+            "ttft_p95_s": None, "cost_emitted_tokens": 0,
+            "cost_device_seconds": 0.0, "phases": phases,
+            "slo_reasons": reasons,
+        }
+
+    rows = [
+        row("prefill", {"by_phase": {"prefill": {
+            "p50_s": 0.01, "p95_s": 0.02, "p99_s": 0.02,
+            "mean_s": 0.01, "count": 10,
+        }}}),
+        row("decode", {"by_phase": {"prefill": {
+            "p50_s": 0.03, "p95_s": 0.30, "p99_s": 0.30,
+            "mean_s": 0.03, "count": 30,
+        }}}, reasons=["SLO breach: ttft_p95_s=0.4 exceeds 0.2; "
+                      "top phases: prefill 90%"]),
+    ]
+    fleet = aggregate_fleet(rows)
+    blk = fleet["phases"]
+    pf = blk["by_phase"]["prefill"]
+    assert pf["count"] == 40
+    assert pf["p95_s"] == pytest.approx(0.30)  # MAX, not mean
+    assert pf["p50_s"] == pytest.approx(
+        (0.01 * 10 + 0.03 * 30) / 40
+    )
+    assert blk["hot_phase"] == "prefill"
+    assert set(blk["by_role"]) == {"prefill", "decode"}
+    assert "top phases: prefill 90%" in fleet["breach_attribution"]
+    # No phase windows anywhere -> no block, no attribution.
+    bare = aggregate_fleet([row("mixed", None)])
+    assert bare["phases"] is None
+    assert bare["breach_attribution"] is None
+
+
+def test_slo_breach_names_top_phases():
+    from ray_lightning_tpu.obs.events import EventLog
+    from ray_lightning_tpu.obs.health import parse_slo_rules, slo_check
+    from ray_lightning_tpu.obs.registry import MetricsRegistry
+
+    snap = {
+        "ttft_p95_s": 0.9,
+        "phases": {"by_phase": {
+            "kv_fetch": {"mean_s": 0.58, "count": 5},
+            "queue": {"mean_s": 0.22, "count": 5},
+            "decode": {"mean_s": 0.20, "count": 5},
+        }},
+    }
+    log = EventLog(capacity=16)
+    check = slo_check(
+        parse_slo_rules({"ttft_p95_s": 0.5}),
+        lambda: snap,
+        registry=MetricsRegistry(),
+        events=log,
+    )
+    (ch,) = check()
+    assert ch.verdict == "unhealthy"
+    assert "top phases: kv_fetch 58%" in ch.reasons[0]
+    (ev,) = log.tail(name="slo_breach")
+    assert ev["phases"].startswith("kv_fetch 58%")
+    # Healthy path and no-phases path stay clean.
+    snap["ttft_p95_s"] = 0.1
+    (ch,) = check()
+    assert ch.verdict == "healthy" and not ch.reasons
+    snap["ttft_p95_s"], snap["phases"] = 0.9, None
+    (ch,) = check()
+    assert "top phases" not in ch.reasons[0]
+
+
+# ---------------------------------------------------------------------------
+# The /why route
+# ---------------------------------------------------------------------------
+def test_why_route_found_missing_and_bad_request():
+    ledgers = {"r1": ledger_from_phase_map(
+        "r1", {"queue": 0.01, "decode": 0.04}, outcome="finished",
+    )}
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "",
+        collect_why=lambda rid: ledgers.get(
+            rid, {"request_id": rid, "found": False}
+        ),
+        port=0,
+    ).start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        with urllib.request.urlopen(f"{base}/why?id=r1") as resp:
+            led = json.loads(resp.read())
+        assert led["found"] and led["request_id"] == "r1"
+        assert led["totals"]["decode"] == pytest.approx(0.04)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/why?id=ghost")
+        assert exc.value.code == 404
+        body = json.loads(exc.value.read())  # found:false rides the 404
+        assert body == {"request_id": "ghost", "found": False}
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/why")
+        assert exc.value.code == 400
+    finally:
+        srv.close()
+    # Without the collector the route 404s like every other gated one.
+    srv2 = obs.MetricsHTTPServer(collect_text=lambda: "", port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://{srv2.host}:{srv2.port}/why?id=r1"
+            )
+        assert exc.value.code == 404
+    finally:
+        srv2.close()
+
+
+# ---------------------------------------------------------------------------
+# Real schedulers: the hard paths, exact sums, exact tokens
+# ---------------------------------------------------------------------------
+def test_local_request_ledger_and_journal_phases(params):
+    """A plain local request: tracer + journal reconstruct a covered
+    ledger (queue/prefill/decode + stream_gap), the journal outcome
+    carries the compact phase map, and output is bit-exact."""
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    tracer = obs.RequestTracer(capacity=256)
+    journal = WorkloadJournal(capacity=64)
+    eng = _engine(params, DENSE_KW)
+    sched = Scheduler(eng, tracer=tracer, journal=journal)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, CFG.vocab_size, size=12).tolist()
+    rid = sched.submit(prompt, _sp(6), request_id="local")
+    evs = []
+    for _ in range(200):
+        evs.extend(sched.step())
+        if not sched.has_work():
+            break
+    assert _tokens(evs, rid) == _ref(params, prompt, 6)
+    entries = journal.dump(None)["entries"]
+    led = assemble_anatomy(
+        rid, [dict(tracer.dump(8), name="replica0")], journal=entries,
+    )
+    _assert_exact_sum(led)
+    assert led["covered"] is True, led
+    for phase in ("queue", "prefill", "decode"):
+        assert led["totals"].get(phase, 0) > 0, led["totals"]
+    # The compact map on the journal outcome record agrees with the
+    # ledger's vocabulary (same phases one layer down).
+    out = [e for e in entries if e["kind"] == "outcome"][0]
+    ph = out["phases"]
+    assert set(ph) & {"queue", "prefill", "decode"} == {
+        "queue", "prefill", "decode",
+    }
+    assert all(
+        k in set(PHASES) | {"kv_fetch_source"} for k in ph
+    ), ph
+    # And the metrics window saw the same request.
+    blk = sched.metrics.snapshot()["phases"]
+    assert blk["requests"] >= 1
+    assert set(blk["by_phase"]) & {"prefill", "decode"}
+
+
+def test_disagg_ship_ledger_cross_process(params):
+    """Disaggregated prefill->ship->decode under one id: the stitched
+    ledger covers the full cross-process timeline (export + transit +
+    warm decode-side prefill), sums exactly, and the stream is
+    bit-exact."""
+    duo = _Duo(params, roles=("prefill", "decode"))
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(0, CFG.vocab_size, size=14).tolist()
+    n = 8
+    duo.scheds[0].submit(prompt, _sp(n), request_id="r", ship_to=1)
+    duo.drive()
+    duo.scheds[1].submit(prompt, _sp(n), request_id="r")
+    _, evB = duo.drive()
+    assert _tokens(evB, "r") == _ref(params, prompt, n)
+    led = assemble_anatomy("r", duo.processes())
+    _assert_exact_sum(led)
+    assert led["covered"] is True, led
+    assert led["coverage"] >= 0.9, led
+    chain = [(o["process"], o["outcome"]) for o in led["outcome"]]
+    assert chain == [("replica0", "shipped"), ("replica1", "finished")]
+    assert led["totals"].get("ship", 0) > 0, led["totals"]
+    by_proc = {
+        (r["phase"], r["process"]) for r in led["phases"]
+    }
+    assert ("prefill", "replica0") in by_proc
+    assert ("decode", "replica1") in by_proc
+    assert "hedged" not in led["markers"]
+
+
+def test_steered_peer_fetch_ledger(params):
+    """A router-steered peer fetch: the victim's ledger shows kv_fetch
+    (detail peer) + transfer_park, zero compiles in the steady-state
+    fetch traffic, and the stream is bit-exact."""
+    import jax
+
+    from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, CFG.vocab_size, size=12).tolist()
+    n = 6
+    expected = _ref(params, prompt, n)  # compiles OUTSIDE the window
+    stats = install_compile_listener()
+    duo = _Duo(params)
+    # Warm replica 0's pool AND both engines' executables.
+    duo.scheds[0].submit(prompt, _sp(n), request_id="warm")
+    duo.scheds[1].submit(
+        rng.integers(0, CFG.vocab_size, size=12).tolist(), _sp(n),
+        request_id="warm1",
+    )
+    duo.drive()
+    jax.random.PRNGKey(0)
+    baseline = stats.count("backend_compile")
+    duo.scheds[1].submit(
+        prompt, _sp(n), request_id="fetched",
+        kv_hint={
+            "peer": 0,
+            "digests": [
+                d.hex() for d in prompt_block_digests(prompt, BLOCK)
+            ],
+        },
+    )
+    _, evB = duo.drive()
+    assert _tokens(evB, "fetched") == expected
+    assert stats.count("backend_compile") == baseline
+    led = assemble_anatomy("fetched", duo.processes())
+    _assert_exact_sum(led)
+    fetch = [r for r in led["phases"] if r["phase"] == "kv_fetch"]
+    assert fetch and fetch[0]["detail"] == "peer", led["phases"]
+    assert fetch[0]["process"] == "replica1"
+    assert led["totals"].get("transfer_park", 0) >= 0
+
+
+def test_store_fetch_after_bounce_ledger(params, tmp_path):
+    """Persistent-store fetch on a bounced (fresh) replica: the ledger's
+    kv_fetch names the store as its source and the output is exact."""
+    from ray_lightning_tpu.serve.kvstore import FleetKVStore
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, CFG.vocab_size, size=12).tolist()
+    n = 6
+    store = FleetKVStore(str(tmp_path))
+    # First life: writethrough populates the store.
+    eng1 = _engine(params, DENSE_KW)
+    inbox1 = queue.Queue()
+    sched1 = Scheduler(
+        eng1,
+        kvfleet=KVFleetPlane(
+            index=0, inbox=inbox1, peers={0: inbox1},
+            block_bytes=eng1.prefix_block_nbytes, min_poll_s=0.0,
+            store=store,
+        ),
+        kvstore=store, kvstore_writethrough=True,
+    )
+    sched1.submit(prompt, _sp(n), request_id="seed")
+    for _ in range(200):
+        sched1.step()
+        if not sched1.has_work():
+            break
+    assert store.writes > 0
+    # The bounce: a fresh engine/scheduler, cold pool, same store dir.
+    tracer = obs.RequestTracer(capacity=256)
+    eng2 = _engine(params, DENSE_KW)
+    inbox2 = queue.Queue()
+    sched2 = Scheduler(
+        eng2,
+        kvfleet=KVFleetPlane(
+            index=0, inbox=inbox2, peers={0: inbox2},
+            block_bytes=eng2.prefix_block_nbytes, min_poll_s=0.0,
+            store=FleetKVStore(str(tmp_path)),
+        ),
+        tracer=tracer,
+    )
+    digs = [d.hex() for d in prompt_block_digests(prompt, BLOCK)]
+    sched2.submit(
+        prompt, _sp(n), request_id="r",
+        kv_hint={"peer": None, "store": True, "digests": digs},
+    )
+    evs = []
+    for _ in range(400):
+        evs.extend(sched2.step())
+        if not sched2.has_work():
+            break
+    assert _tokens(evs, "r") == _ref(params, prompt, n)
+    led = assemble_anatomy(
+        "r", [dict(tracer.dump(8), name="replica0")],
+    )
+    _assert_exact_sum(led)
+    fetch = [r for r in led["phases"] if r["phase"] == "kv_fetch"]
+    assert fetch and fetch[0]["detail"] == "store", led["phases"]
+    assert eng2.prefix_hit_tokens > 0  # admitted warm off the store

@@ -191,6 +191,21 @@ def test_bench_smoke_cpu():
     }, out["extra"]
     assert out["extra"]["journal_overhead"] < 1.05, out["extra"]
     assert out["extra"]["journal_spill_overhead"] > 0, out["extra"]
+    # And for the ANATOMY ledger: the per-request phase stashes (serve
+    # default) must also cost < 5% tokens/s — a latency decomposition
+    # you can't afford to leave on never explains the breach. The
+    # anatomy_rows demo injects a kvfleet_fetch delay on a steered peer
+    # fetch and the breach attribution over the victim's recorded
+    # ledger must name kv_fetch the top contributor.
+    an_modes = {
+        r["mode"]
+        for r in out["extra"]["serve_rows"]
+        if r["workload"] == "anatomy_overhead"
+    }
+    assert an_modes == {"ledger_off", "ledger_on"}, out["extra"]
+    assert out["extra"]["anatomy_overhead"] < 1.05, out["extra"]
+    assert out["extra"]["anatomy_top_phase"] == "kv_fetch", out["extra"]
+    assert "kv_fetch" in out["extra"]["anatomy_attribution"], out["extra"]
     # Mesh-sharded decode sweep: a 1x1 control plus >= 1 model-axis
     # mesh over the forced host devices, per-device KV bytes shrinking
     # ~linearly in the model axis (the tp=N footprint story, measured).

@@ -297,6 +297,31 @@ def test_tracer_ring_buffer_bounded():
     assert tracer.trace("r10") == []  # disabled tracer records nothing
 
 
+def test_tracer_truncation_honesty():
+    """Ring wrap that eats PART of a request's history is reported, not
+    hidden: the retained trace's first event carries ``truncated`` and
+    the dump lists the id — so duration math downstream (anatomy) can
+    refuse to treat the first retained timestamp as the start."""
+    tracer = obs.RequestTracer(capacity=4)
+    tracer.event("old", obs_trace.SPAN_SUBMIT)
+    for i in range(4):  # wraps "old"'s submit out while keeping later
+        tracer.event("old", obs_trace.SPAN_DECODE_FOLD, attrs={"i": i})
+    assert tracer.is_truncated("old")
+    tr = tracer.trace("old")
+    assert tr and tr[0].get("truncated") is True
+    assert all("truncated" not in ev for ev in tr[1:])
+    dump = tracer.dump(4)
+    assert "old" in dump["truncated"]
+    # A fully retained request is NOT flagged.
+    tracer2 = obs.RequestTracer(capacity=8)
+    tracer2.event("fresh", obs_trace.SPAN_SUBMIT)
+    tracer2.event("fresh", obs_trace.SPAN_FINISH)
+    assert not tracer2.is_truncated("fresh")
+    # Healthy rings keep the legacy wire form: no "truncated" key at all.
+    assert "truncated" not in tracer2.dump(4)
+    assert all("truncated" not in ev for ev in tracer2.trace("fresh"))
+
+
 # ---------------------------------------------------------------------------
 # ServeReplica observability RPC surface (in-process)
 # ---------------------------------------------------------------------------

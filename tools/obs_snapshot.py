@@ -31,10 +31,17 @@ over real HTTP — the tpu_watch ``fleet`` manifest stage's artifact.
 (Replica actors are pinned to CPU: the artifact records the
 aggregation plane, not chip throughput.)
 
-The tpu_watch `obs`, `doctor`, and `fleet` manifest stages run this
-and archive the files, so every healthy TPU window leaves a
+``--out-why PATH`` (fleet path only) additionally runs the real
+``rlt why <addr> <request_id>`` CLI against the live endpoint for one
+completed request and archives its rendered phase-ledger timeline —
+the tpu_watch ``anatomy`` manifest stage's artifact (the request
+anatomy wire path proven end-to-end: replica rings -> /why ->
+rendered decomposition).
+
+The tpu_watch `obs`, `doctor`, `fleet`, and `anatomy` manifest stages
+run this and archive the files, so every healthy TPU window leaves a
 scrapeable-metrics + viewable-trace + pullable-bundle + fleet-snapshot
-record alongside the bench JSONs. Runs fine on CPU.
++ request-anatomy record alongside the bench JSONs. Runs fine on CPU.
 """
 import argparse
 import contextlib
@@ -115,6 +122,20 @@ def fleet_main(args) -> None:
             f.write(fleet_body)
         with open(args.out_stitched, "wb") as f:
             f.write(trace_body)
+        why = None
+        if args.out_why:
+            # The real `rlt why` CLI over the live /why route: one
+            # completed request's rendered phase-ledger timeline.
+            from ray_lightning_tpu.cli import main as cli_main
+
+            why_rid = handles[0].request_id
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                why = cli_main([
+                    "why", f"{server.host}:{server.port}", why_rid,
+                ])
+            with open(args.out_why, "w") as f:
+                f.write(buf.getvalue())
         fleet = json.loads(fleet_body)
         trace = json.loads(trace_body)
         procs = sorted(
@@ -122,7 +143,7 @@ def fleet_main(args) -> None:
             for e in trace["traceEvents"]
             if e.get("name") == "process_name"
         )
-        print(json.dumps({
+        summary = {
             "requests": args.requests,
             "fleet_replicas": fleet["latest"]["fleet"]["replicas"],
             "fleet_goodput": fleet["latest"]["fleet"][
@@ -133,7 +154,13 @@ def fleet_main(args) -> None:
             "trace_events": len(trace["traceEvents"]),
             "out_fleet": args.out_fleet,
             "out_stitched": args.out_stitched,
-        }))
+        }
+        if why is not None:
+            summary["why_found"] = bool(why.get("found"))
+            summary["why_coverage"] = why.get("coverage")
+            summary["why_phases"] = sorted(why.get("totals") or {})
+            summary["out_why"] = args.out_why
+        print(json.dumps(summary))
     finally:
         if poller is not None:
             poller.stop()
@@ -168,6 +195,12 @@ def main() -> None:
     p.add_argument(
         "--out-stitched", default="/tmp/fleet_trace.json",
         help="where the fleet path saves the stitched /traces export",
+    )
+    p.add_argument(
+        "--out-why", default="",
+        help="(fleet path) run the real `rlt why` CLI against the live "
+        "endpoint for one completed request and save its rendered "
+        "phase-ledger timeline here",
     )
     p.add_argument("--requests", type=int, default=4)
     p.add_argument("--new-tokens", type=int, default=16)

@@ -22,6 +22,7 @@ have_spec=0
 have_obs=0
 have_doctor=0
 have_fleet=0
+have_anatomy=0
 have_replay=0
 have_failover=0
 have_preempt=0
@@ -40,6 +41,7 @@ spec_fails=0
 obs_fails=0
 doctor_fails=0
 fleet_fails=0
+anatomy_fails=0
 replay_fails=0
 failover_fails=0
 preempt_fails=0
@@ -62,6 +64,7 @@ spec_status=pending
 obs_status=pending
 doctor_status=pending
 fleet_status=pending
+anatomy_status=pending
 replay_status=pending
 failover_status=pending
 preempt_status=pending
@@ -91,6 +94,7 @@ write_manifest() {
     echo "stage=obs status=$obs_status fails=$obs_fails"
     echo "stage=doctor status=$doctor_status fails=$doctor_fails"
     echo "stage=fleet status=$fleet_status fails=$fleet_fails"
+    echo "stage=anatomy status=$anatomy_status fails=$anatomy_fails"
     echo "stage=replay status=$replay_status fails=$replay_fails"
     echo "stage=failover status=$failover_status fails=$failover_fails"
     echo "stage=preempt status=$preempt_status fails=$preempt_fails"
@@ -525,6 +529,36 @@ while true; do
             have_fleet=1
             fleet_status=skipped
             echo "$(date -u +%H:%M:%S) fleet snapshot SKIPPED after $fleet_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_anatomy" -eq 0 ]; then
+        # Stage 7b2: request-anatomy artifact — the fleet path again,
+        # plus one real `rlt why <addr> <request_id>` run against the
+        # live /why route, archiving the rendered per-request phase
+        # ledger (cross-process timeline + coverage line), so each
+        # healthy window proves the latency-decomposition wire path
+        # end-to-end next to the fleet snapshot.
+        echo "$(date -u +%H:%M:%S) launching ANATOMY snapshot" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 1200 python tools/obs_snapshot.py \
+            --out-fleet /tmp/anatomy_fleet.json \
+            --out-stitched /tmp/anatomy_trace.json \
+            --out-why /tmp/anatomy_why.txt \
+            > /tmp/anatomy_snapshot.json 2> /tmp/anatomy_snapshot.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/anatomy_why.txt ] && \
+           grep -q 'observed' /tmp/anatomy_why.txt 2>/dev/null; then
+          have_anatomy=1
+          anatomy_status=ok
+          echo "$(date -u +%H:%M:%S) ANATOMY snapshot SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          anatomy_fails=$((anatomy_fails+1))
+          anatomy_status=failed
+          echo "$(date -u +%H:%M:%S) anatomy snapshot failed rc=$rc (fail $anatomy_fails)" >> /tmp/tpu_watch.log
+          if [ "$anatomy_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_anatomy=1
+            anatomy_status=skipped
+            echo "$(date -u +%H:%M:%S) anatomy snapshot SKIPPED after $anatomy_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_replay" -eq 0 ]; then
