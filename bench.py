@@ -1574,6 +1574,255 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
         anatomy_top_phase = an_shares[0][0] if an_shares else None
         anatomy_attribution = format_attribution(an_shares)
 
+        # ---- watchtower observer effect: decode with the retained ------
+        # telemetry + alert plane off vs on. The watchtower runs driver-
+        # side (its tick reads a fleet snapshot, writes ring buckets, and
+        # evaluates a handful of rules — no hot-path hooks), so its
+        # observer effect is thread/GIL contention only. Measured with
+        # the ALTERNATING protocol on the SAME compiled engine
+        # (jr_sched), "on" = a live watchtower thread ticking at 10ms —
+        # 200x the production cadence. The slow smoke pins ratio < 1.05.
+        from ray_lightning_tpu.obs import watchtower as obs_wt
+        from ray_lightning_tpu.obs.tsdb import RingTSDB
+
+        def _wt_snap():
+            q = jr_sched.queue_depth()
+            return {
+                "ts": _time.time(),
+                "fleet": {
+                    "replicas": 1, "healthy": 1, "queue_depth": q,
+                    "tokens_per_sec": 0.0,
+                    "goodput_tokens_per_device_s": 0.0,
+                },
+                "replicas": [{
+                    "replica": 0, "queue_depth": q,
+                    "tokens_per_sec": 0.0, "health": "healthy",
+                    "slo_breaches": 0, "finished": 0,
+                }],
+            }
+
+        def wt_sweep():
+            for p in jr_prompts:
+                jr_sched.submit(
+                    p, SamplingParams(max_new_tokens=obs_new)
+                )
+            jr_sched.run_until_idle()
+
+        wt_sweep()  # warm (same engine as the journal/anatomy blocks)
+        wt_tps = {"off": 0.0, "on": 0.0}
+        for _ in range(5):
+            for key in ("off", "on"):
+                tower = None
+                if key == "on":
+                    tower = obs_wt.Watchtower(
+                        tsdb=RingTSDB(),
+                        rules=obs_wt.default_rules(),
+                        fleet_latest_fn=_wt_snap,
+                        interval_s=0.01,
+                    ).start()
+                t0 = _time.monotonic()
+                wt_sweep()
+                wt_tps[key] = max(
+                    wt_tps[key], 4 * obs_new / (_time.monotonic() - t0)
+                )
+                if tower is not None:
+                    tower.stop()
+        for mode, tps in (
+            ("watchtower_off", wt_tps["off"]),
+            ("watchtower_on", wt_tps["on"]),
+        ):
+            rows.append(
+                {
+                    "workload": "watchtower_overhead",
+                    "mode": mode,
+                    "tokens_per_sec": round(tps, 2),
+                }
+            )
+        watchtower_overhead = round(
+            wt_tps["off"] / max(wt_tps["on"], 1e-9), 4
+        )
+
+        # ---- alert_fire_rows: a real burn-rate alert, end to end -------
+        # The page the docs promise: the anatomy demo's REAL injected
+        # kvfleet_fetch regression (its recorded phase ledger, where
+        # kv_fetch earned the latency) drives the watchtower on an
+        # injected clock — fleet snapshots during the fault window carry
+        # breaching SLO counters (the delayed fetch sat squarely across
+        # the TTFT bound), the multi-window burn-rate rule must FIRE
+        # within 3 evaluation ticks of the first breach ratio sample
+        # with kv_fetch named in the notification's attribution, and
+        # must RESOLVE after the fault clears and the fast window
+        # drains. Tick cadence 5s (the serve default's neighborhood).
+        wt_phases = aggregate_phases([an_phases])
+        al_clk = [1000.0]
+        al_feed: Dict[str, Any] = {"snap": None}
+        alert_wt = obs_wt.Watchtower(
+            tsdb=RingTSDB(),
+            rules=obs_wt.default_rules(),
+            fleet_latest_fn=lambda: al_feed["snap"],
+            interval_s=5.0,
+            clock=lambda: al_clk[0],
+        )
+        al_breaches = al_finished = 0
+
+        def al_snapshot(breaching):
+            nonlocal al_breaches, al_finished
+            al_finished += 2
+            if breaching:
+                al_breaches += 2
+            return {
+                "ts": al_clk[0],
+                "fleet": {
+                    "replicas": 2, "healthy": 2, "queue_depth": 1,
+                    "tokens_per_sec": 10.0,
+                    "goodput_tokens_per_device_s": 10.0,
+                    "phases": wt_phases,
+                },
+                "replicas": [
+                    {"replica": i, "queue_depth": 0,
+                     "tokens_per_sec": 5.0, "health": "healthy",
+                     "slo_breaches": al_breaches // 2,
+                     "finished": al_finished // 2}
+                    for i in range(2)
+                ],
+            }
+
+        fire_note = None
+        fire_tick = resolve_tick = None
+        tick_no = 0
+        while fire_tick is None and tick_no < 12:
+            tick_no += 1
+            al_clk[0] += 5.0
+            al_feed["snap"] = al_snapshot(breaching=True)
+            for note in alert_wt.tick():
+                if (
+                    note["rule"] == "slo_burn_rate"
+                    and note["state"] == "firing"
+                ):
+                    fire_tick, fire_note = tick_no, note
+        fault_ticks = tick_no
+        while resolve_tick is None and tick_no - fault_ticks < 40:
+            tick_no += 1
+            al_clk[0] += 5.0
+            al_feed["snap"] = al_snapshot(breaching=False)
+            for note in alert_wt.tick():
+                if (
+                    note["rule"] == "slo_burn_rate"
+                    and note["state"] == "resolved"
+                ):
+                    resolve_tick = tick_no
+        alert_attribution = (
+            fire_note.get("attribution", "") if fire_note else ""
+        )
+        rows.append(
+            {
+                "workload": "alert_fire_rows",
+                "mode": "fire",
+                "ticks": fire_tick,
+                "attribution": alert_attribution,
+            }
+        )
+        rows.append(
+            {
+                "workload": "alert_fire_rows",
+                "mode": "resolve",
+                "ticks": (
+                    resolve_tick - fault_ticks
+                    if resolve_tick is not None else None
+                ),
+            }
+        )
+
+        # ---- canary lane: fixed-seed probe, bit-exact, zero compiles ---
+        # The probe rides the organic submit/stream path (the jr engine,
+        # already warm) under the reserved tenant at floor priority; its
+        # tokens must be BIT-EXACT to a solo gpt_generate of the same
+        # prompt, and the probes must not trip a single backend compile
+        # (steady state holds — the canary is traffic, not a new shape).
+        # The measured envelope is written out as the baseline artifact
+        # --serve.canary_baseline consumes.
+        import jax.numpy as _jnp
+
+        from ray_lightning_tpu.models.gpt import gpt_generate
+        from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+
+        can_prompt = [
+            int(t) for t in g.integers(0, cfg.vocab_size, size=obs_prompt)
+        ]
+        can_new = 8
+        solo = gpt_generate(
+            params, cfg,
+            _jnp.asarray([can_prompt], dtype=_jnp.int32),
+            max_new_tokens=can_new,
+        )
+        can_reference = [
+            int(t) for t in np.asarray(solo)[0][len(can_prompt):]
+        ]
+
+        class _ProbeClient:
+            """ServeClient.stream-shaped adapter over jr_sched."""
+
+            def stream(
+                self, prompt, *, max_new_tokens=16, temperature=0.0,
+                seed=0, priority=0, tenant=None, timeout_s=60.0, **_kw
+            ):
+                rid = jr_sched.submit(
+                    list(prompt),
+                    SamplingParams(
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, seed=seed,
+                    ),
+                    priority=priority, tenant=tenant,
+                )
+                while jr_sched.has_work():
+                    for ev in jr_sched.step():
+                        if ev.request_id == rid and ev.token is not None:
+                            yield int(ev.token)
+
+        can_tsdb = RingTSDB()
+        lane = obs_wt.CanaryLane(
+            _ProbeClient(), can_tsdb,
+            prompt=can_prompt, max_new_tokens=can_new,
+            interval_s=0.0,
+            baseline={
+                "prompt": can_prompt, "max_new_tokens": can_new,
+                "tokens": can_reference,
+            },
+        )
+        compile_stats = install_compile_listener()
+        lane.probe()  # warm the probe path before the counted window
+        compiles_before = compile_stats.count("backend_compile")
+        can_results = [lane.probe() for _ in range(3)]
+        canary_compiles = (
+            compile_stats.count("backend_compile") - compiles_before
+        )
+        canary_exact = all(r.get("exact") for r in can_results)
+        canary_baseline = {
+            "prompt": can_prompt,
+            "max_new_tokens": can_new,
+            "tokens": can_reference,
+            "ttft_s": round(
+                max(r["ttft_s"] for r in can_results), 6
+            ),
+            "decode_tokens_per_s": round(
+                min(r["decode_tokens_per_s"] for r in can_results), 3
+            ),
+            "ttft_mult": 3.0,
+            "decode_frac": 0.33,
+        }
+        rows.append(
+            {
+                "workload": "canary_probe",
+                "mode": "probe",
+                "exact": canary_exact,
+                "compiles": canary_compiles,
+                "ttft_s": can_results[-1]["ttft_s"],
+                "decode_tokens_per_sec": can_results[-1][
+                    "decode_tokens_per_s"
+                ],
+            }
+        )
+
         # ---- paged KV: residency at a fixed HBM token budget -----------
         # The paged claim, measured: at the SAME KV token budget, the
         # page allocator admits >= 1.5x the resident requests the dense
@@ -1740,6 +1989,16 @@ def bench_serve(use_tpu: bool) -> Dict[str, Any]:
             "anatomy_overhead": anatomy_overhead,
             "anatomy_top_phase": anatomy_top_phase,
             "anatomy_attribution": anatomy_attribution,
+            "watchtower_overhead": watchtower_overhead,
+            "alert_fire_ticks": fire_tick,
+            "alert_resolve_ticks": (
+                resolve_tick - fault_ticks
+                if resolve_tick is not None else None
+            ),
+            "alert_attribution": alert_attribution,
+            "canary_exact": canary_exact,
+            "canary_compiles": canary_compiles,
+            "canary_baseline": canary_baseline,
             "serve_config": (
                 f"layers={cfg.n_layer} d_model={cfg.d_model} "
                 f"prompt={P} (shared={shared}) new={n_new} chunk={chunk}"

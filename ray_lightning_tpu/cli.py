@@ -35,7 +35,8 @@ import yaml
 
 _SUBCOMMANDS = (
     "fit", "validate", "test", "predict", "generate", "convert-hf",
-    "tokenize", "serve", "doctor", "top", "replay", "why",
+    "tokenize", "serve", "doctor", "top", "replay", "why", "plot",
+    "alerts",
 )
 
 
@@ -139,7 +140,7 @@ def _apply_dotted(
             continue
         if section not in (
             "model", "strategy", "trainer", "data", "generate", "tokenize",
-            "serve", "doctor", "top", "replay", "why",
+            "serve", "doctor", "top", "replay", "why", "plot", "alerts",
         ):
             raise ValueError(f"unknown config section {section!r} in --{key}")
         node = config.get(section)
@@ -156,7 +157,7 @@ def _apply_dotted(
         node = config[section]
         if section in (
             "trainer", "generate", "tokenize", "serve", "doctor", "top",
-            "replay", "why",
+            "replay", "why", "plot", "alerts",
         ):  # plain dicts
             node[field] = yaml.safe_load(raw)
             continue
@@ -223,6 +224,7 @@ def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
             pos_keys = {
                 "doctor": ("addr",), "top": ("addr",),
                 "replay": ("journal",), "why": ("target", "id"),
+                "plot": ("addr", "series"), "alerts": ("addr",),
             }.get(known.subcommand) or ()
             taken = config.get(known.subcommand) or {}
             pos_key = next((k for k in pos_keys if k not in taken), None)
@@ -231,6 +233,13 @@ def parse_args(argv: Optional[List[str]] = None) -> Tuple[str, Dict[str, Any]]:
                 i += 1
                 continue
             raise ValueError(f"unexpected argument {arg!r}")
+        if arg == "--follow" and known.subcommand == "alerts":
+            # Ergonomic alias: `rlt alerts <addr> --follow` ==
+            # `--alerts.follow true` (the only bare flag the dotted
+            # grammar admits — it takes no value).
+            dotted.append(("alerts.follow", "true"))
+            i += 1
+            continue
         key = arg[2:]
         if "=" in key:
             key, _, value = key.partition("=")
@@ -414,6 +423,8 @@ _SERVE_KEYS = frozenset((
     "kvfleet_inflight_mb", "kvfleet_bandwidth_mbps",
     "kvfleet_layerwise",
     "kvstore_dir", "kvstore_mb", "kvstore_writethrough",
+    "alerts", "alerts_interval_s", "alerts_rules", "alerts_webhook",
+    "canary", "canary_interval_s", "canary_baseline",
 ))
 
 
@@ -425,7 +436,14 @@ def _serve_obs_server(
     fleet_history: int = 128,
     supervisor: Any = None,
     router: Any = None,
-) -> Tuple[Any, Optional[Any]]:
+    alerts: bool = True,
+    alerts_interval_s: Optional[float] = None,
+    alerts_rules: Any = None,
+    alerts_webhook: Optional[str] = None,
+    canary: bool = False,
+    canary_interval_s: float = 10.0,
+    canary_baseline: Optional[str] = None,
+) -> Tuple[Any, Optional[Any], Optional[Any]]:
     """Build (started) the driver-side obs HTTP server ``rlt serve``
     runs next to a replica gang, plus its FleetPoller (None when
     ``fleet`` is off). Routes:
@@ -453,7 +471,18 @@ def _serve_obs_server(
       driver journal + the event rings stitched under one id;
     - ``/debug/bundle``: a replica flight-recorder bundle augmented
       driver-side with ``fleet.json`` + ``trace_stitched.json`` so a
-      pulled post-mortem shows the whole fleet, not one process.
+      pulled post-mortem shows the whole fleet, not one process;
+    - ``/query?series=&since=&step=``: one retained watchtower TSDB
+      series (``rlt plot``'s feed);
+    - ``/alerts``: the alert engine's rules/states/firing payload plus
+      the canary summary (``rlt alerts``'s feed).
+
+    The watchtower (PR 20) rides the fleet plane: when ``fleet`` and
+    ``alerts`` are both on, a :class:`obs.watchtower.Watchtower`
+    samples every FleetPoller snapshot into the ring TSDB, evaluates
+    the alert rules on its own cadence, and (with ``canary``) runs the
+    fixed-seed probe lane. Returns ``(server, fleet_poller,
+    watchtower)`` — each None when its plane is off.
 
     Factored out of run_serve so the wire path is testable against any
     client-shaped object without spawning the CLI.
@@ -463,7 +492,9 @@ def _serve_obs_server(
     from ray_lightning_tpu import obs
     from ray_lightning_tpu.fabric import core as fabric_core
     from ray_lightning_tpu.obs import health as obs_health
+    from ray_lightning_tpu.obs import watchtower as obs_wt
     from ray_lightning_tpu.obs.fleet import FleetPoller
+    from ray_lightning_tpu.obs.tsdb import RingTSDB
 
     driver_reg = obs.get_registry()
     driver_wd = obs_health.Watchdog(registry=driver_reg)
@@ -484,6 +515,53 @@ def _serve_obs_server(
             ),
             router_fn=(router.rows if router is not None else None),
         ).start()
+
+    watchtower = None
+    if fleet_poller is not None and (alerts or canary):
+        if isinstance(alerts_rules, str):
+            with open(alerts_rules) as f:
+                alerts_rules = yaml.safe_load(f)
+        rules = (
+            obs_wt.parse_alert_rules(alerts_rules)
+            if alerts_rules is not None else obs_wt.default_rules()
+        )
+        if not alerts:
+            rules = []  # canary-only: just the lane's own rules
+        sinks: List[Any] = [obs_wt.LogSink()]
+        if alerts_webhook:
+            sinks.append(obs_wt.WebhookSink(alerts_webhook))
+        tsdb = RingTSDB(registry=driver_reg)
+        lane = None
+        if canary:
+            baseline = canary_baseline
+            if isinstance(baseline, str):
+                with open(baseline) as f:
+                    baseline = yaml.safe_load(f)
+            lane = obs_wt.CanaryLane(
+                client, tsdb,
+                interval_s=float(canary_interval_s),
+                baseline=baseline,
+                events=obs.get_event_log(),
+                registry=driver_reg,
+            )
+        watchtower = obs_wt.Watchtower(
+            tsdb=tsdb,
+            rules=rules,
+            fleet_latest_fn=fleet_poller.latest,
+            metrics_text_fn=client.metrics_text,
+            canary=lane,
+            sinks=sinks,
+            events=obs.get_event_log(),
+            registry=driver_reg,
+            interval_s=float(
+                alerts_interval_s if alerts_interval_s is not None
+                else fleet_interval_s
+            ),
+        ).start()
+        # Late-bound: the poller was built before the watchtower (its
+        # snapshots are the watchtower's feed), so the /fleet payload's
+        # alerts block is wired after the fact.
+        fleet_poller._alerts_fn = watchtower.fleet_block
 
     def _collect() -> str:
         obs.heartbeats_to_registry(fabric_core.heartbeats(), driver_reg)
@@ -574,9 +652,15 @@ def _serve_obs_server(
         collect_traces=lambda: client.export_stitched_trace(n=16),
         collect_journal=client.journal_jsonl,
         collect_why=lambda rid: obs.anatomy_from_client(client, rid),
+        collect_query=(
+            watchtower.query if watchtower is not None else None
+        ),
+        collect_alerts=(
+            watchtower.alerts_payload if watchtower is not None else None
+        ),
         port=int(metrics_port),
     ).start()
-    return server, fleet_poller
+    return server, fleet_poller, watchtower
 
 
 def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
@@ -657,7 +741,8 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         bit-identical to spec off; accept rates land in
         stats.spec_stats and the spec_accept_rate metric.
       metrics_port: serve a Prometheus /metrics endpoint (plus /stats
-        JSON, /healthz, /debug/bundle, /fleet, /events, /traces) on
+        JSON, /healthz, /debug/bundle, /fleet, /events, /traces,
+        /alerts, /query) on
         this driver-side port for the duration of the run, aggregating
         every replica's registry (0 picks a free port; the chosen URL
         prints to stderr). Point `rlt top <host:port>` at it for a live
@@ -666,6 +751,26 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
         (default on; needs metrics_port to be reachable).
         fleet_interval_s: poll cadence (default 2s); fleet_history:
         snapshots retained in the history ring (default 128).
+      alerts: drive the watchtower (default on; rides the fleet
+        plane) — fleet snapshots are sampled into bounded
+        multi-resolution telemetry rings (obs.tsdb) and declarative
+        alert rules (threshold / absence / multi-window burn-rate over
+        the SLO-breach ratio) evaluate each tick with a
+        pending->firing->resolved lifecycle behind /alerts and
+        /query (rlt alerts / rlt plot). alerts_interval_s: evaluation
+        cadence (default = fleet_interval_s); alerts_rules: rule
+        overrides (a YAML/JSON file path or inline list — see
+        docs/observability.md for the grammar); alerts_webhook: an
+        http(s) URL notifications are shaped for (webhook-shaped stub
+        sink — payloads recorded, no socket opened in this build).
+      canary: run the canary probe lane (default off) — a tiny
+        fixed-seed probe submitted every canary_interval_s (default
+        10s) under the reserved _canary tenant at floor priority;
+        TTFT / decode rate / exactness land in dedicated canary.*
+        series and alert on deviation from the recorded baseline
+        envelope (canary_baseline: JSON file written by bench.py).
+        Canary traffic is excluded from organic accounting (cost
+        ledger, goodput, autoscaler pressure, tenant rows).
       supervisor: drive the driver-side FleetSupervisor (default on) —
         the detect->decide->recover loop: unhealthy replicas drain
         (no new submissions, in-flight work finishes), dead replicas
@@ -971,6 +1076,19 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     fleet_enabled = bool(serve_cfg.pop("fleet", True))
     fleet_interval_s = float(serve_cfg.pop("fleet_interval_s", 2.0))
     fleet_history = int(serve_cfg.pop("fleet_history", 128))
+    # Watchtower (rides the fleet plane): retained telemetry rings +
+    # the burn-rate alert engine behind /alerts, /query, and rlt
+    # alerts/plot; the canary lane submits fixed-seed probes under the
+    # reserved _canary tenant (excluded from organic accounting).
+    alerts_enabled = bool(serve_cfg.pop("alerts", True))
+    alerts_interval_s = serve_cfg.pop("alerts_interval_s", None)
+    if alerts_interval_s is not None:
+        alerts_interval_s = float(alerts_interval_s)
+    alerts_rules = serve_cfg.pop("alerts_rules", None)
+    alerts_webhook = serve_cfg.pop("alerts_webhook", None)
+    canary_enabled = bool(serve_cfg.pop("canary", False))
+    canary_interval_s = float(serve_cfg.pop("canary_interval_s", 10.0))
+    canary_baseline = serve_cfg.pop("canary_baseline", None)
     # Fault tolerance: the driver-side supervisor (drain/restart/fail
     # over) and the client's per-RPC timeout knob.
     supervisor_enabled = bool(serve_cfg.pop("supervisor", True))
@@ -1238,6 +1356,7 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
     )
     metrics_server = None
     fleet_poller = None
+    watchtower = None
     supervisor = None
     router = None
     autoscaler = None
@@ -1299,7 +1418,7 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             # unhealthy, so an external LB can act on it. /fleet,
             # /events, and /traces serve the fleet plane (rlt top,
             # post-mortems, the stitched cross-process trace).
-            metrics_server, fleet_poller = _serve_obs_server(
+            metrics_server, fleet_poller, watchtower = _serve_obs_server(
                 client,
                 int(metrics_port),
                 fleet=fleet_enabled,
@@ -1307,6 +1426,13 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
                 fleet_history=fleet_history,
                 supervisor=supervisor,
                 router=router,
+                alerts=alerts_enabled,
+                alerts_interval_s=alerts_interval_s,
+                alerts_rules=alerts_rules,
+                alerts_webhook=alerts_webhook,
+                canary=canary_enabled,
+                canary_interval_s=canary_interval_s,
+                canary_baseline=canary_baseline,
             )
             if supervisor is not None and fleet_poller is not None:
                 # Share PR 8's pull: the supervisor reads heartbeat ages
@@ -1361,6 +1487,8 @@ def run_serve(config: Dict[str, Any]) -> Dict[str, Any]:
             autoscaler.stop()  # before shutdown: no scaling mid-teardown
         if supervisor is not None:
             supervisor.stop()  # before shutdown: no restarts mid-teardown
+        if watchtower is not None:
+            watchtower.stop()  # before the poller: its snapshot feed
         if fleet_poller is not None:
             fleet_poller.stop()
         if metrics_server is not None:
@@ -1797,6 +1925,16 @@ def render_fleet(payload: Dict[str, Any]) -> str:
                 f"write_errors={fleet.get('kvstore_write_errors', 0)} "
                 f"evictions={fleet.get('kvstore_evictions', 0)}"
             )
+    # Alert plane (when the watchtower is wired): firing count + names
+    # worst-first — "all quiet" renders too, so the line's absence
+    # means the watchtower is OFF, never that nothing is firing.
+    alerts_block = payload.get("alerts")
+    if alerts_block is not None:
+        names = alerts_block.get("names") or []
+        out.append(
+            f"alerts: firing={alerts_block.get('firing', 0)}"
+            + (" " + " ".join(names) if names else " (all quiet)")
+        )
     # Recovery plane (when a FleetSupervisor is wired): one cell per
     # replica — state, lifetime restarts, pending attempts.
     sup = payload.get("supervisor") or []
@@ -2005,6 +2143,284 @@ def run_why(config: Dict[str, Any]) -> Dict[str, Any]:
     return ledger
 
 
+#: Unicode block ramp for the `rlt plot` sparkline (8 heights).
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def render_sparkline(
+    points: List[Any], width: int = 60
+) -> str:
+    """One-line terminal sparkline over ``[(ts, value), ...]`` points.
+
+    The window is resampled to ``width`` columns (last-value-wins per
+    column, gaps rendered as spaces) and values are mapped onto the
+    eight-block ramp between the window's min and max. A flat series
+    renders as a run of the lowest block — still visibly "present".
+    """
+    vals = [float(v) for _, v in points]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    n = len(vals)
+    cols: List[str] = []
+    if n <= width:
+        take = vals
+    else:
+        # Downsample: each column shows the max of its slice (spikes
+        # must survive resampling — that's what the plot is FOR).
+        take = [
+            max(vals[int(i * n / width): max(int(i * n / width) + 1,
+                                             int((i + 1) * n / width))])
+            for i in range(width)
+        ]
+    for v in take:
+        idx = 0 if span <= 0 else int((v - lo) / span * 7.999)
+        cols.append(_SPARK_BLOCKS[idx])
+    return "".join(cols)
+
+
+def run_plot(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``plot``: terminal sparkline of one retained watchtower series.
+
+    Usage: ``rlt plot <host:port> <series>`` against a serve obs
+    endpoint running the watchtower (``/query`` route). Renders the
+    series name, the covered window, min/mean/max/last, and a unicode
+    sparkline. Options (``--plot.*``): ``since_s`` (window, default the
+    finest rung that has data), ``step_s`` (bucket width — picks the
+    matching TSDB rung), ``width`` (sparkline columns, default 60),
+    ``json`` (raw ``/query`` payload as one JSON line). Exit status:
+    0 when the series exists, 1 for an unknown series (the 404 body's
+    ``available`` sample is printed so you can fix the name).
+    """
+    import json as _json
+    import urllib.error
+    import urllib.request
+    from urllib.parse import quote
+
+    cfg = dict(config.pop("plot", None) or {})
+    target = cfg.pop("addr", None) or cfg.pop("target", None)
+    series = cfg.pop("series", None)
+    since_s = cfg.pop("since_s", None)
+    step_s = cfg.pop("step_s", None)
+    width = int(cfg.pop("width", 60))
+    json_out = bool(cfg.pop("json", False))
+    timeout = float(cfg.pop("timeout_s", 10.0))
+    if cfg:
+        raise ValueError(f"unknown plot options: {sorted(cfg)}")
+    if not target or not series:
+        raise ValueError(
+            "plot requires a target and a series name: "
+            "rlt plot <host:port> <series>"
+        )
+    base = str(target) if "://" in str(target) else f"http://{target}"
+    url = base.rstrip("/") + "/query?series=" + quote(str(series))
+    if since_s is not None:
+        url += f"&since={float(since_s)}"
+    if step_s is not None:
+        url += f"&step={float(step_s)}"
+    try:
+        body = urllib.request.urlopen(url, timeout=timeout).read()
+    except urllib.error.HTTPError as exc:
+        if exc.code != 404:
+            raise
+        body = exc.read()  # found:false + available sample ride the 404
+    except urllib.error.URLError as exc:
+        raise ValueError(
+            f"plot target {target!r} is not a reachable obs endpoint "
+            f"(needs --serve.metrics_port + watchtower): {exc.reason}"
+        ) from exc
+    result = _json.loads(body)
+    if json_out:
+        print(_json.dumps(result, default=str))
+        return result
+    if not result.get("found"):
+        available = result.get("available") or []
+        print(f"series {series!r} unknown")
+        if available:
+            print("available: " + " ".join(available))
+        return result
+    points = result.get("points") or []
+    vals = [float(v) for _, v in points]
+    header = f"{series}  step={result.get('step_s')}s  n={len(points)}"
+    if vals:
+        header += (
+            f"  min={min(vals):.4g} mean={sum(vals) / len(vals):.4g}"
+            f" max={max(vals):.4g} last={vals[-1]:.4g}"
+        )
+    print(header)
+    print(render_sparkline(points, width=width) or "(no samples)")
+    return result
+
+
+def render_alerts(payload: Dict[str, Any]) -> str:
+    """Human rendering of the ``/alerts`` payload: one row per rule
+    (state, severity, value vs threshold, firing duration), firing
+    rules first, then the canary line when the lane is running."""
+    alerts = payload.get("alerts") or {}
+    states: Dict[str, Any] = alerts.get("states") or {}
+    rules = {r["name"]: r for r in alerts.get("rules") or []}
+    out: List[str] = []
+    firing = alerts.get("firing") or []
+    firing_names = [
+        f.get("rule", "?") if isinstance(f, dict) else str(f)
+        for f in firing
+    ]
+    out.append(
+        f"alerts: firing={len(firing)}"
+        + ((" " + " ".join(firing_names)) if firing_names else
+           " (all quiet)")
+    )
+    order = sorted(
+        states,
+        key=lambda nm: (states[nm].get("state") != "firing", nm),
+    )
+    for nm in order:
+        st = states[nm]
+        rule = rules.get(nm, {})
+        line = (
+            f"  {st.get('state', '?'):>7}  {nm}"
+            f" [{rule.get('severity', '?')}/{rule.get('kind', '?')}]"
+        )
+        if st.get("value") is not None:
+            line += f" value={st['value']:.4g}"
+        if st.get("detail"):
+            line += f" ({st['detail']})"
+        out.append(line)
+    canary = payload.get("canary")
+    if canary:
+        last = canary.get("last") or {}
+        out.append(
+            "canary: probes={} exact={} ttft_s={} decode_tok_s={}".format(
+                canary.get("probes", 0),
+                last.get("exact", "n/a"),
+                last.get("ttft_s", "n/a"),
+                last.get("decode_tokens_per_s", "n/a"),
+            )
+        )
+    return "\n".join(out)
+
+
+def run_alerts(config: Dict[str, Any]) -> Dict[str, Any]:
+    """``alerts``: the watchtower's alert state — and a live tail.
+
+    Usage: ``rlt alerts <host:port>`` against a serve obs endpoint
+    running the watchtower. One-shot mode renders every rule's state
+    (firing first), values/details, and the canary lane summary.
+    ``--follow`` (or ``--alerts.follow true``) switches to a live tail
+    of ``alert_firing``/``alert_resolved``/``canary_*`` events via the
+    ``/events?since=<seq>`` cursor — each poll fetches only events
+    newer than the last seen sequence (deduped per replica ring, since
+    sequences are per-ring monotonic, not global). Options:
+    ``interval_s`` (follow poll period, default 2), ``iterations``
+    (stop after N polls; 0 = forever), ``json`` (raw payload / JSONL
+    passthrough). Exit status: 0 quiet, 1 when any rule is firing.
+    """
+    import json as _json
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    cfg = dict(config.pop("alerts", None) or {})
+    target = cfg.pop("addr", None) or cfg.pop("target", None)
+    follow = bool(cfg.pop("follow", False))
+    interval_s = float(cfg.pop("interval_s", 2.0))
+    iterations = int(cfg.pop("iterations", 0))
+    json_out = bool(cfg.pop("json", False))
+    timeout = float(cfg.pop("timeout_s", 10.0))
+    if cfg:
+        raise ValueError(f"unknown alerts options: {sorted(cfg)}")
+    if not target:
+        raise ValueError(
+            "alerts requires a target: rlt alerts <host:port> [--follow]"
+        )
+    base = str(target) if "://" in str(target) else f"http://{target}"
+
+    def _fetch_payload() -> Dict[str, Any]:
+        url = base.rstrip("/") + "/alerts"
+        try:
+            body = urllib.request.urlopen(url, timeout=timeout).read()
+        except urllib.error.URLError as exc:
+            raise ValueError(
+                f"alerts target {target!r} is not a reachable obs "
+                f"endpoint (needs --serve.metrics_port + watchtower): "
+                f"{getattr(exc, 'reason', exc)}"
+            ) from exc
+        return _json.loads(body)
+
+    payload = _fetch_payload()
+    if not follow:
+        if json_out:
+            print(_json.dumps(payload, default=str))
+        else:
+            print(render_alerts(payload))
+        return payload
+
+    # Live tail: poll /events with the ?since= cursor. Sequences are
+    # per-RING monotonic (each replica's EventLog counts its own), so
+    # the cursor is kept per (replica, ) origin via a seen-set keyed on
+    # (replica, seq) with the max seq per origin driving ?since= — one
+    # shared cursor at the MIN of the per-origin maxima would refetch,
+    # so dedup client-side and advance since only when safe (single
+    # origin: plain max).
+    seen: set = set()
+    cursor = 0
+    count = 0
+    try:
+        while True:
+            url = base.rstrip("/") + (
+                "/events?subsystem=watchtower&since=" + str(cursor)
+            )
+            try:
+                body = urllib.request.urlopen(url, timeout=timeout).read()
+            except urllib.error.URLError:
+                body = b""
+            new_max = cursor
+            for ln in body.decode().splitlines():
+                if not ln.strip():
+                    continue
+                try:
+                    ev = _json.loads(ln)
+                except ValueError:
+                    continue
+                key = (ev.get("replica"), ev.get("seq"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                if isinstance(ev.get("seq"), int):
+                    new_max = max(new_max, ev["seq"])
+                if json_out:
+                    print(_json.dumps(ev, default=str))
+                else:
+                    print(
+                        "{} {:>5} {} {}".format(
+                            _time.strftime(
+                                "%H:%M:%S",
+                                _time.localtime(float(ev.get("ts", 0))),
+                            ),
+                            ev.get("level", "?"),
+                            ev.get("name", "?"),
+                            " ".join(
+                                f"{k}={v}" for k, v in sorted(ev.items())
+                                if k not in (
+                                    "ts", "level", "subsystem", "name",
+                                    "seq",
+                                )
+                            ),
+                        )
+                    )
+                sys.stdout.flush()
+            cursor = new_max
+            count += 1
+            if iterations and count >= iterations:
+                break
+            _time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    payload = _fetch_payload()
+    return payload
+
+
 def run_tokenize(config: Dict[str, Any]) -> Dict[str, Any]:
     """``tokenize``: train (or load) a ByteBPETokenizer and optionally
     encode the corpus into a pretraining shard.
@@ -2088,6 +2504,10 @@ def main(argv: Optional[List[str]] = None) -> Any:
         return run_replay(config)
     if subcommand == "why":
         return run_why(config)
+    if subcommand == "plot":
+        return run_plot(config)
+    if subcommand == "alerts":
+        return run_alerts(config)
     trainer, model, datamodule = build(config)
     fn = getattr(trainer, subcommand)
     if datamodule is not None:
@@ -2119,6 +2539,14 @@ def cli_entry(argv: Optional[List[str]] = None) -> Any:
     if args and args[0] == "why":
         # 0 when some ring/journal knew the request, 1 when nothing did.
         return 0 if out.get("found") else 1
+    if args and args[0] == "plot":
+        # 0 when the series exists in the TSDB, 1 for an unknown name.
+        return 0 if out.get("found") else 1
+    if args and args[0] == "alerts":
+        # 0 all quiet, 1 when any rule is firing — `rlt alerts $ADDR
+        # && deploy` gates a rollout on the watchtower's verdict.
+        firing = (out.get("alerts") or {}).get("firing") or []
+        return 1 if firing else 0
     # The console wrapper sys.exit()s our return value; any other
     # command's result dict is already on stdout, and a truthy
     # sys.exit(dict) would dump it to stderr and exit 1 — a successful

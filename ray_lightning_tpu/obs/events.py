@@ -40,6 +40,11 @@ class EventLog:
         self.enabled = bool(enabled)
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=self.capacity)
+        # Monotonic per-ring sequence: every recorded event gets the
+        # next number, surviving ring rotation — the `/events?since=`
+        # cursor external tails (rlt alerts --follow, sinks) resume
+        # from without re-downloading the ring.
+        self._seq = 0
 
     # -- hot path ---------------------------------------------------------
     def record(
@@ -50,8 +55,9 @@ class EventLog:
         if not self.enabled:
             return
         with self._lock:
+            self._seq += 1
             self._events.append(
-                (time.time(), level, subsystem, name, kv or None)
+                (time.time(), level, subsystem, name, kv or None, self._seq)
             )
 
     # -- read side --------------------------------------------------------
@@ -65,7 +71,9 @@ class EventLog:
         with self._lock:
             events = list(self._events)
         out = []
-        for ts, level, sub, nm, kv in events:
+        for row in events:
+            ts, level, sub, nm, kv = row[:5]
+            seq = row[5] if len(row) > 5 else None
             if subsystem is not None and sub != subsystem:
                 continue
             if name is not None and nm != name:
@@ -73,6 +81,8 @@ class EventLog:
             ev: Dict[str, Any] = {
                 "ts": ts, "level": level, "subsystem": sub, "name": nm,
             }
+            if seq is not None:
+                ev["seq"] = seq
             if kv:
                 ev.update(kv)
             out.append(ev)

@@ -382,6 +382,10 @@ class FleetPoller:
     ``router_fn`` (optional, zero-arg -> dict — typically
     ``serve.router.Router.rows``) embeds the routing plane the same
     way: per-replica weights/routability plus the routed/shed totals.
+    ``alerts_fn`` (optional, zero-arg -> dict — typically
+    ``obs.watchtower.Watchtower.fleet_block``) embeds the alert
+    engine's firing summary, so ``rlt top`` shows firing alerts
+    without a second request.
     """
 
     def __init__(
@@ -395,10 +399,12 @@ class FleetPoller:
             Callable[[], List[Dict[str, Any]]]
         ] = None,
         router_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        alerts_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ) -> None:
         self._pull = pull_fn
         self._supervisor_fn = supervisor_fn
         self._router_fn = router_fn
+        self._alerts_fn = alerts_fn
         self.interval_s = float(interval_s)
         self.history = max(1, int(history))
         self._events = events
@@ -523,6 +529,11 @@ class FleetPoller:
             try:
                 out["router"] = self._router_fn()
             except Exception:  # noqa: BLE001 - same for the router
+                pass
+        if self._alerts_fn is not None:
+            try:
+                out["alerts"] = self._alerts_fn()
+            except Exception:  # noqa: BLE001 - and the alert engine
                 pass
         return out
 

@@ -42,6 +42,16 @@ request's assembled anatomy phase ledger
 JSON; 400 without an id, 404 when the id is unknown to every ring
 (``found: false`` rides the body either way). ``rlt why <addr> <id>``
 is the rendering client.
+
+The watchtower routes (PR 20): ``/query?series=&since=&step=`` serves
+``collect_query(params)`` — one retained TSDB series
+(:class:`obs.tsdb.RingTSDB`) as ``[(ts, value), ...]`` JSON (400
+without a series name, 404 with ``found: false`` + a name sample for
+an unknown one — ``rlt plot``'s feed); ``/alerts`` serves
+``collect_alerts()`` — the alert engine's rules/states/firing payload
+(``rlt alerts``'s feed). ``/events`` additionally honors a
+``?since=<seq>`` cursor over the per-ring monotonic sequence, so
+tails resume incrementally.
 """
 from __future__ import annotations
 
@@ -56,15 +66,21 @@ CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
 
 def filter_events_jsonl(text: str, query: Dict[str, List[str]]) -> str:
     """Apply ``/events`` query filters to a JSONL body: ``level=`` and
-    ``subsystem=`` keep matching rows (repeatable — values OR), ``n=``
-    keeps the newest n AFTER filtering. Unparseable lines are dropped
-    rather than crashing a scrape; no recognized params = passthrough."""
+    ``subsystem=`` keep matching rows (repeatable — values OR),
+    ``since=<seq>`` keeps rows whose per-ring sequence is NEWER than the
+    cursor (rows without a ``seq`` are dropped by a since filter — a
+    cursor client can't position them), ``n=`` keeps the newest n AFTER
+    filtering. Unparseable lines are dropped rather than crashing a
+    scrape; no recognized params = passthrough."""
     levels = set(query.get("level") or [])
     subsystems = set(query.get("subsystem") or [])
     n = None
     if query.get("n"):
         n = int(query["n"][0])
-    if not levels and not subsystems and n is None:
+    since = None
+    if query.get("since"):
+        since = int(query["since"][0])
+    if not levels and not subsystems and n is None and since is None:
         return text
     kept: List[str] = []
     for ln in text.splitlines():
@@ -77,6 +93,10 @@ def filter_events_jsonl(text: str, query: Dict[str, List[str]]) -> str:
         if levels and row.get("level") not in levels:
             continue
         if subsystems and row.get("subsystem") not in subsystems:
+            continue
+        if since is not None and not (
+            isinstance(row.get("seq"), int) and row["seq"] > since
+        ):
             continue
         kept.append(ln)
     if n is not None:
@@ -100,6 +120,10 @@ class MetricsHTTPServer:
         collect_why: Optional[
             Callable[[str], Dict[str, Any]]
         ] = None,
+        collect_query: Optional[
+            Callable[[Dict[str, List[str]]], Dict[str, Any]]
+        ] = None,
+        collect_alerts: Optional[Callable[[], Dict[str, Any]]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ) -> None:
@@ -112,6 +136,8 @@ class MetricsHTTPServer:
         self._collect_traces = collect_traces
         self._collect_journal = collect_journal
         self._collect_why = collect_why
+        self._collect_query = collect_query
+        self._collect_alerts = collect_alerts
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -180,6 +206,29 @@ class MetricsHTTPServer:
                         if not ledger.get("found"):
                             code = 404
                         body = json.dumps(ledger, default=str).encode()
+                        ctype = "application/json"
+                    elif (
+                        path == "/query"
+                        and outer._collect_query is not None
+                    ):
+                        params = parse_qs(query)
+                        if not params.get("series"):
+                            self.send_error(
+                                400, "missing ?series=<name>"
+                            )
+                            return
+                        result = outer._collect_query(params)
+                        if not result.get("found"):
+                            code = 404
+                        body = json.dumps(result, default=str).encode()
+                        ctype = "application/json"
+                    elif (
+                        path == "/alerts"
+                        and outer._collect_alerts is not None
+                    ):
+                        body = json.dumps(
+                            outer._collect_alerts(), default=str
+                        ).encode()
                         ctype = "application/json"
                     elif (
                         path == "/traces"

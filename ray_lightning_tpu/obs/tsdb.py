@@ -1,0 +1,261 @@
+"""Multi-resolution ring TSDB: bounded in-process time-series storage.
+
+Everything observable so far is point-in-time — a ``/metrics`` scrape,
+a ``/fleet`` snapshot ring, a stats payload — so "decode rate has been
+sagging 2%/hour since the config push" is invisible. This module is the
+retention layer under obs.watchtower: a handful of named series, each
+kept at several resolutions ("rungs", e.g. 1s x 5min / 10s x 1h /
+60s x 12h), every rung a fixed-size ring — memory is bounded by
+construction, no matter how long the process lives.
+
+Two ingestion shapes, mirroring Prometheus semantics:
+
+- **gauges** are sampled: :meth:`RingTSDB.record` writes the value into
+  the current bucket of every rung (last write in a bucket wins);
+- **counters** are stored as rates: :meth:`RingTSDB.ingest_prometheus`
+  parses exposition text (:func:`obs.registry.parse_prometheus_text`),
+  diffs each ``*_total`` family against the previous ingest, and
+  records ``delta/dt`` under ``<family>{labels}:rate`` — the series an
+  alert rule can threshold directly. A counter reset (value decreased,
+  e.g. a replica restart) restarts the delta from the new value instead
+  of producing a negative spike.
+
+Cardinality is capped (``max_series``): series beyond the cap are
+dropped and counted, never silently grown — a misbehaving label
+explosion degrades retention, not memory.
+
+Reads: :meth:`query` picks the best rung for a requested ``since``/
+``step`` and returns ``[(ts, value), ...]`` — the ``/query`` httpd
+route and ``rlt plot``'s feed. All clocks are injectable via explicit
+``ts`` arguments (the watchtower tests drive a fake clock through).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_lightning_tpu.obs.registry import parse_prometheus_text
+
+#: Default resolution ladder: (bucket seconds, bucket count) — 5 min at
+#: 1s, 1 h at 10s, 12 h at 60s. Memory: sum(counts) floats per series.
+DEFAULT_RUNGS: Tuple[Tuple[float, int], ...] = (
+    (1.0, 300),
+    (10.0, 360),
+    (60.0, 720),
+)
+
+
+class RingTSDB:
+    """Bounded multi-resolution store of named scalar series."""
+
+    def __init__(
+        self,
+        rungs: Sequence[Tuple[float, int]] = DEFAULT_RUNGS,
+        max_series: int = 512,
+        registry: Optional[Any] = None,
+    ) -> None:
+        if not rungs:
+            raise ValueError("RingTSDB needs at least one rung")
+        self.rungs = tuple(
+            (float(step), int(cap)) for step, cap in
+            sorted(rungs, key=lambda r: r[0])
+        )
+        if any(step <= 0 or cap <= 0 for step, cap in self.rungs):
+            raise ValueError(f"invalid TSDB rungs {rungs!r}")
+        self.max_series = int(max_series)
+        self._lock = threading.Lock()
+        #: series name -> per-rung ring of (bucket_start_ts, value).
+        self._series: Dict[str, List[deque]] = {}
+        #: counter-delta state: series key -> (ts, cumulative value).
+        self._last_counter: Dict[str, Tuple[float, float]] = {}
+        self._dropped = 0
+        self._points = 0
+        self._reg = None
+        if registry is not None:
+            self._reg = {
+                "series": registry.gauge(
+                    "rlt_tsdb_series", "Series resident in the ring TSDB"
+                ),
+                "points": registry.counter(
+                    "rlt_tsdb_points_total", "Samples recorded to the TSDB"
+                ),
+                "dropped": registry.counter(
+                    "rlt_tsdb_dropped_series_total",
+                    "Series rejected by the TSDB cardinality cap",
+                ),
+            }
+
+    # -- write side -------------------------------------------------------
+    def record(self, name: str, value: float, ts: Optional[float] = None) -> bool:
+        """Sample a gauge: write ``value`` into the current bucket of
+        every rung (last write in a bucket wins). Returns False when the
+        series was rejected by the cardinality cap."""
+        ts = time.time() if ts is None else float(ts)
+        value = float(value)
+        with self._lock:
+            rings = self._series.get(name)
+            if rings is None:
+                if len(self._series) >= self.max_series:
+                    self._dropped += 1
+                    if self._reg is not None:
+                        self._reg["dropped"].inc(1)
+                    return False
+                rings = [deque(maxlen=cap) for _, cap in self.rungs]
+                self._series[name] = rings
+                if self._reg is not None:
+                    self._reg["series"].set(len(self._series))
+            for (step, _cap), ring in zip(self.rungs, rings):
+                bucket = int(ts // step) * step
+                if ring and ring[-1][0] == bucket:
+                    ring[-1] = (bucket, value)
+                else:
+                    ring.append((bucket, value))
+            self._points += 1
+        if self._reg is not None:
+            self._reg["points"].inc(1)
+        return True
+
+    def record_counter(
+        self, name: str, cumulative: float, ts: Optional[float] = None
+    ) -> None:
+        """Observe a cumulative counter; the stored series is its RATE
+        (per second), named ``<name>:rate``. The first observation only
+        seeds the delta state; a decrease (counter reset) restarts from
+        the new cumulative value."""
+        ts = time.time() if ts is None else float(ts)
+        cumulative = float(cumulative)
+        with self._lock:
+            prev = self._last_counter.get(name)
+            self._last_counter[name] = (ts, cumulative)
+        if prev is None:
+            return
+        prev_ts, prev_val = prev
+        dt = ts - prev_ts
+        if dt <= 0:
+            return
+        delta = cumulative - prev_val
+        if delta < 0:  # counter reset: the new process starts from 0
+            delta = cumulative
+        self.record(f"{name}:rate", delta / dt, ts=ts)
+
+    def ingest_prometheus(
+        self,
+        text: str,
+        ts: Optional[float] = None,
+        families: Optional[Sequence[str]] = None,
+    ) -> int:
+        """One scrape of exposition text into the TSDB: ``*_total``
+        families become ``:rate`` series via successive deltas, ``_bucket``
+        histogram internals are skipped, everything else is sampled as a
+        gauge. ``families`` (optional prefix list) bounds which metric
+        families are retained — the watchtower passes the short list it
+        alerts on rather than retaining every label of every family.
+        Returns the number of samples recorded."""
+        ts = time.time() if ts is None else float(ts)
+        wrote = 0
+        for name, by_label in parse_prometheus_text(text).items():
+            if families is not None and not any(
+                name.startswith(p) for p in families
+            ):
+                continue
+            if name.endswith("_bucket"):
+                continue  # histogram internals: quantiles live upstream
+            for labels, value in by_label.items():
+                key = f"{name}{labels}"
+                if name.endswith("_total") or name.endswith(("_sum", "_count")):
+                    self.record_counter(key, value, ts=ts)
+                    wrote += 1
+                else:
+                    wrote += bool(self.record(key, value, ts=ts))
+        return wrote
+
+    # -- read side --------------------------------------------------------
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def _pick_rung(
+        self, since: Optional[float], step: Optional[float], now: float
+    ) -> int:
+        """Best rung index: honor an explicit ``step`` (smallest rung
+        >= it), else the finest rung whose span covers ``since``."""
+        if step is not None:
+            for i, (s, _cap) in enumerate(self.rungs):
+                if s >= float(step) - 1e-9:
+                    return i
+            return len(self.rungs) - 1
+        if since is not None:
+            span = now - float(since)
+            for i, (s, cap) in enumerate(self.rungs):
+                if s * cap >= span:
+                    return i
+            return len(self.rungs) - 1
+        return 0
+
+    def query(
+        self,
+        series: str,
+        since: Optional[float] = None,
+        step: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """The ``/query`` payload for one series: the best-rung points
+        newer than ``since`` (all retained points when omitted). Unknown
+        series answer ``found: false`` plus a bounded name sample so a
+        client can self-correct a typo."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            rings = self._series.get(series)
+            if rings is None:
+                return {
+                    "series": series,
+                    "found": False,
+                    "available": sorted(self._series)[:64],
+                }
+            idx = self._pick_rung(since, step, now)
+            pts = [
+                [t, v] for t, v in rings[idx]
+                if since is None or t >= float(since)
+            ]
+        return {
+            "series": series,
+            "found": True,
+            "step_s": self.rungs[idx][0],
+            "points": pts,
+        }
+
+    def values(
+        self,
+        series: str,
+        window_s: float,
+        now: Optional[float] = None,
+    ) -> List[float]:
+        """Just the values in the trailing window (finest rung that
+        covers it) — the alert engine's evaluation feed."""
+        now = time.time() if now is None else float(now)
+        q = self.query(series, since=now - float(window_s), now=now)
+        return [v for _t, v in q.get("points", [])] if q["found"] else []
+
+    def latest(
+        self, series: str, now: Optional[float] = None
+    ) -> Optional[Tuple[float, float]]:
+        """Newest (ts, value) across the finest rung, None when the
+        series is unknown or empty."""
+        with self._lock:
+            rings = self._series.get(series)
+            if not rings or not rings[0]:
+                return None
+            return tuple(rings[0][-1])
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Compact self-description (rides /alerts and debug bundles)."""
+        with self._lock:
+            return {
+                "rungs": [list(r) for r in self.rungs],
+                "series": len(self._series),
+                "max_series": self.max_series,
+                "dropped_series": self._dropped,
+                "points": self._points,
+            }

@@ -20,6 +20,15 @@ if TYPE_CHECKING:  # registry import is cheap, but keep the seam explicit
     from ray_lightning_tpu.obs.registry import MetricsRegistry
 
 
+#: The reserved synthetic-probe tenant (obs.watchtower's canary lane).
+#: Requests under it ride the REAL serving path but are excluded from
+#: organic accounting — the cost ledger, the goodput gauge, per-tenant
+#: rows, and the queue-depth gauge the router autoscaler reads — so a
+#: canary-only fleet shows zero organic pressure. Probe traffic is
+#: counted in its own ``rlt_canary_*`` families instead.
+CANARY_TENANT = "_canary"
+
+
 def _pct(sorted_vals, q: float) -> float:
     """Nearest-rank percentile over an already-sorted list."""
     idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
@@ -175,6 +184,18 @@ class ServeMetrics:
                     "rlt_serve_phase_seconds",
                     "Per-request phase durations from the anatomy "
                     "ledger, by phase and replica role",
+                ),
+                # Canary probes: counted here (by outcome) INSTEAD of
+                # in the cost ledger families — synthetic traffic must
+                # not look like organic load to billing or autoscaling.
+                "canary_requests": registry.counter(
+                    "rlt_canary_requests_total",
+                    "Canary-tenant terminal requests (excluded from "
+                    "the cost ledger), by outcome",
+                ),
+                "canary_tokens": registry.counter(
+                    "rlt_canary_tokens_total",
+                    "Tokens emitted for canary-tenant requests",
                 ),
             }
         #: Fleet role ("mixed" / "prefill" / "decode") — labels the
@@ -405,7 +426,19 @@ class ServeMetrics:
         stats ``cost`` block, mirrored into the tenant-labelled
         ``rlt_serve_request_cost_*`` counters, and folded into the
         sliding-window goodput gauge (emitted tokens per estimated
-        device-second)."""
+        device-second). Canary-tenant records are diverted whole into
+        the ``rlt_canary_*`` families: no window entry, no cost
+        counters, no goodput contribution — the probe lane must be
+        invisible to organic accounting."""
+        if record.get("tenant") == CANARY_TENANT:
+            if self._reg is not None:
+                self._reg["canary_requests"].inc(
+                    1, outcome=record.get("outcome", "finished")
+                )
+                self._reg["canary_tokens"].inc(
+                    int(record.get("emitted_tokens", 0))
+                )
+            return
         with self._lock:
             self._costs.append(dict(record))
             if self._reg is not None:
@@ -449,7 +482,12 @@ class ServeMetrics:
         seconds}; non-numeric detail keys like ``kv_fetch_source`` are
         kept out of the aggregates). Windowed for the stats ``phases``
         block and mirrored into the phase/role-labelled
-        ``rlt_serve_phase_seconds`` histogram."""
+        ``rlt_serve_phase_seconds`` histogram. Canary-tenant ledgers
+        are skipped — the probe's timings live in the watchtower's
+        dedicated ``canary.*`` series, not the organic decomposition
+        (or its per-tenant rows)."""
+        if tenant == CANARY_TENANT:
+            return
         durs = {
             k: float(v) for k, v in phases.items()
             if isinstance(v, (int, float))

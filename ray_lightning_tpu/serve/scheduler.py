@@ -46,7 +46,7 @@ from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence
 
 from ray_lightning_tpu.obs import trace as _trace
-from ray_lightning_tpu.serve.metrics import ServeMetrics
+from ray_lightning_tpu.serve.metrics import CANARY_TENANT, ServeMetrics
 
 if TYPE_CHECKING:  # engine pulls jax; keep the package import light
     from ray_lightning_tpu.obs.events import EventLog
@@ -441,7 +441,7 @@ class Scheduler:
             heapq.heappush(
                 self._pending, (req.priority, next(self._seq), req)
             )
-            depth = len(self._pending)
+            depth = self._organic_depth_locked()
             self.metrics.record_submit(depth)
             self._acct_open(req)
         if self.journal is not None:
@@ -496,8 +496,18 @@ class Scheduler:
         return known
 
     def queue_depth(self) -> int:
+        """ORGANIC queue depth: pending requests excluding the reserved
+        canary tenant. This is the number the metrics gauge — and
+        through it the router's views and the autoscaler's pressure
+        signal — sees, so a canary-only fleet reports zero load."""
         with self._lock:
-            return len(self._pending)
+            return self._organic_depth_locked()
+
+    def _organic_depth_locked(self) -> int:
+        """Under self._lock: len(self._pending) minus canary probes."""
+        return sum(
+            1 for _, _, r in self._pending if r.tenant != CANARY_TENANT
+        )
 
     def has_work(self) -> bool:
         with self._lock:
@@ -853,7 +863,7 @@ class Scheduler:
                     heapq.heappop(self._pending)
                     self._cancelled.discard(req.request_id)
                     self.metrics.record_cancel(
-                        queue_depth=len(self._pending)
+                        queue_depth=self._organic_depth_locked()
                     )
                     self._trace(req.request_id, _trace.SPAN_CANCEL)
                     self._event("cancel", request_id=req.request_id,
@@ -866,7 +876,7 @@ class Scheduler:
                 if req.expired(t0):
                     heapq.heappop(self._pending)
                     self.metrics.record_expire(
-                        queue_depth=len(self._pending)
+                        queue_depth=self._organic_depth_locked()
                     )
                     self._trace(req.request_id, _trace.SPAN_EXPIRE)
                     self._event("expire", level="warn",

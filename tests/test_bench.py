@@ -206,6 +206,30 @@ def test_bench_smoke_cpu():
     assert out["extra"]["anatomy_overhead"] < 1.05, out["extra"]
     assert out["extra"]["anatomy_top_phase"] == "kv_fetch", out["extra"]
     assert "kv_fetch" in out["extra"]["anatomy_attribution"], out["extra"]
+    # And for the WATCHTOWER: retained telemetry + the alert engine
+    # ticking 200x faster than production must also cost < 5% tokens/s
+    # (it runs driver-side — thread contention only). The alert demo
+    # must fire the burn-rate rule within 3 evaluation ticks with
+    # kv_fetch named in the notification's attribution, then resolve
+    # once the fast window drains after the fault clears; the canary
+    # probe must be bit-exact to solo gpt_generate with ZERO backend
+    # compiles across the counted probes (steady state holds).
+    wt_modes = {
+        r["mode"]
+        for r in out["extra"]["serve_rows"]
+        if r["workload"] == "watchtower_overhead"
+    }
+    assert wt_modes == {"watchtower_off", "watchtower_on"}, out["extra"]
+    assert out["extra"]["watchtower_overhead"] < 1.05, out["extra"]
+    assert out["extra"]["alert_fire_ticks"] is not None, out["extra"]
+    assert out["extra"]["alert_fire_ticks"] <= 3, out["extra"]
+    assert out["extra"]["alert_resolve_ticks"] is not None, out["extra"]
+    assert "kv_fetch" in out["extra"]["alert_attribution"], out["extra"]
+    assert out["extra"]["canary_exact"] is True, out["extra"]
+    assert out["extra"]["canary_compiles"] == 0, out["extra"]
+    base = out["extra"]["canary_baseline"]
+    assert base["tokens"] and base["ttft_s"] > 0, base
+    assert base["decode_tokens_per_s"] > 0, base
     # Mesh-sharded decode sweep: a 1x1 control plus >= 1 model-axis
     # mesh over the forced host devices, per-device KV bytes shrinking
     # ~linearly in the model axis (the tp=N footprint story, measured).

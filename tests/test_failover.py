@@ -644,9 +644,9 @@ class _HealthStub:
 def _healthz(client, supervisor=None):
     from ray_lightning_tpu.cli import _serve_obs_server
 
-    server, poller = _serve_obs_server(
+    server, poller, _ = _serve_obs_server(
         client, 0, fleet=True, fleet_interval_s=60.0,
-        supervisor=supervisor,
+        supervisor=supervisor, alerts=False,
     )
     try:
         poller.poll_now()

@@ -321,6 +321,27 @@ def test_metric_name_hygiene_after_serve_smoke(fleet_params):
             lambda: ([rep.stats()], [rep.health()], {}), registry=reg
         )
         poller.poll_now()
+        # Watchtower families (PR 20): a tick through the TSDB + alert
+        # engine + a (stubbed-client) canary probe so the rlt_tsdb_* /
+        # rlt_alert_* / rlt_canary_* names join the linted namespace.
+        from ray_lightning_tpu.obs import watchtower as obs_wt
+        from ray_lightning_tpu.obs.tsdb import RingTSDB
+
+        class _ProbeStub:
+            def stream(self, prompt, **kw):
+                yield from (1, 2, 3)
+
+        wt_tsdb = RingTSDB(registry=reg)
+        wt = obs_wt.Watchtower(
+            tsdb=wt_tsdb,
+            rules=obs_wt.default_rules(),
+            canary=obs_wt.CanaryLane(
+                _ProbeStub(), wt_tsdb, interval_s=0.0, registry=reg,
+            ),
+            fleet_latest_fn=poller.latest,
+            registry=reg,
+        )
+        wt.tick()
         names = reg.names()
         assert names, "empty registry after a serve smoke"
         for name in names:
@@ -349,6 +370,9 @@ def test_metric_name_hygiene_after_serve_smoke(fleet_params):
         # The serve smoke really exercised the new series.
         assert "rlt_serve_request_cost_tokens_total" in names
         assert "rlt_fleet_replicas" in names
+        assert "rlt_tsdb_points_total" in names
+        assert "rlt_alert_evaluations_total" in names
+        assert "rlt_canary_probes_total" in names
     finally:
         rep.stop()
 
@@ -488,8 +512,8 @@ def test_serve_obs_server_routes_over_real_http(start_fabric, tmp_path):
 
     start_fabric(num_cpus=1)  # heartbeat collectors want a live fabric
     client = _StubClient()
-    server, poller = _serve_obs_server(
-        client, 0, fleet=True, fleet_interval_s=5.0
+    server, poller, _ = _serve_obs_server(
+        client, 0, fleet=True, fleet_interval_s=5.0, alerts=False
     )
     try:
         poller.poll_now()
@@ -637,8 +661,8 @@ def test_fleet_end_to_end_two_replicas(
         assert all(e["pid"] == procs["client"] for e in waits)
 
         # -- the /fleet plane over real HTTP (rlt serve's wiring) ----------
-        server, poller = _serve_obs_server(
-            client, 0, fleet=True, fleet_interval_s=0.2
+        server, poller, _ = _serve_obs_server(
+            client, 0, fleet=True, fleet_interval_s=0.2, alerts=False
         )
         poller.poll_now()
         base = f"http://{server.host}:{server.port}"

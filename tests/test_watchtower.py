@@ -1,0 +1,1125 @@
+"""Watchtower tests: the retained-telemetry TSDB (obs/tsdb.py), the
+burn-rate alert engine with its firing/resolved lifecycle, the canary
+probe lane (obs/watchtower.py), and the integrations that ride along —
+``/query`` / ``/alerts`` / ``/events?since=`` over real HTTP, the
+``rlt plot`` / ``rlt alerts`` CLI, the ``/fleet`` alerts block, and
+canary traffic's exclusion from ALL organic accounting (cost ledger,
+goodput, queue depth, autoscaler pressure).
+
+The load-bearing e2e at the bottom is the PR's contract: a genuinely
+injected ``kvfleet_fetch`` delay (serve.faults) drives real requests
+through a steered peer fetch, the real SLO watchdog verdicts feed the
+breach ratio, and the default ``slo_burn_rate`` rule fires within 3
+evaluation ticks with ``kv_fetch`` named as the top phase — then
+resolves after the fault clears. Every clock the alert engine reads is
+injected; the only real time in the e2e is the injected delay itself.
+"""
+import json
+import queue
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import obs
+from ray_lightning_tpu.models.gpt import (
+    GPTConfig,
+    gpt_generate,
+    init_gpt_params,
+)
+from ray_lightning_tpu.obs.events import EventLog
+from ray_lightning_tpu.obs.registry import MetricsRegistry
+from ray_lightning_tpu.obs.tsdb import RingTSDB
+from ray_lightning_tpu.obs.watchtower import (
+    CANARY_PRIORITY,
+    CANARY_TENANT,
+    AlertEngine,
+    AlertRule,
+    CanaryLane,
+    LogSink,
+    Watchtower,
+    WebhookSink,
+    canary_rules,
+    default_rules,
+    parse_alert_rules,
+)
+
+CFG = GPTConfig(
+    vocab_size=97,
+    n_layer=2,
+    n_head=4,
+    d_model=32,
+    max_seq=64,
+    attn_impl="reference",
+    compute_dtype="float32",
+)
+
+BLOCK = 4
+
+DENSE_KW = dict(
+    num_slots=3, max_seq=64, prefill_buckets=[16], prefill_chunk=4,
+    prefix_blocks=16, prefix_block=BLOCK, decode_fold=2,
+)
+
+_REF_MEMO = {}
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+
+    return init_gpt_params(jax.random.PRNGKey(0), CFG)
+
+
+def _ref(params, prompt, n):
+    key = (tuple(prompt), n)
+    if key not in _REF_MEMO:
+        out = gpt_generate(
+            params, CFG, np.asarray(prompt, np.int32)[None], n
+        )
+        _REF_MEMO[key] = np.asarray(out)[0, len(prompt):].tolist()
+    return _REF_MEMO[key]
+
+
+# ---------------------------------------------------------------------------
+# RingTSDB: rungs, counters-as-rates, cardinality, prometheus ingest
+# ---------------------------------------------------------------------------
+def test_tsdb_record_rung_selection_and_last_write_wins():
+    db = RingTSDB(rungs=[(1.0, 4), (10.0, 6)])
+    db.record("x", 1.0, ts=100.0)
+    db.record("x", 2.0, ts=100.4)  # same 1s bucket: overwritten
+    db.record("x", 3.0, ts=101.0)
+    assert db.latest("x") == (101.0, 3.0)
+    fine = db.query("x", since=99.0, now=101.5)
+    assert fine["step_s"] == 1.0
+    assert fine["points"] == [[100.0, 2.0], [101.0, 3.0]]
+    # An explicit step picks the matching (coarser) rung; both samples
+    # collapsed into one 10s bucket, last write winning.
+    coarse = db.query("x", step=10.0, now=101.5)
+    assert coarse["step_s"] == 10.0
+    assert coarse["points"] == [[100.0, 3.0]]
+    # A window wider than the finest rung's span climbs the ladder.
+    wide = db.query("x", since=101.5 - 30.0, now=101.5)
+    assert wide["step_s"] == 10.0
+    # values() trims to the trailing window.
+    assert db.values("x", 2.0, now=101.5) == [2.0, 3.0]
+    assert db.values("x", 0.6, now=101.5) == [3.0]
+    with pytest.raises(ValueError):
+        RingTSDB(rungs=[])
+    with pytest.raises(ValueError):
+        RingTSDB(rungs=[(0.0, 10)])
+
+
+def test_tsdb_counter_rate_and_reset():
+    db = RingTSDB()
+    db.record_counter("c", 10.0, ts=100.0)  # seeds only
+    assert db.latest("c:rate") is None
+    db.record_counter("c", 40.0, ts=110.0)
+    assert db.latest("c:rate")[1] == pytest.approx(3.0)
+    # A counter reset (replica restart) restarts from the new value —
+    # never a negative rate spike.
+    db.record_counter("c", 5.0, ts=120.0)
+    assert db.latest("c:rate")[1] == pytest.approx(0.5)
+    # Non-advancing clock: no sample, no division by zero.
+    db.record_counter("c", 9.0, ts=120.0)
+    assert db.latest("c:rate")[1] == pytest.approx(0.5)
+
+
+def test_tsdb_cardinality_cap_counts_drops():
+    reg = MetricsRegistry()
+    db = RingTSDB(max_series=2, registry=reg)
+    assert db.record("a", 1.0, ts=1.0) is True
+    assert db.record("b", 1.0, ts=1.0) is True
+    assert db.record("exploded_label", 1.0, ts=1.0) is False
+    assert db.record("a", 2.0, ts=2.0) is True  # existing still writes
+    d = db.to_dict()
+    assert d["series"] == 2 and d["dropped_series"] == 1
+    text = reg.render()
+    assert "rlt_tsdb_series 2" in text
+    assert "rlt_tsdb_dropped_series_total 1" in text
+    assert "rlt_tsdb_points_total" in text
+
+
+def test_tsdb_prometheus_ingest_families_and_rates():
+    db = RingTSDB()
+    text1 = (
+        'rlt_serve_requests_total{kind="finished"} 2\n'
+        "rlt_noise_total 5\n"
+        'rlt_serve_phase_seconds_bucket{le="1"} 3\n'
+        "rlt_fleet_replicas 2\n"
+    )
+    text2 = text1.replace(" 2\n", " 12\n", 1)
+    db.ingest_prometheus(
+        text1, ts=100.0, families=("rlt_serve_requests_total",)
+    )
+    db.ingest_prometheus(
+        text2, ts=110.0, families=("rlt_serve_requests_total",)
+    )
+    names = db.series_names()
+    # Counter family -> :rate series; everything outside the family
+    # filter (noise, gauges) and histogram _bucket internals dropped.
+    assert any(
+        n.startswith("rlt_serve_requests_total") and n.endswith(":rate")
+        for n in names
+    )
+    assert not any("noise" in n or "bucket" in n or "fleet" in n
+                   for n in names)
+    rate = next(n for n in names if n.endswith(":rate"))
+    assert db.latest(rate)[1] == pytest.approx(1.0)
+    # Without a family filter, gauges are sampled as-is.
+    db2 = RingTSDB()
+    db2.ingest_prometheus(text1, ts=100.0)
+    assert db2.latest("rlt_fleet_replicas")[1] == 2.0
+
+
+def test_tsdb_query_unknown_series_names_alternatives():
+    db = RingTSDB()
+    db.record("fleet.replicas", 2.0, ts=1.0)
+    out = db.query("fleet.replicaz")
+    assert out["found"] is False
+    assert out["available"] == ["fleet.replicas"]
+    assert db.values("fleet.replicaz", 60.0) == []
+
+
+# ---------------------------------------------------------------------------
+# Rule parsing
+# ---------------------------------------------------------------------------
+def test_parse_alert_rules_forms_and_loud_rejection():
+    rules = parse_alert_rules({
+        "hot_queue": {"kind": "threshold", "series": "fleet.queue_depth",
+                      "threshold": 10, "severity": "warn"},
+        "feed_dead": {"kind": "absence", "series": "fleet.replicas"},
+    })
+    assert {r.name for r in rules} == {"hot_queue", "feed_dead"}
+    as_list = parse_alert_rules([
+        {"name": "burn", "kind": "burn_rate",
+         "series": "fleet.slo_breach_ratio"},
+    ])
+    assert as_list[0].kind == "burn_rate"
+    assert parse_alert_rules(None) == []
+    with pytest.raises(ValueError, match="unknown fields"):
+        parse_alert_rules([{"name": "x", "kind": "threshold",
+                            "series": "s", "treshold": 5}])
+    with pytest.raises(ValueError, match="unknown kind"):
+        AlertRule(name="x", kind="ratio", series="s")
+    with pytest.raises(ValueError, match="op must be"):
+        AlertRule(name="x", kind="threshold", series="s", op=">=")
+    with pytest.raises(ValueError, match="severity"):
+        AlertRule(name="x", kind="threshold", series="s",
+                  severity="critical")
+    with pytest.raises(ValueError, match="expected a list or mapping"):
+        parse_alert_rules("threshold")
+    with pytest.raises(ValueError, match="duplicate alert rule"):
+        AlertEngine(RingTSDB(), [
+            AlertRule(name="x", kind="absence", series="s"),
+            AlertRule(name="x", kind="absence", series="t"),
+        ])
+    names = {r.name for r in default_rules()}
+    assert "slo_burn_rate" in names and "telemetry_absent" in names
+
+
+def test_canary_rules_envelope_needs_baseline():
+    bare = {r.name for r in canary_rules(None)}
+    assert bare == {"canary_exactness", "canary_absent"}
+    full = {r.name for r in canary_rules({"ttft_s": 0.01})}
+    assert "canary_envelope" in full
+
+
+# ---------------------------------------------------------------------------
+# Alert engine state machine (injected clock throughout)
+# ---------------------------------------------------------------------------
+def _engine(rules, attribution=None):
+    db = RingTSDB()
+    log = EventLog()
+    sink = LogSink()
+    reg = MetricsRegistry()
+    eng = AlertEngine(
+        db, rules, events=log, sinks=[sink], registry=reg,
+        attribution_fn=attribution,
+    )
+    return db, eng, log, sink, reg
+
+
+def test_alert_pending_hold_then_fire_with_value_and_detail():
+    rule = AlertRule(
+        name="deep_queue", kind="threshold", series="q", op=">",
+        threshold=5.0, window_s=30.0, for_ticks=3, resolve_ticks=2,
+        severity="error",
+    )
+    db, eng, log, sink, reg = _engine([rule])
+    for t in (1000.0, 1001.0):
+        db.record("q", 9.0, ts=t)
+        assert eng.evaluate(now=t) == []  # pending hold: no page yet
+    st = eng.to_dict()["states"]["deep_queue"]
+    assert st["state"] == "pending" and st["consecutive_bad"] == 2
+    db.record("q", 9.0, ts=1002.0)
+    (note,) = eng.evaluate(now=1002.0)
+    assert note["rule"] == "deep_queue" and note["state"] == "firing"
+    assert note["value"] == 9.0 and "q=9.0 > 5.0" in note["detail"]
+    assert note["renotify"] is False
+    (ev,) = log.tail(name="alert_firing")
+    assert ev["rule"] == "deep_queue" and ev["level"] == "error"
+    assert sink.delivered[-1]["state"] == "firing"
+    assert eng.firing()[0]["rule"] == "deep_queue"
+    text = reg.render()
+    assert 'rlt_alert_transitions_total{to="firing"} 1' in text
+    assert "rlt_alert_firing 1" in text
+
+
+def test_alert_renotify_dedup_and_resolve_hysteresis():
+    rule = AlertRule(
+        name="t", kind="threshold", series="q", op="<", threshold=2.0,
+        window_s=60.0, for_ticks=1, resolve_ticks=2, renotify_s=10.0,
+    )
+    db, eng, log, sink, _reg = _engine([rule])
+    db.record("q", 0.5, ts=1000.0)
+    (fire,) = eng.evaluate(now=1000.0)
+    assert fire["state"] == "firing"
+    # Still bad inside renotify_s: deduped.
+    for t in (1003.0, 1006.0, 1009.0):
+        db.record("q", 0.5, ts=t)
+        assert eng.evaluate(now=t) == []
+    db.record("q", 0.5, ts=1011.0)
+    (renote,) = eng.evaluate(now=1011.0)
+    assert renote["renotify"] is True and renote["state"] == "firing"
+    # One clean tick is hysteresis, not resolution.
+    db.record("q", 7.0, ts=1012.0)
+    assert eng.evaluate(now=1012.0) == []
+    assert eng.to_dict()["states"]["t"]["state"] == "firing"
+    db.record("q", 7.0, ts=1013.0)
+    (resolved,) = eng.evaluate(now=1013.0)
+    assert resolved["state"] == "resolved"
+    assert resolved["duration_s"] == pytest.approx(13.0)
+    st = eng.to_dict()["states"]["t"]
+    assert st["state"] == "ok" and st["fires"] == 1 and st["resolves"] == 1
+    (ev,) = log.tail(name="alert_resolved")
+    assert ev["rule"] == "t" and ev["level"] == "info"
+
+
+def test_alert_pending_that_recovers_never_pages():
+    rule = AlertRule(
+        name="t", kind="threshold", series="q", op=">", threshold=5.0,
+        for_ticks=3,
+    )
+    db, eng, log, sink, _reg = _engine([rule])
+    db.record("q", 9.0, ts=1000.0)
+    assert eng.evaluate(now=1000.0) == []
+    db.record("q", 1.0, ts=1001.0)
+    assert eng.evaluate(now=1001.0) == []
+    assert eng.to_dict()["states"]["t"]["state"] == "ok"
+    assert not sink.delivered and not log.tail(name="alert_firing")
+
+
+def test_alert_absence_startup_grace_gap_and_flatline():
+    gap = AlertRule(
+        name="gap", kind="absence", series="hb", window_s=30.0,
+        for_ticks=1, resolve_ticks=1,
+    )
+    flat = AlertRule(
+        name="flat", kind="absence", series="hb", window_s=30.0,
+        flatline=True, for_ticks=1, resolve_ticks=1,
+    )
+    db, eng, log, _sink, _reg = _engine([gap, flat])
+    # Startup grace: a series that never reported is not a dead feed.
+    assert eng.evaluate(now=1000.0) == []
+    db.record("hb", 5.0, ts=1000.0)
+    assert eng.evaluate(now=1010.0) == []  # live
+    notes = eng.evaluate(now=1040.0)  # 40s gap > 30s window: both fire
+    assert {n["rule"] for n in notes} == {"gap", "flat"}
+    assert "no samples for" in notes[0]["detail"]
+    db.record("hb", 5.0, ts=1041.0)
+    notes = eng.evaluate(now=1041.0)
+    assert {n["rule"] for n in notes} == {"gap", "flat"}
+    assert all(n["state"] == "resolved" for n in notes)
+    # Flatline: samples keep arriving but the value never moves — the
+    # gap rule stays quiet (feed is alive), the flatline rule pages.
+    for t in (1050.0, 1060.0, 1070.0):
+        db.record("hb", 5.0, ts=t)
+    (note,) = eng.evaluate(now=1071.0)
+    assert note["rule"] == "flat" and "flatlined" in note["detail"]
+    db.record("hb", 6.0, ts=1080.0)
+    (resolved,) = eng.evaluate(now=1081.0)
+    assert resolved["rule"] == "flat" and resolved["state"] == "resolved"
+
+
+def test_alert_burn_rate_requires_both_windows():
+    rule = AlertRule(
+        name="burn", kind="burn_rate", series="ratio",
+        fast_window_s=30.0, slow_window_s=600.0,
+        fast_burn=0.5, slow_burn=0.05, for_ticks=1, resolve_ticks=1,
+    )
+    db, eng, _log, _sink, _reg = _engine([rule])
+    # 60 samples at 10s cadence: a clean hour tail, then a 30s cliff.
+    for i in range(60):
+        ts = 1000.0 + 10.0 * i
+        db.record("ratio", 1.0 if i >= 57 else 0.0, ts=ts)
+    # Fast window (last 3 samples) is 1.0, slow mean is 3/60 == 0.05 —
+    # NOT above slow_burn: a cliff without history does not page.
+    assert eng.evaluate(now=1595.0) == []
+    st = eng.to_dict()["states"]["burn"]
+    assert "fast(30.0s)" in st["detail"] and "slow(600.0s)" in st["detail"]
+    # Two more breaching samples tip the slow window into agreement.
+    db.record("ratio", 1.0, ts=1600.0)
+    db.record("ratio", 1.0, ts=1610.0)
+    (note,) = eng.evaluate(now=1615.0)
+    assert note["rule"] == "burn" and note["state"] == "firing"
+    # Slow-only must not fire either: recent window clean.
+    rule2 = AlertRule(
+        name="slow_only", kind="burn_rate", series="r2",
+        fast_window_s=30.0, slow_window_s=600.0,
+        fast_burn=0.1, slow_burn=0.05, for_ticks=1,
+    )
+    db2, eng2, _l, _s, _r = _engine([rule2])
+    for i in range(60):
+        db2.record("r2", 1.0 if i < 57 else 0.0, ts=1000.0 + 10.0 * i)
+    assert eng2.evaluate(now=1595.0) == []
+
+
+def test_alert_attribution_rides_notifications_and_failure_is_garnish():
+    rule = AlertRule(
+        name="t", kind="threshold", series="q", op=">", threshold=0.0,
+        for_ticks=1,
+    )
+    db, eng, _log, _sink, _reg = _engine(
+        [rule], attribution=lambda: "top phases: kv_fetch 80%"
+    )
+    db.record("q", 1.0, ts=1000.0)
+    (note,) = eng.evaluate(now=1000.0)
+    assert note["attribution"] == "top phases: kv_fetch 80%"
+
+    def _boom():
+        raise RuntimeError("anatomy down")
+
+    db2, eng2, _l, _s, _r = _engine([rule], attribution=_boom)
+    db2.record("q", 1.0, ts=1000.0)
+    (note2,) = eng2.evaluate(now=1000.0)
+    assert note2["attribution"] == "" and note2["state"] == "firing"
+
+
+def test_one_bad_sink_does_not_mute_the_others():
+    class _Bad:
+        name = "bad"
+
+        def notify(self, payload):
+            raise RuntimeError("sink down")
+
+    good = LogSink()
+    rule = AlertRule(name="t", kind="threshold", series="q",
+                     threshold=0.0, for_ticks=1)
+    db = RingTSDB()
+    eng = AlertEngine(db, [rule], sinks=[_Bad(), good])
+    db.record("q", 1.0, ts=1000.0)
+    (note,) = eng.evaluate(now=1000.0)
+    assert note["state"] == "firing"
+    assert good.delivered[-1]["rule"] == "t"
+
+
+# ---------------------------------------------------------------------------
+# WebhookSink: shaped-not-sent, injected transport
+# ---------------------------------------------------------------------------
+def test_webhook_sink_validates_shapes_and_stubs_transport():
+    with pytest.raises(ValueError, match="not http"):
+        WebhookSink("s3://bucket/hook")
+    with pytest.raises(ValueError, match="not http"):
+        WebhookSink("not-a-url")
+    sink = WebhookSink("http://pager.example/hook")
+    sink.notify({"rule": "t", "state": "firing", "value": 9})
+    (rec,) = sink.sent
+    assert rec["url"] == "http://pager.example/hook"
+    assert json.loads(rec["body"])["rule"] == "t"
+    posts = []
+    live = WebhookSink(
+        "https://pager.example/hook",
+        post_fn=lambda url, body, headers: posts.append(
+            (url, body, headers)
+        ),
+    )
+    live.notify({"rule": "t", "state": "resolved"})
+    ((url, body, headers),) = posts
+    assert url.startswith("https://") and b'"resolved"' in body
+    assert headers["Content-Type"] == "application/json"
+    dead = WebhookSink(
+        "http://pager.example/hook",
+        post_fn=lambda *a: (_ for _ in ()).throw(OSError("refused")),
+    )
+    dead.notify({"rule": "t", "state": "firing"})
+    assert dead.errors == 1 and len(dead.sent) == 1
+
+
+# ---------------------------------------------------------------------------
+# Watchtower feeds: fleet snapshots, SLO ratio diffing, /metrics ingest
+# ---------------------------------------------------------------------------
+def _snap(ts, breaches, finished, replicas=2, healthy=2, phases=None):
+    rows = [
+        {"replica": i, "queue_depth": i, "tokens_per_sec": 5.0,
+         "health": "healthy" if i < healthy else "unhealthy",
+         "slo_breaches": breaches // replicas + (breaches % replicas
+                                                 if i == 0 else 0),
+         "finished": finished // replicas + (finished % replicas
+                                             if i == 0 else 0)}
+        for i in range(replicas)
+    ]
+    fleet = {
+        "replicas": replicas, "healthy": healthy, "queue_depth": 1,
+        "tokens_per_sec": 10.0, "goodput_tokens_per_device_s": 4.0,
+        "kvstore_write_errors": 0, "phases": phases,
+    }
+    return {"ts": ts, "fleet": fleet, "replicas": rows}
+
+
+def test_watchtower_observe_fleet_ratio_diff_and_ts_dedup():
+    wt = Watchtower(tsdb=RingTSDB(), rules=[], clock=lambda: 0.0)
+    wt.observe_fleet(_snap(1, breaches=0, finished=0), now=1000.0)
+    # First snapshot seeds the cumulative counters: no ratio yet.
+    assert wt.tsdb.latest("fleet.slo_breach_ratio") is None
+    assert wt.tsdb.latest("fleet.replicas")[1] == 2.0
+    # The SAME snapshot re-observed (tick faster than the poller) must
+    # not double-count the delta.
+    wt.observe_fleet(_snap(1, breaches=4, finished=4), now=1001.0)
+    assert wt.tsdb.latest("fleet.slo_breach_ratio") is None
+    wt.observe_fleet(_snap(2, breaches=2, finished=4), now=1010.0)
+    assert wt.tsdb.latest("fleet.slo_breach_ratio")[1] == pytest.approx(0.5)
+    # Breaches with zero finishes (everything timing out) reads 1.0.
+    wt.observe_fleet(_snap(3, breaches=3, finished=4), now=1020.0)
+    assert wt.tsdb.latest("fleet.slo_breach_ratio")[1] == pytest.approx(1.0)
+    wt.observe_fleet(_snap(4, breaches=3, finished=8, healthy=1),
+                     now=1030.0)
+    assert wt.tsdb.latest("fleet.slo_breach_ratio")[1] == pytest.approx(0.0)
+    assert wt.tsdb.latest("fleet.unhealthy")[1] == 1.0
+    assert wt.tsdb.latest("replica1.health")[1] == 0.0
+    assert wt.tsdb.latest("replica0.queue_depth")[1] == 0.0
+    assert wt.observe_fleet(None) is None  # no snapshot yet: a no-op
+
+
+def test_watchtower_attribution_from_fleet_phases():
+    phases = {
+        "by_phase": {
+            "kv_fetch": {"mean_s": 0.5, "count": 4, "p95_s": 0.6,
+                         "p50_s": 0.5, "p99_s": 0.6},
+            "decode": {"mean_s": 0.01, "count": 4, "p95_s": 0.02,
+                       "p50_s": 0.01, "p99_s": 0.02},
+        },
+        "hot_phase_p95_s": 0.6,
+    }
+    wt = Watchtower(tsdb=RingTSDB(), rules=[], clock=lambda: 0.0)
+    assert wt._attribution() == ""  # no snapshot yet
+    wt.observe_fleet(_snap(1, 0, 0, phases=phases), now=1000.0)
+    assert "kv_fetch" in wt._attribution()
+    assert wt.tsdb.latest("fleet.hot_phase_p95_s")[1] == pytest.approx(0.6)
+
+
+def test_watchtower_tick_ingests_metrics_text_and_payload_shapes():
+    texts = {"n": 0}
+
+    def metrics_text():
+        texts["n"] += 1
+        return "rlt_serve_requests_total %d\n" % (10 * texts["n"])
+
+    clk = [1000.0]
+    wt = Watchtower(
+        tsdb=RingTSDB(),
+        rules=[AlertRule(name="t", kind="threshold", series="q",
+                         threshold=0.0, for_ticks=1, severity="error")],
+        metrics_text_fn=metrics_text,
+        clock=lambda: clk[0],
+    )
+    wt.tick()
+    clk[0] = 1010.0
+    wt.tick()
+    rates = [n for n in wt.tsdb.series_names() if n.endswith(":rate")]
+    assert rates and wt.tsdb.latest(rates[0])[1] == pytest.approx(1.0)
+    payload = wt.alerts_payload()
+    assert payload["ticks"] == 2 and payload["canary"] is None
+    assert payload["alerts"]["evaluations"] == 2
+    assert payload["tsdb"]["series"] >= 1
+    assert wt.fleet_block() == {"firing": 0, "names": []}
+    wt.tsdb.record("q", 5.0, ts=clk[0])
+    wt.engine.evaluate(now=clk[0])
+    assert wt.fleet_block() == {"firing": 1, "names": ["t(error)"]}
+    # /query param plumbing.
+    out = wt.query({"series": ["q"], "step": ["60"]})
+    assert out["found"] and out["step_s"] == 60.0
+    with pytest.raises(ValueError, match="missing"):
+        wt.query({})
+
+
+def test_watchtower_thread_lifecycle_outlives_a_broken_feed():
+    def bad_feed():
+        raise RuntimeError("poller down")
+
+    wt = Watchtower(
+        tsdb=RingTSDB(), rules=[], fleet_latest_fn=bad_feed,
+        interval_s=0.01,
+    )
+    wt.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if wt.alerts_payload()["ticks"] >= 3:
+                break
+            time.sleep(0.01)
+    finally:
+        wt.stop()
+    assert wt.alerts_payload()["ticks"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# Canary lane (stub client): exactness, envelope, error path, kwargs
+# ---------------------------------------------------------------------------
+class _ScriptClient:
+    """Stream stub: replays one scripted token list (or exception) per
+    probe, recording the kwargs the lane submitted with."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def stream(self, prompt, **kw):
+        self.calls.append((list(prompt), dict(kw)))
+        item = self.script.pop(0) if len(self.script) > 1 else self.script[0]
+        if isinstance(item, Exception):
+            raise item
+        for tok in item:
+            time.sleep(0.001)  # a real (tiny) decode cadence
+            yield tok
+
+
+def test_canary_probe_exactness_envelope_events_and_kwargs():
+    baseline = {
+        "prompt": [1, 2, 3], "max_new_tokens": 4,
+        "tokens": [7, 8, 9, 10],
+        # An absurd recorded decode rate makes the (deterministic)
+        # envelope check trip: floor = 1e9 * 0.33 tok/s.
+        "decode_tokens_per_s": 1e9, "decode_frac": 0.33,
+        "ttft_s": 1000.0, "ttft_mult": 3.0,
+    }
+    client = _ScriptClient([
+        [7, 8, 9, 10], [7, 8, 9, 99], RuntimeError("replica wedged"),
+    ])
+    log = EventLog()
+    reg = MetricsRegistry()
+    lane = CanaryLane(
+        client, RingTSDB(), baseline=baseline, interval_s=5.0,
+        events=log, registry=reg, clock=lambda: 1000.0,
+    )
+    r1 = lane.probe(now=1000.0)
+    assert r1["ok"] and r1["exact"] == 1
+    assert r1["deviation"] > 1.0  # outside the recorded decode floor
+    prompt, kw = client.calls[0]
+    assert prompt == [1, 2, 3]  # baseline prompt wins
+    assert kw["tenant"] == CANARY_TENANT
+    assert kw["priority"] == CANARY_PRIORITY
+    assert kw["temperature"] == 0.0 and kw["seed"] == 0
+    assert kw["max_new_tokens"] == 4
+    # Throttle: within interval_s the tick is a no-op.
+    assert lane.tick(now=1002.0) is None
+    r2 = lane.tick(now=1006.0)
+    assert r2["exact"] == 0
+    (mm,) = log.tail(name="canary_mismatch")
+    assert mm["tokens"] == [7, 8, 9, 99] and mm["level"] == "error"
+    r3 = lane.probe(now=1020.0)
+    assert r3["ok"] is False and "replica wedged" in r3["error"]
+    assert lane.errors == 1 and lane.probes == 3
+    assert lane.tsdb.latest("canary.error")[1] == 1.0
+    assert lane.tsdb.latest("canary.exact")[1] == 0.0
+    (err_ev,) = log.tail(name="canary_error")
+    assert "RuntimeError" in err_ev["error"]
+    text = reg.render()
+    assert 'rlt_canary_probes_total{outcome="exact"} 1' in text
+    assert 'rlt_canary_probes_total{outcome="mismatch"} 1' in text
+    assert 'rlt_canary_probes_total{outcome="error"} 1' in text
+    d = lane.to_dict()
+    assert d["probes"] == 3 and d["errors"] == 1 and d["baseline"]
+
+
+def test_canary_self_baseline_from_first_probe():
+    client = _ScriptClient([[5, 6], [5, 6], [5, 7]])
+    lane = CanaryLane(client, RingTSDB(), prompt=[1, 2],
+                      max_new_tokens=2, clock=lambda: 0.0)
+    assert lane.probe(now=0.0)["exact"] == 1  # defines the reference
+    assert lane.probe(now=100.0)["exact"] == 1
+    r3 = lane.probe(now=200.0)
+    assert r3["exact"] == 0 and r3["deviation"] == 0.0  # no envelope
+
+
+# ---------------------------------------------------------------------------
+# Canary exclusion from organic accounting
+# ---------------------------------------------------------------------------
+def test_canary_cost_and_phases_diverted_from_organic_accounting():
+    from ray_lightning_tpu.serve.metrics import ServeMetrics
+
+    reg = MetricsRegistry()
+    m = ServeMetrics(2, registry=reg)
+    m.record_cost({
+        "tenant": CANARY_TENANT, "outcome": "finished",
+        "emitted_tokens": 8, "device_s": 1.0, "queue_s": 0.0,
+    })
+    m.record_phases({"decode": 0.5}, tenant=CANARY_TENANT)
+    assert m.cost_records() == [] and m.phase_records() == []
+    text = reg.render()
+    assert 'rlt_canary_requests_total{outcome="finished"} 1' in text
+    assert "rlt_canary_tokens_total 8" in text
+    assert "_canary" not in text.replace("rlt_canary", "")
+    # The goodput gauge was never touched: no sample rendered.
+    assert not any(
+        ln.startswith("rlt_serve_goodput_tokens_per_device_second ")
+        for ln in text.splitlines()
+    )
+    # An organic record still lands everywhere.
+    m.record_cost({
+        "tenant": "default", "outcome": "finished",
+        "emitted_tokens": 10, "device_s": 2.0, "queue_s": 0.1,
+    })
+    m.record_phases({"decode": 0.5}, tenant="default")
+    assert len(m.cost_records()) == 1 and len(m.phase_records()) == 1
+    text = reg.render()
+    assert 'rlt_serve_request_cost_tokens_total{tenant="default"} 10' in text
+    assert "rlt_serve_goodput_tokens_per_device_second 5" in text
+
+
+def test_canary_queue_invisible_to_depth_and_autoscaler(params):
+    """Regression: a canary-only fleet shows ZERO organic pressure —
+    the queue-depth gauge the router autoscaler reads stays 0 and no
+    scale-up fires; organic traffic still registers."""
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.router import RouterAutoscaler
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    sched = Scheduler(DecodeEngine(params, CFG, **DENSE_KW))
+    for _ in range(6):
+        sched.submit(
+            [1, 2, 3, 5, 8], SamplingParams(max_new_tokens=2),
+            tenant=CANARY_TENANT, priority=CANARY_PRIORITY,
+        )
+    assert len(sched._pending) == 6
+    assert sched.queue_depth() == 0
+    assert sched.metrics.snapshot()["queue_depth"] == 0
+
+    class _ScaleClient:
+        def __init__(self):
+            self.roles = ["mixed"]
+            self.added = []
+
+        def alive_replicas(self):
+            return list(range(len(self.roles)))
+
+        def role_of(self, idx):
+            return self.roles[idx]
+
+        def add_replica(self, role=None):
+            self.roles.append(role or "mixed")
+            self.added.append(role)
+            return len(self.roles) - 1
+
+        def retire_replica(self, idx, **kw):
+            self.roles.pop(idx)
+            return {"migrated": [], "lost": []}
+
+    class _View:
+        shed_count = 0
+
+        def views(self):
+            return {0: {"role": "mixed",
+                        "queue_depth": sched.queue_depth(),
+                        "active_slots": 0, "slo_breaches": 0}}
+
+    client = _ScaleClient()
+    auto = RouterAutoscaler(
+        client, router=_View(), min_replicas=1, max_replicas=3,
+        sustain_ticks=1, registry=MetricsRegistry(), events=EventLog(),
+    )
+    for _ in range(4):
+        assert auto.tick()["scaled"] is None
+    assert client.added == []
+    # Organic traffic past the per-replica threshold IS pressure.
+    for _ in range(6):
+        sched.submit([1, 2, 3, 5, 8], SamplingParams(max_new_tokens=2))
+    assert sched.queue_depth() == 6
+    auto.tick()
+    out = auto.tick()
+    assert client.added, out
+
+
+# ---------------------------------------------------------------------------
+# Canary through a REAL scheduler: bit-exact, zero steady-state compiles
+# ---------------------------------------------------------------------------
+class _SchedClient:
+    """The stream surface the canary lane expects, over an in-process
+    Scheduler (what `rlt serve` wires through the real client)."""
+
+    def __init__(self, sched):
+        self.sched = sched
+
+    def stream(self, prompt, *, max_new_tokens=16, temperature=0.0,
+               seed=0, priority=0, tenant=None, timeout_s=60.0, **_kw):
+        from ray_lightning_tpu.serve.scheduler import SamplingParams
+
+        rid = self.sched.submit(
+            list(prompt),
+            SamplingParams(max_new_tokens=max_new_tokens,
+                           temperature=temperature, seed=seed),
+            priority=priority, tenant=tenant,
+        )
+        for _ in range(100_000):
+            for ev in self.sched.step():
+                if ev.request_id == rid and ev.token is not None:
+                    yield ev.token
+            if not self.sched.has_work():
+                return
+
+
+def test_canary_probe_real_scheduler_bit_exact_zero_compiles(params):
+    """Standing contracts on the probe lane itself: the canary's tokens
+    are bit-exact to solo gpt_generate, and steady-state probes compile
+    nothing (compiles_since_init == 0 after the first probe warmed)."""
+    from ray_lightning_tpu.obs.jaxmon import install_compile_listener
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.scheduler import Scheduler
+
+    sched = Scheduler(DecodeEngine(params, CFG, **DENSE_KW))
+    prompt = list(range(1, 9))
+    ref = _ref(params, prompt, 6)
+    lane = CanaryLane(
+        _SchedClient(sched), RingTSDB(), interval_s=0.0,
+        baseline={"prompt": prompt, "max_new_tokens": 6, "tokens": ref},
+    )
+    stats = install_compile_listener()
+    first = lane.probe()  # absorbs the engine's one-time compiles
+    assert first["ok"] and first["exact"] == 1, first
+    before = stats.count("backend_compile")
+    for _ in range(2):
+        out = lane.probe()
+        assert out["ok"] and out["exact"] == 1, out
+    assert stats.count("backend_compile") == before
+    assert sched.queue_depth() == 0  # probes never counted as organic
+    assert lane.tsdb.latest("canary.exact")[1] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# The HTTP surface: /events?since=, /query, /alerts over real sockets
+# ---------------------------------------------------------------------------
+def test_http_events_since_cursor_query_and_alerts_routes():
+    log = EventLog()
+    for k in range(5):
+        log.record("watchtower", f"ev{k}")
+    wt = Watchtower(tsdb=RingTSDB(), rules=[], events=log,
+                    clock=lambda: 1000.0)
+    wt.tsdb.record("fleet.replicas", 2.0, ts=1000.0)
+    wt.tick()
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "",
+        collect_events=log.to_jsonl,
+        collect_query=wt.query,
+        collect_alerts=wt.alerts_payload,
+    ).start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        rows = [
+            json.loads(ln) for ln in urllib.request.urlopen(
+                base + "/events", timeout=10
+            ).read().decode().splitlines() if ln
+        ]
+        seqs = [r["seq"] for r in rows]
+        assert seqs == sorted(seqs) and len(seqs) == len(set(seqs))
+        cursor = seqs[2]
+        newer = [
+            json.loads(ln) for ln in urllib.request.urlopen(
+                base + f"/events?since={cursor}", timeout=10
+            ).read().decode().splitlines() if ln
+        ]
+        assert [r["seq"] for r in newer] == seqs[3:]
+        assert all(r["seq"] > cursor for r in newer)
+        out = json.loads(urllib.request.urlopen(
+            base + "/query?series=fleet.replicas", timeout=10
+        ).read())
+        assert out["found"] and out["points"][-1][1] == 2.0
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/query", timeout=10)
+        assert exc.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                base + "/query?series=ghost", timeout=10
+            )
+        assert exc.value.code == 404
+        body = json.loads(exc.value.read())
+        assert body["found"] is False
+        assert "fleet.replicas" in body["available"]
+        alerts = json.loads(urllib.request.urlopen(
+            base + "/alerts", timeout=10
+        ).read())
+        assert alerts["ticks"] == 1 and "alerts" in alerts
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI: rlt plot / rlt alerts / the fleet alerts line
+# ---------------------------------------------------------------------------
+def test_parse_args_plot_and_alerts():
+    from ray_lightning_tpu.cli import parse_args
+
+    sub, cfg = parse_args(["plot", "127.0.0.1:9400", "fleet.queue_depth"])
+    assert sub == "plot"
+    assert cfg["plot"]["addr"] == "127.0.0.1:9400"
+    assert cfg["plot"]["series"] == "fleet.queue_depth"
+    sub, cfg = parse_args(
+        ["alerts", "127.0.0.1:9400", "--follow",
+         "--alerts.interval_s", "0.5"]
+    )
+    assert sub == "alerts" and cfg["alerts"]["addr"] == "127.0.0.1:9400"
+    assert cfg["alerts"]["follow"] is True
+    assert cfg["alerts"]["interval_s"] == 0.5
+
+
+def test_render_sparkline_spikes_survive_downsampling():
+    from ray_lightning_tpu.cli import render_sparkline
+
+    flat = render_sparkline([(i, 5.0) for i in range(10)], width=20)
+    assert flat == "▁" * 10
+    ramp = render_sparkline([(i, float(i)) for i in range(8)], width=20)
+    assert ramp[0] == "▁" and ramp[-1] == "█"
+    # A single spike in a 600-point series must survive the 60-column
+    # downsample (per-column max, not mean).
+    pts = [(i, 1.0) for i in range(600)]
+    pts[300] = (300, 100.0)
+    assert "█" in render_sparkline(pts, width=60)
+    assert render_sparkline([], width=10) == ""
+
+
+def test_run_plot_and_run_alerts_over_real_http(capsys):
+    from ray_lightning_tpu.cli import run_alerts, run_plot
+
+    wt = Watchtower(
+        tsdb=RingTSDB(),
+        rules=[AlertRule(name="deep_queue", kind="threshold", series="q",
+                         threshold=0.0, for_ticks=1, severity="error")],
+        clock=lambda: 1000.0,
+    )
+    for i in range(5):
+        wt.tsdb.record("q", float(i), ts=990.0 + i)
+    wt.tick()  # q > 0 -> deep_queue fires
+    srv = obs.MetricsHTTPServer(
+        collect_text=lambda: "",
+        collect_query=wt.query,
+        collect_alerts=wt.alerts_payload,
+    ).start()
+    try:
+        addr = f"{srv.host}:{srv.port}"
+        out = run_plot({"plot": {"addr": addr, "series": "q"}})
+        assert out["found"]
+        shown = capsys.readouterr().out
+        assert "q  step=" in shown and "max=4" in shown
+        assert any(c in shown for c in "▁▂▃▄▅▆▇█")
+        miss = run_plot({"plot": {"addr": addr, "series": "nope"}})
+        assert miss["found"] is False
+        shown = capsys.readouterr().out
+        assert "unknown" in shown and "available: q" in shown
+        with pytest.raises(ValueError, match="unknown plot options"):
+            run_plot({"plot": {"addr": addr, "series": "q", "nope": 1}})
+        with pytest.raises(ValueError, match="plot requires"):
+            run_plot({"plot": {}})
+        payload = run_alerts({"alerts": {"addr": addr}})
+        assert payload["alerts"]["firing"][0]["rule"] == "deep_queue"
+        shown = capsys.readouterr().out
+        assert "firing=1 deep_queue" in shown
+        assert "[error/threshold]" in shown
+        with pytest.raises(ValueError, match="alerts requires"):
+            run_alerts({"alerts": {}})
+        with pytest.raises(ValueError, match="not a reachable"):
+            run_plot({"plot": {"addr": "127.0.0.1:9", "series": "q",
+                               "timeout_s": 0.5}})
+    finally:
+        srv.close()
+
+
+def test_fleet_payload_and_top_line_carry_alerts_block():
+    from ray_lightning_tpu.cli import render_fleet
+    from ray_lightning_tpu.obs.fleet import FleetPoller
+
+    wt = Watchtower(
+        tsdb=RingTSDB(),
+        rules=[AlertRule(name="hot", kind="threshold", series="q",
+                         threshold=0.0, for_ticks=1, severity="warn")],
+        clock=lambda: 1000.0,
+    )
+    p = FleetPoller(
+        lambda: (
+            [{"queue_depth": 0, "active_slots": 0, "num_slots": 2,
+              "tokens_per_sec": 1.0}],
+            [{"verdict": "healthy"}],
+            None,
+        ),
+        alerts_fn=wt.fleet_block,
+    )
+    p.poll_now()
+    quiet = p.to_dict()
+    assert quiet["alerts"] == {"firing": 0, "names": []}
+    assert "alerts: firing=0 (all quiet)" in render_fleet(quiet)
+    wt.tsdb.record("q", 3.0, ts=1000.0)
+    wt.tick()
+    loud = p.to_dict()
+    assert loud["alerts"]["names"] == ["hot(warn)"]
+    assert "alerts: firing=1 hot(warn)" in render_fleet(loud)
+    # Without the watchtower the block (and the line) are absent —
+    # its absence means OFF, not quiet.
+    bare = FleetPoller(lambda: ([], [], None))
+    bare.poll_now()
+    assert "alerts" not in bare.to_dict()
+    assert "alerts:" not in render_fleet(bare.to_dict())
+
+
+# ---------------------------------------------------------------------------
+# E2E: an injected kv-fetch delay pages with the phase that earned it
+# ---------------------------------------------------------------------------
+def test_e2e_kv_delay_fires_burn_rate_names_kv_fetch_then_resolves(params):
+    """The PR's acceptance path, all-real except the clocks: a
+    kvfleet_fetch delay (serve.faults) slows steered peer fetches, the
+    real SLO watchdog verdicts those requests' measured TTFTs into the
+    breach counters, the watchtower diffs them into the breach-ratio
+    series, and the DEFAULT slo_burn_rate rule fires within 3
+    evaluation ticks — its notification naming kv_fetch as the top
+    phase from the victims' real ledgers. Once the fault clears, the
+    fast window drains and the alert resolves."""
+    from ray_lightning_tpu.obs.fleet import FleetPoller
+    from ray_lightning_tpu.obs.health import parse_slo_rules, slo_check
+    from ray_lightning_tpu.serve.engine import DecodeEngine
+    from ray_lightning_tpu.serve.faults import FaultInjector
+    from ray_lightning_tpu.serve.kvfleet import KVFleetPlane
+    from ray_lightning_tpu.serve.metrics import ServeMetrics
+    from ray_lightning_tpu.serve.router import prompt_block_digests
+    from ray_lightning_tpu.serve.scheduler import SamplingParams, Scheduler
+
+    delay_s, slo_ttft_s, n_bad = 0.5, 0.15, 3
+    rng = np.random.default_rng(7)
+    steered = [rng.integers(0, CFG.vocab_size, size=16).tolist()
+               for _ in range(n_bad)]
+    warm_prompt = rng.integers(0, CFG.vocab_size, size=16).tolist()
+    inboxes = {0: queue.Queue(), 1: queue.Queue()}
+    scheds = []
+    for i in (0, 1):
+        # Replica 0 gets a deep prefix pool: all three steered prompts'
+        # blocks must stay resident for their fetches to be steered.
+        eng = DecodeEngine(
+            params, CFG, **dict(DENSE_KW, prefix_blocks=64)
+            if i == 0 else DENSE_KW
+        )
+        plane = KVFleetPlane(
+            index=i, role="mixed", inbox=inboxes[i],
+            peers=dict(inboxes), block_bytes=eng.prefix_block_nbytes,
+            timeout_s=5.0, min_poll_s=0.0,
+        )
+        scheds.append(Scheduler(
+            eng, kvfleet=plane,
+            # A small metrics window so the victim ledgers (not the
+            # compile-heavy warmup request) dominate the fleet phase
+            # decomposition by the time the alert fires.
+            metrics=ServeMetrics(eng.num_slots, window=n_bad)
+            if i == 1 else None,
+            # One-shot rules: one armed delay per steered fetch — the
+            # injector disarming rule N is "the fault clears".
+            faults=FaultInjector.parse([
+                {"point": "kvfleet_fetch", "action": "delay",
+                 "seconds": delay_s, "after": k + 1}
+                for k in range(n_bad)
+            ]) if i == 1 else None,
+        ))
+
+    def run_one(prompt, hint=None):
+        """Submit to replica 1, return the measured wall TTFT."""
+        rid = scheds[1].submit(
+            prompt, SamplingParams(max_new_tokens=4), kv_hint=hint,
+        )
+        t0 = time.monotonic()
+        first = None
+        for _ in range(50_000):
+            scheds[0].step()
+            for ev in scheds[1].step():
+                if (ev.request_id == rid and ev.token is not None
+                        and first is None):
+                    first = time.monotonic()
+            if not scheds[1].has_work():
+                break
+        assert not scheds[1].has_work(), "request did not finish"
+        return (first if first is not None else time.monotonic()) - t0
+
+    # Warm: replica 0 caches every steered prompt's blocks, replica 1
+    # compiles its executables on an unrelated prompt.
+    for p in steered:
+        scheds[0].submit(p, SamplingParams(max_new_tokens=2))
+    scheds[0].run_until_idle()
+    run_one(warm_prompt)
+
+    # The breach feed is the REAL watchdog over real measured TTFTs.
+    slo_state = {"ttft": 0.0, "breaches": 0}
+    check = slo_check(
+        parse_slo_rules({"ttft_p95_s": slo_ttft_s}),
+        lambda: {"ttft_p95_s": slo_state["ttft"]},
+        registry=MetricsRegistry(), events=EventLog(),
+    )
+
+    def observe(ttft):
+        slo_state["ttft"] = ttft
+        if any(c.verdict == "unhealthy" for c in check()):
+            slo_state["breaches"] += 1
+
+    poller = FleetPoller(lambda: (
+        [dict(scheds[1].metrics.snapshot(),
+              slo_breaches=slo_state["breaches"])],
+        [{"verdict": "healthy"}],
+        None,
+    ))
+    log = EventLog()
+    clk = [10_000.0]
+    wt = Watchtower(
+        tsdb=RingTSDB(), rules=default_rules(), events=log,
+        fleet_latest_fn=poller.latest, interval_s=5.0,
+        clock=lambda: clk[0],
+    )
+
+    def tick():
+        clk[0] += 5.0
+        poller.poll_now()
+        return wt.tick()
+
+    observe(run_one(warm_prompt[:8] + warm_prompt[8:]))  # clean seed
+    tick()  # seeds the cumulative SLO counters: no ratio sample yet
+
+    fire_note, fire_tick = None, None
+    for i, prompt in enumerate(steered):
+        ttft = run_one(prompt, hint={
+            "peer": 0,
+            "digests": [d.hex()
+                        for d in prompt_block_digests(prompt, BLOCK)],
+        })
+        assert ttft >= delay_s, (
+            f"steered fetch {i} was not delayed (ttft={ttft:.3f}s)"
+        )
+        observe(ttft)
+        for note in tick():
+            if note["rule"] == "slo_burn_rate" and note["state"] == "firing":
+                fire_note, fire_tick = note, i + 1
+    assert fire_note is not None, "burn-rate alert never fired"
+    assert fire_tick <= 3, f"fired on breach tick {fire_tick}, want <= 3"
+    assert "kv_fetch" in fire_note["attribution"], fire_note
+    (fire_ev,) = log.tail(name="alert_firing")
+    assert fire_ev["rule"] == "slo_burn_rate"
+    assert "kv_fetch" in fire_ev["attribution"]
+    assert wt.fleet_block()["firing"] == 1
+
+    # Fault cleared (every one-shot rule consumed): idle ticks drain
+    # the fast window (60s at 5s cadence) and the alert resolves.
+    resolve_note = None
+    for _ in range(25):
+        for note in tick():
+            if (note["rule"] == "slo_burn_rate"
+                    and note["state"] == "resolved"):
+                resolve_note = note
+        if resolve_note:
+            break
+    assert resolve_note is not None, "alert never resolved"
+    st = wt.engine.to_dict()["states"]["slo_burn_rate"]
+    assert st["state"] == "ok" and st["fires"] == 1 and st["resolves"] == 1
+    assert log.tail(name="alert_resolved")
+    assert wt.fleet_block() == {"firing": 0, "names": []}

@@ -38,10 +38,17 @@ the tpu_watch ``anatomy`` manifest stage's artifact (the request
 anatomy wire path proven end-to-end: replica rings -> /why ->
 rendered decomposition).
 
-The tpu_watch `obs`, `doctor`, `fleet`, and `anatomy` manifest stages
-run this and archive the files, so every healthy TPU window leaves a
-scrapeable-metrics + viewable-trace + pullable-bundle + fleet-snapshot
-+ request-anatomy record alongside the bench JSONs. Runs fine on CPU.
+``--out-alerts PATH`` (fleet path only) additionally starts the
+watchtower (retained TSDB + alert engine) on the driver, lets it
+ingest a few fleet snapshots, and archives the ``/alerts`` payload
+plus one ``/query`` series pull fetched over real HTTP as one JSON
+file — the tpu_watch ``watchtower`` manifest stage's artifact.
+
+The tpu_watch `obs`, `doctor`, `fleet`, `anatomy`, and `watchtower`
+manifest stages run this and archive the files, so every healthy TPU
+window leaves a scrapeable-metrics + viewable-trace + pullable-bundle
++ fleet-snapshot + request-anatomy + retained-alerting record
+alongside the bench JSONs. Runs fine on CPU.
 """
 import argparse
 import contextlib
@@ -94,7 +101,7 @@ def fleet_main(args) -> None:
         decode_fold=2,
         env={"JAX_PLATFORMS": "cpu"},
     )
-    server = poller = None
+    server = poller = watchtower = None
     try:
         g = np.random.default_rng(0)
         handles = [
@@ -107,10 +114,19 @@ def fleet_main(args) -> None:
         for h in handles:
             for _ in client.stream_handle(h, timeout_s=300.0):
                 pass
-        server, poller = _serve_obs_server(
-            client, 0, fleet=True, fleet_interval_s=0.2
+        server, poller, watchtower = _serve_obs_server(
+            client, 0, fleet=True, fleet_interval_s=0.2,
+            alerts=bool(args.out_alerts),
         )
         poller.poll_now()  # at least one snapshot before the fetch
+        if watchtower is not None:
+            # A few manual ticks so the retained rings hold real fleet
+            # samples and every alert rule has been evaluated before
+            # the /alerts + /query fetches below.
+            for _ in range(3):
+                poller.poll_now()
+                watchtower.tick()
+                time.sleep(0.05)
         base = f"http://{server.host}:{server.port}"
         fleet_body = urllib.request.urlopen(
             base + "/fleet", timeout=30
@@ -136,6 +152,20 @@ def fleet_main(args) -> None:
                 ])
             with open(args.out_why, "w") as f:
                 f.write(buf.getvalue())
+        alerts = None
+        if args.out_alerts:
+            # The watchtower plane over real HTTP: the /alerts payload
+            # (rules/states/firing + retained-ring inventory) plus one
+            # /query series pull — both archived in one JSON file.
+            alerts_body = urllib.request.urlopen(
+                base + "/alerts", timeout=30
+            ).read()
+            alerts = json.loads(alerts_body)
+            query = json.loads(urllib.request.urlopen(
+                base + "/query?series=fleet.replicas", timeout=30
+            ).read())
+            with open(args.out_alerts, "w") as f:
+                json.dump({"alerts": alerts, "query": query}, f)
         fleet = json.loads(fleet_body)
         trace = json.loads(trace_body)
         procs = sorted(
@@ -160,8 +190,21 @@ def fleet_main(args) -> None:
             summary["why_coverage"] = why.get("coverage")
             summary["why_phases"] = sorted(why.get("totals") or {})
             summary["out_why"] = args.out_why
+        if alerts is not None:
+            summary["alert_rules"] = len(
+                (alerts.get("alerts") or {}).get("rules") or []
+            )
+            summary["alerts_firing"] = (
+                (alerts.get("alerts") or {}).get("firing") or []
+            )
+            summary["tsdb_series"] = (
+                (alerts.get("tsdb") or {}).get("series")
+            )
+            summary["out_alerts"] = args.out_alerts
         print(json.dumps(summary))
     finally:
+        if watchtower is not None:
+            watchtower.stop()
         if poller is not None:
             poller.stop()
         if server is not None:
@@ -201,6 +244,12 @@ def main() -> None:
         help="(fleet path) run the real `rlt why` CLI against the live "
         "endpoint for one completed request and save its rendered "
         "phase-ledger timeline here",
+    )
+    p.add_argument(
+        "--out-alerts", default="",
+        help="(fleet path) start the watchtower, fetch the /alerts "
+        "payload plus one /query series over real HTTP, and archive "
+        "both as one JSON file here",
     )
     p.add_argument("--requests", type=int, default=4)
     p.add_argument("--new-tokens", type=int, default=16)

@@ -23,6 +23,7 @@ have_obs=0
 have_doctor=0
 have_fleet=0
 have_anatomy=0
+have_watchtower=0
 have_replay=0
 have_failover=0
 have_preempt=0
@@ -42,6 +43,7 @@ obs_fails=0
 doctor_fails=0
 fleet_fails=0
 anatomy_fails=0
+watchtower_fails=0
 replay_fails=0
 failover_fails=0
 preempt_fails=0
@@ -65,6 +67,7 @@ obs_status=pending
 doctor_status=pending
 fleet_status=pending
 anatomy_status=pending
+watchtower_status=pending
 replay_status=pending
 failover_status=pending
 preempt_status=pending
@@ -95,6 +98,7 @@ write_manifest() {
     echo "stage=doctor status=$doctor_status fails=$doctor_fails"
     echo "stage=fleet status=$fleet_status fails=$fleet_fails"
     echo "stage=anatomy status=$anatomy_status fails=$anatomy_fails"
+    echo "stage=watchtower status=$watchtower_status fails=$watchtower_fails"
     echo "stage=replay status=$replay_status fails=$replay_fails"
     echo "stage=failover status=$failover_status fails=$failover_fails"
     echo "stage=preempt status=$preempt_status fails=$preempt_fails"
@@ -559,6 +563,38 @@ while true; do
             have_anatomy=1
             anatomy_status=skipped
             echo "$(date -u +%H:%M:%S) anatomy snapshot SKIPPED after $anatomy_fails failures" >> /tmp/tpu_watch.log
+          fi
+        fi
+      elif [ "$have_watchtower" -eq 0 ]; then
+        # Stage 7b3: watchtower artifact — the fleet path again with the
+        # retained-telemetry watchtower running on the driver (multi-
+        # resolution TSDB rings + burn-rate alert engine), archiving the
+        # live /alerts payload (rules/states/firing + ring inventory)
+        # plus one /query series pull fetched over real HTTP, so each
+        # healthy window proves the alerting wire path end-to-end next
+        # to the fleet snapshot.
+        echo "$(date -u +%H:%M:%S) launching WATCHTOWER snapshot" >> /tmp/tpu_watch.log
+        ( cd /tmp/bench_snap2 && \
+          timeout 1200 python tools/obs_snapshot.py \
+            --out-fleet /tmp/watchtower_fleet.json \
+            --out-stitched /tmp/watchtower_trace.json \
+            --out-alerts /tmp/watchtower_alerts.json \
+            > /tmp/watchtower_snapshot.json 2> /tmp/watchtower_snapshot.err )
+        rc=$?
+        if [ $rc -eq 0 ] && [ -s /tmp/watchtower_alerts.json ] && \
+           grep -q '"alerts"' /tmp/watchtower_alerts.json 2>/dev/null && \
+           grep -q '"query"' /tmp/watchtower_alerts.json 2>/dev/null; then
+          have_watchtower=1
+          watchtower_status=ok
+          echo "$(date -u +%H:%M:%S) WATCHTOWER snapshot SUCCEEDED" >> /tmp/tpu_watch.log
+        else
+          watchtower_fails=$((watchtower_fails+1))
+          watchtower_status=failed
+          echo "$(date -u +%H:%M:%S) watchtower snapshot failed rc=$rc (fail $watchtower_fails)" >> /tmp/tpu_watch.log
+          if [ "$watchtower_fails" -ge "$MAX_STAGE_FAILS" ]; then
+            have_watchtower=1
+            watchtower_status=skipped
+            echo "$(date -u +%H:%M:%S) watchtower snapshot SKIPPED after $watchtower_fails failures" >> /tmp/tpu_watch.log
           fi
         fi
       elif [ "$have_replay" -eq 0 ]; then
